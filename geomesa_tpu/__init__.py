@@ -1,0 +1,24 @@
+"""geomesa_tpu: a TPU-native spatio-temporal indexing and query framework.
+
+A from-scratch re-design of the capabilities of GeoMesa (reference:
+/root/reference, JVM/Scala) for JAX/XLA/Pallas on TPU:
+
+- space-filling-curve indexing (Z2/Z3/XZ2/XZ3) over an HBM-resident,
+  Arrow-style columnar feature table sorted by index key,
+- a cost-based query planner (filter split -> strategy decision -> ranges),
+- push-down filtering and aggregation (density / stats / BIN / sampling)
+  executed as vectorized XLA/Pallas scans over contiguous row spans,
+- multi-device scale-out via `jax.sharding.Mesh` + collective reductions
+  (the analogue of GeoMesa's tablet-server fan-out + client merge).
+
+Architecture inversion (see SURVEY.md section 7): the reference's
+row-iterator-over-KV-store becomes columnar-scan-over-HBM. The planner runs
+on host (thousands of ops), the scan runs on device (millions of rows).
+"""
+
+__version__ = "0.1.0"
+
+from geomesa_tpu.sft import FeatureType, AttributeDescriptor
+from geomesa_tpu.datastore import DataStore
+
+__all__ = ["FeatureType", "AttributeDescriptor", "DataStore", "__version__"]

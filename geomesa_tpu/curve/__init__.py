@@ -1,0 +1,25 @@
+"""Space-filling-curve math: the pure-math foundation tier.
+
+Equivalent of the reference's `geomesa-z3` module (see SURVEY.md section 2.1):
+Morton (Z-order) bit interleaving in 2-D and 3-D, dimension normalization,
+epoch-binned time, XZ-ordering for geometries with extent, and range
+decomposition of query boxes into covering curve intervals.
+
+Everything here is host-side vectorized NumPy (uint64): curve math runs at
+plan/ingest time over batches of thousands, not in the per-row device hot
+loop. The device scan path never touches 64-bit z values; it operates on the
+decoded int32 dimension columns directly (see geomesa_tpu.scan).
+"""
+
+from geomesa_tpu.curve.zorder import Z2, Z3
+from geomesa_tpu.curve.normalize import NormalizedDimension, NormalizedLat, NormalizedLon, NormalizedTime
+from geomesa_tpu.curve.binnedtime import BinnedTime, TimePeriod
+from geomesa_tpu.curve.z2sfc import Z2SFC
+from geomesa_tpu.curve.z3sfc import Z3SFC
+from geomesa_tpu.curve.xz2sfc import XZ2SFC
+from geomesa_tpu.curve.xz3sfc import XZ3SFC
+
+__all__ = [
+    "Z2", "Z3", "NormalizedDimension", "NormalizedLat", "NormalizedLon", "NormalizedTime",
+    "BinnedTime", "TimePeriod", "Z2SFC", "Z3SFC", "XZ2SFC", "XZ3SFC",
+]

@@ -1,0 +1,137 @@
+"""Epoch-binned time: timestamp -> (short bin, long offset-into-bin).
+
+Functional parity with the reference's BinnedTime
+(/root/reference/geomesa-z3/src/main/scala/org/locationtech/geomesa/curve/BinnedTime.scala:16-65):
+
+- period Day   -> bin = days since 1970-01-01,   offset in MILLIS
+- period Week  -> bin = weeks since 1970-01-01,  offset in SECONDS
+- period Month -> bin = calendar months since 1970-01, offset in SECONDS
+- period Year  -> bin = calendar years since 1970, offset in MINUTES
+
+Bins are int16 ("short" in the reference); offsets fit in the Z3/XZ3 time
+dimension (21 bits covers a week of seconds: 604800 < 2^21).
+
+All conversions are vectorized over numpy int64 arrays of epoch
+milliseconds. Month/Year use numpy datetime64 calendar arithmetic, which
+matches java.time ChronoUnit month/year bin boundaries.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+
+MILLIS_PER_DAY = 86_400_000
+SECONDS_PER_WEEK = 604_800
+
+
+class TimePeriod(enum.Enum):
+    DAY = "day"
+    WEEK = "week"
+    MONTH = "month"
+    YEAR = "year"
+
+    @staticmethod
+    def parse(s: "str | TimePeriod") -> "TimePeriod":
+        if isinstance(s, TimePeriod):
+            return s
+        return TimePeriod(s.lower())
+
+
+# Max offset value within a bin, per period (reference BinnedTime.maxOffset):
+# day -> millis/day, week -> seconds/week, month -> seconds in a 31-day month,
+# year -> minutes in a 366-day year.
+MAX_OFFSET = {
+    TimePeriod.DAY: MILLIS_PER_DAY - 1,
+    TimePeriod.WEEK: SECONDS_PER_WEEK - 1,
+    TimePeriod.MONTH: 31 * 24 * 60 * 60 - 1,
+    TimePeriod.YEAR: 366 * 24 * 60 - 1,
+}
+
+# Largest representable date per period: bins are int16, so the max bin is
+# 2^15 - 1 (reference BinnedTime.maxDate). We only need the bin arithmetic.
+MAX_BIN = (1 << 15) - 1
+
+
+@dataclass(frozen=True)
+class BinnedValue:
+    bin: np.ndarray  # int16-valued (held as int32 for safe arithmetic)
+    offset: np.ndarray  # int64
+
+
+class BinnedTime:
+    """Vectorized epoch-millis <-> (bin, offset) codec for one period."""
+
+    def __init__(self, period: "TimePeriod | str"):
+        self.period = TimePeriod.parse(period)
+
+    @property
+    def max_offset(self) -> int:
+        return MAX_OFFSET[self.period]
+
+    def to_binned(self, millis) -> BinnedValue:
+        """Epoch millis -> (bin, offset). Reference: timeToBinnedTime (:73).
+
+        Pre-epoch instants clamp to (bin 0, offset 0), mirroring the
+        reference's epoch clamp in BinnedTime.dateToBinnedTime.
+        """
+        ms = np.maximum(np.asarray(millis, dtype=np.int64), 0)
+        p = self.period
+        if p is TimePeriod.DAY:
+            b = np.floor_divide(ms, MILLIS_PER_DAY)
+            off = ms - b * MILLIS_PER_DAY
+        elif p is TimePeriod.WEEK:
+            b = np.floor_divide(ms, MILLIS_PER_DAY * 7)
+            off = np.floor_divide(ms - b * (MILLIS_PER_DAY * 7), 1000)
+        elif p is TimePeriod.MONTH:
+            dt = ms.astype("datetime64[ms]")
+            months = dt.astype("datetime64[M]")
+            b = months.astype(np.int64)
+            off = np.floor_divide((dt - months).astype("timedelta64[ms]").astype(np.int64), 1000)
+        else:  # YEAR
+            dt = ms.astype("datetime64[ms]")
+            years = dt.astype("datetime64[Y]")
+            b = years.astype(np.int64)
+            off = np.floor_divide((dt - years).astype("timedelta64[ms]").astype(np.int64), 60_000)
+        b = np.clip(b, 0, MAX_BIN)
+        return BinnedValue(bin=b.astype(np.int32), offset=off.astype(np.int64))
+
+    def from_binned(self, bin, offset) -> np.ndarray:
+        """(bin, offset) -> epoch millis (start-of-offset instant)."""
+        b = np.asarray(bin, dtype=np.int64)
+        off = np.asarray(offset, dtype=np.int64)
+        p = self.period
+        if p is TimePeriod.DAY:
+            return b * MILLIS_PER_DAY + off
+        if p is TimePeriod.WEEK:
+            return b * (MILLIS_PER_DAY * 7) + off * 1000
+        if p is TimePeriod.MONTH:
+            base = b.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+            return base + off * 1000
+        base = b.astype("datetime64[Y]").astype("datetime64[ms]").astype(np.int64)
+        return base + off * 60_000
+
+    def bin_start_millis(self, bin) -> np.ndarray:
+        return self.from_binned(bin, 0)
+
+    def bins_for_interval(self, lo_millis: int, hi_millis: int):
+        """All (bin, lo_offset, hi_offset) triples covering [lo, hi] millis.
+
+        The analogue of the reference's BinnedTime.timesByBin logic used by
+        Z3IndexKeySpace (Z3IndexKeySpace.scala:132-158): a long interval is
+        tiled per time bin; interior bins cover the whole offset range.
+        Returns (bins int32[n], lo int64[n], hi int64[n]) with inclusive
+        offsets.
+        """
+        lo_b = self.to_binned(lo_millis)
+        hi_b = self.to_binned(hi_millis)
+        b0 = int(lo_b.bin)
+        b1 = int(hi_b.bin)
+        bins = np.arange(b0, b1 + 1, dtype=np.int32)
+        lo = np.zeros(len(bins), dtype=np.int64)
+        hi = np.full(len(bins), self.max_offset, dtype=np.int64)
+        lo[0] = int(lo_b.offset)
+        hi[-1] = int(hi_b.offset)
+        return bins, lo, hi

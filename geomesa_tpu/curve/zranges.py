@@ -1,0 +1,191 @@
+"""Decomposition of query boxes into covering Z-curve ranges.
+
+Functional parity with the reference's ZN.zranges
+(/root/reference/geomesa-z3/src/main/scala/org/locationtech/geomesa/zorder/sfcurve/ZN.scala:110-242):
+breadth-first quad/oct-tree traversal from the longest common prefix of the
+query corners, emitting:
+
+- *contained* ranges: curve cells fully inside every queried dimension
+  interval (rows in them need no further spatial/temporal filtering), and
+- *overlapping* ranges: cells that straddle the query boundary (rows need
+  the per-row membership test — on TPU, the scan kernel mask).
+
+The traversal is budgeted: `max_ranges` caps output size (reference default
+``geomesa.scan.ranges.target`` = 2000, QueryProperties.scala) and
+`max_recurse` caps depth (ZN.DefaultRecurse = 7 levels past the common
+prefix). When the budget is hit, remaining cells are emitted as coarse
+overlapping ranges — always a superset of the query, never a miss.
+
+Host-side pure Python/NumPy: this runs once per query over thousands of
+cells, not per row. Keeping range count bounded keeps the device scan grid
+static-shaped for XLA (SURVEY.md hard part (d)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from geomesa_tpu.curve.zorder import _ZN  # noqa: F401  (typing only)
+
+DEFAULT_MAX_RANGES = 2000
+DEFAULT_MAX_RECURSE = 7
+
+
+@dataclass(frozen=True)
+class IndexRange:
+    """Inclusive z-range [lower, upper]; contained = no row filter needed."""
+
+    lower: int
+    upper: int
+    contained: bool
+
+
+@dataclass(frozen=True)
+class ZBox:
+    """A query box in z-space: per-dimension normalized [min, max] ordinals."""
+
+    mins: tuple[int, ...]
+    maxes: tuple[int, ...]
+
+
+def zranges(
+    curve,
+    boxes: Sequence[ZBox],
+    max_ranges: int | None = None,
+    max_recurse: int | None = None,
+) -> list[IndexRange]:
+    """Covering z-ranges for the union of ``boxes`` on ``curve``.
+
+    curve: Z2 or Z3 from geomesa_tpu.curve.zorder (needs .dims,
+    .bits_per_dim, .index, .decode).
+    """
+    if not boxes:
+        return []
+    max_ranges = max_ranges or DEFAULT_MAX_RANGES
+    max_recurse = DEFAULT_MAX_RECURSE if max_recurse is None else max_recurse
+    dims = curve.dims
+    bits_per_dim = curve.bits_per_dim
+    total_bits = dims * bits_per_dim
+    children = 1 << dims
+
+    mins = np.array([b.mins for b in boxes], dtype=np.uint64)  # [nbox, dims]
+    maxes = np.array([b.maxes for b in boxes], dtype=np.uint64)
+
+    zmins = [int(curve.index(*b.mins)) for b in boxes]
+    zmaxes = [int(curve.index(*b.maxes)) for b in boxes]
+
+    # longest common prefix over all corner z-values, aligned to dims bits
+    offset = total_bits
+    while offset > 0:
+        nxt = offset - dims
+        bits0 = zmins[0] >> nxt
+        if all((v >> nxt) == bits0 for v in zmins + zmaxes):
+            offset = nxt
+        else:
+            break
+    prefix = (zmins[0] >> offset) << offset if offset < total_bits else 0
+
+    ranges: list[IndexRange] = []
+
+    def cell_bounds(z_prefix: int, level_bits: int) -> tuple[np.ndarray, np.ndarray]:
+        """Per-dimension [lo, hi] ordinals of the cell with the given prefix;
+        level_bits = number of low bits free within the cell."""
+        zmin = z_prefix
+        zmax = z_prefix | ((1 << level_bits) - 1)
+        lo = np.array(curve.decode(np.uint64(zmin)), dtype=np.uint64)
+        hi = np.array(curve.decode(np.uint64(zmax)), dtype=np.uint64)
+        return lo, hi
+
+    def classify(lo: np.ndarray, hi: np.ndarray) -> int:
+        """2 = fully contained in some box, 1 = overlaps some box, 0 = disjoint."""
+        contained = np.all((lo >= mins) & (hi <= maxes), axis=1)
+        if contained.any():
+            return 2
+        overlaps = np.all((lo <= maxes) & (hi >= mins), axis=1)
+        if overlaps.any():
+            return 1
+        return 0
+
+    # BFS over cells. Each entry: (z_prefix, free_bits)
+    level = [(prefix, offset)]
+    recursions = 0
+    while level and recursions < max_recurse and len(ranges) + len(level) * children < max_ranges * 2:
+        nxt: list[tuple[int, int]] = []
+        for z_prefix, free_bits in level:
+            if free_bits == 0:
+                lo, hi = cell_bounds(z_prefix, 0)
+                c = classify(lo, hi)
+                if c:
+                    ranges.append(IndexRange(z_prefix, z_prefix, c == 2))
+                continue
+            child_bits = free_bits - dims
+            for q in range(children):
+                child_prefix = z_prefix | (q << child_bits)
+                lo, hi = cell_bounds(child_prefix, child_bits)
+                c = classify(lo, hi)
+                if c == 2:
+                    ranges.append(
+                        IndexRange(child_prefix, child_prefix | ((1 << child_bits) - 1), True)
+                    )
+                elif c == 1:
+                    if child_bits == 0:
+                        ranges.append(IndexRange(child_prefix, child_prefix, False))
+                    else:
+                        nxt.append((child_prefix, child_bits))
+        level = nxt
+        recursions += 1
+
+    # budget exhausted: emit remaining cells as coarse overlapping ranges
+    for z_prefix, free_bits in level:
+        ranges.append(IndexRange(z_prefix, z_prefix | ((1 << free_bits) - 1), False))
+
+    return merge_ranges(ranges, max_ranges)
+
+
+def merge_ranges(ranges: list[IndexRange], max_ranges: int | None = None) -> list[IndexRange]:
+    """Sort, merge overlapping/adjacent ranges, and reduce below max_ranges
+    by closing the smallest gaps first (over-covering, never dropping).
+
+    Reference: the sort+merge at the tail of ZN.zranges (ZN.scala:198-242).
+    """
+    if not ranges:
+        return []
+    ranges = sorted(ranges, key=lambda r: (r.lower, r.upper))
+    merged: list[IndexRange] = [ranges[0]]
+    for r in ranges[1:]:
+        last = merged[-1]
+        if r.lower <= last.upper + 1:
+            merged[-1] = IndexRange(
+                last.lower, max(last.upper, r.upper), last.contained and r.contained
+            )
+        else:
+            merged.append(r)
+    if max_ranges is not None and len(merged) > max_ranges:
+        # close smallest gaps until under budget
+        gaps = np.array(
+            [merged[i + 1].lower - merged[i].upper for i in range(len(merged) - 1)]
+        )
+        k = len(merged) - max_ranges
+        cutoff_idx = np.argpartition(gaps, k - 1)[:k]
+        close = np.zeros(len(gaps), dtype=bool)
+        close[cutoff_idx] = True
+        out: list[IndexRange] = [merged[0]]
+        for i, r in enumerate(merged[1:]):
+            if close[i]:
+                last = out[-1]
+                out[-1] = IndexRange(last.lower, max(last.upper, r.upper), False)
+            else:
+                out.append(r)
+        merged = out
+    return merged
+
+
+def ranges_to_arrays(ranges: list[IndexRange]):
+    """(lower u64[n], upper u64[n], contained bool[n]) arrays for searchsorted."""
+    lo = np.array([r.lower for r in ranges], dtype=np.uint64)
+    hi = np.array([r.upper for r in ranges], dtype=np.uint64)
+    contained = np.array([r.contained for r in ranges], dtype=bool)
+    return lo, hi, contained

@@ -1,0 +1,10 @@
+"""DataStore: schema lifecycle + query entry point (placeholder, grows with
+the index/planner/scan layers). Reference: GeoMesaDataStore
+(/root/reference/geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/geotools/GeoMesaDataStore.scala:50).
+"""
+
+from __future__ import annotations
+
+
+class DataStore:  # pragma: no cover - replaced as layers land
+    pass

@@ -1,0 +1,79 @@
+"""BinnedTime codec tests (reference: BinnedTimeTest.scala)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve.binnedtime import (
+    MAX_OFFSET,
+    MILLIS_PER_DAY,
+    BinnedTime,
+    TimePeriod,
+)
+
+MS_2020 = np.datetime64("2020-06-15T12:34:56.789", "ms").astype(np.int64)
+
+
+class TestBinnedTime:
+    @pytest.mark.parametrize("period", list(TimePeriod))
+    def test_roundtrip(self, period):
+        bt = BinnedTime(period)
+        rng = np.random.default_rng(0)
+        ms = rng.integers(0, 2_000_000_000_000, size=1000)  # 1970..2033
+        bv = bt.to_binned(ms)
+        back = bt.from_binned(bv.bin, bv.offset)
+        # offsets are truncated to the period resolution
+        res = {
+            TimePeriod.DAY: 1,
+            TimePeriod.WEEK: 1000,
+            TimePeriod.MONTH: 1000,
+            TimePeriod.YEAR: 60_000,
+        }[TimePeriod.parse(period)]
+        assert np.all(back <= ms)
+        assert np.all(ms - back < res)
+
+    @pytest.mark.parametrize("period", list(TimePeriod))
+    def test_offsets_within_bounds(self, period):
+        bt = BinnedTime(period)
+        rng = np.random.default_rng(1)
+        ms = rng.integers(0, 2_000_000_000_000, size=1000)
+        bv = bt.to_binned(ms)
+        assert np.all(bv.offset >= 0)
+        assert np.all(bv.offset <= MAX_OFFSET[TimePeriod.parse(period)])
+
+    def test_day_bins(self):
+        bt = BinnedTime(TimePeriod.DAY)
+        bv = bt.to_binned(MS_2020)
+        assert int(bv.bin) == int(MS_2020 // MILLIS_PER_DAY)
+        assert int(bv.offset) == int(MS_2020 % MILLIS_PER_DAY)
+
+    def test_week_epoch_alignment(self):
+        bt = BinnedTime(TimePeriod.WEEK)
+        # 1970-01-01 is week 0 offset 0; 1970-01-08 is week 1 offset 0
+        assert int(bt.to_binned(0).bin) == 0
+        assert int(bt.to_binned(7 * MILLIS_PER_DAY).bin) == 1
+        assert int(bt.to_binned(7 * MILLIS_PER_DAY).offset) == 0
+
+    def test_month_calendar_boundaries(self):
+        bt = BinnedTime(TimePeriod.MONTH)
+        feb = np.datetime64("2020-02-01T00:00:00", "ms").astype(np.int64)
+        bv = bt.to_binned(feb)
+        assert int(bv.offset) == 0
+        assert int(bv.bin) == (2020 - 1970) * 12 + 1
+
+    def test_year_calendar_boundaries(self):
+        bt = BinnedTime(TimePeriod.YEAR)
+        y = np.datetime64("2021-01-01T00:00:00", "ms").astype(np.int64)
+        bv = bt.to_binned(y)
+        assert int(bv.offset) == 0
+        assert int(bv.bin) == 2021 - 1970
+
+    def test_bins_for_interval(self):
+        bt = BinnedTime(TimePeriod.WEEK)
+        lo = 10 * 7 * MILLIS_PER_DAY + 5_000_000
+        hi = 12 * 7 * MILLIS_PER_DAY + 9_000_000
+        bins, los, his = bt.bins_for_interval(lo, hi)
+        assert bins.tolist() == [10, 11, 12]
+        assert los[0] == 5_000
+        assert his[0] == MAX_OFFSET[TimePeriod.WEEK]
+        assert los[1] == 0
+        assert his[2] == 9_000

@@ -1,0 +1,157 @@
+"""Z2SFC / Z3SFC / XZ2SFC / XZ3SFC tests: round trips + query covering.
+
+Modeled on the reference's Z3SFCTest / XZ2SFCTest
+(/root/reference/geomesa-z3/src/test/scala/.../curve/).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import XZ2SFC, XZ3SFC, Z2SFC, Z3SFC
+from geomesa_tpu.curve.binnedtime import MAX_OFFSET, TimePeriod
+
+
+def covers(ranges, codes):
+    """Vector: is each code inside some range?"""
+    if not ranges:
+        return np.zeros(len(codes), dtype=bool)
+    lo = np.array([r.lower for r in ranges])
+    hi = np.array([r.upper for r in ranges])
+    codes = np.asarray(codes, dtype=np.int64)
+    idx = np.searchsorted(lo, codes, side="right") - 1
+    return (idx >= 0) & (codes <= hi[np.clip(idx, 0, len(hi) - 1)])
+
+
+class TestZ2SFC:
+    def test_invert_roundtrip(self):
+        sfc = Z2SFC()
+        rng = np.random.default_rng(0)
+        lon = rng.uniform(-180, 180, 1000)
+        lat = rng.uniform(-90, 90, 1000)
+        z = sfc.index(lon, lat)
+        lon2, lat2 = sfc.invert(z)
+        # 31 bits over 360 degrees -> ~1.7e-7 degree resolution
+        assert np.allclose(lon, lon2, atol=1e-6)
+        assert np.allclose(lat, lat2, atol=1e-6)
+
+    def test_query_covering(self):
+        sfc = Z2SFC()
+        rng = np.random.default_rng(1)
+        lon = rng.uniform(-180, 180, 5000)
+        lat = rng.uniform(-90, 90, 5000)
+        z = sfc.index(lon, lat).astype(np.int64)
+        bbox = (-10.0, -10.0, 10.0, 10.0)
+        ranges = sfc.ranges([bbox])
+        inside = (lon >= bbox[0]) & (lat >= bbox[1]) & (lon <= bbox[2]) & (lat <= bbox[3])
+        cov = covers(ranges, z)
+        assert np.all(cov[inside]), "every point inside the bbox must be covered"
+
+
+class TestZ3SFC:
+    def test_invert_roundtrip(self):
+        sfc = Z3SFC.for_period(TimePeriod.WEEK)
+        rng = np.random.default_rng(2)
+        lon = rng.uniform(-180, 180, 1000)
+        lat = rng.uniform(-90, 90, 1000)
+        t = rng.uniform(0, MAX_OFFSET[TimePeriod.WEEK], 1000)
+        z = sfc.index(lon, lat, t)
+        lon2, lat2, t2 = sfc.invert(z)
+        assert np.allclose(lon, lon2, atol=2e-4)
+        assert np.allclose(lat, lat2, atol=1e-4)
+        assert np.allclose(t, t2, atol=MAX_OFFSET[TimePeriod.WEEK] / (1 << 21) + 1)
+
+    def test_query_covering(self):
+        sfc = Z3SFC.for_period(TimePeriod.WEEK)
+        rng = np.random.default_rng(3)
+        n = 5000
+        lon = rng.uniform(-180, 180, n)
+        lat = rng.uniform(-90, 90, n)
+        t = rng.uniform(0, MAX_OFFSET[TimePeriod.WEEK], n)
+        z = sfc.index(lon, lat, t).astype(np.int64)
+        bbox = (30.0, 40.0, 45.0, 50.0)
+        twin = (100_000.0, 400_000.0)
+        ranges = sfc.ranges([bbox], [twin])
+        inside = (
+            (lon >= bbox[0]) & (lat >= bbox[1]) & (lon <= bbox[2]) & (lat <= bbox[3])
+            & (t >= twin[0]) & (t <= twin[1])
+        )
+        cov = covers(ranges, z)
+        assert np.all(cov[inside])
+
+    def test_period_singletons(self):
+        assert Z3SFC.for_period("week") is Z3SFC.for_period(TimePeriod.WEEK)
+        assert Z3SFC.for_period("day") is not Z3SFC.for_period("week")
+
+
+class TestXZ2SFC:
+    def test_query_covering_random_boxes(self):
+        sfc = XZ2SFC.for_precision(12)
+        rng = np.random.default_rng(4)
+        n = 2000
+        # random small boxes (elements)
+        cx = rng.uniform(-170, 170, n)
+        cy = rng.uniform(-80, 80, n)
+        w = rng.uniform(0, 5, n)
+        h = rng.uniform(0, 5, n)
+        xmin, xmax = cx - w / 2, cx + w / 2
+        ymin, ymax = cy - h / 2, cy + h / 2
+        codes = sfc.index(xmin, ymin, xmax, ymax).astype(np.int64)
+        q = (-20.0, -20.0, 25.0, 30.0)
+        ranges = sfc.ranges([q])
+        intersects = (xmin <= q[2]) & (xmax >= q[0]) & (ymin <= q[3]) & (ymax >= q[1])
+        cov = covers(ranges, codes)
+        missed = intersects & ~cov
+        assert not missed.any(), f"missed {int(missed.sum())} intersecting elements"
+
+    def test_points_as_degenerate_boxes(self):
+        sfc = XZ2SFC.for_precision(12)
+        rng = np.random.default_rng(5)
+        x = rng.uniform(-180, 180, 1000)
+        y = rng.uniform(-90, 90, 1000)
+        codes = sfc.index(x, y, x, y).astype(np.int64)
+        q = (0.0, 0.0, 50.0, 50.0)
+        ranges = sfc.ranges([q])
+        inside = (x >= q[0]) & (x <= q[2]) & (y >= q[1]) & (y <= q[3])
+        assert np.all(covers(ranges, codes)[inside])
+
+    def test_contained_ranges_do_not_need_filtering(self):
+        sfc = XZ2SFC.for_precision(12)
+        rng = np.random.default_rng(6)
+        n = 3000
+        cx = rng.uniform(-170, 170, n)
+        cy = rng.uniform(-80, 80, n)
+        w = rng.uniform(0, 3, n)
+        xmin, xmax = cx - w / 2, cx + w / 2
+        ymin, ymax = cy - w / 2, cy + w / 2
+        codes = sfc.index(xmin, ymin, xmax, ymax).astype(np.int64)
+        q = (-40.0, -40.0, 40.0, 40.0)
+        contained_ranges = [r for r in sfc.ranges([q]) if r.contained]
+        cov = covers(contained_ranges, codes)
+        intersects = (xmin <= q[2]) & (xmax >= q[0]) & (ymin <= q[3]) & (ymax >= q[1])
+        # everything in a contained range must genuinely intersect the query
+        assert np.all(intersects[cov])
+
+
+class TestXZ3SFC:
+    def test_query_covering(self):
+        sfc = XZ3SFC.for_period(TimePeriod.WEEK)
+        rng = np.random.default_rng(7)
+        n = 1500
+        cx = rng.uniform(-170, 170, n)
+        cy = rng.uniform(-80, 80, n)
+        w = rng.uniform(0, 4, n)
+        t0 = rng.uniform(0, 500_000, n)
+        dt = rng.uniform(0, 50_000, n)
+        xmin, xmax = cx - w / 2, cx + w / 2
+        ymin, ymax = cy - w / 2, cy + w / 2
+        tmax = np.minimum(t0 + dt, MAX_OFFSET[TimePeriod.WEEK])
+        codes = sfc.index(xmin, ymin, t0, xmax, ymax, tmax).astype(np.int64)
+        q = (-30.0, -30.0, 100_000.0, 30.0, 30.0, 300_000.0)
+        ranges = sfc.ranges([q])
+        intersects = (
+            (xmin <= q[3]) & (xmax >= q[0]) & (ymin <= q[4]) & (ymax >= q[1])
+            & (t0 <= q[5]) & (tmax >= q[2])
+        )
+        cov = covers(ranges, codes)
+        missed = intersects & ~cov
+        assert not missed.any(), f"missed {int(missed.sum())}"

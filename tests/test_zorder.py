@@ -1,0 +1,120 @@
+"""Curve math unit tests: split/combine round trips, golden vectors, zdiv.
+
+Modeled on the reference's Z3Test / Z2Test / Z3RangeTest
+(/root/reference/geomesa-z3/src/test/scala/.../curve, .../zorder/sfcurve).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve.zorder import Z2, Z3, zdiv
+
+
+class TestZ3:
+    def test_split_combine_roundtrip(self):
+        rng = np.random.default_rng(0)
+        vals = rng.integers(0, 1 << 21, size=10_000, dtype=np.uint64)
+        assert np.array_equal(Z3.combine(Z3.split(vals)), vals)
+
+    def test_index_decode_roundtrip(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 1 << 21, size=10_000, dtype=np.uint64)
+        y = rng.integers(0, 1 << 21, size=10_000, dtype=np.uint64)
+        t = rng.integers(0, 1 << 21, size=10_000, dtype=np.uint64)
+        z = Z3.index(x, y, t)
+        dx, dy, dt = Z3.decode(z)
+        assert np.array_equal(dx, x)
+        assert np.array_equal(dy, y)
+        assert np.array_equal(dt, t)
+
+    def test_golden_interleave(self):
+        # z(1,0,0) = 0b001, z(0,1,0) = 0b010, z(0,0,1) = 0b100
+        assert int(Z3.index(1, 0, 0)) == 1
+        assert int(Z3.index(0, 1, 0)) == 2
+        assert int(Z3.index(0, 0, 1)) == 4
+        assert int(Z3.index(1, 1, 1)) == 7
+        # bit i of x lands at position 3i
+        for i in range(21):
+            assert int(Z3.index(1 << i, 0, 0)) == 1 << (3 * i)
+            assert int(Z3.index(0, 1 << i, 0)) == 1 << (3 * i + 1)
+            assert int(Z3.index(0, 0, 1 << i)) == 1 << (3 * i + 2)
+
+    def test_ordering_locality(self):
+        # consecutive cells along x within an aligned pair differ by 1
+        assert int(Z3.index(3, 5, 7)) != int(Z3.index(3, 5, 6))
+
+    def test_max_values(self):
+        m = (1 << 21) - 1
+        z = int(Z3.index(m, m, m))
+        assert z == (1 << 63) - 1
+
+    def test_scalar_and_array_agree(self):
+        xs = np.array([5, 1000, 2**20], dtype=np.uint64)
+        batched = Z3.index(xs, xs, xs)
+        singles = [int(Z3.index(int(v), int(v), int(v))) for v in xs]
+        assert [int(b) for b in batched] == singles
+
+
+class TestZ2:
+    def test_split_combine_roundtrip(self):
+        rng = np.random.default_rng(2)
+        vals = rng.integers(0, 1 << 31, size=10_000, dtype=np.uint64)
+        assert np.array_equal(Z2.combine(Z2.split(vals)), vals)
+
+    def test_index_decode_roundtrip(self):
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, 1 << 31, size=10_000, dtype=np.uint64)
+        y = rng.integers(0, 1 << 31, size=10_000, dtype=np.uint64)
+        z = Z2.index(x, y)
+        dx, dy = Z2.decode(z)
+        assert np.array_equal(dx, x)
+        assert np.array_equal(dy, y)
+
+    def test_golden_interleave(self):
+        assert int(Z2.index(1, 0)) == 1
+        assert int(Z2.index(0, 1)) == 2
+        assert int(Z2.index(3, 3)) == 15
+        for i in range(31):
+            assert int(Z2.index(1 << i, 0)) == 1 << (2 * i)
+            assert int(Z2.index(0, 1 << i)) == 1 << (2 * i + 1)
+
+    def test_max_values(self):
+        m = (1 << 31) - 1
+        assert int(Z2.index(m, m)) == (1 << 62) - 1
+
+
+class TestZdiv:
+    """Brute-force validation of LITMAX/BIGMIN on a small 2-D space."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_litmax_bigmin_bruteforce(self, seed):
+        rng = np.random.default_rng(seed)
+        bits = 5  # 5 bits/dim -> z in [0, 1024)
+        for _ in range(50):
+            x0, x1 = sorted(rng.integers(0, 1 << bits, 2).tolist())
+            y0, y1 = sorted(rng.integers(0, 1 << bits, 2).tolist())
+            zmin = int(Z2.index(x0, y0))
+            zmax = int(Z2.index(x1, y1))
+            # all z inside the box
+            xs, ys = np.meshgrid(np.arange(x0, x1 + 1), np.arange(y0, y1 + 1))
+            inside = np.sort(
+                Z2.index(xs.ravel().astype(np.uint64), ys.ravel().astype(np.uint64)).astype(np.int64)
+            )
+            # pick zval strictly inside [zmin, zmax] but outside the box
+            candidates = [
+                z for z in range(zmin + 1, zmax) if z not in set(inside.tolist())
+            ]
+            if not candidates:
+                continue
+            zval = int(rng.choice(candidates))
+            litmax, bigmin = zdiv(Z2, zmin, zmax, zval)
+            expect_lit = inside[inside < zval]
+            expect_big = inside[inside > zval]
+            if len(expect_lit):
+                assert litmax == int(expect_lit[-1]), (
+                    f"litmax box=({x0},{y0})..({x1},{y1}) zval={zval}"
+                )
+            if len(expect_big):
+                assert bigmin == int(expect_big[0]), (
+                    f"bigmin box=({x0},{y0})..({x1},{y1}) zval={zval}"
+                )

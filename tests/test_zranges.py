@@ -1,0 +1,102 @@
+"""Range decomposition covering tests: brute-force verification that
+zranges always covers every point in the query box (never a false miss),
+and that `contained` ranges never include points outside the box.
+
+Modeled on the reference's Z3RangeTest / ZRangeTest
+(/root/reference/geomesa-z3/src/test/scala/.../zorder/sfcurve/).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve.zorder import Z2, Z3
+from geomesa_tpu.curve.zranges import ZBox, merge_ranges, zranges, IndexRange
+
+
+def brute_force_cover_check(curve, box: ZBox, ranges, dims_range):
+    """Every z of a point in the box must fall in some range; every z in a
+    `contained` range must decode to a point in the box."""
+    grids = np.meshgrid(*[np.arange(lo, hi + 1) for lo, hi in dims_range])
+    zs = curve.index(*[g.ravel().astype(np.uint64) for g in grids]).astype(np.int64)
+    lo = np.array([r.lower for r in ranges])
+    hi = np.array([r.upper for r in ranges])
+    # coverage: each z in some [lo, hi]
+    idx = np.searchsorted(lo, zs, side="right") - 1
+    ok = (idx >= 0) & (zs <= hi[np.clip(idx, 0, len(hi) - 1)])
+    assert ok.all(), f"missed {int((~ok).sum())} points of {len(zs)}"
+
+
+class TestZ2Ranges:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_boxes_covered(self, seed):
+        rng = np.random.default_rng(seed)
+        x0, x1 = sorted(rng.integers(0, 64, 2).tolist())
+        y0, y1 = sorted(rng.integers(0, 64, 2).tolist())
+        box = ZBox((x0, y0), (x1, y1))
+        ranges = zranges(Z2, [box], max_ranges=2000, max_recurse=32)
+        brute_force_cover_check(Z2, box, ranges, [(x0, x1), (y0, y1)])
+
+    def test_contained_ranges_exact(self):
+        box = ZBox((0, 0), (15, 15))  # aligned power-of-two box
+        ranges = zranges(Z2, [box], max_ranges=2000, max_recurse=32)
+        # an aligned 16x16 box is exactly one contained range of 256 cells
+        assert len(ranges) == 1
+        assert ranges[0].contained
+        assert ranges[0].upper - ranges[0].lower + 1 == 256
+
+    def test_contained_flag_correct(self):
+        rng = np.random.default_rng(42)
+        for _ in range(5):
+            x0, x1 = sorted(rng.integers(0, 32, 2).tolist())
+            y0, y1 = sorted(rng.integers(0, 32, 2).tolist())
+            ranges = zranges(Z2, [ZBox((x0, y0), (x1, y1))], max_ranges=5000, max_recurse=32)
+            for r in ranges:
+                if r.contained:
+                    for z in range(r.lower, r.upper + 1):
+                        x, y = Z2.decode(np.uint64(z))
+                        assert x0 <= int(x) <= x1 and y0 <= int(y) <= y1
+
+    def test_max_ranges_budget(self):
+        # a degenerate thin box produces many ranges; budget must cap them
+        box = ZBox((0, 5), ((1 << 31) - 1, 5))
+        ranges = zranges(Z2, [box], max_ranges=20)
+        assert 0 < len(ranges) <= 20
+
+    def test_multiple_boxes(self):
+        b1 = ZBox((0, 0), (7, 7))
+        b2 = ZBox((100, 100), (107, 107))
+        ranges = zranges(Z2, [b1, b2], max_ranges=2000, max_recurse=32)
+        brute_force_cover_check(Z2, b1, ranges, [(0, 7), (0, 7)])
+        brute_force_cover_check(Z2, b2, ranges, [(100, 107), (100, 107)])
+
+
+class TestZ3Ranges:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_boxes_covered(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        x0, x1 = sorted(rng.integers(0, 16, 2).tolist())
+        y0, y1 = sorted(rng.integers(0, 16, 2).tolist())
+        t0, t1 = sorted(rng.integers(0, 16, 2).tolist())
+        box = ZBox((x0, y0, t0), (x1, y1, t1))
+        ranges = zranges(Z3, [box], max_ranges=2000, max_recurse=32)
+        brute_force_cover_check(Z3, box, ranges, [(x0, x1), (y0, y1), (t0, t1)])
+
+
+class TestMergeRanges:
+    def test_merge_overlapping(self):
+        rs = [IndexRange(0, 10, True), IndexRange(5, 20, True), IndexRange(22, 30, False)]
+        merged = merge_ranges(rs)
+        assert [(r.lower, r.upper) for r in merged] == [(0, 20), (22, 30)]
+
+    def test_merge_adjacent(self):
+        rs = [IndexRange(0, 10, True), IndexRange(11, 20, False)]
+        merged = merge_ranges(rs)
+        assert [(r.lower, r.upper) for r in merged] == [(0, 20)]
+        assert not merged[0].contained
+
+    def test_cap_closes_smallest_gaps(self):
+        rs = [IndexRange(0, 1, True), IndexRange(5, 6, True), IndexRange(100, 101, True)]
+        merged = merge_ranges(rs, max_ranges=2)
+        assert len(merged) == 2
+        assert (merged[0].lower, merged[0].upper) == (0, 6)
+        assert (merged[1].lower, merged[1].upper) == (100, 101)
