@@ -74,10 +74,17 @@ class BinnedTime:
     def to_binned(self, millis) -> BinnedValue:
         """Epoch millis -> (bin, offset). Reference: timeToBinnedTime (:73).
 
-        Pre-epoch instants clamp to (bin 0, offset 0), mirroring the
-        reference's epoch clamp in BinnedTime.dateToBinnedTime.
+        Out-of-range instants (pre-epoch, or past the max representable bin)
+        raise, mirroring the reference's require checks
+        (BinnedTime.scala:202-204) — silent clamping would alias distinct
+        instants onto boundary bins and corrupt query results.
         """
-        ms = np.maximum(np.asarray(millis, dtype=np.int64), 0)
+        ms = np.asarray(millis, dtype=np.int64)
+        if np.any(ms < 0):
+            raise ValueError(
+                f"pre-epoch timestamp(s) not supported by period {self.period.value}: "
+                f"min={int(np.min(ms))}ms"
+            )
         p = self.period
         if p is TimePeriod.DAY:
             b = np.floor_divide(ms, MILLIS_PER_DAY)
@@ -95,7 +102,11 @@ class BinnedTime:
             years = dt.astype("datetime64[Y]")
             b = years.astype(np.int64)
             off = np.floor_divide((dt - years).astype("timedelta64[ms]").astype(np.int64), 60_000)
-        b = np.clip(b, 0, MAX_BIN)
+        if np.any(b > MAX_BIN):
+            raise ValueError(
+                f"timestamp(s) past the max representable date for period "
+                f"{self.period.value} (bin {int(np.max(b))} > {MAX_BIN})"
+            )
         return BinnedValue(bin=b.astype(np.int32), offset=off.astype(np.int64))
 
     def from_binned(self, bin, offset) -> np.ndarray:
@@ -124,7 +135,17 @@ class BinnedTime:
         tiled per time bin; interior bins cover the whole offset range.
         Returns (bins int32[n], lo int64[n], hi int64[n]) with inclusive
         offsets.
+
+        Query-side semantics: endpoints extending past the representable
+        range are *clamped* into it (a query reaching before the epoch or
+        past the max bin is still answerable over its in-range portion) —
+        only ingest (`to_binned`) rejects out-of-range instants.
         """
+        if lo_millis > hi_millis:
+            raise ValueError(f"inverted interval: {lo_millis} > {hi_millis}")
+        max_millis = int(self.from_binned(MAX_BIN, self.max_offset))
+        lo_millis = min(max(int(lo_millis), 0), max_millis)
+        hi_millis = min(max(int(hi_millis), 0), max_millis)
         lo_b = self.to_binned(lo_millis)
         hi_b = self.to_binned(hi_millis)
         b0 = int(lo_b.bin)

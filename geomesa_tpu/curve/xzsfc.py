@@ -130,7 +130,9 @@ class XZSFC:
         """
         if not queries:
             return []
-        max_ranges = max_ranges or DEFAULT_MAX_RANGES
+        max_ranges = DEFAULT_MAX_RANGES if max_ranges is None else max_ranges
+        if max_ranges < 1:
+            raise ValueError(f"max_ranges must be >= 1: {max_ranges}")
         qlo = np.array([q.lo for q in queries])  # [nq, dims]
         qhi = np.array([q.hi for q in queries])
 

@@ -47,9 +47,15 @@ class Z2SFC:
         max_ranges: int | None = None,
         max_recurse: int | None = None,
     ) -> list[IndexRange]:
-        """Covering z-ranges for (xmin, ymin, xmax, ymax) boxes."""
+        """Covering z-ranges for (xmin, ymin, xmax, ymax) boxes.
+
+        Boxes must be axis-ordered (min <= max per dimension); callers split
+        antimeridian-crossing boxes into two, as the reference's do.
+        """
         boxes = []
         for (xmin, ymin, xmax, ymax) in bounds:
+            if xmin > xmax or ymin > ymax:
+                raise ValueError(f"inverted bbox: {(xmin, ymin, xmax, ymax)}")
             boxes.append(
                 ZBox(
                     (int(self.lon.normalize(xmin)), int(self.lat.normalize(ymin))),

@@ -74,7 +74,11 @@ class Z3SFC:
         """
         boxes = []
         for (xmin, ymin, xmax, ymax) in bounds:
+            if xmin > xmax or ymin > ymax:
+                raise ValueError(f"inverted bbox: {(xmin, ymin, xmax, ymax)}")
             for (tmin, tmax) in times:
+                if tmin > tmax:
+                    raise ValueError(f"inverted time window: {(tmin, tmax)}")
                 boxes.append(
                     ZBox(
                         (

@@ -50,35 +50,6 @@ class _ZN:
     def combine(self, z):  # pragma: no cover - interface
         raise NotImplementedError
 
-    # -- generic helpers -------------------------------------------------
-    def contains(self, zmin, zmax, z) -> np.ndarray:
-        """Is z's decoded point inside the box spanned by zmin..zmax per-dim?
-
-        Reference: ZN.contains (ZN.scala) — decodes each dimension and
-        compares against the decoded corners of the range.
-        """
-        zmin, zmax, z = _u(zmin), _u(zmax), _u(z)
-        ok = np.ones(np.broadcast(zmin, zmax, z).shape, dtype=bool)
-        for d in range(self.dims):
-            lo = self.combine(zmin >> _U(d))
-            hi = self.combine(zmax >> _U(d))
-            v = self.combine(z >> _U(d))
-            ok &= (v >= lo) & (v <= hi)
-        return ok
-
-    def overlaps(self, amin, amax, bmin, bmax) -> np.ndarray:
-        """Do the per-dimension projections of two z-boxes overlap?"""
-        amin, amax, bmin, bmax = map(_u, (amin, amax, bmin, bmax))
-        ok = np.ones(np.broadcast(amin, amax, bmin, bmax).shape, dtype=bool)
-        for d in range(self.dims):
-            alo = self.combine(amin >> _U(d))
-            ahi = self.combine(amax >> _U(d))
-            blo = self.combine(bmin >> _U(d))
-            bhi = self.combine(bmax >> _U(d))
-            ok &= (alo <= bhi) & (ahi >= blo)
-        return ok
-
-
 class _Z2(_ZN):
     """2-D Morton: 31 bits per dimension, 62-bit keys (reference Z2.scala)."""
 
@@ -167,19 +138,11 @@ def longest_common_prefix(curve: _ZN, *values: int) -> ZPrefix:
 
     Reference: ZN.longestCommonPrefix (ZN.scala:250-265). Quad/oct tree
     levels consume `dims` bits at a time, so the prefix is aligned to the
-    dimension count.
+    dimension count. Scans from the top for the smallest aligned offset at
+    which all values share the same high bits.
     """
-    offset = curve.total_bits
     step = curve.dims
     first = values[0]
-    while offset > 0:
-        bits = first >> offset
-        if all((v >> offset) == bits for v in values):
-            break
-        offset += step  # back off one level... (loop below adjusts)
-        break
-    # simple scan from the top: find the smallest aligned offset at which
-    # all values share the same high bits
     offset = curve.total_bits
     while offset > 0:
         nxt = offset - step
@@ -213,20 +176,14 @@ def zdiv(curve: _ZN, zmin: int, zmax: int, zval: int) -> tuple[int, int]:
 
     def load(target: int, p: int, bits: int, dim: int) -> int:
         """Set the bits of dimension `dim` in `target` at/below position
-        `bits` (dimension-local bit count) to the pattern `p`."""
-        # mask for dimension `dim` bits at positions < bits (dim-local)
-        mask = 0
-        for b in range(bits):
-            mask |= 1 << (b * dims + dim)
-        pattern = 0
-        pp = p
-        b = 0
-        while pp:
-            if pp & 1:
-                pattern |= 1 << (b * dims + dim)
-            pp >>= 1
-            b += 1
-        return (target & ~mask) | (pattern & mask)
+        `bits` (dimension-local bit count) to the pattern `p`.
+
+        The dimension-strided mask/pattern are the curve's own split()
+        spread shifted to the dimension lane — no per-bit loops.
+        """
+        mask = int(curve.split(np.uint64((1 << bits) - 1))) << dim
+        pattern = int(curve.split(np.uint64(p & ((1 << bits) - 1)))) << dim
+        return (target & ~mask) | pattern
 
     for i in range(total - 1, -1, -1):
         bit = 1 << i

@@ -28,7 +28,7 @@ from typing import Sequence
 
 import numpy as np
 
-from geomesa_tpu.curve.zorder import _ZN  # noqa: F401  (typing only)
+from geomesa_tpu.curve.zorder import _ZN, longest_common_prefix, zdiv  # noqa: F401
 
 DEFAULT_MAX_RANGES = 2000
 DEFAULT_MAX_RECURSE = 7
@@ -64,12 +64,19 @@ def zranges(
     """
     if not boxes:
         return []
-    max_ranges = max_ranges or DEFAULT_MAX_RANGES
+    max_ranges = DEFAULT_MAX_RANGES if max_ranges is None else max_ranges
+    if max_ranges < 1:
+        raise ValueError(f"max_ranges must be >= 1: {max_ranges}")
     max_recurse = DEFAULT_MAX_RECURSE if max_recurse is None else max_recurse
     dims = curve.dims
     bits_per_dim = curve.bits_per_dim
     total_bits = dims * bits_per_dim
     children = 1 << dims
+
+    for b in boxes:
+        for d in range(dims):
+            if b.mins[d] > b.maxes[d]:
+                raise ValueError(f"inverted box on dim {d}: {b.mins} > {b.maxes}")
 
     mins = np.array([b.mins for b in boxes], dtype=np.uint64)  # [nbox, dims]
     maxes = np.array([b.maxes for b in boxes], dtype=np.uint64)
@@ -78,15 +85,9 @@ def zranges(
     zmaxes = [int(curve.index(*b.maxes)) for b in boxes]
 
     # longest common prefix over all corner z-values, aligned to dims bits
-    offset = total_bits
-    while offset > 0:
-        nxt = offset - dims
-        bits0 = zmins[0] >> nxt
-        if all((v >> nxt) == bits0 for v in zmins + zmaxes):
-            offset = nxt
-        else:
-            break
-    prefix = (zmins[0] >> offset) << offset if offset < total_bits else 0
+    lcp = longest_common_prefix(curve, *(zmins + zmaxes))
+    offset = lcp.offset
+    prefix = lcp.prefix
 
     ranges: list[IndexRange] = []
 
@@ -142,7 +143,69 @@ def zranges(
     for z_prefix, free_bits in level:
         ranges.append(IndexRange(z_prefix, z_prefix | ((1 << free_bits) - 1), False))
 
-    return merge_ranges(ranges, max_ranges)
+    merged = merge_ranges(ranges, max_ranges)
+    return _tighten_ranges(curve, merged, zmins, zmaxes, mins, maxes)
+
+
+def _tighten_ranges(
+    curve,
+    ranges: list[IndexRange],
+    zmins: list[int],
+    zmaxes: list[int],
+    mins: np.ndarray,
+    maxes: np.ndarray,
+) -> list[IndexRange]:
+    """Shrink range endpoints to in-union z-values via LITMAX/BIGMIN.
+
+    The reference invokes zdiv from its range decomposition to skip the gap
+    at a miss (ZN.scala:309-361 called from the zranges loop); here the BFS
+    classifies whole cells, so the equivalent tightening runs as a post-pass
+    against the union of query boxes: each range's lower endpoint advances to
+    the smallest z >= it inside *some* box (min of per-box BIGMINs), the
+    upper retracts to the largest z <= it inside some box (max of per-box
+    LITMAXs), and ranges containing no in-union z are dropped. In Morton
+    order the z of a box's min/max corner is that box's global min/max z,
+    which bounds the per-box candidate search.
+    """
+
+    def in_box(z: int, b: int) -> bool:
+        pt = np.array(curve.decode(np.uint64(z)), dtype=np.uint64)
+        return bool(np.all(pt >= mins[b]) & np.all(pt <= maxes[b]))
+
+    nbox = len(zmins)
+    out: list[IndexRange] = []
+    for r in ranges:
+        lo_cands: list[int] = []
+        hi_cands: list[int] = []
+        for b in range(nbox):
+            zmin, zmax = zmins[b], zmaxes[b]
+            if zmax < r.lower or zmin > r.upper:
+                continue  # box b has no z in this range's window at all
+            # smallest z of box b that is >= r.lower
+            if r.lower <= zmin:
+                cand = zmin
+            elif in_box(r.lower, b):
+                cand = r.lower
+            else:
+                _, cand = zdiv(curve, zmin, zmax, r.lower)
+            if cand <= r.upper:
+                lo_cands.append(cand)
+            # largest z of box b that is <= r.upper
+            if r.upper >= zmax:
+                cand = zmax
+            elif in_box(r.upper, b):
+                cand = r.upper
+            else:
+                cand, _ = zdiv(curve, zmin, zmax, r.upper)
+            if cand >= r.lower:
+                hi_cands.append(cand)
+        if not lo_cands or not hi_cands:
+            continue
+        lo, hi = min(lo_cands), max(hi_cands)
+        if lo > hi:
+            continue
+        out.append(IndexRange(lo, hi, r.contained))
+    return out
 
 
 def merge_ranges(ranges: list[IndexRange], max_ranges: int | None = None) -> list[IndexRange]:

@@ -77,3 +77,49 @@ class TestBinnedTime:
         assert his[0] == MAX_OFFSET[TimePeriod.WEEK]
         assert los[1] == 0
         assert his[2] == 9_000
+
+
+class TestBoundsChecks:
+    """Out-of-range instants raise instead of silently aliasing onto boundary
+    bins (reference BinnedTime.scala:202-204 require checks)."""
+
+    def test_pre_epoch_raises(self):
+        import pytest
+        from geomesa_tpu.curve.binnedtime import BinnedTime
+        with pytest.raises(ValueError):
+            BinnedTime("week").to_binned(-1)
+
+    def test_past_max_bin_raises(self):
+        import pytest
+        import numpy as np
+        from geomesa_tpu.curve.binnedtime import BinnedTime, MAX_BIN, MILLIS_PER_DAY
+        bt = BinnedTime("day")
+        too_far = (MAX_BIN + 1) * MILLIS_PER_DAY
+        with pytest.raises(ValueError):
+            bt.to_binned(too_far)
+        # the boundary bin itself is fine
+        ok = bt.to_binned(MAX_BIN * MILLIS_PER_DAY)
+        assert int(ok.bin) == MAX_BIN
+
+    def test_inverted_interval_raises(self):
+        import pytest
+        from geomesa_tpu.curve.binnedtime import BinnedTime
+        with pytest.raises(ValueError):
+            BinnedTime("week").bins_for_interval(100, 50)
+
+
+class TestQuerySideClamping:
+    """bins_for_interval clamps out-of-range query endpoints (query-side)
+    while to_binned raises (ingest-side)."""
+
+    def test_pre_epoch_query_clamped(self):
+        from geomesa_tpu.curve.binnedtime import BinnedTime
+        bins, lo, hi = BinnedTime("week").bins_for_interval(-10_000_000, 1_000_000_000)
+        assert bins[0] == 0 and lo[0] == 0
+
+    def test_far_future_query_clamped(self):
+        from geomesa_tpu.curve.binnedtime import BinnedTime, MAX_BIN
+        bt = BinnedTime("day")
+        start = int(bt.from_binned(MAX_BIN - 1, 0))
+        bins, lo, hi = bt.bins_for_interval(start, start * 10)
+        assert bins[-1] == MAX_BIN and hi[-1] == bt.max_offset

@@ -100,3 +100,78 @@ class TestMergeRanges:
         assert len(merged) == 2
         assert (merged[0].lower, merged[0].upper) == (0, 6)
         assert (merged[1].lower, merged[1].upper) == (100, 101)
+
+
+class TestZdivTightening:
+    """zdiv (LITMAX/BIGMIN) is wired into single-box decomposition as an
+    endpoint-tightening pass — ranges must still cover, and endpoints of
+    every returned range must decode to in-box points."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_endpoints_in_box(self, seed):
+        rng = np.random.default_rng(200 + seed)
+        x0, x1 = sorted(rng.integers(0, 64, 2).tolist())
+        y0, y1 = sorted(rng.integers(0, 64, 2).tolist())
+        box = ZBox((x0, y0), (x1, y1))
+        ranges = zranges(Z2, [box], max_ranges=2000, max_recurse=32)
+        brute_force_cover_check(Z2, box, ranges, [(x0, x1), (y0, y1)])
+        for r in ranges:
+            for z in (r.lower, r.upper):
+                x, y = Z2.decode(np.uint64(z))
+                assert x0 <= int(x) <= x1, (r, int(x), int(y))
+                assert y0 <= int(y) <= y1, (r, int(x), int(y))
+
+    def test_coarse_ranges_tightened(self):
+        # with a tiny recursion budget the BFS emits coarse cells; the zdiv
+        # pass must still pull endpoints into the box
+        box = ZBox((3, 5), (36, 41))
+        ranges = zranges(Z2, [box], max_ranges=10, max_recurse=1)
+        brute_force_cover_check(Z2, box, ranges, [(3, 36), (5, 41)])
+        for r in ranges:
+            x, y = Z2.decode(np.uint64(r.lower))
+            assert 3 <= int(x) <= 36 and 5 <= int(y) <= 41
+
+
+class TestRangeQuality:
+    """False-positive over-coverage at the DEFAULT recursion budget must stay
+    bounded (the reference tunes this via ZN.DefaultRecurse; analogous to the
+    range-count expectations in Z3RangeTest)."""
+
+    def test_default_budget_tightness_z2(self):
+        # a realistic city-scale bbox at full 31-bit precision
+        from geomesa_tpu.curve.z2sfc import Z2SFC
+        sfc = Z2SFC()
+        ranges = sfc.ranges([(-74.1, 40.6, -73.8, 40.9)])  # default budgets
+        assert ranges, "no ranges returned"
+        covered = sum(r.upper - r.lower + 1 for r in ranges)
+        # exact cell count of the query box
+        nx = int(sfc.lon.normalize(-73.8)) - int(sfc.lon.normalize(-74.1)) + 1
+        ny = int(sfc.lat.normalize(40.9)) - int(sfc.lat.normalize(40.6)) + 1
+        exact = nx * ny
+        # allow bounded over-coverage at the default budget
+        assert covered >= exact
+        assert covered <= exact * 40, f"over-coverage {covered / exact:.1f}x"
+
+    def test_validation_errors(self):
+        from geomesa_tpu.curve.z2sfc import Z2SFC
+        with pytest.raises(ValueError):
+            Z2SFC().ranges([(10.0, 0.0, -10.0, 5.0)])  # inverted x
+        with pytest.raises(ValueError):
+            zranges(Z2, [ZBox((5, 0), (1, 3))])
+        with pytest.raises(ValueError):
+            zranges(Z2, [ZBox((0, 0), (1, 1))], max_ranges=0)
+
+
+class TestMultiBoxTightening:
+    def test_multibox_endpoints_in_union(self):
+        b1 = ZBox((0, 0), (10, 10))
+        b2 = ZBox((40, 40), (50, 50))
+        ranges = zranges(Z2, [b1, b2], max_ranges=50, max_recurse=3)
+        brute_force_cover_check(Z2, b1, ranges, [(0, 10), (0, 10)])
+        brute_force_cover_check(Z2, b2, ranges, [(40, 50), (40, 50)])
+        for r in ranges:
+            for z in (r.lower, r.upper):
+                x, y = int(Z2.decode(np.uint64(z))[0]), int(Z2.decode(np.uint64(z))[1])
+                in1 = 0 <= x <= 10 and 0 <= y <= 10
+                in2 = 40 <= x <= 50 and 40 <= y <= 50
+                assert in1 or in2, (r, x, y)
