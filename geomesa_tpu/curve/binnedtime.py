@@ -143,7 +143,9 @@ class BinnedTime:
         """
         if lo_millis > hi_millis:
             raise ValueError(f"inverted interval: {lo_millis} > {hi_millis}")
-        max_millis = int(self.from_binned(MAX_BIN, self.max_offset))
+        # last true millisecond of bin MAX_BIN: MAX_OFFSET over-states short
+        # months/non-leap years, so derive the ceiling from the next bin start
+        max_millis = int(self.from_binned(MAX_BIN + 1, 0)) - 1
         lo_millis = min(max(int(lo_millis), 0), max_millis)
         hi_millis = min(max(int(hi_millis), 0), max_millis)
         lo_b = self.to_binned(lo_millis)

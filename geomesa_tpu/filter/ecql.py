@@ -1,0 +1,392 @@
+"""Minimal ECQL text parser for the indexable query subset.
+
+The reference parses full (E)CQL via GeoTools' ECQL parser and then
+decomposes the tree (geomesa-filter). Here we parse the subset the indexes
+accelerate plus general attribute predicates:
+
+    BBOX(geom, -180, -90, 180, 90)
+    INTERSECTS(geom, POLYGON ((...)))     [also CONTAINS / WITHIN / DWITHIN]
+    dtg DURING 2018-01-01T00:00:00Z/2018-01-08T00:00:00Z
+    dtg BEFORE 2018-01-01T00:00:00Z      /  dtg AFTER ...
+    dtg BETWEEN '2018-01-01' AND '2018-02-01'
+    age > 5, name = 'alice', name IN ('a', 'b'), name LIKE 'a%',
+    attr IS NULL, IN ('fid1', 'fid2')    [bare IN = feature-id filter]
+    AND / OR / NOT, parentheses, INCLUDE, EXCLUDE
+
+Grammar (precedence low->high): or_expr := and_expr (OR and_expr)* ;
+and_expr := not_expr (AND not_expr)* ; not_expr := [NOT] primary.
+
+Dates parse as ISO-8601 (numpy datetime64) to epoch millis.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.filter.predicates import (
+    BBox,
+    Between,
+    Cmp,
+    Contains,
+    During,
+    DWithin,
+    EXCLUDE,
+    Filter,
+    IdFilter,
+    In,
+    INCLUDE,
+    Intersects,
+    IsNull,
+    Like,
+    Not,
+    Or,
+    And,
+    Within,
+)
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s*(?:
+        (?P<string>'(?:[^']|'')*')
+      | (?P<datetime>\d{4}-\d{2}-\d{2}T[\d:.]+Z?)
+      | (?P<number>-?\d+\.?\d*(?:[eE][+-]?\d+)?)
+      | (?P<op><>|<=|>=|=|<|>)
+      | (?P<punct>[(),/])
+      | (?P<word>[A-Za-z_][A-Za-z0-9_.:]*)
+    )
+    """,
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "AND", "OR", "NOT", "IN", "LIKE", "IS", "NULL", "BETWEEN", "DURING",
+    "BEFORE", "AFTER", "INCLUDE", "EXCLUDE", "BBOX", "INTERSECTS",
+    "CONTAINS", "WITHIN", "DWITHIN", "TEQUALS",
+}
+
+_GEOM_WORDS = {
+    "POINT", "LINESTRING", "POLYGON", "MULTIPOINT", "MULTILINESTRING", "MULTIPOLYGON",
+}
+
+
+def parse_dt_millis(s: str) -> int:
+    """ISO-8601 instant -> epoch millis."""
+    s = s.strip().rstrip("Z")
+    return int(np.datetime64(s, "ms").astype(np.int64))
+
+
+class _Tok:
+    def __init__(self, kind: str, value: str):
+        self.kind = kind
+        self.value = value
+
+    def __repr__(self):  # pragma: no cover
+        return f"{self.kind}:{self.value}"
+
+
+def _tokenize(text: str) -> list[_Tok]:
+    toks: list[_Tok] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m or m.end() == pos:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise ValueError(f"cannot tokenize ECQL at: {rest[:40]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        val = m.group(kind)
+        toks.append(_Tok(kind, val))
+    return toks
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    # -- token helpers ---------------------------------------------------
+    def peek(self) -> _Tok | None:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> _Tok:
+        t = self.peek()
+        if t is None:
+            raise ValueError(f"unexpected end of ECQL: {self.text!r}")
+        self.i += 1
+        return t
+
+    def accept_word(self, *words: str) -> str | None:
+        t = self.peek()
+        if t and t.kind == "word" and t.value.upper() in words:
+            self.i += 1
+            return t.value.upper()
+        return None
+
+    def expect_word(self, *words: str) -> str:
+        w = self.accept_word(*words)
+        if w is None:
+            raise ValueError(f"expected {words} at token {self.peek()} in {self.text!r}")
+        return w
+
+    def accept_punct(self, p: str) -> bool:
+        t = self.peek()
+        if t and t.kind == "punct" and t.value == p:
+            self.i += 1
+            return True
+        return False
+
+    def expect_punct(self, p: str):
+        if not self.accept_punct(p):
+            raise ValueError(f"expected {p!r} at token {self.peek()} in {self.text!r}")
+
+    # -- literals --------------------------------------------------------
+    def literal(self):
+        t = self.next()
+        if t.kind == "string":
+            return t.value[1:-1].replace("''", "'")
+        if t.kind == "number":
+            v = float(t.value)
+            return int(v) if v.is_integer() and "." not in t.value and "e" not in t.value.lower() else v
+        if t.kind == "datetime":
+            return parse_dt_millis(t.value)
+        raise ValueError(f"expected literal, got {t}")
+
+    def _maybe_temporal_literal(self, v) -> object:
+        """A quoted date string used in BETWEEN etc. parses to millis."""
+        if isinstance(v, str):
+            try:
+                return parse_dt_millis(v) if re.match(r"^\d{4}-\d{2}-\d{2}", v) else v
+            except Exception:
+                return v
+        return v
+
+    # -- grammar ---------------------------------------------------------
+    def parse(self) -> Filter:
+        f = self.or_expr()
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens at {self.peek()} in {self.text!r}")
+        return f
+
+    def or_expr(self) -> Filter:
+        parts = [self.and_expr()]
+        while self.accept_word("OR"):
+            parts.append(self.and_expr())
+        return parts[0] if len(parts) == 1 else Or(parts)
+
+    def and_expr(self) -> Filter:
+        parts = [self.not_expr()]
+        while self.accept_word("AND"):
+            parts.append(self.not_expr())
+        return parts[0] if len(parts) == 1 else And(parts)
+
+    def not_expr(self) -> Filter:
+        if self.accept_word("NOT"):
+            return Not(self.not_expr())
+        return self.primary()
+
+    def primary(self) -> Filter:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of ECQL")
+        if t.kind == "punct" and t.value == "(":
+            self.next()
+            f = self.or_expr()
+            self.expect_punct(")")
+            return f
+        if t.kind == "word":
+            w = t.value.upper()
+            if w == "INCLUDE":
+                self.next()
+                return INCLUDE
+            if w == "EXCLUDE":
+                self.next()
+                return EXCLUDE
+            if w == "BBOX":
+                return self.bbox()
+            if w in ("INTERSECTS", "CONTAINS", "WITHIN"):
+                return self.spatial_binary(w)
+            if w == "DWITHIN":
+                return self.dwithin()
+            if w == "IN":  # bare IN -> feature id filter
+                self.next()
+                return IdFilter(tuple(self.paren_literals()))
+            return self.attribute_predicate()
+        raise ValueError(f"unexpected token {t} in {self.text!r}")
+
+    def bbox(self) -> Filter:
+        self.expect_word("BBOX")
+        self.expect_punct("(")
+        prop = self.next().value
+        self.expect_punct(",")
+        nums = [self.literal()]
+        for _ in range(3):
+            self.expect_punct(",")
+            nums.append(self.literal())
+        # optional CRS argument
+        if self.accept_punct(","):
+            self.next()
+        self.expect_punct(")")
+        return BBox(prop, float(nums[0]), float(nums[1]), float(nums[2]), float(nums[3]))
+
+    def _wkt_geometry(self) -> geo.Geometry:
+        t = self.peek()
+        if t is None or t.kind != "word" or t.value.upper() not in _GEOM_WORDS:
+            raise ValueError(f"expected WKT geometry at {t}")
+        # re-lex from the raw text: find the geometry substring by paren balance
+        # locate the current token's position in the original text
+        word = self.next().value
+        # find the text position after tokens consumed so far: rebuild by
+        # scanning for the word followed by '('
+        # simpler: reconstruct WKT from tokens until parens balance
+        depth = 0
+        parts = [word]
+        started = False
+        while True:
+            t = self.next()
+            if t.kind == "punct" and t.value == "(":
+                depth += 1
+                started = True
+                parts.append("(")
+            elif t.kind == "punct" and t.value == ")":
+                depth -= 1
+                parts.append(")")
+                if started and depth == 0:
+                    break
+            elif t.kind == "punct" and t.value == ",":
+                parts.append(",")
+            elif t.kind == "number":
+                parts.append(t.value + " ")
+            else:
+                parts.append(t.value + " ")
+        return geo.from_wkt(
+            "".join(parts).replace(" ,", ",").replace(" )", ")")
+        )
+
+    def spatial_binary(self, op: str) -> Filter:
+        self.expect_word(op)
+        self.expect_punct("(")
+        prop = self.next().value
+        self.expect_punct(",")
+        g = self._wkt_geometry()
+        self.expect_punct(")")
+        cls = {"INTERSECTS": Intersects, "CONTAINS": Contains, "WITHIN": Within}[op]
+        return cls(prop, g)
+
+    def dwithin(self) -> Filter:
+        self.expect_word("DWITHIN")
+        self.expect_punct("(")
+        prop = self.next().value
+        self.expect_punct(",")
+        g = self._wkt_geometry()
+        self.expect_punct(",")
+        dist = float(self.literal())
+        # optional units argument (meters/kilometers/statute miles...); we
+        # store planar degrees like the reference's fallback path
+        if self.accept_punct(","):
+            units = self.next().value.lower()
+            dist = _to_degrees(dist, units)
+        self.expect_punct(")")
+        return DWithin(prop, g, dist)
+
+    def paren_literals(self) -> list:
+        self.expect_punct("(")
+        vals = [self.literal()]
+        while self.accept_punct(","):
+            vals.append(self.literal())
+        self.expect_punct(")")
+        return vals
+
+    def attribute_predicate(self) -> Filter:
+        prop = self.next().value
+        t = self.peek()
+        if t is None:
+            raise ValueError(f"dangling property {prop!r}")
+        if t.kind == "op":
+            op = self.next().value
+            v = self._maybe_temporal_literal(self.literal())
+            return Cmp(prop, op, v)
+        w = t.value.upper() if t.kind == "word" else None
+        if w == "DURING":
+            self.next()
+            lo = self.next()
+            self.expect_punct("/")
+            hi = self.next()
+            return During(prop, parse_dt_millis(lo.value), parse_dt_millis(hi.value))
+        if w == "BEFORE":
+            self.next()
+            return Cmp(prop, "<", parse_dt_millis(self.next().value))
+        if w == "AFTER":
+            self.next()
+            return Cmp(prop, ">", parse_dt_millis(self.next().value))
+        if w == "TEQUALS":
+            self.next()
+            return Cmp(prop, "=", parse_dt_millis(self.next().value))
+        if w == "BETWEEN":
+            self.next()
+            lo = self._maybe_temporal_literal(self.literal())
+            self.expect_word("AND")
+            hi = self._maybe_temporal_literal(self.literal())
+            return Between(prop, lo, hi)
+        if w == "IN":
+            self.next()
+            return In(prop, tuple(self.paren_literals()))
+        if w == "LIKE":
+            self.next()
+            pat = self.literal()
+            return Like(prop, str(pat))
+        if w == "IS":
+            self.next()
+            if self.accept_word("NOT"):
+                self.expect_word("NULL")
+                return Not(IsNull(prop))
+            self.expect_word("NULL")
+            return IsNull(prop)
+        if w == "NOT":
+            self.next()
+            inner = self.attribute_predicate_continued(prop)
+            return Not(inner)
+        raise ValueError(f"unexpected predicate on {prop!r}: {t}")
+
+    def attribute_predicate_continued(self, prop: str) -> Filter:
+        """Handles `prop NOT IN (...)` / `prop NOT LIKE ...` / `NOT BETWEEN`."""
+        if self.accept_word("IN"):
+            return In(prop, tuple(self.paren_literals()))
+        if self.accept_word("LIKE"):
+            return Like(prop, str(self.literal()))
+        if self.accept_word("BETWEEN"):
+            lo = self._maybe_temporal_literal(self.literal())
+            self.expect_word("AND")
+            hi = self._maybe_temporal_literal(self.literal())
+            return Between(prop, lo, hi)
+        raise ValueError(f"unexpected NOT clause on {prop!r}")
+
+
+_METERS_PER_DEGREE = 111_320.0
+
+
+def _to_degrees(dist: float, units: str) -> float:
+    """Convert a DWITHIN distance to approximate planar degrees at the
+    equator (the reference treats geographic DWITHIN similarly loosely)."""
+    scale = {
+        "meters": 1.0,
+        "m": 1.0,
+        "kilometers": 1000.0,
+        "km": 1000.0,
+        "feet": 0.3048,
+        "statute": 1609.34,
+        "miles": 1609.34,
+        "nautical": 1852.0,
+        "degrees": _METERS_PER_DEGREE,
+    }.get(units, _METERS_PER_DEGREE)
+    return dist * scale / _METERS_PER_DEGREE
+
+
+def parse(text: str) -> Filter:
+    """Parse an ECQL string into a Filter tree."""
+    return _Parser(text).parse()

@@ -1,0 +1,436 @@
+"""Filter predicate AST with vectorized (columnar) evaluation.
+
+The reference represents queries as GeoTools/ECQL `Filter` trees and
+evaluates them per-feature through JTS + FastFilterFactory
+(/root/reference/geomesa-filter/src/main/scala/org/locationtech/geomesa/
+filter/factory/FastFilterFactory.scala). The TPU redesign keeps the same
+logical algebra (And/Or/Not over spatial, temporal, attribute and id
+predicates) but evaluation is *columnar*: ``Filter.evaluate(batch)`` returns
+a boolean mask over a whole batch of features at once. The device scan
+kernels implement the same semantics over jnp columns for the push-down
+tier; this host path is the exactness reference and the fallback for
+predicates the device can't run.
+
+Geometry columns in a batch are either a ``PointColumn`` (struct-of-arrays
+x/y — the point fast path) or a ``PackedGeometryColumn`` (extents).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+
+
+@dataclass(frozen=True)
+class PointColumn:
+    """Struct-of-arrays geometry column for point features."""
+
+    x: np.ndarray
+    y: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+
+GeometryColumn = "PointColumn | geo.PackedGeometryColumn"
+
+
+class Filter:
+    """Base predicate. Subclasses are frozen dataclasses."""
+
+    def evaluate(self, batch: Mapping[str, object]) -> np.ndarray:
+        """Boolean mask over the batch (dict: attr name -> column)."""
+        raise NotImplementedError
+
+    # -- algebra sugar ---------------------------------------------------
+    def __and__(self, other: "Filter") -> "Filter":
+        return And((self, other))
+
+    def __or__(self, other: "Filter") -> "Filter":
+        return Or((self, other))
+
+    def __invert__(self) -> "Filter":
+        return Not(self)
+
+
+def _batch_len(batch: Mapping[str, object]) -> int:
+    for v in batch.values():
+        if isinstance(v, (PointColumn, geo.PackedGeometryColumn)):
+            return len(v)
+        return len(v)
+    return 0
+
+
+def _column(batch: Mapping[str, object], prop: str) -> np.ndarray:
+    try:
+        return batch[prop]
+    except KeyError:
+        raise KeyError(f"no column {prop!r} in batch (have {list(batch)})")
+
+
+@dataclass(frozen=True)
+class Include(Filter):
+    """Matches everything (ECQL INCLUDE)."""
+
+    def evaluate(self, batch):
+        return np.ones(_batch_len(batch), dtype=bool)
+
+
+@dataclass(frozen=True)
+class Exclude(Filter):
+    """Matches nothing (ECQL EXCLUDE)."""
+
+    def evaluate(self, batch):
+        return np.zeros(_batch_len(batch), dtype=bool)
+
+
+INCLUDE = Include()
+EXCLUDE = Exclude()
+
+
+# ---------------------------------------------------------------------------
+# spatial
+# ---------------------------------------------------------------------------
+
+
+def _eval_spatial(col, fn_points, fn_geom) -> np.ndarray:
+    if isinstance(col, PointColumn):
+        return fn_points(col.x, col.y)
+    if isinstance(col, geo.PackedGeometryColumn):
+        out = np.zeros(len(col), dtype=bool)
+        # bbox prefilter then exact per-geometry
+        for i in range(len(col)):
+            out[i] = fn_geom(col.geometry(i))
+        return out
+    raise TypeError(f"not a geometry column: {type(col)}")
+
+
+@dataclass(frozen=True)
+class BBox(Filter):
+    """BBOX(prop, xmin, ymin, xmax, ymax) — geometry interacts with the box.
+
+    Reference: the `bbox` spatial op extracted by FilterHelper
+    (geomesa-filter/.../FilterHelper.scala:100-130).
+    """
+
+    prop: str
+    xmin: float
+    ymin: float
+    xmax: float
+    ymax: float
+
+    @property
+    def bounds(self) -> tuple[float, float, float, float]:
+        return (self.xmin, self.ymin, self.xmax, self.ymax)
+
+    def evaluate(self, batch):
+        col = _column(batch, self.prop)
+        if isinstance(col, PointColumn):
+            return (
+                (col.x >= self.xmin)
+                & (col.x <= self.xmax)
+                & (col.y >= self.ymin)
+                & (col.y <= self.ymax)
+            )
+        if isinstance(col, geo.PackedGeometryColumn):
+            q = np.array(self.bounds)
+            rough = geo.bbox_intersects(col.bboxes.astype(np.float64), q)
+            out = np.zeros(len(col), dtype=bool)
+            bx = geo.box(*self.bounds)
+            for i in np.nonzero(rough)[0]:
+                out[i] = geo.intersects(col.geometry(int(i)), bx)
+            return out
+        raise TypeError(f"not a geometry column: {type(col)}")
+
+
+@dataclass(frozen=True)
+class Intersects(Filter):
+    """INTERSECTS(prop, <geometry>)."""
+
+    prop: str
+    geom: geo.Geometry
+
+    def evaluate(self, batch):
+        col = _column(batch, self.prop)
+        g = self.geom
+        if isinstance(col, PointColumn):
+            if isinstance(g, (geo.Polygon, geo.MultiPolygon)):
+                inside = geo.points_in_polygon(col.x, col.y, g)
+                # boundary counts for intersects
+                edge = ~inside
+                if edge.any():
+                    for i in np.nonzero(edge)[0]:
+                        if geo._point_on_rings(g, float(col.x[i]), float(col.y[i])):
+                            inside[i] = True
+                return inside
+            out = np.zeros(len(col), dtype=bool)
+            for i in range(len(col)):
+                out[i] = geo.intersects(geo.Point(float(col.x[i]), float(col.y[i])), g)
+            return out
+        if isinstance(col, geo.PackedGeometryColumn):
+            q = np.array(g.bounds())
+            rough = geo.bbox_intersects(col.bboxes.astype(np.float64), q)
+            out = np.zeros(len(col), dtype=bool)
+            for i in np.nonzero(rough)[0]:
+                out[i] = geo.intersects(col.geometry(int(i)), g)
+            return out
+        raise TypeError(f"not a geometry column: {type(col)}")
+
+
+@dataclass(frozen=True)
+class Within(Filter):
+    """WITHIN(prop, <geometry>): the feature lies within the query geometry."""
+
+    prop: str
+    geom: geo.Geometry
+
+    def evaluate(self, batch):
+        col = _column(batch, self.prop)
+        g = self.geom
+        if not isinstance(g, (geo.Polygon, geo.MultiPolygon)):
+            raise ValueError("WITHIN requires a polygonal query geometry")
+        if isinstance(col, PointColumn):
+            return geo.points_in_polygon(col.x, col.y, g)
+        return _eval_spatial(col, None, lambda feat: geo.contains(g, feat))
+
+
+@dataclass(frozen=True)
+class Contains(Filter):
+    """CONTAINS(prop, <geometry>): the feature contains the query geometry."""
+
+    prop: str
+    geom: geo.Geometry
+
+    def evaluate(self, batch):
+        col = _column(batch, self.prop)
+        if isinstance(col, PointColumn):
+            if isinstance(self.geom, geo.Point):
+                return (col.x == self.geom.x) & (col.y == self.geom.y)
+            return np.zeros(len(col), dtype=bool)
+        return _eval_spatial(
+            col, None, lambda feat: isinstance(feat, (geo.Polygon, geo.MultiPolygon))
+            and geo.contains(feat, self.geom)
+        )
+
+
+@dataclass(frozen=True)
+class DWithin(Filter):
+    """DWITHIN(prop, <geometry>, distance): within planar distance."""
+
+    prop: str
+    geom: geo.Geometry
+    dist: float
+
+    def evaluate(self, batch):
+        col = _column(batch, self.prop)
+        if isinstance(col, PointColumn):
+            if isinstance(self.geom, geo.Point):
+                return np.hypot(col.x - self.geom.x, col.y - self.geom.y) <= self.dist
+            out = np.zeros(len(col), dtype=bool)
+            for i in range(len(col)):
+                out[i] = (
+                    geo._point_geom_distance(float(col.x[i]), float(col.y[i]), self.geom)
+                    <= self.dist
+                )
+            return out
+        return _eval_spatial(col, None, lambda feat: geo.distance(feat, self.geom) <= self.dist)
+
+    @property
+    def bounds(self) -> tuple[float, float, float, float]:
+        x0, y0, x1, y1 = self.geom.bounds()
+        return (x0 - self.dist, y0 - self.dist, x1 + self.dist, y1 + self.dist)
+
+
+# ---------------------------------------------------------------------------
+# temporal (epoch-millis int64 columns)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class During(Filter):
+    """prop DURING lo/hi — half-open [lo, hi) on epoch millis, matching the
+    reference's During semantics (FilterHelper.extractIntervals treats During
+    as exclusive bounds; we use inclusive-lo/exclusive-hi which matches how
+    GeoMesa plans Z3 ranges in practice)."""
+
+    prop: str
+    lo_ms: int
+    hi_ms: int
+
+    def evaluate(self, batch):
+        c = np.asarray(_column(batch, self.prop), dtype=np.int64)
+        return (c >= self.lo_ms) & (c < self.hi_ms)
+
+
+# ---------------------------------------------------------------------------
+# attribute comparisons
+# ---------------------------------------------------------------------------
+
+_OPS = {"=", "<>", "<", "<=", ">", ">="}
+
+
+def _is_str_col(c: np.ndarray) -> bool:
+    return c.dtype.kind in ("U", "S", "O")
+
+
+@dataclass(frozen=True)
+class Cmp(Filter):
+    """prop <op> literal, op in =, <>, <, <=, >, >=."""
+
+    prop: str
+    op: str
+    value: object
+
+    def __post_init__(self):
+        if self.op not in _OPS:
+            raise ValueError(f"bad op {self.op!r}")
+
+    def evaluate(self, batch):
+        c = _column(batch, self.prop)
+        c = np.asarray(c)
+        v = self.value
+        if self.op == "=":
+            return c == v
+        if self.op == "<>":
+            return c != v
+        if self.op == "<":
+            return c < v
+        if self.op == "<=":
+            return c <= v
+        if self.op == ">":
+            return c > v
+        return c >= v
+
+
+@dataclass(frozen=True)
+class Between(Filter):
+    """prop BETWEEN lo AND hi (inclusive both ends, per ECQL)."""
+
+    prop: str
+    lo: object
+    hi: object
+
+    def evaluate(self, batch):
+        c = np.asarray(_column(batch, self.prop))
+        return (c >= self.lo) & (c <= self.hi)
+
+
+@dataclass(frozen=True)
+class In(Filter):
+    """prop IN (v1, v2, ...)."""
+
+    prop: str
+    values: tuple
+
+    def evaluate(self, batch):
+        c = np.asarray(_column(batch, self.prop))
+        return np.isin(c, np.asarray(list(self.values)))
+
+
+@dataclass(frozen=True)
+class Like(Filter):
+    """prop LIKE 'pattern' with % (any) and _ (one) wildcards."""
+
+    prop: str
+    pattern: str
+
+    def _regex(self) -> re.Pattern:
+        esc = re.escape(self.pattern).replace("%", ".*").replace("_", ".")
+        return re.compile(f"^{esc}$")
+
+    def evaluate(self, batch):
+        c = np.asarray(_column(batch, self.prop))
+        rx = self._regex()
+        return np.array([bool(rx.match(str(v))) for v in c], dtype=bool)
+
+
+@dataclass(frozen=True)
+class IsNull(Filter):
+    """prop IS NULL — NaN for floats, sentinel '' for strings, NaT dates."""
+
+    prop: str
+
+    def evaluate(self, batch):
+        c = np.asarray(_column(batch, self.prop))
+        if c.dtype.kind == "f":
+            return np.isnan(c)
+        if _is_str_col(c):
+            return np.array([v == "" or v is None for v in c], dtype=bool)
+        return np.zeros(len(c), dtype=bool)
+
+
+@dataclass(frozen=True)
+class IdFilter(Filter):
+    """Feature-id lookup (ECQL `IN ('id1', 'id2')` without a property).
+
+    Reference: IdFilterStrategy / IdIndexKeySpace.
+    """
+
+    ids: tuple
+
+    def evaluate(self, batch):
+        fids = batch.get("__id__")
+        if fids is None:
+            raise KeyError("batch has no __id__ column for id filter")
+        return np.isin(np.asarray(fids), np.asarray(list(self.ids)))
+
+
+# ---------------------------------------------------------------------------
+# logical
+# ---------------------------------------------------------------------------
+
+
+def _flatten(cls, filters: Sequence[Filter]) -> tuple[Filter, ...]:
+    out: list[Filter] = []
+    for f in filters:
+        if isinstance(f, cls):
+            out.extend(f.filters)
+        else:
+            out.append(f)
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class And(Filter):
+    filters: tuple = ()
+
+    def __init__(self, filters: Sequence[Filter]):
+        object.__setattr__(self, "filters", _flatten(And, tuple(filters)))
+        if len(self.filters) < 1:
+            raise ValueError("And needs >= 1 children")
+
+    def evaluate(self, batch):
+        m = self.filters[0].evaluate(batch)
+        for f in self.filters[1:]:
+            m = m & f.evaluate(batch)
+        return m
+
+
+@dataclass(frozen=True)
+class Or(Filter):
+    filters: tuple = ()
+
+    def __init__(self, filters: Sequence[Filter]):
+        object.__setattr__(self, "filters", _flatten(Or, tuple(filters)))
+        if len(self.filters) < 1:
+            raise ValueError("Or needs >= 1 children")
+
+    def evaluate(self, batch):
+        m = self.filters[0].evaluate(batch)
+        for f in self.filters[1:]:
+            m = m | f.evaluate(batch)
+        return m
+
+
+@dataclass(frozen=True)
+class Not(Filter):
+    filter: Filter = None  # type: ignore[assignment]
+
+    def evaluate(self, batch):
+        return ~self.filter.evaluate(batch)

@@ -123,3 +123,9 @@ class TestQuerySideClamping:
         start = int(bt.from_binned(MAX_BIN - 1, 0))
         bins, lo, hi = bt.bins_for_interval(start, start * 10)
         assert bins[-1] == MAX_BIN and hi[-1] == bt.max_offset
+
+    def test_clamp_all_periods(self):
+        from geomesa_tpu.curve.binnedtime import BinnedTime, MAX_BIN
+        for period in ("day", "week", "month", "year"):
+            bins, lo, hi = BinnedTime(period).bins_for_interval(0, 10**18)
+            assert bins[-1] == MAX_BIN, period

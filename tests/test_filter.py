@@ -1,0 +1,215 @@
+"""Filter model tests: ECQL parsing, columnar evaluation vs hand-computed
+truth, and extraction algebra (geometries / intervals / ids / bounds).
+
+Reference analogues: geomesa-filter's FilterHelperTest / ECQL-driven tests.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu import filter as flt
+
+
+def batch(n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "geom": flt.PointColumn(rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        "dtg": rng.integers(1_500_000_000_000, 1_600_000_000_000, n),
+        "age": rng.integers(0, 100, n).astype(np.int32),
+        "score": rng.uniform(0, 1, n),
+        "name": np.array([f"user{i % 3}" for i in range(n)]),
+        "__id__": np.array([f"fid{i}" for i in range(n)]),
+    }
+
+
+class TestEcqlParse:
+    def test_bbox(self):
+        f = flt.parse("BBOX(geom, -10, -5, 10, 5)")
+        assert f == flt.BBox("geom", -10, -5, 10, 5)
+
+    def test_during(self):
+        f = flt.parse("dtg DURING 2018-01-01T00:00:00Z/2018-01-08T00:00:00Z")
+        assert isinstance(f, flt.During)
+        assert f.lo_ms == 1514764800000
+        assert f.hi_ms == 1514764800000 + 7 * 86400000
+
+    def test_and_or_not_precedence(self):
+        f = flt.parse("age > 5 AND age < 10 OR NOT name = 'x'")
+        assert isinstance(f, flt.Or)
+        assert isinstance(f.filters[0], flt.And)
+        assert isinstance(f.filters[1], flt.Not)
+
+    def test_intersects_wkt(self):
+        f = flt.parse("INTERSECTS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))")
+        assert isinstance(f, flt.Intersects)
+        assert isinstance(f.geom, geo.Polygon)
+        assert f.geom.bounds() == (0, 0, 10, 10)
+
+    def test_dwithin_units(self):
+        f = flt.parse("DWITHIN(geom, POINT (1 2), 111320, meters)")
+        assert isinstance(f, flt.DWithin)
+        assert f.dist == pytest.approx(1.0)
+
+    def test_in_and_id_in(self):
+        f = flt.parse("name IN ('a', 'b')")
+        assert f == flt.In("name", ("a", "b"))
+        f2 = flt.parse("IN ('fid1', 'fid2')")
+        assert f2 == flt.IdFilter(("fid1", "fid2"))
+
+    def test_between_dates(self):
+        f = flt.parse("dtg BETWEEN '2018-01-01T00:00:00' AND '2018-02-01T00:00:00'")
+        assert isinstance(f, flt.Between)
+        assert isinstance(f.lo, int) and f.lo == 1514764800000
+
+    def test_like_null_include(self):
+        assert flt.parse("name LIKE 'user%'") == flt.Like("name", "user%")
+        assert flt.parse("name IS NULL") == flt.IsNull("name")
+        assert flt.parse("INCLUDE") is flt.INCLUDE
+        assert flt.parse("EXCLUDE") is flt.EXCLUDE
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            flt.parse("BBOX(geom, 1, 2)")
+        with pytest.raises(ValueError):
+            flt.parse("age >")
+        with pytest.raises(ValueError):
+            flt.parse("age = 5 garbage")
+
+
+class TestEvaluate:
+    def test_bbox_points(self):
+        b = batch(500)
+        f = flt.parse("BBOX(geom, -50, -20, 30, 40)")
+        got = f.evaluate(b)
+        x, y = b["geom"].x, b["geom"].y
+        truth = (x >= -50) & (x <= 30) & (y >= -20) & (y <= 40)
+        assert np.array_equal(got, truth)
+
+    def test_temporal_and_attr(self):
+        b = batch(500)
+        lo, hi = 1_520_000_000_000, 1_560_000_000_000
+        f = flt.parse(
+            f"dtg DURING 2018-03-02T14:13:20Z/2019-06-09T16:53:20Z AND age >= 50"
+        )
+        got = f.evaluate(b)
+        truth = (b["dtg"] >= lo) & (b["dtg"] < hi) & (b["age"] >= 50)
+        assert np.array_equal(got, truth)
+
+    def test_or_not(self):
+        b = batch(200)
+        f = flt.parse("age < 10 OR NOT score <= 0.5")
+        truth = (b["age"] < 10) | ~(b["score"] <= 0.5)
+        assert np.array_equal(f.evaluate(b), truth)
+
+    def test_string_ops(self):
+        b = batch(30)
+        assert np.array_equal(
+            flt.parse("name = 'user1'").evaluate(b), b["name"] == "user1"
+        )
+        assert np.array_equal(
+            flt.parse("name IN ('user0', 'user2')").evaluate(b),
+            np.isin(b["name"], ["user0", "user2"]),
+        )
+        assert np.array_equal(
+            flt.parse("name LIKE 'user_'").evaluate(b), np.ones(30, dtype=bool)
+        )
+
+    def test_id_filter(self):
+        b = batch(10)
+        got = flt.parse("IN ('fid2', 'fid5')").evaluate(b)
+        assert list(np.nonzero(got)[0]) == [2, 5]
+
+    def test_intersects_points(self):
+        b = batch(300)
+        poly = geo.Polygon([(-50, -50), (50, -50), (0, 60)])
+        f = flt.Intersects("geom", poly)
+        got = f.evaluate(b)
+        truth = geo.points_in_polygon(b["geom"].x, b["geom"].y, poly)
+        # boundary-inclusive semantics may add grazing points; interior match
+        assert np.array_equal(got & truth, truth)
+        assert (got & ~truth).sum() <= 2
+
+    def test_packed_geometry_column(self):
+        polys = [geo.box(i * 10, 0, i * 10 + 5, 5) for i in range(5)]
+        b = {"geom": geo.PackedGeometryColumn.from_geometries(polys)}
+        got = flt.parse("BBOX(geom, 12, 1, 23, 4)").evaluate(b)
+        assert list(got) == [False, True, True, False, False]
+
+
+class TestExtraction:
+    def test_geometries_simple_bbox(self):
+        f = flt.parse("BBOX(geom, -10, -5, 10, 5) AND age > 3")
+        fv = flt.extract_geometries(f, "geom")
+        assert fv.precise and len(fv.values) == 1
+        assert fv.values[0].bounds() == (-10, -5, 10, 5)
+
+    def test_geometries_and_intersection(self):
+        f = flt.parse("BBOX(geom, 0, 0, 10, 10) AND BBOX(geom, 5, 5, 20, 20)")
+        fv = flt.extract_geometries(f, "geom")
+        assert len(fv.values) == 1
+        assert fv.values[0].bounds() == (5, 5, 10, 10)
+
+    def test_geometries_disjoint_and(self):
+        f = flt.parse("BBOX(geom, 0, 0, 1, 1) AND BBOX(geom, 5, 5, 6, 6)")
+        assert flt.extract_geometries(f, "geom").disjoint
+
+    def test_geometries_or_union(self):
+        f = flt.parse("BBOX(geom, 0, 0, 1, 1) OR BBOX(geom, 5, 5, 6, 6)")
+        fv = flt.extract_geometries(f, "geom")
+        assert len(fv.values) == 2
+
+    def test_geometries_or_unconstrained_branch(self):
+        f = flt.parse("BBOX(geom, 0, 0, 1, 1) OR age > 5")
+        assert flt.extract_geometries(f, "geom").empty
+
+    def test_polygon_kept_inside_box(self):
+        f = flt.parse(
+            "BBOX(geom, -100, -100, 100, 100) AND "
+            "INTERSECTS(geom, POLYGON ((0 0, 10 0, 5 10, 0 0)))"
+        )
+        fv = flt.extract_geometries(f, "geom")
+        assert len(fv.values) == 1
+        assert isinstance(fv.values[0], geo.Polygon)
+        assert fv.values[0].bounds() == (0, 0, 10, 10)
+        assert fv.precise
+
+    def test_intervals(self):
+        f = flt.parse(
+            "dtg DURING 2018-01-01T00:00:00Z/2018-02-01T00:00:00Z AND "
+            "dtg DURING 2018-01-15T00:00:00Z/2018-03-01T00:00:00Z"
+        )
+        fv = flt.extract_intervals(f, "dtg")
+        assert len(fv.values) == 1
+        iv = fv.values[0]
+        assert iv.lo == flt.parse_dt_millis("2018-01-15T00:00:00")
+        assert iv.hi == flt.parse_dt_millis("2018-02-01T00:00:00")
+
+    def test_intervals_one_sided(self):
+        f = flt.parse("dtg AFTER 2018-01-01T00:00:00Z")
+        fv = flt.extract_intervals(f, "dtg")
+        assert len(fv.values) == 1
+        assert fv.values[0].lo == flt.parse_dt_millis("2018-01-01T00:00:00") + 1
+
+    def test_intervals_or_merged(self):
+        f = flt.parse(
+            "dtg DURING 2018-01-01T00:00:00Z/2018-01-10T00:00:00Z OR "
+            "dtg DURING 2018-01-05T00:00:00Z/2018-01-20T00:00:00Z"
+        )
+        fv = flt.extract_intervals(f, "dtg")
+        assert len(fv.values) == 1
+
+    def test_ids(self):
+        f = flt.parse("IN ('a', 'b', 'c') AND IN ('b', 'c', 'd')")
+        assert flt.extract_ids(f).values == ["b", "c"]
+
+    def test_attribute_bounds(self):
+        f = flt.parse("age > 5 AND age <= 20")
+        fv = flt.extract_attribute_bounds(f, "age")
+        assert len(fv.values) == 1
+        b = fv.values[0]
+        assert (b.lo, b.lo_inclusive, b.hi, b.hi_inclusive) == (5, False, 20, True)
+
+    def test_attribute_bounds_disjoint(self):
+        f = flt.parse("age > 20 AND age < 10")
+        assert flt.extract_attribute_bounds(f, "age").disjoint
