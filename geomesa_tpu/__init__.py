@@ -20,5 +20,12 @@ __version__ = "0.1.0"
 
 from geomesa_tpu.sft import FeatureType, AttributeDescriptor
 from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
 
-__all__ = ["FeatureType", "AttributeDescriptor", "DataStore", "__version__"]
+__all__ = [
+    "FeatureType",
+    "AttributeDescriptor",
+    "DataStore",
+    "FeatureCollection",
+    "__version__",
+]

@@ -1,10 +1,188 @@
-"""DataStore: schema lifecycle + query entry point (placeholder, grows with
-the index/planner/scan layers). Reference: GeoMesaDataStore
-(/root/reference/geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/geotools/GeoMesaDataStore.scala:50).
+"""DataStore: schema lifecycle, ingest, and the query entry point.
+
+Reference: GeoMesaDataStore (/root/reference/geomesa-index-api/src/main/
+scala/org/locationtech/geomesa/index/geotools/GeoMesaDataStore.scala:50) +
+MetadataBackedDataStore. The TPU redesign keeps the lifecycle
+(create_schema -> write -> query) but the "backend" is in-process: each
+index is an HBM-resident sorted columnar IndexTable; queries run through
+QueryPlanner onto the device scan kernels.
+
+Index selection per schema mirrors GeoMesaFeatureIndexFactory.indices:
+points get Z3 (when a time attribute exists) + Z2; extent geometries get
+XZ3/XZ2; `index=true` attributes get attribute indexes; ids are always
+addressable (reference IdIndexKeySpace — here a host hash map, since an id
+lookup is pointer-chasing, not a scan).
 """
 
 from __future__ import annotations
 
+from typing import Iterable, Mapping, Optional, Sequence
 
-class DataStore:  # pragma: no cover - replaced as layers land
-    pass
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include
+from geomesa_tpu.index import XZ2Index, XZ3Index, Z2Index, Z3Index
+from geomesa_tpu.planning.explain import Explainer
+from geomesa_tpu.planning.planner import QueryGuardError, QueryPlan, QueryPlanner
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.storage.table import IndexTable
+
+
+class DataStore:
+    """In-process TPU-backed feature store."""
+
+    def __init__(self, block_full_table_scans: bool = False, tile: int | None = None):
+        self._schemas: dict[str, FeatureType] = {}
+        self._features: dict[str, FeatureCollection] = {}
+        self._indexes: dict[str, list] = {}
+        self._tables: dict[tuple[str, str], IndexTable] = {}
+        self._id_map: dict[str, dict[str, int]] = {}
+        self._stats: dict[str, object] = {}
+        self.block_full_table_scans = block_full_table_scans
+        self.tile = tile
+        self.planner = QueryPlanner(self)
+
+    # -- schema lifecycle (reference MetadataBackedDataStore) ------------
+    def create_schema(self, sft: "FeatureType | str", spec: str | None = None) -> FeatureType:
+        """Register a feature type. Accepts a FeatureType or (name, spec)."""
+        if isinstance(sft, str):
+            if spec is None:
+                raise ValueError("create_schema(name, spec) needs a spec string")
+            sft = FeatureType.from_spec(sft, spec)
+        if sft.name in self._schemas:
+            raise ValueError(f"schema {sft.name!r} already exists")
+        if sft.geom_field is None:
+            raise ValueError(f"schema {sft.name!r} has no geometry attribute")
+        self._schemas[sft.name] = sft
+        self._indexes[sft.name] = self._choose_indexes(sft)
+        self._id_map[sft.name] = {}
+        return sft
+
+    def _choose_indexes(self, sft: FeatureType) -> list:
+        indexes: list = []
+        if sft.is_points:
+            if sft.dtg_field is not None:
+                indexes.append(Z3Index(sft))
+            indexes.append(Z2Index(sft))
+        else:
+            if sft.dtg_field is not None:
+                indexes.append(XZ3Index(sft))
+            indexes.append(XZ2Index(sft))
+        return indexes
+
+    def get_schema(self, type_name: str) -> FeatureType:
+        return self._schemas[type_name]
+
+    def type_names(self) -> list[str]:
+        return sorted(self._schemas)
+
+    def delete_schema(self, type_name: str) -> None:
+        """Drop a schema and all its data (reference removeSchema)."""
+        self._schemas.pop(type_name)
+        self._features.pop(type_name, None)
+        self._id_map.pop(type_name, None)
+        self._stats.pop(type_name, None)
+        for idx in self._indexes.pop(type_name, []):
+            self._tables.pop((type_name, idx.name), None)
+
+    # -- ingest ----------------------------------------------------------
+    def write(
+        self,
+        type_name: str,
+        features: "FeatureCollection | Sequence[Mapping]",
+    ) -> int:
+        """Append a batch of features and rebuild the index tables.
+
+        Bulk-oriented like an LSM memtable flush: the batch is merged with
+        the existing collection and every index re-sorts. (The reference
+        gets incremental sorted inserts from the backing KV store; here a
+        sorted merge is a cheap device-friendly operation and batches are
+        the expected ingest unit.)
+        """
+        sft = self._schemas[type_name]
+        if not isinstance(features, FeatureCollection):
+            features = FeatureCollection.from_rows(sft, features)
+        if len(features) == 0:
+            return 0
+        existing = self._features.get(type_name)
+        merged = (
+            features if existing is None else FeatureCollection.concat([existing, features])
+        )
+        if len(set(merged.ids.tolist())) != len(merged):
+            raise ValueError("duplicate feature ids in write batch")
+        self._features[type_name] = merged
+        self._id_map[type_name] = {str(i): k for k, i in enumerate(merged.ids)}
+        for idx in self._indexes[type_name]:
+            keys = idx.write_keys(merged)
+            kwargs = {"tile": self.tile} if self.tile else {}
+            self._tables[(type_name, idx.name)] = IndexTable(idx, keys, **kwargs)
+        self._update_stats(type_name, merged)
+        return len(features)
+
+    def _update_stats(self, type_name: str, fc: FeatureCollection) -> None:
+        try:
+            from geomesa_tpu.stats.store import StatsStore
+        except ImportError:
+            self._stats[type_name] = None
+            return
+        self._stats[type_name] = StatsStore.build(self._schemas[type_name], fc)
+
+    # -- planner hooks ---------------------------------------------------
+    def indexes(self, type_name: str) -> list:
+        return self._indexes[type_name]
+
+    def table(self, type_name: str, index_name: str) -> IndexTable:
+        return self._tables[(type_name, index_name)]
+
+    def features(self, type_name: str) -> FeatureCollection:
+        fc = self._features.get(type_name)
+        if fc is None:
+            sft = self._schemas[type_name]
+            return FeatureCollection.from_rows(sft, [])
+        return fc
+
+    def id_lookup(self, type_name: str, ids: Iterable[str]) -> np.ndarray:
+        m = self._id_map.get(type_name, {})
+        return np.array([m[i] for i in ids if i in m], dtype=np.int64)
+
+    def stats_for(self, type_name: str):
+        return self._stats.get(type_name)
+
+    def guard_full_scan(self, type_name: str, f: Filter) -> None:
+        """Reference FullTableScanQueryGuard (planning/guard/
+        FullTableScanQueryGuard.scala:39-48): block unindexable scans when
+        configured."""
+        if self.block_full_table_scans and not isinstance(f, Include):
+            raise QueryGuardError(
+                f"query on {type_name!r} requires a full-table scan, which is "
+                "disabled (block_full_table_scans=True)"
+            )
+
+    # -- queries ---------------------------------------------------------
+    def query(
+        self,
+        type_name: str,
+        f: "Filter | str" = INCLUDE,
+        limit: Optional[int] = None,
+        explain: Explainer | None = None,
+    ) -> FeatureCollection:
+        """Run a query; returns the matching features as a collection."""
+        plan = self.planner.plan(type_name, f, limit=limit, explain=explain)
+        return self.planner.execute(plan, explain=explain)
+
+    def count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
+        """Exact hit count (scan + refine)."""
+        if isinstance(f, Include):
+            return len(self.features(type_name))
+        return len(self.query(type_name, f))
+
+    def explain(self, type_name: str, f: "Filter | str" = INCLUDE) -> str:
+        """Render the query plan trace without running the scan
+        (reference CLI `explain` command)."""
+        exp = Explainer()
+        plan = self.planner.plan(type_name, f, explain=exp)
+        exp(f"Plan: strategy={plan.strategy}")
+        if plan.config is not None and not plan.config.disjoint:
+            exp(f"Ranges: {plan.config.n_ranges}")
+        return exp.render()

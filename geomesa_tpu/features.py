@@ -1,0 +1,195 @@
+"""Columnar feature collections: the host-side batch representation.
+
+The reference moves features around as per-row SimpleFeature objects
+serialized with Kryo (/root/reference/geomesa-features/geomesa-feature-kryo/
+src/main/scala/org/locationtech/geomesa/features/kryo/KryoFeatureSerializer.scala:44-90).
+The TPU redesign is columnar end-to-end: a FeatureCollection is a
+struct-of-arrays batch (ids, one array per scalar attribute, geometry as a
+PointColumn or PackedGeometryColumn). This is both the ingest format and
+the query result format, and it is exactly the ``batch`` mapping the filter
+predicates evaluate over (geomesa_tpu.filter.predicates).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.filter.predicates import PointColumn
+from geomesa_tpu.sft import COLUMN_DTYPES, FeatureType
+
+
+def _date_to_millis(v) -> int:
+    """Accept int epoch-millis, numpy datetime64, or ISO-8601 string."""
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, np.datetime64):
+        return int(v.astype("datetime64[ms]").astype(np.int64))
+    if isinstance(v, str):
+        return int(np.datetime64(v.rstrip("Z"), "ms").astype(np.int64))
+    raise TypeError(f"cannot convert {type(v)} to epoch millis")
+
+
+@dataclass
+class FeatureCollection:
+    """A batch of features for one FeatureType, stored column-wise.
+
+    - ``ids``: numpy unicode array of feature ids
+    - ``columns``: attribute name -> numpy array (Date attrs = int64 millis,
+      strings = unicode arrays); the geometry attribute maps to a
+      PointColumn (point schemas) or PackedGeometryColumn (extents)
+    """
+
+    sft: FeatureType
+    ids: np.ndarray
+    columns: dict
+
+    def __post_init__(self):
+        n = len(self.ids)
+        for name, col in self.columns.items():
+            if len(col) != n:
+                raise ValueError(
+                    f"column {name!r} has {len(col)} rows, expected {n}"
+                )
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    @property
+    def batch(self) -> Mapping[str, object]:
+        """The mapping the filter predicates evaluate over."""
+        return {**self.columns, "__id__": self.ids}
+
+    @property
+    def geom_column(self):
+        g = self.sft.geom_field
+        return self.columns[g] if g else None
+
+    def geometries(self) -> list[geo.Geometry]:
+        col = self.geom_column
+        if col is None:
+            return []
+        if isinstance(col, PointColumn):
+            return [geo.Point(float(x), float(y)) for x, y in zip(col.x, col.y)]
+        return col.geometries()
+
+    def take(self, idx) -> "FeatureCollection":
+        idx = np.asarray(idx)
+        cols = {}
+        for name, col in self.columns.items():
+            if isinstance(col, PointColumn):
+                cols[name] = PointColumn(col.x[idx], col.y[idx])
+            elif isinstance(col, geo.PackedGeometryColumn):
+                cols[name] = col.take(idx)
+            else:
+                cols[name] = np.asarray(col)[idx]
+        return FeatureCollection(self.sft, self.ids[idx], cols)
+
+    def mask(self, m: np.ndarray) -> "FeatureCollection":
+        return self.take(np.nonzero(np.asarray(m))[0])
+
+    def to_rows(self) -> list[dict]:
+        """Expand to per-feature dicts (export / debugging)."""
+        geoms = {self.sft.geom_field: self.geometries()} if self.sft.geom_field else {}
+        rows = []
+        for i in range(len(self)):
+            row = {"__id__": str(self.ids[i])}
+            for name, col in self.columns.items():
+                if name in geoms:
+                    row[name] = geoms[name][i]
+                else:
+                    row[name] = col[i].item() if hasattr(col[i], "item") else col[i]
+            rows.append(row)
+        return rows
+
+    @staticmethod
+    def from_rows(sft: FeatureType, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> "FeatureCollection":
+        """Build from per-feature dicts: {attr: value, ...}.
+
+        Geometry values may be Geometry objects or WKT strings; dates may be
+        epoch millis, datetime64, or ISO strings. Missing ids are generated.
+        """
+        n = len(rows)
+        if ids is None:
+            ids = [str(r.get("__id__", i)) for i, r in enumerate(rows)]
+        cols: dict = {}
+        for attr in sft.attributes:
+            vals = [r.get(attr.name) for r in rows]
+            if attr.is_geometry:
+                geoms = [
+                    geo.from_wkt(v) if isinstance(v, str) else v for v in vals
+                ]
+                if sft.is_points and attr.name == sft.geom_field:
+                    xs = np.array([g.x for g in geoms], dtype=np.float64)
+                    ys = np.array([g.y for g in geoms], dtype=np.float64)
+                    cols[attr.name] = PointColumn(xs, ys)
+                else:
+                    cols[attr.name] = geo.PackedGeometryColumn.from_geometries(geoms)
+            elif attr.type == "Date":
+                cols[attr.name] = np.array(
+                    [_date_to_millis(v) for v in vals], dtype=np.int64
+                )
+            elif attr.type in COLUMN_DTYPES:
+                cols[attr.name] = np.array(vals, dtype=COLUMN_DTYPES[attr.type])
+            else:  # String / Bytes / UUID -> unicode
+                cols[attr.name] = np.array(
+                    ["" if v is None else str(v) for v in vals]
+                )
+        return FeatureCollection(sft, np.array([str(i) for i in ids]), cols)
+
+    @staticmethod
+    def from_columns(
+        sft: FeatureType,
+        ids: Sequence[str],
+        columns: Mapping[str, object],
+    ) -> "FeatureCollection":
+        """Build from pre-columnar data; geometry column may be (x, y) tuple
+        of arrays, a PointColumn, a PackedGeometryColumn, or a list of
+        Geometry objects."""
+        cols: dict = {}
+        for attr in sft.attributes:
+            col = columns[attr.name]
+            if attr.is_geometry:
+                if isinstance(col, (PointColumn, geo.PackedGeometryColumn)):
+                    cols[attr.name] = col
+                elif isinstance(col, tuple):
+                    cols[attr.name] = PointColumn(
+                        np.asarray(col[0], dtype=np.float64),
+                        np.asarray(col[1], dtype=np.float64),
+                    )
+                else:
+                    cols[attr.name] = geo.PackedGeometryColumn.from_geometries(col)
+            elif attr.type == "Date":
+                c = np.asarray(col)
+                if c.dtype.kind == "M":
+                    c = c.astype("datetime64[ms]").astype(np.int64)
+                cols[attr.name] = c.astype(np.int64)
+            elif attr.type in COLUMN_DTYPES:
+                cols[attr.name] = np.asarray(col, dtype=COLUMN_DTYPES[attr.type])
+            else:
+                cols[attr.name] = np.asarray(col)
+        return FeatureCollection(sft, np.asarray(ids), cols)
+
+    @staticmethod
+    def concat(parts: Sequence["FeatureCollection"]) -> "FeatureCollection":
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            raise ValueError("nothing to concat")
+        sft = parts[0].sft
+        ids = np.concatenate([p.ids for p in parts])
+        cols: dict = {}
+        for name in parts[0].columns:
+            vals = [p.columns[name] for p in parts]
+            if isinstance(vals[0], PointColumn):
+                cols[name] = PointColumn(
+                    np.concatenate([v.x for v in vals]),
+                    np.concatenate([v.y for v in vals]),
+                )
+            elif isinstance(vals[0], geo.PackedGeometryColumn):
+                cols[name] = geo.PackedGeometryColumn.concat(vals)
+            else:
+                cols[name] = np.concatenate(vals)
+        return FeatureCollection(sft, ids, cols)

@@ -290,6 +290,10 @@ class _Parser:
         # store planar degrees like the reference's fallback path
         if self.accept_punct(","):
             units = self.next().value.lower()
+            # two-word units: "statute miles" / "nautical miles"
+            nxt = self.peek()
+            if nxt is not None and nxt.kind == "word" and nxt.value.lower() == "miles":
+                units = f"{units} {self.next().value.lower()}"
             dist = _to_degrees(dist, units)
         self.expect_punct(")")
         return DWithin(prop, g, dist)
@@ -373,18 +377,23 @@ _METERS_PER_DEGREE = 111_320.0
 def _to_degrees(dist: float, units: str) -> float:
     """Convert a DWITHIN distance to approximate planar degrees at the
     equator (the reference treats geographic DWITHIN similarly loosely)."""
-    scale = {
+    scales = {
         "meters": 1.0,
         "m": 1.0,
         "kilometers": 1000.0,
         "km": 1000.0,
         "feet": 0.3048,
-        "statute": 1609.34,
+        "ft": 0.3048,
+        "statute miles": 1609.34,
         "miles": 1609.34,
-        "nautical": 1852.0,
+        "mi": 1609.34,
+        "nautical miles": 1852.0,
+        "nm": 1852.0,
         "degrees": _METERS_PER_DEGREE,
-    }.get(units, _METERS_PER_DEGREE)
-    return dist * scale / _METERS_PER_DEGREE
+    }
+    if units not in scales:
+        raise ValueError(f"unknown DWITHIN units {units!r}")
+    return dist * scales[units] / _METERS_PER_DEGREE
 
 
 def parse(text: str) -> Filter:

@@ -73,6 +73,25 @@ class FilterValues(Generic[T]):
         return FilterValues(values=[], disjoint=True)
 
 
+def _references_prop(f: Filter, prop: str) -> bool:
+    """Does any predicate in the tree constrain ``prop``?"""
+    if isinstance(f, (And, Or)):
+        return any(_references_prop(c, prop) for c in f.filters)
+    if isinstance(f, Not):
+        return _references_prop(f.filter, prop)
+    return getattr(f, "prop", None) == prop
+
+
+def _imprecise_children(parts, children, prop) -> bool:
+    """True when some child contributed no extractable values but still
+    constrains the property (e.g. a NOT branch): the combined values are
+    then a superset, not exact."""
+    return any(
+        not p.values and not p.disjoint and _references_prop(c, prop)
+        for p, c in zip(parts, children)
+    )
+
+
 # ---------------------------------------------------------------------------
 # geometry extraction
 # ---------------------------------------------------------------------------
@@ -103,15 +122,15 @@ def extract_geometries(f: Filter, prop: str) -> FilterValues:
         g, precise = single
         return FilterValues(values=[g], precise=precise)
     if isinstance(f, And):
-        parts = [extract_geometries(c, prop) for c in f.filters]
-        parts = [p for p in parts if not p.empty or p.disjoint]
-        if any(p.disjoint for p in parts):
+        all_parts = [extract_geometries(c, prop) for c in f.filters]
+        if any(p.disjoint for p in all_parts):
             return FilterValues.disjoint_()
-        parts = [p for p in parts if p.values]
+        # a child constraining prop without extractable values (e.g. NOT)
+        # makes the extraction a superset, not exact
+        imprecise = _imprecise_children(all_parts, f.filters, prop)
+        parts = [p for p in all_parts if p.values]
         if not parts:
             return FilterValues.nothing()
-        if len(parts) == 1:
-            return parts[0]
         # AND of spatial constraints: intersect via bbox intersection; keep
         # the exact geometry when one side is a covering box of the other
         out = parts[0]
@@ -119,6 +138,8 @@ def extract_geometries(f: Filter, prop: str) -> FilterValues:
             out = _intersect_geom_values(out, p)
             if out.disjoint:
                 return out
+        if imprecise:
+            out = FilterValues(values=out.values, precise=False)
         return out
     if isinstance(f, Or):
         parts = [extract_geometries(c, prop) for c in f.filters]
@@ -249,10 +270,11 @@ def extract_intervals(f: Filter, prop: str) -> FilterValues:
             return FilterValues.disjoint_()
         return FilterValues(values=[iv], precise=precise)
     if isinstance(f, And):
-        parts = [extract_intervals(c, prop) for c in f.filters]
-        if any(p.disjoint for p in parts):
+        all_parts = [extract_intervals(c, prop) for c in f.filters]
+        if any(p.disjoint for p in all_parts):
             return FilterValues.disjoint_()
-        parts = [p for p in parts if p.values]
+        imprecise = _imprecise_children(all_parts, f.filters, prop)
+        parts = [p for p in all_parts if p.values]
         if not parts:
             return FilterValues.nothing()
         out = parts[0]
@@ -266,6 +288,8 @@ def extract_intervals(f: Filter, prop: str) -> FilterValues:
             if not merged:
                 return FilterValues.disjoint_()
             out = FilterValues(values=merged, precise=out.precise and p.precise)
+        if imprecise:
+            out = FilterValues(values=out.values, precise=False)
         return out
     if isinstance(f, Or):
         parts = [extract_intervals(c, prop) for c in f.filters]
@@ -359,10 +383,11 @@ def extract_attribute_bounds(f: Filter, prop: str) -> FilterValues:
     if isinstance(f, In) and f.prop == prop:
         return FilterValues(values=[Bounds(v, v) for v in f.values])
     if isinstance(f, And):
-        parts = [extract_attribute_bounds(c, prop) for c in f.filters]
-        if any(p.disjoint for p in parts):
+        all_parts = [extract_attribute_bounds(c, prop) for c in f.filters]
+        if any(p.disjoint for p in all_parts):
             return FilterValues.disjoint_()
-        parts = [p for p in parts if p.values]
+        imprecise = _imprecise_children(all_parts, f.filters, prop)
+        parts = [p for p in all_parts if p.values]
         if not parts:
             return FilterValues.nothing()
         out = parts[0]
@@ -376,6 +401,8 @@ def extract_attribute_bounds(f: Filter, prop: str) -> FilterValues:
             if not merged:
                 return FilterValues.disjoint_()
             out = FilterValues(values=merged, precise=out.precise and p.precise)
+        if imprecise:
+            out = FilterValues(values=out.values, precise=False)
         return out
     if isinstance(f, Or):
         parts = [extract_attribute_bounds(c, prop) for c in f.filters]

@@ -160,17 +160,21 @@ class Intersects(Filter):
         col = _column(batch, self.prop)
         g = self.geom
         if isinstance(col, PointColumn):
+            # vectorized bbox prefilter bounds the per-point work to
+            # near-hit points (the Python loops below are exact but slow)
+            x0, y0, x1, y1 = g.bounds()
+            near = (col.x >= x0) & (col.x <= x1) & (col.y >= y0) & (col.y <= y1)
             if isinstance(g, (geo.Polygon, geo.MultiPolygon)):
-                inside = geo.points_in_polygon(col.x, col.y, g)
+                inside = np.zeros(len(col), dtype=bool)
+                ni = np.nonzero(near)[0]
+                inside[ni] = geo.points_in_polygon(col.x[ni], col.y[ni], g)
                 # boundary counts for intersects
-                edge = ~inside
-                if edge.any():
-                    for i in np.nonzero(edge)[0]:
-                        if geo._point_on_rings(g, float(col.x[i]), float(col.y[i])):
-                            inside[i] = True
+                for i in ni[~inside[ni]]:
+                    if geo._point_on_rings(g, float(col.x[i]), float(col.y[i])):
+                        inside[i] = True
                 return inside
             out = np.zeros(len(col), dtype=bool)
-            for i in range(len(col)):
+            for i in np.nonzero(near)[0]:
                 out[i] = geo.intersects(geo.Point(float(col.x[i]), float(col.y[i])), g)
             return out
         if isinstance(col, geo.PackedGeometryColumn):

@@ -405,7 +405,13 @@ def _read_wkb(buf: memoryview, pos: int) -> tuple[Geometry, int]:
     endian = "<" if byte_order == 1 else ">"
     (code,) = struct.unpack_from(endian + "I", buf, pos + 1)
     pos += 5
-    code &= 0xFF  # strip any SRID/dimension flags
+    if code & 0x20000000:  # EWKB SRID flag: skip the 4-byte SRID payload
+        pos += 4
+    if code & 0xC0000000:  # EWKB Z/M flags: 3-/4-D coords unsupported
+        raise ValueError(f"unsupported WKB dimension flags in type 0x{code:x}")
+    code &= 0x1FFFFFFF
+    if code > MULTIPOLYGON:  # ISO WKB Z/M variants (1001, 2001, ...) too
+        raise ValueError(f"unsupported WKB geometry type {code}")
 
     def read_pts(pos: int) -> tuple[np.ndarray, int]:
         (n,) = struct.unpack_from(endian + "I", buf, pos)
@@ -565,8 +571,76 @@ class PackedGeometryColumn:
         return [self.geometry(i) for i in range(len(self))]
 
     def take(self, idx: np.ndarray) -> "PackedGeometryColumn":
-        """Subset by geometry indices (used when gathering query results)."""
-        return PackedGeometryColumn.from_geometries([self.geometry(int(i)) for i in idx])
+        """Subset by geometry indices (used when gathering query results).
+
+        Pure array surgery — slices the nested offsets without
+        materializing host geometry objects (this runs on every extent
+        query's result gather).
+        """
+        idx = np.asarray(idx, dtype=np.int64)
+
+        def expand(starts, ends):
+            """Concatenate aranges [starts[i], ends[i]) -> flat index list."""
+            lens = ends - starts
+            if len(lens) == 0 or lens.sum() == 0:
+                return np.zeros(0, dtype=np.int64), np.zeros(1, dtype=np.int32)
+            flat = np.repeat(starts - np.concatenate([[0], np.cumsum(lens)[:-1]]), lens) + np.arange(lens.sum())
+            offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+            return flat, offsets
+
+        p_flat, gpo = expand(
+            self.geom_part_offsets[idx].astype(np.int64),
+            self.geom_part_offsets[idx + 1].astype(np.int64),
+        )
+        r_flat, pro = expand(
+            self.part_ring_offsets[p_flat].astype(np.int64),
+            self.part_ring_offsets[p_flat + 1].astype(np.int64),
+        )
+        c_flat, ro = expand(
+            self.ring_offsets[r_flat].astype(np.int64),
+            self.ring_offsets[r_flat + 1].astype(np.int64),
+        )
+        return PackedGeometryColumn(
+            coords=self.coords[c_flat],
+            ring_offsets=ro,
+            part_ring_offsets=pro,
+            geom_part_offsets=gpo,
+            types=self.types[idx],
+            bboxes=self.bboxes[idx],
+        )
+
+    @staticmethod
+    def concat(cols: Sequence["PackedGeometryColumn"]) -> "PackedGeometryColumn":
+        """Concatenate columns by shifting the nested offset arrays."""
+        cols = list(cols)
+        if len(cols) == 1:
+            return cols[0]
+
+        def stack_offsets(arrays, shifts):
+            out = [arrays[0]]
+            for a, s in zip(arrays[1:], shifts[1:]):
+                out.append(a[1:].astype(np.int64) + s)
+            return np.concatenate(out).astype(np.int32)
+
+        coord_shift = np.concatenate([[0], np.cumsum([len(c.coords) for c in cols])])
+        ring_shift = np.concatenate(
+            [[0], np.cumsum([len(c.ring_offsets) - 1 for c in cols])]
+        )
+        part_shift = np.concatenate(
+            [[0], np.cumsum([len(c.part_ring_offsets) - 1 for c in cols])]
+        )
+        return PackedGeometryColumn(
+            coords=np.concatenate([c.coords for c in cols], axis=0),
+            ring_offsets=stack_offsets([c.ring_offsets for c in cols], coord_shift),
+            part_ring_offsets=stack_offsets(
+                [c.part_ring_offsets for c in cols], ring_shift
+            ),
+            geom_part_offsets=stack_offsets(
+                [c.geom_part_offsets for c in cols], part_shift
+            ),
+            types=np.concatenate([c.types for c in cols]),
+            bboxes=np.concatenate([c.bboxes for c in cols], axis=0),
+        )
 
 
 def pad_polygon(poly: "Polygon | MultiPolygon", max_verts: int):
@@ -785,9 +859,10 @@ def _point_on_rings(g: Geometry, x: float, y: float) -> bool:
 
 
 def contains(a: Geometry, b: Geometry) -> bool:
-    """Does polygonal `a` contain `b`? (interior-only approximation: all of
-    b's vertices inside a and no boundary crossing — the JTS `contains` for
-    the cases the query path needs: polygon contains point/line/polygon)."""
+    """Does polygonal `a` contain `b`? (all of b's vertices inside a, no
+    boundary crossing, and no hole of `a` lying inside b — the JTS
+    `contains` for the cases the query path needs: polygon contains
+    point/line/polygon)."""
     if not isinstance(a, (Polygon, MultiPolygon)):
         raise ValueError("contains() requires a polygonal left operand")
     if isinstance(b, Point):
@@ -797,7 +872,20 @@ def contains(a: Geometry, b: Geometry) -> bool:
     verts = np.concatenate(_rings_of(b), axis=0)
     if not bool(points_in_polygon(verts[:, 0], verts[:, 1], a).all()):
         return False
-    return not _any_edge_intersection(a, b)
+    if _any_edge_intersection(a, b):
+        return False
+    # a hole of `a` strictly inside b excludes part of b's interior even
+    # though no vertex of b touches it and no edges cross
+    if isinstance(b, (Polygon, MultiPolygon)):
+        holes = (
+            a.holes
+            if isinstance(a, Polygon)
+            else [h for p in a.parts for h in p.holes]
+        )
+        for h in holes:
+            if bool(points_in_polygon(h[:-1, 0], h[:-1, 1], b).any()):
+                return False
+    return True
 
 
 def distance(a: Geometry, b: Geometry) -> float:

@@ -1,0 +1,28 @@
+"""Index layer: key spaces mapping features to sort keys and filters to
+scan configurations.
+
+The reference's index layer (geomesa-index-api, SURVEY.md §2.2) centers on
+`IndexKeySpace[T, U]`: write-side `toIndexKey` and read-side
+`getIndexValues`/`getRanges` (/root/reference/geomesa-index-api/src/main/
+scala/org/locationtech/geomesa/index/api/IndexKeySpace.scala:23-109).
+The TPU redesign keeps that contract but inverts the storage: instead of
+byte-string rows in a KV store, a key space produces (bin, z) *sort keys*
+for a device-resident columnar table plus the device scan predicate that
+replaces the server-side row filter (Z3Filter et al.).
+"""
+
+from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
+from geomesa_tpu.index.z2 import Z2Index
+from geomesa_tpu.index.z3 import Z3Index
+from geomesa_tpu.index.xz2 import XZ2Index
+from geomesa_tpu.index.xz3 import XZ3Index
+
+__all__ = [
+    "IndexKeySpace",
+    "ScanConfig",
+    "WriteKeys",
+    "Z2Index",
+    "Z3Index",
+    "XZ2Index",
+    "XZ3Index",
+]

@@ -1,0 +1,110 @@
+"""Index key space API: write keys + scan configuration.
+
+Reference contract: IndexKeySpace.toIndexKey / getIndexValues / getRanges /
+useFullFilter (/root/reference/geomesa-index-api/src/main/scala/org/
+locationtech/geomesa/index/api/IndexKeySpace.scala:23-109). Here the write
+side emits columnar sort keys and device columns; the read side emits a
+`ScanConfig` = host z-ranges (for tile pruning over the sorted table) plus
+the device predicate arrays (the Z3Filter analogue, evaluated as one
+vectorized mask over gathered tiles).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, runtime_checkable
+
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import Filter
+from geomesa_tpu.sft import FeatureType
+
+
+@dataclass
+class WriteKeys:
+    """Write-side output of a key space for a batch of features.
+
+    - ``bins``: int32 [n] — coarse sort key (time bin; 0 for atemporal)
+    - ``zs``:   uint64 [n] — fine sort key (z / xz sequence code)
+    - ``device_cols``: name -> numpy array [n], the columns the scan kernel
+      tests (f32 coords / i32 time parts / f32 bboxes)
+    """
+
+    bins: np.ndarray
+    zs: np.ndarray
+    device_cols: dict
+
+
+@dataclass
+class ScanConfig:
+    """Read-side output: how to scan one index for one filter.
+
+    - ``range_bins``/``range_lo``/``range_hi``: parallel arrays of covering
+      z-ranges, inclusive, grouped per time bin (tile pruning input)
+    - ``boxes``: f32 [B, 4] spatial boxes (xmin, ymin, xmax, ymax), widened
+      one f32 ulp outward so the device mask never drops a true hit
+    - ``windows``: i32 [W, 3] (bin, off_lo, off_hi) inclusive time windows,
+      or None for atemporal indexes
+    - ``extent_mode``: device test is bbox-*intersects* against per-feature
+      bboxes (XZ indexes) rather than point-in-box
+    - ``geom_precise``/``time_precise``: the device mask exactly answers the
+      spatial/temporal constraint up to f32 widening (residual host
+      refinement still applies exactness; these gate the `loose` fast path)
+    """
+
+    index: str
+    range_bins: np.ndarray
+    range_lo: np.ndarray
+    range_hi: np.ndarray
+    boxes: Optional[np.ndarray]
+    windows: Optional[np.ndarray]
+    extent_mode: bool = False
+    geom_precise: bool = True
+    time_precise: bool = True
+    disjoint: bool = False
+
+    @staticmethod
+    def empty(index: str) -> "ScanConfig":
+        """A config for an unsatisfiable filter (returns nothing)."""
+        return ScanConfig(
+            index=index,
+            range_bins=np.zeros(0, np.int32),
+            range_lo=np.zeros(0, np.uint64),
+            range_hi=np.zeros(0, np.uint64),
+            boxes=None,
+            windows=None,
+            disjoint=True,
+        )
+
+    @property
+    def n_ranges(self) -> int:
+        return len(self.range_bins)
+
+
+def widen_boxes(bounds) -> np.ndarray:
+    """f64 boxes -> f32 boxes widened one ulp outward (superset semantics)."""
+    b = np.asarray(bounds, dtype=np.float64).reshape(-1, 4)
+    lo = np.nextafter(b[:, :2].astype(np.float32), np.float32(-np.inf))
+    hi = np.nextafter(b[:, 2:].astype(np.float32), np.float32(np.inf))
+    return np.concatenate([lo, hi], axis=1).astype(np.float32)
+
+
+@runtime_checkable
+class IndexKeySpace(Protocol):
+    """One logical index over a feature type."""
+
+    name: str
+
+    def supports(self, sft: FeatureType) -> bool:
+        """Can this index be built for the schema?"""
+        ...
+
+    def write_keys(self, fc: FeatureCollection) -> WriteKeys:
+        """Sort keys + device columns for a batch (reference toIndexKey)."""
+        ...
+
+    def scan_config(self, f: Filter) -> Optional[ScanConfig]:
+        """Scan configuration for a filter, or None when this index cannot
+        serve it (reference getIndexValues + getRanges)."""
+        ...

@@ -1,0 +1,70 @@
+"""Z2 index: z-order keys for point features, no time dimension.
+
+Reference: Z2IndexKeySpace (/root/reference/geomesa-index-api/src/main/
+scala/org/locationtech/geomesa/index/z2/Z2IndexKeySpace.scala) and the
+server-side Z2Filter (index/filters/Z2Filter.scala). Bin is constant 0 so
+the sorted table is ordered purely by z2.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from geomesa_tpu.curve.z2sfc import Z2SFC
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.extract import extract_geometries, geometry_bounds
+from geomesa_tpu.filter.predicates import Filter, PointColumn
+from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.sft import FeatureType
+
+
+class Z2Index:
+    """Spatial-only point index."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        self.name = "z2"
+        self.geom = sft.geom_field
+        self.sfc = Z2SFC()
+
+    def supports(self, sft: FeatureType) -> bool:
+        return sft.is_points
+
+    def write_keys(self, fc: FeatureCollection) -> WriteKeys:
+        col = fc.columns[self.geom]
+        if not isinstance(col, PointColumn):
+            raise TypeError("z2 index requires a point geometry column")
+        z = self.sfc.index(col.x, col.y)
+        n = len(col)
+        return WriteKeys(
+            bins=np.zeros(n, dtype=np.int32),
+            zs=z.astype(np.uint64),
+            device_cols={
+                "x": col.x.astype(np.float32),
+                "y": col.y.astype(np.float32),
+            },
+        )
+
+    def scan_config(self, f: Filter) -> Optional[ScanConfig]:
+        geoms = extract_geometries(f, self.geom)
+        if geoms.disjoint:
+            return ScanConfig.empty(self.name)
+        if not geoms.values:
+            return None  # no spatial constraint: a z2 scan would be full-table
+        bounds = geometry_bounds(geoms)
+        ranges = self.sfc.ranges(bounds)
+        if not ranges:
+            return ScanConfig.empty(self.name)
+        from geomesa_tpu.index.z3 import _bounds_only
+
+        return ScanConfig(
+            index=self.name,
+            range_bins=np.zeros(len(ranges), dtype=np.int32),
+            range_lo=np.array([r.lower for r in ranges], dtype=np.uint64),
+            range_hi=np.array([r.upper for r in ranges], dtype=np.uint64),
+            boxes=widen_boxes(bounds),
+            windows=None,
+            geom_precise=geoms.precise and _bounds_only(geoms.values),
+        )

@@ -1,0 +1,11 @@
+"""Query planning: filter -> index selection -> scan plan -> execution.
+
+The reference's planning package (/root/reference/geomesa-index-api/src/
+main/scala/org/locationtech/geomesa/index/planning/): QueryPlanner
+orchestrates FilterSplitter -> StrategyDecider -> QueryPlan -> scan.
+"""
+
+from geomesa_tpu.planning.explain import Explainer
+from geomesa_tpu.planning.planner import QueryPlan, QueryPlanner
+
+__all__ = ["Explainer", "QueryPlan", "QueryPlanner"]

@@ -1,0 +1,59 @@
+"""Explainer: tree-structured query-plan tracing.
+
+Reference: /root/reference/geomesa-index-api/src/main/scala/org/
+locationtech/geomesa/index/utils/Explainer.scala — nested push/pop spans
+surfaced by the CLI `explain` command. Same shape here: an Explainer
+collects indented lines; ExplainString renders them, ExplainNull is the
+no-op used on the hot path.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class Explainer:
+    """Collects an indented plan trace."""
+
+    def __init__(self):
+        self._lines: list[str] = []
+        self._depth = 0
+
+    def __call__(self, msg: str) -> "Explainer":
+        self._lines.append("  " * self._depth + str(msg))
+        return self
+
+    @contextmanager
+    def span(self, msg: str):
+        """Nested section with wall-clock timing (MethodProfiling.profile)."""
+        self(msg)
+        self._depth += 1
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = (time.perf_counter() - t0) * 1e3
+            self(f"took {dt:.2f}ms")
+            self._depth -= 1
+
+    def render(self) -> str:
+        return "\n".join(self._lines)
+
+    @property
+    def lines(self) -> list[str]:
+        return list(self._lines)
+
+
+class ExplainNull(Explainer):
+    """No-op explainer for the hot path."""
+
+    def __call__(self, msg: str) -> "Explainer":
+        return self
+
+    @contextmanager
+    def span(self, msg: str):
+        yield self
+
+    def render(self) -> str:
+        return ""

@@ -1,0 +1,156 @@
+"""QueryPlanner: pick an index, build a scan plan, execute, refine.
+
+Reference call stack (SURVEY.md §3.1): QueryPlanner.runQuery ->
+StrategyDecider.getFilterPlan -> keySpace.getIndexValues/getRanges ->
+adapter.createQueryPlan -> scan -> client-side reduce
+(/root/reference/geomesa-index-api/src/main/scala/org/locationtech/
+geomesa/index/planning/QueryPlanner.scala:40-161, StrategyDecider.scala:
+47-181). The TPU pipeline: extract filter values -> per-index ScanConfig ->
+priority/cost selection -> tile-pruned device scan -> host gather ->
+residual full-filter refinement (the `useFullFilter` tier, always exact
+f64) -> limit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter import ecql
+from geomesa_tpu.filter.extract import extract_ids
+from geomesa_tpu.filter.predicates import Filter, Include
+from geomesa_tpu.index.api import ScanConfig
+from geomesa_tpu.planning.explain import Explainer, ExplainNull
+
+# index selection priority when multiple indexes can serve a filter;
+# mirrors the reference's cost multipliers (SpatioTemporalFilterStrategy:
+# z3 = 1.1 with bounded time; SpatialFilterStrategy z2 = 2.0; attribute =
+# 1.0 with equality...). Lower = preferred.
+INDEX_PRIORITY = {"z3": 1.1, "xz3": 1.1, "z2": 2.0, "xz2": 2.0, "attr": 2.5, "id": 0.5}
+
+
+@dataclass
+class QueryPlan:
+    """A chosen execution strategy for one query."""
+
+    type_name: str
+    filter: Filter
+    index: Optional[str]  # None = full-table host scan
+    config: Optional[ScanConfig]
+    ids: Optional[list] = None  # id-lookup plan
+    limit: Optional[int] = None
+
+    @property
+    def strategy(self) -> str:
+        if self.ids is not None:
+            return "id-lookup"
+        if self.index is None:
+            return "full-scan"
+        return self.index
+
+
+class QueryGuardError(Exception):
+    """A query guard rejected the plan (reference planning/guard/)."""
+
+
+class QueryPlanner:
+    """Plans and runs queries for one DataStore."""
+
+    def __init__(self, store):
+        self.store = store
+
+    # -- planning --------------------------------------------------------
+    def plan(
+        self,
+        type_name: str,
+        f: "Filter | str",
+        limit: Optional[int] = None,
+        explain: Explainer | None = None,
+    ) -> QueryPlan:
+        exp = explain or ExplainNull()
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        exp(f"Planning query on '{type_name}': {type(f).__name__}")
+
+        # id filters take absolute priority (reference IdFilterStrategy)
+        ids = extract_ids(f)
+        if ids.disjoint:
+            exp("Id extraction: disjoint -> empty plan")
+            return QueryPlan(type_name, f, None, ScanConfig.empty("id"), ids=[])
+        if ids.values:
+            exp(f"Strategy: id-lookup ({len(ids.values)} ids)")
+            return QueryPlan(type_name, f, "id", None, ids=list(ids.values), limit=limit)
+
+        indexes = self.store.indexes(type_name)
+        options: list[tuple[float, str, ScanConfig]] = []
+        for idx in indexes:
+            cfg = idx.scan_config(f)
+            if cfg is None:
+                continue
+            if cfg.disjoint:
+                exp(f"Index {idx.name}: filter disjoint -> empty plan")
+                return QueryPlan(type_name, f, idx.name, cfg, limit=limit)
+            cost = self.cost(type_name, idx.name, cfg, exp)
+            options.append((cost, idx.name, cfg))
+            exp(
+                f"Index {idx.name}: {cfg.n_ranges} ranges, cost {cost:.1f}"
+            )
+        if not options:
+            exp("Strategy: full-table host scan (no index serves this filter)")
+            self.store.guard_full_scan(type_name, f)
+            return QueryPlan(type_name, f, None, None, limit=limit)
+        options.sort(key=lambda o: o[0])
+        cost, name, cfg = options[0]
+        exp(f"Strategy: {name} (cost {cost:.1f})")
+        return QueryPlan(type_name, f, name, cfg, limit=limit)
+
+    def cost(self, type_name: str, index_name: str, cfg: ScanConfig, exp) -> float:
+        """Cost = estimated scan size x index multiplier. With stats
+        available this uses sketch-based count estimates (reference
+        CostBasedStrategyDecider, StrategyDecider.scala:143-180); without,
+        the priority constant alone decides."""
+        mult = INDEX_PRIORITY.get(index_name, 3.0)
+        stats = self.store.stats_for(type_name)
+        if stats is not None:
+            est = stats.estimate_scan(index_name, cfg)
+            if est is not None:
+                return est * mult
+        return mult
+
+    # -- execution -------------------------------------------------------
+    def execute(
+        self, plan: QueryPlan, explain: Explainer | None = None
+    ) -> FeatureCollection:
+        exp = explain or ExplainNull()
+        fc = self.store.features(plan.type_name)
+
+        if plan.ids is not None:  # id lookup
+            ordinals = self.store.id_lookup(plan.type_name, plan.ids)
+            candidates = fc.take(ordinals)
+        elif plan.index is None:  # full host scan
+            with exp.span("Full-table host scan"):
+                mask = plan.filter.evaluate(fc.batch)
+            out = fc.mask(mask)
+            return out.take(np.arange(min(len(out), plan.limit))) if plan.limit else out
+        else:
+            table = self.store.table(plan.type_name, plan.index)
+            with exp.span(f"Device scan [{plan.index}]"):
+                cap = plan.limit if plan.limit else 4096
+                ordinals = table.scan(plan.config, cap_hint=max(cap, 4096))
+            exp(f"Candidates: {len(ordinals)}")
+            candidates = fc.take(ordinals)
+
+        # residual refinement: always re-apply the full filter on host (f64
+        # exact) — device masks are widened supersets; this also evaluates
+        # any non-indexed predicates (the reference's ECQL iterator tier)
+        if not isinstance(plan.filter, Include):
+            with exp.span("Residual filter refinement"):
+                mask = plan.filter.evaluate(candidates.batch)
+            candidates = candidates.mask(mask)
+        exp(f"Hits: {len(candidates)}")
+        if plan.limit is not None and len(candidates) > plan.limit:
+            candidates = candidates.take(np.arange(plan.limit))
+        return candidates

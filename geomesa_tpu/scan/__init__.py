@@ -1,0 +1,7 @@
+"""Device scan kernels: the TPU analogue of the reference's server-side
+iterator/filter tier (Accumulo iterators, HBase filters — SURVEY.md §2.4).
+"""
+
+from geomesa_tpu.scan.kernels import tile_scan, tile_count
+
+__all__ = ["tile_scan", "tile_count"]

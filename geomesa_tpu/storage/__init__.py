@@ -1,0 +1,11 @@
+"""Storage layer: device-resident columnar tables sorted by index key.
+
+The TPU analogue of the reference's backend tier (SURVEY.md §2.4): instead
+of rows in a distributed KV store, each index owns a struct-of-arrays
+table in HBM sorted by (bin, z), scanned by the kernels in
+geomesa_tpu.scan.
+"""
+
+from geomesa_tpu.storage.table import IndexTable
+
+__all__ = ["IndexTable"]

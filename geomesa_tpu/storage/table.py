@@ -1,0 +1,187 @@
+"""IndexTable: one index's sorted, device-resident columnar table.
+
+The reference materializes each index as a sorted KV table (Accumulo/HBase
+tablets; write path Z3IndexKeySpace.toIndexKey + IndexWriter, /root/
+reference/geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/
+z3/Z3IndexKeySpace.scala:63-95). Here the same logical layout is a
+struct-of-arrays table sorted lexicographically by (bin, z):
+
+- host side: the sort keys (bins i32, zs u64), the per-bin segment offsets,
+  and the permutation back to the backing FeatureCollection — used for
+  range -> row-span -> tile pruning (the analogue of seeking scan ranges in
+  a tablet server);
+- device side: the predicate columns the scan kernel tests, padded to a
+  multiple of the tile size with never-matching sentinels and pushed to
+  device memory once at build.
+
+Mutability: like an LSM store, appends land in the build path (write() in
+the DataStore concatenates + re-sorts the delta with the existing table —
+the Lambda-store hot/cold pattern; see geomesa_tpu.datastore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
+from geomesa_tpu.scan import kernels
+
+DEFAULT_TILE = 2048
+# tile-prune only when candidates are under this fraction of the table;
+# past it a straight linear scan is cheaper than a big gather
+FULL_SCAN_FRACTION = 0.5
+
+_SENTINELS = {
+    "x": np.float32(np.inf),
+    "y": np.float32(np.inf),
+    "gxmin": np.float32(np.inf),
+    "gymin": np.float32(np.inf),
+    "gxmax": np.float32(-np.inf),
+    "gymax": np.float32(-np.inf),
+    "tbin": np.int32(-1),
+    "toff": np.int32(0),
+}
+
+
+class IndexTable:
+    """Sorted columnar table for one (feature type, index) pair."""
+
+    def __init__(
+        self,
+        keyspace: IndexKeySpace,
+        keys: WriteKeys,
+        tile: int = DEFAULT_TILE,
+        device=None,
+    ):
+        self.keyspace = keyspace
+        self.tile = tile
+        n = len(keys.bins)
+        self.n = n
+
+        order = np.lexsort((keys.zs, keys.bins))
+        self.bins = keys.bins[order]
+        self.zs = keys.zs[order]
+        self.perm = order.astype(np.int64)  # table row -> feature ordinal
+
+        # per-bin segments for searchsorted pruning
+        self.ubins, starts = np.unique(self.bins, return_index=True)
+        self.bin_starts = np.append(starts, n).astype(np.int64)
+
+        # device columns, padded to a whole number of tiles
+        n_pad = max(tile, ((n + tile - 1) // tile) * tile)
+        self.n_pad = n_pad
+        self.n_tiles = n_pad // tile
+        cols = {}
+        for name, col in keys.device_cols.items():
+            out = np.full(n_pad, _SENTINELS[name], dtype=col.dtype)
+            out[:n] = col[order]
+            cols[name] = out
+        self.cols = {
+            k: (jax.device_put(v, device) if device else jnp.asarray(v))
+            for k, v in cols.items()
+        }
+        self.host_cols = cols
+
+    # -- pruning ---------------------------------------------------------
+    def candidate_spans(self, config: ScanConfig) -> list[tuple[int, int]]:
+        """Merged, sorted row spans [lo, hi) covering the scan ranges."""
+        spans: list[tuple[int, int]] = []
+        for b in np.unique(config.range_bins):
+            i = int(np.searchsorted(self.ubins, b))
+            if i >= len(self.ubins) or self.ubins[i] != b:
+                continue
+            s, e = int(self.bin_starts[i]), int(self.bin_starts[i + 1])
+            sel = config.range_bins == b
+            seg = self.zs[s:e]
+            lo = np.searchsorted(seg, config.range_lo[sel], side="left") + s
+            hi = np.searchsorted(seg, config.range_hi[sel], side="right") + s
+            for a, z in zip(lo.tolist(), hi.tolist()):
+                if z > a:
+                    spans.append((a, z))
+        spans.sort()
+        merged: list[tuple[int, int]] = []
+        for a, z in spans:
+            if merged and a <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], z))
+            else:
+                merged.append((a, z))
+        return merged
+
+    def candidate_tiles(self, config: ScanConfig) -> np.ndarray:
+        """Sorted unique tile ids covering the scan ranges; falls back to
+        every tile when pruning would not pay off."""
+        spans = self.candidate_spans(config)
+        if not spans:
+            return np.zeros(0, dtype=np.int32)
+        tiles: list[np.ndarray] = []
+        covered = 0
+        for a, z in spans:
+            t0, t1 = a // self.tile, (z - 1) // self.tile
+            tiles.append(np.arange(t0, t1 + 1, dtype=np.int32))
+            covered += t1 - t0 + 1
+            if covered >= self.n_tiles * FULL_SCAN_FRACTION:
+                return np.arange(self.n_tiles, dtype=np.int32)
+        return np.unique(np.concatenate(tiles))
+
+    # -- scanning --------------------------------------------------------
+    def scan(self, config: ScanConfig, cap_hint: int = 4096) -> np.ndarray:
+        """Run the device scan; return matching *feature ordinals* (into the
+        backing FeatureCollection), ascending in table order."""
+        if config.disjoint or self.n == 0:
+            return np.zeros(0, dtype=np.int64)
+        tiles = self.candidate_tiles(config)
+        if len(tiles) == 0:
+            return np.zeros(0, dtype=np.int64)
+        tile_ids = kernels.pad_tiles(tiles)
+        boxes = kernels.pad_boxes(config.boxes) if config.boxes is not None else None
+        windows = (
+            kernels.pad_windows(config.windows) if config.windows is not None else None
+        )
+        cap = kernels.pad_pow2(cap_hint, 4096)
+        max_possible = len(tiles) * self.tile
+        while True:
+            count, rows = kernels.tile_scan(
+                self.cols,
+                tile_ids,
+                boxes,
+                windows,
+                tile=self.tile,
+                cap=min(cap, kernels.pad_pow2(max_possible, 4096)),
+                extent_mode=config.extent_mode,
+            )
+            count = int(count)
+            if count <= cap or cap >= max_possible:
+                break
+            cap = kernels.pad_pow2(count, cap * 4)
+        rows = np.asarray(rows[:count])
+        return self.perm[rows]
+
+    def count(self, config: ScanConfig) -> int:
+        """Count rows matching the device predicate (loose semantics: f32
+        widened boxes; exact counting goes through scan + refinement)."""
+        if config.disjoint or self.n == 0:
+            return 0
+        tiles = self.candidate_tiles(config)
+        if len(tiles) == 0:
+            return 0
+        return int(
+            kernels.tile_count(
+                self.cols,
+                kernels.pad_tiles(tiles),
+                kernels.pad_boxes(config.boxes) if config.boxes is not None else None,
+                kernels.pad_windows(config.windows)
+                if config.windows is not None
+                else None,
+                tile=self.tile,
+                extent_mode=config.extent_mode,
+            )
+        )
+
+    @property
+    def nbytes_device(self) -> int:
+        return sum(int(v.nbytes) for v in self.cols.values())

@@ -1,0 +1,236 @@
+"""End-to-end DataStore tests: device scan results must exactly match a
+host brute-force filter evaluation.
+
+The analogue of the reference's TestGeoMesaDataStore-based index tests
+(/root/reference/geomesa-index-api/src/test/scala/org/locationtech/geomesa/
+index/TestGeoMesaDataStore.scala:40-150, Z3IndexTest.scala:35): the whole
+planner/index/scan stack runs against the in-memory store with zero infra
+(JAX CPU), randomized queries cross-checked against brute force.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu.filter import ecql
+from geomesa_tpu.filter.predicates import BBox, During, And, Cmp, IdFilter, INCLUDE
+from geomesa_tpu.planning.explain import Explainer
+from geomesa_tpu.planning.planner import QueryGuardError
+
+N = 20_000
+T0 = 1514764800000  # 2018-01-01T00:00:00Z
+WEEK_MS = 7 * 86400000
+
+
+def make_point_store(n=N, seed=0, tile=256):
+    rng = np.random.default_rng(seed)
+    sft = FeatureType.from_spec(
+        "gdelt", "name:String,count:Integer,dtg:Date,*geom:Point:srid=4326"
+    )
+    ds = DataStore(tile=tile)
+    ds.create_schema(sft)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    # cluster half the points so queries hit dense regions too
+    x[: n // 2] = rng.normal(-77, 3, n // 2).clip(-180, 180)
+    y[: n // 2] = rng.normal(38, 3, n // 2).clip(-90, 90)
+    t = rng.integers(T0, T0 + 8 * WEEK_MS, n)
+    fc = FeatureCollection.from_columns(
+        sft,
+        ids=[f"f{i}" for i in range(n)],
+        columns={
+            "name": rng.choice(["a", "b", "c"], n),
+            "count": rng.integers(0, 100, n).astype(np.int32),
+            "dtg": t,
+            "geom": (x, y),
+        },
+    )
+    ds.write("gdelt", fc)
+    return ds, fc
+
+
+@pytest.fixture(scope="module")
+def point_store():
+    return make_point_store()
+
+
+def brute(fc, f):
+    return set(fc.mask(f.evaluate(fc.batch)).ids.tolist())
+
+
+def ids(result):
+    return set(result.ids.tolist())
+
+
+class TestZ3QueryPath:
+    def test_bbox_time_queries_match_brute_force(self, point_store):
+        ds, fc = point_store
+        rng = np.random.default_rng(42)
+        for _ in range(25):
+            cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+            w, h = rng.uniform(0.5, 30, 2)
+            t_lo = int(rng.integers(T0, T0 + 7 * WEEK_MS))
+            t_hi = t_lo + int(rng.integers(3600_000, 2 * WEEK_MS))
+            f = And(
+                [
+                    BBox("geom", cx - w, cy - h, cx + w, cy + h),
+                    During("dtg", t_lo, t_hi),
+                ]
+            )
+            exp = Explainer()
+            got = ids(ds.query("gdelt", f, explain=exp))
+            assert "z3" in exp.render()
+            assert got == brute(fc, f)
+
+    def test_tiny_and_empty_boxes(self, point_store):
+        ds, fc = point_store
+        f = And([BBox("geom", 0, 0, 1e-9, 1e-9), During("dtg", T0, T0 + WEEK_MS)])
+        assert ids(ds.query("gdelt", f)) == brute(fc, f)
+
+    def test_whole_world_with_time(self, point_store):
+        ds, fc = point_store
+        f = During("dtg", T0 + WEEK_MS, T0 + 2 * WEEK_MS)
+        got = ids(ds.query("gdelt", f))
+        assert got == brute(fc, f)
+        assert len(got) > 0
+
+    def test_interval_spanning_many_bins(self, point_store):
+        ds, fc = point_store
+        f = And(
+            [
+                BBox("geom", -90, 20, -60, 50),
+                During("dtg", T0 + 1000, T0 + 6 * WEEK_MS + 12345),
+            ]
+        )
+        assert ids(ds.query("gdelt", f)) == brute(fc, f)
+
+
+class TestZ2QueryPath:
+    def test_bbox_only_uses_z2(self, point_store):
+        ds, fc = point_store
+        f = BBox("geom", -80, 35, -74, 41)
+        exp = Explainer()
+        got = ids(ds.query("gdelt", f, explain=exp))
+        assert "Strategy: z2" in exp.render()
+        assert got == brute(fc, f)
+
+    def test_random_bboxes(self, point_store):
+        ds, fc = point_store
+        rng = np.random.default_rng(7)
+        for _ in range(15):
+            cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+            w, h = rng.uniform(0.1, 40, 2)
+            f = BBox("geom", cx - w, cy - h, cx + w, cy + h)
+            assert ids(ds.query("gdelt", f)) == brute(fc, f)
+
+    def test_polygon_intersects(self, point_store):
+        ds, fc = point_store
+        f = ecql.parse(
+            "INTERSECTS(geom, POLYGON ((-80 30, -70 30, -70 45, -85 45, -80 30)))"
+        )
+        assert ids(ds.query("gdelt", f)) == brute(fc, f)
+
+
+class TestOtherPaths:
+    def test_id_lookup(self, point_store):
+        ds, fc = point_store
+        f = IdFilter(("f10", "f999", "f19999", "missing"))
+        exp = Explainer()
+        got = ids(ds.query("gdelt", f, explain=exp))
+        assert "id-lookup" in exp.render()
+        assert got == {"f10", "f999", "f19999"}
+
+    def test_include_returns_all(self, point_store):
+        ds, fc = point_store
+        assert len(ds.query("gdelt")) == len(fc)
+
+    def test_attribute_only_full_scan(self, point_store):
+        ds, fc = point_store
+        f = Cmp("count", ">", 90)
+        assert ids(ds.query("gdelt", f)) == brute(fc, f)
+
+    def test_mixed_residual_attribute(self, point_store):
+        ds, fc = point_store
+        f = ecql.parse(
+            "BBOX(geom, -85, 30, -70, 45) AND dtg DURING "
+            "2018-01-05T00:00:00Z/2018-01-20T00:00:00Z AND count > 50"
+        )
+        exp = Explainer()
+        got = ids(ds.query("gdelt", f, explain=exp))
+        assert "z3" in exp.render()
+        assert got == brute(fc, f)
+
+    def test_or_of_boxes(self, point_store):
+        ds, fc = point_store
+        f = ecql.parse("BBOX(geom, -80, 35, -75, 40) OR BBOX(geom, 10, 10, 20, 20)")
+        assert ids(ds.query("gdelt", f)) == brute(fc, f)
+
+    def test_limit(self, point_store):
+        ds, _ = point_store
+        got = ds.query("gdelt", BBox("geom", -180, -90, 180, 90), limit=17)
+        assert len(got) == 17
+
+    def test_count(self, point_store):
+        ds, fc = point_store
+        f = BBox("geom", -80, 35, -74, 41)
+        assert ds.count("gdelt", f) == len(brute(fc, f))
+
+    def test_disjoint_filter_empty(self, point_store):
+        ds, _ = point_store
+        f = And([BBox("geom", 0, 0, 10, 10), BBox("geom", 50, 50, 60, 60)])
+        assert len(ds.query("gdelt", f)) == 0
+
+    def test_guard_blocks_full_scan(self):
+        ds, _ = make_point_store(n=100, tile=64)
+        ds.block_full_table_scans = True
+        with pytest.raises(QueryGuardError):
+            ds.query("gdelt", Cmp("count", ">", 90))
+
+    def test_explain_renders(self, point_store):
+        ds, _ = point_store
+        text = ds.explain(
+            "gdelt",
+            "BBOX(geom, -85, 30, -70, 45) AND dtg DURING "
+            "2018-01-05T00:00:00Z/2018-01-20T00:00:00Z",
+        )
+        assert "Strategy: z3" in text and "Ranges:" in text
+
+
+class TestSchemaLifecycle:
+    def test_create_get_delete(self):
+        ds = DataStore()
+        ds.create_schema("t1", "dtg:Date,*geom:Point:srid=4326")
+        assert ds.type_names() == ["t1"]
+        assert ds.get_schema("t1").is_points
+        ds.delete_schema("t1")
+        assert ds.type_names() == []
+
+    def test_duplicate_schema_rejected(self):
+        ds = DataStore()
+        ds.create_schema("t1", "*geom:Point")
+        with pytest.raises(ValueError):
+            ds.create_schema("t1", "*geom:Point")
+
+    def test_incremental_writes(self):
+        ds = DataStore(tile=64)
+        sft = ds.create_schema("t", "dtg:Date,*geom:Point")
+        rows1 = [
+            {"dtg": T0 + i * 1000, "geom": f"POINT ({i} {i})", "__id__": f"a{i}"}
+            for i in range(50)
+        ]
+        rows2 = [
+            {"dtg": T0 + i * 1000, "geom": f"POINT ({-i} {i})", "__id__": f"b{i}"}
+            for i in range(1, 50)
+        ]
+        ds.write("t", rows1)
+        ds.write("t", rows2)
+        assert ds.count("t") == 99
+        f = ecql.parse("BBOX(geom, 0.5, 0.5, 49.5, 49.5)")
+        assert len(ds.query("t", f)) == 49
+
+    def test_duplicate_ids_rejected(self):
+        ds = DataStore()
+        ds.create_schema("t", "dtg:Date,*geom:Point")
+        rows = [{"dtg": T0, "geom": "POINT (0 0)", "__id__": "x"}] * 2
+        with pytest.raises(ValueError):
+            ds.write("t", rows)

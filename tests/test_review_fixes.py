@@ -1,0 +1,110 @@
+"""Regression tests for review findings: hole-containment, EWKB SRID,
+DWITHIN units, NOT-branch imprecision, packed-column array surgery."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.filter import ecql
+from geomesa_tpu.filter.extract import (
+    extract_attribute_bounds,
+    extract_geometries,
+    extract_intervals,
+)
+from geomesa_tpu.filter.predicates import And, Cmp, During, Not, BBox
+
+
+class TestContainsWithHoles:
+    def test_hole_inside_contained_polygon_rejected(self):
+        outer = geo.Polygon(
+            [(0, 0), (10, 0), (10, 10), (0, 10)], holes=[[(4, 4), (6, 4), (6, 6), (4, 6)]]
+        )
+        inner = geo.Polygon([(2, 2), (8, 2), (8, 8), (2, 8)])
+        assert not geo.contains(outer, inner)
+
+    def test_hole_outside_contained_polygon_ok(self):
+        outer = geo.Polygon(
+            [(0, 0), (10, 0), (10, 10), (0, 10)], holes=[[(8.5, 8.5), (9, 8.5), (9, 9), (8.5, 9)]]
+        )
+        inner = geo.Polygon([(1, 1), (5, 1), (5, 5), (1, 5)])
+        assert geo.contains(outer, inner)
+
+
+class TestEwkb:
+    def test_srid_flag_skips_payload(self):
+        # EWKB little-endian point with SRID 4326
+        data = struct.pack("<BIIdd", 1, 0x20000001, 4326, 1.5, 2.5)
+        g = geo.from_wkb(data)
+        assert isinstance(g, geo.Point) and g.x == 1.5 and g.y == 2.5
+
+    def test_z_flag_rejected(self):
+        data = struct.pack("<BIddd", 1, 0x80000001, 1.0, 2.0, 3.0)
+        with pytest.raises(ValueError):
+            geo.from_wkb(data)
+
+    def test_iso_z_type_rejected(self):
+        data = struct.pack("<BIddd", 1, 1001, 1.0, 2.0, 3.0)
+        with pytest.raises(ValueError):
+            geo.from_wkb(data)
+
+
+class TestDwithinUnits:
+    def test_two_word_units(self):
+        f = ecql.parse("DWITHIN(geom, POINT (0 0), 10, statute miles)")
+        assert abs(f.dist - 10 * 1609.34 / 111_320) < 1e-6
+
+    def test_nautical_miles(self):
+        f = ecql.parse("DWITHIN(geom, POINT (0 0), 1, nautical miles)")
+        assert abs(f.dist - 1852.0 / 111_320) < 1e-9
+
+    def test_unknown_units_rejected(self):
+        with pytest.raises(ValueError):
+            ecql.parse("DWITHIN(geom, POINT (0 0), 10, furlongs)")
+
+
+class TestNotImprecision:
+    def test_interval_not_branch_imprecise(self):
+        f = And([During("d", 0, 100), Not(During("d", 50, 60))])
+        fv = extract_intervals(f, "d")
+        assert fv.values and not fv.precise
+
+    def test_geometry_not_branch_imprecise(self):
+        f = And([BBox("g", 0, 0, 10, 10), Not(BBox("g", 2, 2, 3, 3))])
+        fv = extract_geometries(f, "g")
+        assert fv.values and not fv.precise
+
+    def test_attr_not_branch_imprecise(self):
+        f = And([Cmp("a", ">", 5), Not(Cmp("a", "=", 7))])
+        fv = extract_attribute_bounds(f, "a")
+        assert fv.values and not fv.precise
+
+    def test_unrelated_not_stays_precise(self):
+        f = And([During("d", 0, 100), Not(Cmp("other", "=", 1))])
+        fv = extract_intervals(f, "d")
+        assert fv.values and fv.precise
+
+
+class TestPackedColumnSurgery:
+    def _col(self):
+        geoms = [
+            geo.Point(1, 2),
+            geo.Polygon([(0, 0), (4, 0), (4, 4)], holes=[[(1, 1), (2, 1), (2, 2)]]),
+            geo.MultiLineString([geo.LineString([(0, 0), (1, 1)]), geo.LineString([(2, 2), (3, 3), (4, 4)])]),
+            geo.MultiPolygon([geo.Polygon([(0, 0), (1, 0), (1, 1)]), geo.Polygon([(5, 5), (6, 5), (6, 6)])]),
+        ]
+        return geo.PackedGeometryColumn.from_geometries(geoms), geoms
+
+    def test_take_matches_object_path(self):
+        col, geoms = self._col()
+        for idx in ([2, 0], [3, 1, 2], [], [1], [0, 1, 2, 3]):
+            sub = col.take(np.array(idx, dtype=np.int64))
+            assert [g.wkt for g in sub.geometries()] == [geoms[i].wkt for i in idx]
+            np.testing.assert_array_equal(sub.bboxes, col.bboxes[np.array(idx, dtype=np.int64)])
+
+    def test_concat_roundtrip(self):
+        col, geoms = self._col()
+        both = geo.PackedGeometryColumn.concat([col, col.take(np.array([1, 3]))])
+        expect = [g.wkt for g in geoms] + [geoms[1].wkt, geoms[3].wkt]
+        assert [g.wkt for g in both.geometries()] == expect
