@@ -1,0 +1,168 @@
+"""Driver benchmark: GDELT-shaped Z3 BBOX+time query mix on one TPU chip.
+
+BASELINE.md config 1: Z3 point index, BBOX + time-range queries over a
+GDELT-shaped point table. The baseline proxy is a NumPy full-columnar CPU
+scan of the same predicate (the reference's geomesa-fs Parquet/CPU path is
+JVM and cannot run here; a vectorized in-memory CPU scan is a *stronger*
+baseline than a Parquet file scan).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
+Env knobs: GEOMESA_BENCH_N (points, default 100M), GEOMESA_BENCH_QUERIES.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N = int(os.environ.get("GEOMESA_BENCH_N", 100_000_000))
+N_QUERIES = int(os.environ.get("GEOMESA_BENCH_QUERIES", 40))
+SEED = 42
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_store(n):
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+
+    rng = np.random.default_rng(SEED)
+    # GDELT-shaped: world-wide events clustered around population centers —
+    # approximate with a mixture of uniform background + gaussian clusters
+    n_clustered = n // 2
+    n_uniform = n - n_clustered
+    cx = rng.uniform(-160, 160, 64)
+    cy = rng.uniform(-55, 65, 64)
+    which = rng.integers(0, 64, n_clustered)
+    x = np.concatenate(
+        [
+            rng.uniform(-180, 180, n_uniform),
+            np.clip(cx[which] + rng.normal(0, 3.0, n_clustered), -180, 180),
+        ]
+    )
+    y = np.concatenate(
+        [
+            rng.uniform(-90, 90, n_uniform),
+            np.clip(cy[which] + rng.normal(0, 2.0, n_clustered), -90, 90),
+        ]
+    )
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    span_ms = 120 * 86400_000
+    t = t0 + rng.integers(0, span_ms, n)
+
+    sft = FeatureType.from_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z3"
+    ds = DataStore()
+    ds.create_schema(sft)
+    fc = FeatureCollection.from_columns(sft, np.arange(n), {"dtg": t, "geom": (x, y)})
+    t_in = time.perf_counter()
+    ds.write("gdelt", fc, check_ids=False)
+    ingest_s = time.perf_counter() - t_in
+    return ds, (x, y, t, t0, span_ms), ingest_s
+
+
+def make_queries(t0, span_ms):
+    rng = np.random.default_rng(SEED + 1)
+    qs = []
+    for i in range(N_QUERIES):
+        # selectivity mix: small city-scale boxes through continent-scale
+        w = float(rng.choice([1.0, 2.0, 5.0, 10.0, 20.0, 40.0]))
+        h = w / 2
+        qx = rng.uniform(-175, 175 - w)
+        qy = rng.uniform(-85, 85 - h)
+        dur_ms = int(rng.choice([6, 24, 72, 168, 24 * 14]) * 3600_000)
+        start = int(t0 + rng.integers(0, span_ms - dur_ms))
+        lo = np.datetime64(start, "ms")
+        hi = np.datetime64(start + dur_ms, "ms")
+        qs.append(
+            (
+                f"bbox(geom, {qx:.4f}, {qy:.4f}, {qx + w:.4f}, {qy + h:.4f}) "
+                f"AND dtg DURING {lo}Z/{hi}Z",
+                (qx, qy, qx + w, qy + h, start, start + dur_ms),
+            )
+        )
+    return qs
+
+
+def brute_force_times(data, queries, k=6):
+    """CPU columnar baseline on the first k queries, extrapolated."""
+    x, y, t, _, _ = data
+    times = []
+    for _, (x0, y0, x1, y1, tlo, thi) in queries[:k]:
+        s = time.perf_counter()
+        m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1) & (t >= tlo) & (t < thi)
+        n_hits = int(m.sum())
+        idx = np.nonzero(m)[0]
+        times.append(time.perf_counter() - s)
+        del m, idx
+    return float(np.mean(times)), n_hits
+
+
+def main():
+    import jax
+
+    platform = os.environ.get("GEOMESA_BENCH_PLATFORM")
+    if platform:  # e.g. "cpu" for off-TPU verification runs
+        jax.config.update("jax_platforms", platform)
+    log(f"devices: {jax.devices()}")
+    log(f"building {N:,} point store ...")
+    t_build = time.perf_counter()
+    ds, data, ingest_s = build_store(N)
+    log(f"store built in {time.perf_counter() - t_build:.1f}s (index sort+place {ingest_s:.1f}s)")
+    table = ds.table("gdelt", "z3")
+    log(f"device bytes: {table.nbytes_device / 1e9:.2f} GB")
+
+    queries = make_queries(data[3], data[4])
+
+    # warmup: run the whole mix once untimed so every pad-bucket shape is
+    # compiled (first compile is slow over the tunnel; steady-state is what
+    # the metric measures)
+    t_warm = time.perf_counter()
+    for i, (q, _) in enumerate(queries):
+        s = time.perf_counter()
+        ds.query("gdelt", q)
+        log(f"warmup {i}: {time.perf_counter() - s:.2f}s")
+    log(f"warmup done in {time.perf_counter() - t_warm:.1f}s")
+
+    lat = []
+    hits = 0
+    t_all = time.perf_counter()
+    for q, _ in queries:
+        s = time.perf_counter()
+        out = ds.query("gdelt", q)
+        lat.append(time.perf_counter() - s)
+        hits += len(out)
+    wall = time.perf_counter() - t_all
+    lat_ms = np.array(lat) * 1e3
+
+    base_mean, _ = brute_force_times(data, queries)
+    tpu_mean = float(np.mean(lat))
+    vs_baseline = base_mean / tpu_mean
+
+    result = {
+        "metric": "gdelt_z3_bbox_time_features_per_sec_per_chip",
+        "value": round(hits / wall, 1),
+        "unit": "features/s",
+        "vs_baseline": round(vs_baseline, 2),
+        "n_points": N,
+        "n_queries": N_QUERIES,
+        "hits_total": hits,
+        "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "latency_mean_ms": round(tpu_mean * 1e3, 2),
+        "cpu_baseline_mean_ms": round(base_mean * 1e3, 2),
+        "ingest_rate_per_s": round(N / ingest_s, 1),
+        "device_gb": round(table.nbytes_device / 1e9, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,15 +32,24 @@ from geomesa_tpu.storage.table import IndexTable
 class DataStore:
     """In-process TPU-backed feature store."""
 
-    def __init__(self, block_full_table_scans: bool = False, tile: int | None = None):
+    def __init__(
+        self,
+        block_full_table_scans: bool = False,
+        tile: int | None = None,
+        mesh=None,
+    ):
+        """``mesh``: an optional ``jax.sharding.Mesh``; when given, index
+        tables shard over it and scans run as shard_map collectives
+        (geomesa_tpu.parallel)."""
         self._schemas: dict[str, FeatureType] = {}
         self._features: dict[str, FeatureCollection] = {}
         self._indexes: dict[str, list] = {}
         self._tables: dict[tuple[str, str], IndexTable] = {}
-        self._id_map: dict[str, dict[str, int]] = {}
+        self._id_map: dict[str, dict[str, int] | None] = {}
         self._stats: dict[str, object] = {}
         self.block_full_table_scans = block_full_table_scans
         self.tile = tile
+        self.mesh = mesh
         self.planner = QueryPlanner(self)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
@@ -69,6 +78,14 @@ class DataStore:
             if sft.dtg_field is not None:
                 indexes.append(XZ3Index(sft))
             indexes.append(XZ2Index(sft))
+        # reference `geomesa.indices.enabled` user-data hint
+        # (utils/geotools/SimpleFeatureTypes Configs.EnabledIndices)
+        enabled = sft.user_data.get("geomesa.indices.enabled")
+        if enabled:
+            names = {s.strip() for s in str(enabled).split(",")}
+            indexes = [i for i in indexes if i.name in names]
+            if not indexes:
+                raise ValueError(f"no supported index in {enabled!r}")
         return indexes
 
     def get_schema(self, type_name: str) -> FeatureType:
@@ -91,6 +108,7 @@ class DataStore:
         self,
         type_name: str,
         features: "FeatureCollection | Sequence[Mapping]",
+        check_ids: bool = True,
     ) -> int:
         """Append a batch of features and rebuild the index tables.
 
@@ -98,7 +116,8 @@ class DataStore:
         the existing collection and every index re-sorts. (The reference
         gets incremental sorted inserts from the backing KV store; here a
         sorted merge is a cheap device-friendly operation and batches are
-        the expected ingest unit.)
+        the expected ingest unit.) ``check_ids=False`` skips the duplicate
+        id check for large bulk loads with known-unique ids.
         """
         sft = self._schemas[type_name]
         if not isinstance(features, FeatureCollection):
@@ -109,24 +128,43 @@ class DataStore:
         merged = (
             features if existing is None else FeatureCollection.concat([existing, features])
         )
-        if len(set(merged.ids.tolist())) != len(merged):
+        if check_ids and len(np.unique(merged.ids)) != len(merged):
             raise ValueError("duplicate feature ids in write batch")
         self._features[type_name] = merged
-        self._id_map[type_name] = {str(i): k for k, i in enumerate(merged.ids)}
+        self._id_map[type_name] = None  # rebuilt lazily on first id lookup
+        stats = self._update_stats(type_name, features)
         for idx in self._indexes[type_name]:
             keys = idx.write_keys(merged)
-            kwargs = {"tile": self.tile} if self.tile else {}
-            self._tables[(type_name, idx.name)] = IndexTable(idx, keys, **kwargs)
-        self._update_stats(type_name, merged)
+            if idx.name == "z3" and len(keys.zs):
+                # sketch sees only the delta batch (the store-level sketch
+                # accumulates); cell width is codec-defined (3 x per-dim
+                # precision), NOT data-dependent, so cells stay aligned
+                dkeys = keys if existing is None else idx.write_keys(features)
+                stats.observe_index_keys(
+                    idx.name, dkeys.bins, dkeys.zs,
+                    3 * getattr(idx.sfc, "precision", 21),
+                )
+            kwargs: dict = {"tile": self.tile} if self.tile else {}
+            if self.mesh is not None:
+                from geomesa_tpu.parallel import DistributedIndexTable
+
+                table = DistributedIndexTable(idx, keys, self.mesh, **kwargs)
+            else:
+                table = IndexTable(idx, keys, **kwargs)
+            self._tables[(type_name, idx.name)] = table
         return len(features)
 
-    def _update_stats(self, type_name: str, fc: FeatureCollection) -> None:
-        try:
-            from geomesa_tpu.stats.store import StatsStore
-        except ImportError:
-            self._stats[type_name] = None
-            return
-        self._stats[type_name] = StatsStore.build(self._schemas[type_name], fc)
+    def _update_stats(self, type_name: str, delta: FeatureCollection):
+        """Incremental: sketch the delta batch, merge into existing stats
+        (the reference's MetadataBackedStats merge-on-write)."""
+        from geomesa_tpu.stats.store import StatsStore
+
+        stats = StatsStore.build(self._schemas[type_name], delta)
+        prev = self._stats.get(type_name)
+        if prev is not None:
+            stats = prev.merge(stats)
+        self._stats[type_name] = stats
+        return stats
 
     # -- planner hooks ---------------------------------------------------
     def indexes(self, type_name: str) -> list:
@@ -143,7 +181,11 @@ class DataStore:
         return fc
 
     def id_lookup(self, type_name: str, ids: Iterable[str]) -> np.ndarray:
-        m = self._id_map.get(type_name, {})
+        m = self._id_map.get(type_name)
+        if m is None:
+            fc = self._features.get(type_name)
+            m = {} if fc is None else {str(i): k for k, i in enumerate(fc.ids)}
+            self._id_map[type_name] = m
         return np.array([m[i] for i in ids if i in m], dtype=np.int64)
 
     def stats_for(self, type_name: str):
@@ -176,6 +218,32 @@ class DataStore:
         if isinstance(f, Include):
             return len(self.features(type_name))
         return len(self.query(type_name, f))
+
+    def estimate_count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
+        """Estimated hit count from the stats sketches, without scanning
+        (reference GeoMesaStats.getCount / StatsBasedEstimator,
+        stats/GeoMesaStats.scala:30-110). Falls back to an exact count when
+        no sketch covers the filter."""
+        from geomesa_tpu.filter import ecql
+
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        if isinstance(f, Include):
+            return len(self.features(type_name))
+        stats = self.stats_for(type_name)
+        if stats is not None:
+            for idx in self._indexes[type_name]:
+                if idx.name != "z3":
+                    continue
+                cfg = idx.scan_config(f)
+                if cfg is None:
+                    continue
+                if cfg.disjoint:
+                    return 0
+                est = stats.estimate_scan(idx.name, cfg)
+                if est is not None:
+                    return int(round(est))
+        return self.count(type_name, f)
 
     def explain(self, type_name: str, f: "Filter | str" = INCLUDE) -> str:
         """Render the query plan trace without running the scan

@@ -1,0 +1,11 @@
+"""Multi-device execution: mesh sharding + collective-merged scans.
+
+SURVEY.md §2.6 mapping: shard fan-out -> round-robin tile dealing over a
+``jax.sharding.Mesh``; coprocessor aggregation -> ``psum``/``all_gather``
+under ``shard_map``.
+"""
+
+from geomesa_tpu.parallel.dtable import DistributedIndexTable
+from geomesa_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+__all__ = ["DistributedIndexTable", "make_mesh", "SHARD_AXIS"]

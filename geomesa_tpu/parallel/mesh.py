@@ -1,0 +1,38 @@
+"""Device mesh helpers for the distributed scan path.
+
+The reference spreads hot ranges over tablet servers with a 1-byte shard
+prefix (/root/reference/geomesa-index-api/src/main/scala/org/locationtech/
+geomesa/index/api/ShardStrategy.scala:21-80) and fans scans out over
+server-side RPC. The TPU equivalent is a 1-D ``jax.sharding.Mesh`` over the
+chips of a slice: table tiles are dealt round-robin across the mesh axis so
+any z-range's rows land on every device, scans run under ``shard_map``, and
+partial results merge with XLA collectives over ICI (psum / all_gather)
+instead of coprocessor RPC.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"asked for {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_spec(mesh: Mesh) -> NamedSharding:
+    """Sharding for [D, ...] arrays split along the mesh axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

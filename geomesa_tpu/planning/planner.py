@@ -108,17 +108,19 @@ class QueryPlanner:
         return QueryPlan(type_name, f, name, cfg, limit=limit)
 
     def cost(self, type_name: str, index_name: str, cfg: ScanConfig, exp) -> float:
-        """Cost = estimated scan size x index multiplier. With stats
-        available this uses sketch-based count estimates (reference
-        CostBasedStrategyDecider, StrategyDecider.scala:143-180); without,
-        the priority constant alone decides."""
+        """Cost = estimated rows scanned x index multiplier (reference
+        CostBasedStrategyDecider: stats.getCount x costMultiplier,
+        StrategyDecider.scala:143-180). The primary estimator is exact —
+        the sum of the searchsorted row spans the ranges cover, since the
+        sorted keys are host-resident; the sketch estimate (Z3Histogram)
+        and the bare priority constant are fallbacks."""
         mult = INDEX_PRIORITY.get(index_name, 3.0)
-        stats = self.store.stats_for(type_name)
-        if stats is not None:
-            est = stats.estimate_scan(index_name, cfg)
-            if est is not None:
-                return est * mult
-        return mult
+        try:
+            table = self.store.table(type_name, index_name)
+        except KeyError:
+            return mult  # no data written yet
+        rows = sum(hi - lo for lo, hi in table.candidate_spans(cfg))
+        return (rows + 1) * mult
 
     # -- execution -------------------------------------------------------
     def execute(

@@ -76,6 +76,18 @@ def _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode):
     return m, base
 
 
+def compact_rows(m, base, cap):
+    """(count, row ids [cap]) from a membership mask: ascending matching
+    entries of ``base``, -1 past count. If count > cap the caller re-runs
+    with a larger cap."""
+    flat = jnp.where(m, base, -1).ravel()
+    count = m.sum(dtype=jnp.int32)
+    (idx,) = jnp.nonzero(flat >= 0, size=cap, fill_value=0)
+    rows = flat[idx]
+    rows = jnp.where(jnp.arange(cap) < count, rows, -1)
+    return count, rows
+
+
 @partial(jax.jit, static_argnames=("tile", "cap", "extent_mode"))
 def tile_scan(cols, tile_ids, boxes, windows, *, tile, cap, extent_mode=False):
     """Gather-scan candidate tiles; return (count, matching row ids).
@@ -88,12 +100,7 @@ def tile_scan(cols, tile_ids, boxes, windows, *, tile, cap, extent_mode=False):
       -1 past count; if count > cap the caller re-runs with a larger cap)
     """
     m, base = _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode)
-    flat = jnp.where(m, base, -1).ravel()
-    count = m.sum(dtype=jnp.int32)
-    (idx,) = jnp.nonzero(flat >= 0, size=cap, fill_value=0)
-    rows = flat[idx]
-    rows = jnp.where(jnp.arange(cap) < count, rows, -1)
-    return count, rows
+    return compact_rows(m, base, cap)
 
 
 @partial(jax.jit, static_argnames=("tile", "extent_mode"))
@@ -103,11 +110,13 @@ def tile_count(cols, tile_ids, boxes, windows, *, tile, extent_mode=False):
     return m.sum(dtype=jnp.int32)
 
 
-def pad_pow2(n: int, lo: int = 16) -> int:
-    """Next power-of-two bucket >= max(n, lo) — bounds XLA recompiles."""
+def pad_pow2(n: int, lo: int = 16, factor: int = 2) -> int:
+    """Next geometric bucket >= max(n, lo) — bounds XLA recompiles. A
+    larger ``factor`` means fewer distinct compiled shapes at the price of
+    more padded (masked, never-matching) work."""
     b = lo
     while b < n:
-        b *= 2
+        b *= factor
     return b
 
 
@@ -116,7 +125,7 @@ def pad_boxes(boxes, bucket: int | None = None) -> jnp.ndarray:
     import numpy as np
 
     b = np.asarray(boxes, dtype=np.float32).reshape(-1, 4)
-    size = bucket or pad_pow2(len(b), 1)
+    size = bucket or pad_pow2(len(b), 4, factor=4)
     out = np.full((size, 4), np.nan, dtype=np.float32)
     out[:, 0] = np.inf
     out[:, 2] = -np.inf
@@ -132,7 +141,7 @@ def pad_windows(windows, bucket: int | None = None) -> jnp.ndarray:
     import numpy as np
 
     w = np.asarray(windows, dtype=np.int32).reshape(-1, 3)
-    size = bucket or pad_pow2(len(w), 1)
+    size = bucket or pad_pow2(len(w), 16, factor=4)
     out = np.zeros((size, 3), dtype=np.int32)
     out[:, 0] = -1
     out[:, 1] = 1
@@ -146,7 +155,7 @@ def pad_tiles(tiles, bucket: int | None = None) -> jnp.ndarray:
     import numpy as np
 
     t = np.asarray(tiles, dtype=np.int32)
-    size = bucket or pad_pow2(len(t))
+    size = bucket or pad_pow2(len(t), 16, factor=4)
     out = np.full(size, -1, dtype=np.int32)
     out[: len(t)] = t
     return jnp.asarray(out)

@@ -1,0 +1,25 @@
+"""Stats subsystem: ingest-time sketches + the planner cost model's inputs.
+
+Reference: geomesa-index-api stats/ + geomesa-utils stats/ (SURVEY.md §2.2,
+§2.5).
+"""
+
+from geomesa_tpu.stats.sketches import (
+    CountStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+    Z3Histogram,
+)
+from geomesa_tpu.stats.store import StatsStore
+
+__all__ = [
+    "CountStat",
+    "Frequency",
+    "Histogram",
+    "MinMax",
+    "TopK",
+    "Z3Histogram",
+    "StatsStore",
+]

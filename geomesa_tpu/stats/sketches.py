@@ -1,0 +1,286 @@
+"""Stats sketches: MinMax, Histogram, Frequency (count-min), TopK, Z3Histogram.
+
+Reference: the `geomesa-utils` stats package (/root/reference/
+geomesa-utils-parent/geomesa-utils/src/main/scala/org/locationtech/geomesa/
+utils/stats/ — MinMax.scala, Histogram.scala, Frequency.scala, TopK.scala,
+Z3Histogram.scala, parse DSL Stat.scala:30). The reference observes one
+feature at a time inside server iterators; the TPU redesign observes whole
+columns with vectorized reductions and merges partial sketches with `+=`
+(the collective-merge analogue: per-shard sketches psum/concat-merge into
+one).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["MinMax", "Histogram", "Frequency", "TopK", "Z3Histogram", "CountStat"]
+
+
+class CountStat:
+    """Total observed count (reference CountStat)."""
+
+    def __init__(self):
+        self.count = 0
+
+    def observe(self, col: np.ndarray) -> None:
+        self.count += len(col)
+
+    def __iadd__(self, other: "CountStat") -> "CountStat":
+        self.count += other.count
+        return self
+
+    def to_json(self):
+        return {"count": int(self.count)}
+
+
+class MinMax:
+    """Min/max bounds of one attribute (reference MinMax.scala)."""
+
+    def __init__(self):
+        self.min = None
+        self.max = None
+        self.count = 0
+
+    def observe(self, col: np.ndarray) -> None:
+        col = np.asarray(col)
+        if len(col) == 0:
+            return
+        self.count += len(col)
+        lo, hi = col.min(), col.max()
+        self.min = lo if self.min is None else min(self.min, lo)
+        self.max = hi if self.max is None else max(self.max, hi)
+
+    def __iadd__(self, other: "MinMax") -> "MinMax":
+        if other.min is not None:
+            self.observe(np.array([other.min, other.max]))
+            self.count += other.count - 2
+        return self
+
+    @property
+    def bounds(self):
+        return None if self.min is None else (self.min, self.max)
+
+    def to_json(self):
+        if self.min is None:
+            return {"min": None, "max": None, "count": 0}
+        return {
+            "min": self.min.item() if hasattr(self.min, "item") else self.min,
+            "max": self.max.item() if hasattr(self.max, "item") else self.max,
+            "count": int(self.count),
+        }
+
+
+class Histogram:
+    """Fixed-width binned counts over [lo, hi] (reference Histogram.scala:
+    the planner's range-selectivity input)."""
+
+    def __init__(self, n_bins: int, lo: float, hi: float):
+        if hi <= lo:
+            hi = lo + 1.0
+        self.n_bins = n_bins
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.counts = np.zeros(n_bins, dtype=np.int64)
+
+    def observe(self, col: np.ndarray) -> None:
+        col = np.asarray(col, dtype=np.float64)
+        if len(col) == 0:
+            return
+        idx = ((col - self.lo) / (self.hi - self.lo) * self.n_bins).astype(np.int64)
+        idx = np.clip(idx, 0, self.n_bins - 1)
+        np.add.at(self.counts, idx, 1)
+
+    def __iadd__(self, other: "Histogram") -> "Histogram":
+        if (other.lo, other.hi, other.n_bins) == (self.lo, self.hi, self.n_bins):
+            self.counts += other.counts
+            return self
+        # bounds differ across batches: rebin both into the union span
+        # (reference Histogram expands via its defined bounds; here bounds
+        # are data-derived per batch so the merge rebins proportionally)
+        lo, hi = min(self.lo, other.lo), max(self.hi, other.hi)
+        n = max(self.n_bins, other.n_bins)
+        out = Histogram(n, lo, hi)
+        for h in (self, other):
+            w = (h.hi - h.lo) / h.n_bins
+            centers = h.lo + (np.arange(h.n_bins) + 0.5) * w
+            idx = np.clip(
+                ((centers - lo) / (hi - lo) * n).astype(np.int64), 0, n - 1
+            )
+            np.add.at(out.counts, idx, h.counts)
+        self.n_bins, self.lo, self.hi, self.counts = n, lo, hi, out.counts
+        return self
+
+    def estimate_range(self, lo: float, hi: float) -> float:
+        """Estimated count within [lo, hi] assuming uniform intra-bin mass."""
+        w = (self.hi - self.lo) / self.n_bins
+        est = 0.0
+        for b in range(self.n_bins):
+            b_lo = self.lo + b * w
+            b_hi = b_lo + w
+            overlap = max(0.0, min(hi, b_hi) - max(lo, b_lo))
+            if overlap > 0:
+                est += self.counts[b] * overlap / w
+        return est
+
+    def to_json(self):
+        return {
+            "bins": self.n_bins,
+            "lo": self.lo,
+            "hi": self.hi,
+            "counts": self.counts.tolist(),
+        }
+
+
+def _cm_hashes(keys: np.ndarray, depth: int, width: int) -> np.ndarray:
+    """[depth, n] multiply-shift hashes of u64 keys."""
+    keys = keys.astype(np.uint64)
+    salts = np.array(
+        [0x9E3779B97F4A7C15, 0xBF58476D1CE4E5B9, 0x94D049BB133111EB, 0xD6E8FEB86659FD93],
+        dtype=np.uint64,
+    )[:depth, None]
+    h = keys[None, :] * salts
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> np.uint64(33)
+    return (h % np.uint64(width)).astype(np.int64)
+
+
+def _to_u64_keys(col: np.ndarray) -> np.ndarray:
+    col = np.asarray(col)
+    if col.dtype.kind in "iu":
+        return col.astype(np.uint64)
+    if col.dtype.kind == "f":
+        return col.astype(np.float64).view(np.uint64)
+    # strings: cheap vectorized FNV-style fold over a fixed-width byte view
+    b = np.frombuffer(
+        col.astype("U16").tobytes(), dtype=np.uint32
+    ).reshape(len(col), -1).astype(np.uint64)
+    h = np.full(len(col), 0xCBF29CE484222325, dtype=np.uint64)
+    for j in range(b.shape[1]):
+        h = (h ^ b[:, j]) * np.uint64(0x100000001B3)
+    return h
+
+
+class Frequency:
+    """Count-min sketch for equality selectivity (reference Frequency.scala)."""
+
+    def __init__(self, depth: int = 4, width: int = 1024):
+        self.depth = depth
+        self.width = width
+        self.table = np.zeros((depth, width), dtype=np.int64)
+        self.count = 0
+
+    def observe(self, col: np.ndarray) -> None:
+        if len(col) == 0:
+            return
+        self.count += len(col)
+        idx = _cm_hashes(_to_u64_keys(col), self.depth, self.width)
+        for d in range(self.depth):
+            np.add.at(self.table[d], idx[d], 1)
+
+    def __iadd__(self, other: "Frequency") -> "Frequency":
+        self.table += other.table
+        self.count += other.count
+        return self
+
+    def estimate(self, value) -> int:
+        idx = _cm_hashes(_to_u64_keys(np.array([value])), self.depth, self.width)
+        return int(min(self.table[d, idx[d, 0]] for d in range(self.depth)))
+
+    def to_json(self):
+        return {"depth": self.depth, "width": self.width, "count": int(self.count)}
+
+
+class TopK:
+    """Heavy hitters. Columnar ingest makes exact per-batch counts cheap
+    (np.unique); the sketch keeps the top-k across merges (reference
+    TopK.scala wraps StreamSummary — same contract, batch-exact here)."""
+
+    def __init__(self, k: int = 10, cap: int = 65536):
+        self.k = k
+        self.cap = cap
+        self.counts: dict = {}
+
+    def observe(self, col: np.ndarray) -> None:
+        vals, cnts = np.unique(np.asarray(col), return_counts=True)
+        for v, c in zip(vals.tolist(), cnts.tolist()):
+            self.counts[v] = self.counts.get(v, 0) + c
+        if len(self.counts) > self.cap:
+            keep = sorted(self.counts.items(), key=lambda kv: -kv[1])[: self.cap // 2]
+            self.counts = dict(keep)
+
+    def __iadd__(self, other: "TopK") -> "TopK":
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+        return self
+
+    def top(self, k: int | None = None) -> list[tuple]:
+        return sorted(self.counts.items(), key=lambda kv: -kv[1])[: k or self.k]
+
+    def to_json(self):
+        return {"top": [[v, int(c)] for v, c in self.top()]}
+
+
+class Z3Histogram:
+    """Counts over coarse (time bin, z-prefix) cells: the spatio-temporal
+    selectivity sketch (reference Z3Histogram.scala). Cells are the top
+    ``prefix_bits`` of the z value per time bin; estimates sum matching
+    cells for a set of z ranges."""
+
+    def __init__(self, total_bits: int, prefix_bits: int = 12):
+        self.total_bits = total_bits
+        self.shift = np.uint64(max(0, total_bits - prefix_bits))
+        self.cells: dict = {}  # (bin, z_prefix) -> count
+
+    def observe(self, bins: np.ndarray, zs: np.ndarray) -> None:
+        key = bins.astype(np.int64) * (1 << 32) + (
+            zs.astype(np.uint64) >> self.shift
+        ).astype(np.int64)
+        vals, cnts = np.unique(key, return_counts=True)
+        for v, c in zip(vals.tolist(), cnts.tolist()):
+            self.cells[v] = self.cells.get(v, 0) + c
+
+    def __iadd__(self, other: "Z3Histogram") -> "Z3Histogram":
+        for v, c in other.cells.items():
+            self.cells[v] = self.cells.get(v, 0) + c
+        return self
+
+    def estimate(self, range_bins, range_lo, range_hi) -> float:
+        """Estimated rows covered by inclusive z ranges, assuming uniform
+        intra-cell mass."""
+        if not self.cells:
+            return 0.0
+        keys = np.array(sorted(self.cells), dtype=np.int64)
+        cnts = np.array([self.cells[k] for k in keys.tolist()], dtype=np.float64)
+        cell = np.uint64(1) << self.shift
+        est = 0.0
+        for b, lo, hi in zip(
+            np.asarray(range_bins).tolist(),
+            np.asarray(range_lo, dtype=np.uint64).tolist(),
+            np.asarray(range_hi, dtype=np.uint64).tolist(),
+        ):
+            p_lo = np.uint64(lo) >> self.shift
+            p_hi = np.uint64(hi) >> self.shift
+            k_lo = b * (1 << 32) + int(p_lo)
+            k_hi = b * (1 << 32) + int(p_hi)
+            i0 = np.searchsorted(keys, k_lo, side="left")
+            i1 = np.searchsorted(keys, k_hi, side="right")
+            if i1 <= i0:
+                continue
+            est += cnts[i0:i1].sum()
+            # partial overlap of boundary cells
+            frac_lo = float(np.uint64(lo) & (cell - np.uint64(1))) / float(cell)
+            frac_hi = 1.0 - float(
+                (np.uint64(hi) & (cell - np.uint64(1))) + np.uint64(1)
+            ) / float(cell)
+            if keys[i0] == k_lo:
+                est -= cnts[i0] * frac_lo
+            if keys[i1 - 1] == k_hi:
+                est -= cnts[i1 - 1] * frac_hi
+        return max(est, 0.0)
+
+    def to_json(self):
+        return {"cells": len(self.cells), "shift": int(self.shift)}

@@ -1,0 +1,136 @@
+"""StatsStore: per-schema sketches maintained at ingest, serving the
+planner's cost model and user-facing stats queries.
+
+Reference: GeoMesaStats (/root/reference/geomesa-index-api/src/main/scala/
+org/locationtech/geomesa/index/stats/GeoMesaStats.scala:30-110) — counts,
+bounds, min/max, histograms — persisted as sketches by MetadataBackedStats
+and consumed by CostBasedStrategyDecider. Here the sketches are built with
+one pass of vectorized column reductions per write batch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.stats.sketches import (
+    CountStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+    Z3Histogram,
+)
+
+HISTOGRAM_BINS = 1000
+
+
+class StatsStore:
+    """Sketch bundle for one feature type."""
+
+    def __init__(self, sft):
+        self.sft = sft
+        self.count = CountStat()
+        self.minmax: dict[str, MinMax] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self.frequencies: dict[str, Frequency] = {}
+        self.topk: dict[str, TopK] = {}
+        self.z3: Z3Histogram | None = None
+        self.bounds_geom: MinMax | None = None  # packed (x, y) bounds
+
+    # -- build -----------------------------------------------------------
+    @staticmethod
+    def build(sft, fc) -> "StatsStore":
+        from geomesa_tpu.filter.predicates import PointColumn
+
+        st = StatsStore(sft)
+        st.count.observe(fc.ids)
+        for attr in sft.attributes:
+            col = fc.columns.get(attr.name)
+            if col is None:
+                continue
+            if attr.is_geometry:
+                if isinstance(col, PointColumn):
+                    xs, ys = col.x, col.y
+                else:
+                    b = col.bboxes  # [n, 4] xmin ymin xmax ymax
+                    xs = np.concatenate([b[:, 0], b[:, 2]])
+                    ys = np.concatenate([b[:, 1], b[:, 3]])
+                mm_x, mm_y = MinMax(), MinMax()
+                mm_x.observe(xs)
+                mm_y.observe(ys)
+                st.minmax[attr.name + ".x"] = mm_x
+                st.minmax[attr.name + ".y"] = mm_y
+                continue
+            col = np.asarray(col)
+            if col.dtype.kind in "iuf" or attr.type == "Date":
+                mm = MinMax()
+                mm.observe(col)
+                st.minmax[attr.name] = mm
+                if mm.bounds is not None:
+                    h = Histogram(
+                        HISTOGRAM_BINS, float(mm.min), float(mm.max) + 1e-9
+                    )
+                    h.observe(col.astype(np.float64))
+                    st.histograms[attr.name] = h
+            else:
+                f = Frequency()
+                f.observe(col)
+                st.frequencies[attr.name] = f
+                tk = TopK()
+                tk.observe(col)
+                st.topk[attr.name] = tk
+        return st
+
+    def observe_index_keys(self, index_name: str, bins, zs, total_bits: int) -> None:
+        """Feed (bin, z) write keys into the spatio-temporal sketch."""
+        if index_name in ("z3", "z2"):
+            if self.z3 is None:
+                self.z3 = Z3Histogram(total_bits)
+            self.z3.observe(np.asarray(bins), np.asarray(zs))
+
+    def merge(self, other: "StatsStore") -> "StatsStore":
+        """Partial-sketch merge (per-shard stats -> one; the collective
+        reduce analogue)."""
+        self.count += other.count
+        for d_name in ("minmax", "histograms", "frequencies", "topk"):
+            mine, theirs = getattr(self, d_name), getattr(other, d_name)
+            for k, v in theirs.items():
+                if k in mine:
+                    mine[k] += v
+                else:
+                    mine[k] = v
+        if other.z3 is not None:
+            if self.z3 is None:
+                self.z3 = other.z3
+            else:
+                self.z3 += other.z3
+        return self
+
+    # -- planner queries -------------------------------------------------
+    def total_count(self) -> int:
+        return self.count.count
+
+    def estimate_scan(self, index_name: str, cfg) -> float | None:
+        """Estimated rows a scan config touches (cost-model input)."""
+        if self.z3 is not None and index_name in ("z3", "z2"):
+            return self.z3.estimate(cfg.range_bins, cfg.range_lo, cfg.range_hi)
+        return None
+
+    def estimate_equality(self, attr: str, value) -> float | None:
+        f = self.frequencies.get(attr)
+        return float(f.estimate(value)) if f is not None else None
+
+    def estimate_range(self, attr: str, lo: float, hi: float) -> float | None:
+        h = self.histograms.get(attr)
+        return h.estimate_range(lo, hi) if h is not None else None
+
+    def attribute_bounds(self, attr: str):
+        mm = self.minmax.get(attr)
+        return mm.bounds if mm is not None else None
+
+    def to_json(self) -> dict:
+        return {
+            "count": self.count.to_json(),
+            "minmax": {k: v.to_json() for k, v in self.minmax.items()},
+            "topk": {k: v.to_json() for k, v in self.topk.items()},
+        }

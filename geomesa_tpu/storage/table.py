@@ -48,16 +48,13 @@ _SENTINELS = {
 }
 
 
-class IndexTable:
-    """Sorted columnar table for one (feature type, index) pair."""
+class SortedKeys:
+    """Host-side sorted key structure shared by the single-device and
+    distributed tables: the (bin, z) lexicographic sort, the permutation
+    back to feature ordinals, and searchsorted range -> row-span pruning
+    (the analogue of seeking scan ranges in a tablet server)."""
 
-    def __init__(
-        self,
-        keyspace: IndexKeySpace,
-        keys: WriteKeys,
-        tile: int = DEFAULT_TILE,
-        device=None,
-    ):
+    def __init__(self, keyspace: IndexKeySpace, keys: WriteKeys, tile: int):
         self.keyspace = keyspace
         self.tile = tile
         n = len(keys.bins)
@@ -72,20 +69,15 @@ class IndexTable:
         self.ubins, starts = np.unique(self.bins, return_index=True)
         self.bin_starts = np.append(starts, n).astype(np.int64)
 
-        # device columns, padded to a whole number of tiles
-        n_pad = max(tile, ((n + tile - 1) // tile) * tile)
-        self.n_pad = n_pad
-        self.n_tiles = n_pad // tile
+    def pad_cols(self, keys: WriteKeys, n_pad: int) -> dict:
+        """Sorted device columns padded to n_pad rows with never-matching
+        sentinels."""
         cols = {}
         for name, col in keys.device_cols.items():
             out = np.full(n_pad, _SENTINELS[name], dtype=col.dtype)
-            out[:n] = col[order]
+            out[: self.n] = col[self.perm]
             cols[name] = out
-        self.cols = {
-            k: (jax.device_put(v, device) if device else jnp.asarray(v))
-            for k, v in cols.items()
-        }
-        self.host_cols = cols
+        return cols
 
     # -- pruning ---------------------------------------------------------
     def candidate_spans(self, config: ScanConfig) -> list[tuple[int, int]]:
@@ -113,20 +105,45 @@ class IndexTable:
         return merged
 
     def candidate_tiles(self, config: ScanConfig) -> np.ndarray:
-        """Sorted unique tile ids covering the scan ranges; falls back to
-        every tile when pruning would not pay off."""
+        """Sorted unique tile ids covering the scan ranges (subclasses set
+        ``n_tiles``); falls back to every tile when pruning would not pay
+        off (past FULL_SCAN_FRACTION a linear scan beats a big gather)."""
         spans = self.candidate_spans(config)
         if not spans:
-            return np.zeros(0, dtype=np.int32)
+            return np.zeros(0, dtype=np.int64)
         tiles: list[np.ndarray] = []
         covered = 0
         for a, z in spans:
             t0, t1 = a // self.tile, (z - 1) // self.tile
-            tiles.append(np.arange(t0, t1 + 1, dtype=np.int32))
+            tiles.append(np.arange(t0, t1 + 1, dtype=np.int64))
             covered += t1 - t0 + 1
             if covered >= self.n_tiles * FULL_SCAN_FRACTION:
-                return np.arange(self.n_tiles, dtype=np.int32)
+                return np.arange(self.n_tiles, dtype=np.int64)
         return np.unique(np.concatenate(tiles))
+
+
+class IndexTable(SortedKeys):
+    """Sorted columnar table for one (feature type, index) pair."""
+
+    def __init__(
+        self,
+        keyspace: IndexKeySpace,
+        keys: WriteKeys,
+        tile: int = DEFAULT_TILE,
+        device=None,
+    ):
+        super().__init__(keyspace, keys, tile)
+
+        # device columns, padded to a whole number of tiles
+        n_pad = max(tile, ((self.n + tile - 1) // tile) * tile)
+        self.n_pad = n_pad
+        self.n_tiles = n_pad // tile
+        cols = self.pad_cols(keys, n_pad)
+        self.cols = {
+            k: (jax.device_put(v, device) if device else jnp.asarray(v))
+            for k, v in cols.items()
+        }
+        self.host_cols = cols
 
     # -- scanning --------------------------------------------------------
     def scan(self, config: ScanConfig, cap_hint: int = 4096) -> np.ndarray:

@@ -8,7 +8,13 @@ tests run without a TPU pod.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# a platform plugin (e.g. the axon TPU tunnel) may override JAX_PLATFORMS at
+# import time; the config update wins as long as no backend is initialized yet
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

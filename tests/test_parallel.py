@@ -1,0 +1,132 @@
+"""Multi-device sharded scan: result equality with the single-device path.
+
+Runs on the forced 8-device CPU mesh (conftest.py), mirroring the reference
+TestGeoMesaDataStore strategy: the full planner + distributed scan stack
+with zero infra.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.parallel import make_mesh
+from geomesa_tpu.sft import FeatureType
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _points(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    t = t0 + rng.integers(0, 45 * 86400_000, n)
+    return x, y, t
+
+
+def _store(mesh=None, n=4000, tile=64):
+    sft = FeatureType.from_spec("pts", SPEC)
+    ds = DataStore(tile=tile, mesh=mesh)
+    ds.create_schema(sft)
+    x, y, t = _points(n)
+    fc = FeatureCollection.from_columns(
+        sft,
+        [str(i) for i in range(n)],
+        {
+            "name": np.array([f"n{i % 17}" for i in range(n)]),
+            "age": np.arange(n) % 90,
+            "dtg": t,
+            "geom": (x, y),
+        },
+    )
+    ds.write("pts", fc)
+    return ds
+
+
+QUERIES = [
+    "bbox(geom, -20, -10, 40, 35) AND dtg DURING 2024-01-03T00:00:00Z/2024-01-20T12:00:00Z",
+    "bbox(geom, -180, -90, 180, 90) AND dtg DURING 2024-01-01T00:00:00Z/2024-02-15T00:00:00Z",
+    "bbox(geom, 10, 10, 11, 11)",
+    "bbox(geom, -150, -80, 150, 80) AND age < 30",
+    "bbox(geom, -20, -10, 40, 35) AND dtg DURING 2024-01-03T00:00:00Z/2024-01-20T12:00:00Z AND name = 'n3'",
+]
+
+
+@pytest.fixture(scope="module")
+def stores():
+    return _store(), _store(make_mesh(8))
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_distributed_matches_single(stores, q):
+    single, dist = stores
+    a = sorted(single.query("pts", q).ids.tolist())
+    b = sorted(dist.query("pts", q).ids.tolist())
+    assert a == b
+    assert len(a) > 0  # queries chosen to hit
+
+
+def test_distributed_matches_brute_force(stores):
+    single, dist = stores
+    q = QUERIES[0]
+    from geomesa_tpu.filter import ecql
+
+    f = ecql.parse(q)
+    fc = dist.features("pts")
+    mask = np.asarray(f.evaluate(fc.batch))
+    expect = sorted(fc.ids[mask].tolist())
+    got = sorted(dist.query("pts", q).ids.tolist())
+    assert got == expect
+
+
+def test_distributed_count(stores):
+    single, dist = stores
+    # loose count >= exact hits; equal here because the bbox test is precise
+    # for points up to f32 widening
+    q = "bbox(geom, -20, -10, 40, 35)"
+    assert dist.count("pts", q) == single.count("pts", q)
+
+
+def test_distributed_empty_result(stores):
+    _, dist = stores
+    out = dist.query("pts", "bbox(geom, 10.00001, 10.00001, 10.00002, 10.00002) AND dtg DURING 2030-01-01T00:00:00Z/2030-01-02T00:00:00Z")
+    assert len(out) == 0
+
+
+def test_mesh_sizes():
+    # distributed path works for mesh sizes that do not divide tile counts
+    for d in (2, 3, 5):
+        ds = _store(make_mesh(d), n=1000, tile=32)
+        single = _store(n=1000, tile=32)
+        for q in QUERIES[:2]:
+            assert sorted(ds.query("pts", q).ids.tolist()) == sorted(
+                single.query("pts", q).ids.tolist()
+            )
+
+
+def test_extent_geometries_distributed():
+    # polygons via XZ2/XZ3 on the mesh
+    sft = FeatureType.from_spec("polys", "name:String,dtg:Date,*geom:Polygon:srid=4326")
+    rng = np.random.default_rng(3)
+    rows = []
+    for i in range(300):
+        cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+        w, h = rng.uniform(0.1, 4, 2)
+        rows.append(
+            {
+                "__id__": str(i),
+                "name": f"p{i}",
+                "dtg": int(np.datetime64("2024-01-05", "ms").astype(np.int64) + i * 3600_000),
+                "geom": f"POLYGON(({cx} {cy}, {cx + w} {cy}, {cx + w} {cy + h}, {cx} {cy + h}, {cx} {cy}))",
+            }
+        )
+    q = "bbox(geom, -30, -30, 30, 30)"
+    out = {}
+    for mesh in (None, make_mesh(4)):
+        ds = DataStore(tile=32, mesh=mesh)
+        ds.create_schema(sft)
+        ds.write("polys", rows)
+        out[mesh is None] = sorted(ds.query("polys", q).ids.tolist())
+    assert out[True] == out[False]
+    assert len(out[True]) > 0
