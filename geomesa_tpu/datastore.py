@@ -21,7 +21,7 @@ from typing import Iterable, Mapping, Optional, Sequence
 import numpy as np
 
 from geomesa_tpu.features import FeatureCollection
-from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include
+from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include, PointColumn
 from geomesa_tpu.index import XZ2Index, XZ3Index, Z2Index, Z3Index
 from geomesa_tpu.planning.explain import Explainer
 from geomesa_tpu.planning.planner import QueryGuardError, QueryPlan, QueryPlanner
@@ -208,10 +208,159 @@ class DataStore:
         f: "Filter | str" = INCLUDE,
         limit: Optional[int] = None,
         explain: Explainer | None = None,
+        hints=None,
     ) -> FeatureCollection:
-        """Run a query; returns the matching features as a collection."""
+        """Run a query; returns the matching features as a collection.
+        ``hints`` is an optional geomesa_tpu.planning.hints.QueryHints."""
         plan = self.planner.plan(type_name, f, limit=limit, explain=explain)
-        return self.planner.execute(plan, explain=explain)
+        return self.planner.execute(plan, explain=explain, hints=hints)
+
+    # -- aggregation push-down (reference iterators/ + coprocessor tier) --
+    def density(
+        self,
+        type_name: str,
+        f: "Filter | str" = INCLUDE,
+        envelope: tuple | None = None,
+        width: int = 256,
+        height: int = 256,
+        weight: str | None = None,
+    ) -> np.ndarray:
+        """[height, width] density grid (reference DensityScan push-down,
+        index/iterators/DensityScan.scala:29-100 + DensityProcess).
+
+        When the chosen index's device mask decides the whole filter and no
+        weight attribute is requested, the grid is rendered on device (one
+        scatter-add over candidate tiles; psum-merged across a mesh).
+        Otherwise rows gather to host and the grid is a NumPy scatter over
+        refined results (LocalQueryRunner semantics). Extent geometries
+        weight their bbox centroid pixel.
+        """
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.planning.planner import mask_decides_filter
+
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        if envelope is None:
+            envelope = (-180.0, -90.0, 180.0, 90.0)
+        plan = self.planner.plan(type_name, f)
+        cfg = plan.config
+        device_ok = (
+            plan.index is not None
+            and weight is None
+            and mask_decides_filter(f, cfg, self._schemas[type_name])
+        )
+        if device_ok:
+            if cfg.disjoint:
+                return np.zeros((height, width), dtype=np.float32)
+            table = self.table(type_name, plan.index)
+            return table.density(cfg, envelope, width, height)
+        out = self.planner.execute(plan)
+        return _host_density(out, envelope, width, height, weight)
+
+    def stats_query(
+        self,
+        type_name: str,
+        spec: str,
+        f: "Filter | str" = INCLUDE,
+        estimate: bool = False,
+    ) -> list:
+        """Evaluate a Stat DSL spec over the query hits (reference StatsScan
+        / StatsProcess; grammar in geomesa_tpu.stats.stat_spec).
+
+        ``estimate=True`` takes the device fast path for a bare ``Count()``
+        spec when the scan mask decides the filter: a count-only kernel with
+        no row gather (loose f32-widened semantics, like the reference's
+        estimate-only stats)."""
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.planning.planner import mask_decides_filter
+        from geomesa_tpu.stats import stat_spec
+        from geomesa_tpu.stats.sketches import CountStat
+
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        terms = stat_spec.parse(spec)
+        if estimate and all(t.kind == "count" for t in terms):
+            plan = self.planner.plan(type_name, f)
+            if plan.index is not None and mask_decides_filter(
+                f, plan.config, self._schemas[type_name]
+            ):
+                n = (
+                    0
+                    if plan.config.disjoint
+                    else self.table(type_name, plan.index).count(plan.config)
+                )
+                out = []
+                for _ in terms:
+                    c = CountStat()
+                    c.count = n
+                    out.append(c)
+                return out
+        return stat_spec.evaluate(spec, self.query(type_name, f))
+
+    def bounds(
+        self, type_name: str, f: "Filter | str" = INCLUDE, estimate: bool = True
+    ) -> Optional[tuple]:
+        """Spatial envelope (xmin, ymin, xmax, ymax) of matching features,
+        or None when nothing matches (reference GeoMesaStats.getBounds,
+        stats/GeoMesaStats.scala:30-110). ``estimate=True`` uses the device
+        bounds kernel without a row gather when the scan mask decides the
+        filter (loose f32 semantics; extent features contribute their bbox
+        midpoint); otherwise exact from the refined results' geometries."""
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.planning.planner import mask_decides_filter
+
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        if estimate and not isinstance(f, Include):
+            plan = self.planner.plan(type_name, f)
+            if plan.index is not None and mask_decides_filter(
+                f, plan.config, self._schemas[type_name]
+            ):
+                table = self.table(type_name, plan.index)
+                if plan.config.disjoint:
+                    return None
+                if hasattr(table, "bounds_stats"):
+                    cnt, env = table.bounds_stats(plan.config)
+                    return env
+        out = self.query(type_name, f)
+        if len(out) == 0:
+            return None
+        col = out.geom_column
+        if isinstance(col, PointColumn):
+            return (
+                float(col.x.min()), float(col.y.min()),
+                float(col.x.max()), float(col.y.max()),
+            )
+        b = col.bboxes.astype(np.float64)
+        return (
+            float(b[:, 0].min()), float(b[:, 1].min()),
+            float(b[:, 2].max()), float(b[:, 3].max()),
+        )
+
+    def bin_query(
+        self,
+        type_name: str,
+        f: "Filter | str" = INCLUDE,
+        track: str | None = None,
+        label: str | None = None,
+        sort: bool = False,
+    ) -> bytes:
+        """Matching features as packed 16/24-byte BIN records (reference
+        BinAggregatingScan + BinaryOutputEncoder; see
+        geomesa_tpu.utils.bin_format). ``track=None`` correlates by id."""
+        from geomesa_tpu.utils import bin_format
+
+        sft = self._schemas[type_name]
+        out = self.query(type_name, f)
+        lon, lat = out.representative_xy()
+        dtg = (
+            np.asarray(out.columns[sft.dtg_field], dtype=np.int64)
+            if sft.dtg_field
+            else np.zeros(len(out), np.int64)
+        )
+        track_col = out.ids if track is None else out.columns[track]
+        label_col = out.columns[label] if label else None
+        return bin_format.encode(lon, lat, dtg, track_col, label_col, sort=sort)
 
     def count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
         """Exact hit count (scan + refine)."""
@@ -254,3 +403,23 @@ class DataStore:
         if plan.config is not None and not plan.config.disjoint:
             exp(f"Ranges: {plan.config.n_ranges}")
         return exp.render()
+
+
+def _host_density(fc: FeatureCollection, envelope, width: int, height: int, weight: str | None) -> np.ndarray:
+    """NumPy scatter-add density over refined results (LocalQueryRunner
+    analogue for filters the device mask cannot decide, or weighted grids)."""
+    x0, y0, x1, y1 = (float(v) for v in envelope)
+    grid = np.zeros(height * width, dtype=np.float32)
+    if len(fc) == 0:
+        return grid.reshape(height, width)
+    x, y = fc.representative_xy()
+    w = (
+        np.asarray(fc.columns[weight], dtype=np.float32)
+        if weight
+        else np.ones(len(fc), dtype=np.float32)
+    )
+    m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    px = np.clip(((x - x0) / (x1 - x0) * width).astype(np.int64), 0, width - 1)
+    py = np.clip(((y - y0) / (y1 - y0) * height).astype(np.int64), 0, height - 1)
+    np.add.at(grid, (py * width + px)[m], w[m])
+    return grid.reshape(height, width)

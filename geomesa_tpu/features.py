@@ -68,6 +68,18 @@ class FeatureCollection:
         g = self.sft.geom_field
         return self.columns[g] if g else None
 
+    def representative_xy(self) -> tuple[np.ndarray, np.ndarray]:
+        """(x, y) representative coordinate per feature: the point itself,
+        or the bbox midpoint for extent geometries (the same representative
+        the device aggregation kernels use — scan/aggregations._mask_xy)."""
+        col = self.geom_column
+        if col is None:
+            raise ValueError("schema has no geometry attribute")
+        if isinstance(col, PointColumn):
+            return col.x, col.y
+        b = col.bboxes.astype(np.float64)
+        return (b[:, 0] + b[:, 2]) * 0.5, (b[:, 1] + b[:, 3]) * 0.5
+
     def geometries(self) -> list[geo.Geometry]:
         col = self.geom_column
         if col is None:
@@ -90,6 +102,54 @@ class FeatureCollection:
 
     def mask(self, m: np.ndarray) -> "FeatureCollection":
         return self.take(np.nonzero(np.asarray(m))[0])
+
+    def project(self, names: Sequence[str]) -> "FeatureCollection":
+        """Column projection (reference query transforms): keep only the
+        named attributes. Ids are always kept; the projected SFT preserves
+        attribute order and flags."""
+        keep = [a for a in self.sft.attributes if a.name in set(names)]
+        missing = set(names) - {a.name for a in keep}
+        if missing:
+            raise KeyError(f"unknown transform attributes: {sorted(missing)}")
+        sub = FeatureType(self.sft.name, keep, dict(self.sft.user_data))
+        return FeatureCollection(
+            sub, self.ids, {a.name: self.columns[a.name] for a in keep}
+        )
+
+    def sort_values(self, by: str) -> "FeatureCollection":
+        """Stable sort by one attribute; ``-attr`` sorts descending
+        (reference SORT_FIELDS hint)."""
+        desc = by.startswith("-")
+        name = by[1:] if desc else by
+        col = self.ids if name == "__id__" else self.columns[name]
+        if isinstance(col, PointColumn):
+            col = col.x
+        col = np.asarray(col)
+        if desc:
+            # stable descending: ties keep original order (reversing an
+            # ascending stable sort would reverse ties too)
+            ranks = np.unique(col, return_inverse=True)[1]
+            order = np.argsort(-ranks, kind="stable")
+        else:
+            order = np.argsort(col, kind="stable")
+        return self.take(order)
+
+    def sample(self, fraction: float, by: str | None = None) -> "FeatureCollection":
+        """Deterministic stride sampling keeping ~fraction of rows
+        (reference SamplingIterator: modular per-record sampling,
+        optionally stratified per ``by`` value so every group survives)."""
+        n = len(self)
+        if n == 0 or fraction >= 1.0:
+            return self
+        step = max(1, int(round(1.0 / fraction)))
+        if by is None:
+            return self.take(np.arange(0, n, step))
+        vals = np.asarray(self.columns[by])
+        keep = np.zeros(n, dtype=bool)
+        for v in np.unique(vals):
+            idx = np.nonzero(vals == v)[0]
+            keep[idx[::step]] = True
+        return self.mask(keep)
 
     def to_rows(self) -> list[dict]:
         """Expand to per-feature dicts (export / debugging)."""

@@ -69,6 +69,37 @@ def _build_scan(mesh, names, tile, cap, extent_mode, has_boxes, has_windows, cou
     )
 
 
+@lru_cache(maxsize=64)
+def _build_density(mesh, names, tile, width, height, extent_mode, has_boxes, has_windows):
+    """jit(shard_map(local density + psum)): every device renders its own
+    candidate tiles onto the grid, partial grids merge over ICI with psum —
+    the coprocessor-aggregation merge collapsed into one collective."""
+    from geomesa_tpu.scan import aggregations
+
+    axis = mesh.axis_names[0]
+
+    def body(tile_ids, boxes, windows, grid_bounds, *col_arrays):
+        cols = {k: v[0] for k, v in zip(names, col_arrays)}
+        grid = aggregations.tile_density(
+            cols,
+            tile_ids[0],
+            boxes if has_boxes else None,
+            windows if has_windows else None,
+            grid_bounds,
+            tile=tile,
+            width=width,
+            height=height,
+            extent_mode=extent_mode,
+        )
+        return lax.psum(grid, axis)
+
+    n_cols = len(names)
+    in_specs = (P(axis, None), P(), P(), P()) + (P(axis, None),) * n_cols
+    return jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False)
+    )
+
+
 class DistributedIndexTable(SortedKeys):
     """Sorted columnar index table sharded over a 1-D mesh."""
 
@@ -193,6 +224,26 @@ class DistributedIndexTable(SortedKeys):
         tiles_dev, boxes, windows = self._args(config, tiles)
         (cnt_all,) = fn(tiles_dev, boxes, windows, *(self.cols[k] for k in self.col_names))
         return int(np.asarray(cnt_all).sum())
+
+    def density(
+        self, config: ScanConfig, bounds, width: int, height: int
+    ) -> np.ndarray:
+        """psum-merged density grid, equal to the single-device result."""
+        if config.disjoint or self.n == 0:
+            return np.zeros((height, width), dtype=np.float32)
+        tiles = self.candidate_tiles_per_device(config)
+        if tiles is None:
+            return np.zeros((height, width), dtype=np.float32)
+        fn = _build_density(
+            self.mesh, self.col_names, self.tile, width, height,
+            config.extent_mode, config.boxes is not None, config.windows is not None,
+        )
+        tiles_dev, boxes, windows = self._args(config, tiles)
+        gb = jax.device_put(
+            jnp.asarray(np.asarray(bounds, dtype=np.float32)), self._rep_spec
+        )
+        grid = fn(tiles_dev, boxes, windows, gb, *(self.cols[k] for k in self.col_names))
+        return np.asarray(grid)
 
     @property
     def nbytes_device(self) -> int:

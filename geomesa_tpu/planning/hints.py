@@ -1,0 +1,41 @@
+"""Per-query hints: the reference's QueryHints tier.
+
+Reference: /root/reference/geomesa-index-api/src/main/scala/org/
+locationtech/geomesa/index/conf/QueryHints.scala — DENSITY_*, STATS_*,
+BIN_*, SAMPLING, LOOSE_BBOX, plus GeoTools-level transforms/sort/limit.
+Here hints are one typed dataclass handed to DataStore.query (or implied by
+the dedicated density/stats/bin entry points).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+@dataclass
+class QueryHints:
+    """Options applied around the core scan.
+
+    - ``transforms``: attribute-name projection of the result columns
+      (reference query transforms / relational projection)
+    - ``sort_by``: attribute to sort results by; prefix ``-`` for
+      descending (reference SORT_FIELDS hint)
+    - ``sample``: keep roughly this fraction of hits, (0, 1]; applied as a
+      deterministic stride after refinement (reference SamplingIterator)
+    - ``sample_by``: stratify sampling per value of this attribute
+      (reference SAMPLE_BY hint)
+    - ``loose``: accept the widened device mask without exact host
+      refinement of spatial/temporal predicates — the reference's
+      LOOSE_BBOX fast path. Non-indexed predicates are still applied.
+    """
+
+    transforms: Optional[Sequence[str]] = None
+    sort_by: Optional[str] = None
+    sample: Optional[float] = None
+    sample_by: Optional[str] = None
+    loose: bool = False
+
+    def validate(self) -> None:
+        if self.sample is not None and not (0.0 < self.sample <= 1.0):
+            raise ValueError(f"sample must be in (0, 1], got {self.sample}")

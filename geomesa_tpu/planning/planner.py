@@ -56,6 +56,66 @@ class QueryGuardError(Exception):
     """A query guard rejected the plan (reference planning/guard/)."""
 
 
+def _filter_leaf_kinds(
+    f: Filter, geom_field: str | None, dtg_field: str | None
+) -> set | None:
+    """The set of predicate kinds ({"spatial", "temporal"}) this filter is
+    built from, or None when any predicate is outside the indexable
+    spatio-temporal subset (And of leaves; Or only of same-kind leaves)."""
+    from geomesa_tpu.filter.predicates import (
+        And, BBox, Between, Cmp, During, Include, Intersects, Or,
+    )
+
+    def leaf_kind(p) -> str | None:
+        if isinstance(p, (BBox, Intersects)) and p.prop == geom_field:
+            return "spatial"
+        if isinstance(p, (During, Between)) and p.prop == dtg_field:
+            return "temporal"
+        if isinstance(p, Cmp) and p.prop == dtg_field and p.op in ("<", "<=", ">", ">=", "="):
+            return "temporal"
+        return None
+
+    def walk(p) -> set | None:
+        if isinstance(p, Include):
+            return set()
+        if isinstance(p, And):
+            out: set = set()
+            for c in p.filters:
+                k = walk(c)
+                if k is None:
+                    return None
+                out |= k
+            return out
+        if isinstance(p, Or):
+            kinds = {leaf_kind(c) for c in p.filters}
+            return kinds if len(kinds) == 1 and None not in kinds else None
+        k = leaf_kind(p)
+        return {k} if k else None
+
+    return walk(f)
+
+
+def mask_decides_filter(f: Filter, config: Optional[ScanConfig], sft) -> bool:
+    """True when the device scan mask decides this filter entirely, so
+    loose mode / aggregation push-down may skip host refinement. Requires
+    (a) every predicate to be an indexable spatial/temporal leaf, (b) the
+    config to be precise on both axes, and (c) the chosen index to actually
+    enforce each predicate kind present — an atemporal index (z2) leaves
+    ``windows=None`` and must not satisfy a temporal filter. Gate for the
+    LOOSE_BBOX fast path (reference Z3IndexKeySpace.useFullFilter,
+    Z3IndexKeySpace.scala:240-254)."""
+    if config is None or not (config.geom_precise and config.time_precise):
+        return False
+    kinds = _filter_leaf_kinds(f, sft.geom_field, sft.dtg_field)
+    if kinds is None:
+        return False
+    if "spatial" in kinds and config.boxes is None:
+        return False
+    if "temporal" in kinds and config.windows is None:
+        return False
+    return True
+
+
 class QueryPlanner:
     """Plans and runs queries for one DataStore."""
 
@@ -124,7 +184,10 @@ class QueryPlanner:
 
     # -- execution -------------------------------------------------------
     def execute(
-        self, plan: QueryPlan, explain: Explainer | None = None
+        self,
+        plan: QueryPlan,
+        explain: Explainer | None = None,
+        hints=None,
     ) -> FeatureCollection:
         exp = explain or ExplainNull()
         fc = self.store.features(plan.type_name)
@@ -135,8 +198,7 @@ class QueryPlanner:
         elif plan.index is None:  # full host scan
             with exp.span("Full-table host scan"):
                 mask = plan.filter.evaluate(fc.batch)
-            out = fc.mask(mask)
-            return out.take(np.arange(min(len(out), plan.limit))) if plan.limit else out
+            return self._post(fc.mask(mask), plan, hints, exp)
         else:
             table = self.store.table(plan.type_name, plan.index)
             with exp.span(f"Device scan [{plan.index}]"):
@@ -145,14 +207,38 @@ class QueryPlanner:
             exp(f"Candidates: {len(ordinals)}")
             candidates = fc.take(ordinals)
 
-        # residual refinement: always re-apply the full filter on host (f64
-        # exact) — device masks are widened supersets; this also evaluates
-        # any non-indexed predicates (the reference's ECQL iterator tier)
-        if not isinstance(plan.filter, Include):
+        # LOOSE_BBOX fast path: skip exact host refinement when the widened
+        # device mask already decides the whole filter (reference
+        # Z3IndexKeySpace.useFullFilter + the loose-bbox query hint)
+        loose_ok = (
+            hints is not None
+            and getattr(hints, "loose", False)
+            and mask_decides_filter(
+                plan.filter, plan.config, self.store.get_schema(plan.type_name)
+            )
+        )
+        if loose_ok:
+            exp("Loose mode: device mask accepted without refinement")
+        elif not isinstance(plan.filter, Include):
             with exp.span("Residual filter refinement"):
                 mask = plan.filter.evaluate(candidates.batch)
             candidates = candidates.mask(mask)
-        exp(f"Hits: {len(candidates)}")
-        if plan.limit is not None and len(candidates) > plan.limit:
-            candidates = candidates.take(np.arange(plan.limit))
-        return candidates
+        return self._post(candidates, plan, hints, exp)
+
+    def _post(self, out: FeatureCollection, plan, hints, exp):
+        """Client-side reduce pipeline: sample -> sort -> limit -> project
+        (reference QueryPlanner.scala:66-102 runs the same stages after the
+        scan: reducer, sort, maxFeatures, projection)."""
+        exp(f"Hits: {len(out)}")
+        if hints is not None:
+            hints.validate()
+            if hints.sample is not None:
+                out = out.sample(hints.sample, hints.sample_by)
+                exp(f"Sampled: {len(out)}")
+            if hints.sort_by:
+                out = out.sort_values(hints.sort_by)
+        if plan.limit is not None and len(out) > plan.limit:
+            out = out.take(np.arange(plan.limit))
+        if hints is not None and hints.transforms is not None:
+            out = out.project(hints.transforms)
+        return out

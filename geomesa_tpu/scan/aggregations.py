@@ -1,0 +1,76 @@
+"""Device-side aggregation kernels: density grids and scan statistics.
+
+Reference: the server-side aggregating scans — DensityScan renders matching
+rows onto a pixel grid inside region servers (/root/reference/
+geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/iterators/
+DensityScan.scala:29-100 over utils/geom/RenderingGrid + GridSnap), and
+StatsScan folds stat sketches over rows (iterators/StatsScan.scala). The
+TPU inversion: the membership mask from the tile scan feeds a scatter-add
+onto the grid (one fused XLA program, no per-row iteration), and count /
+spatial-bounds statistics are masked reductions. Partial grids from
+sharded tables merge with `psum` (geomesa_tpu.parallel.dtable), the
+analogue of the client-side reducer merging coprocessor partials.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from geomesa_tpu.scan.kernels import _tile_mask
+
+
+def _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode):
+    """Shared prologue: membership mask + representative x/y per row.
+
+    Extent rows are represented by their bbox centroid (the exact
+    geometry-rendering path stays on host, mirroring the reference's
+    point-vs-shape split in DensityScan.getWeight)."""
+    m, base = _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode)
+    if extent_mode:
+        x = (cols["gxmin"][base] + cols["gxmax"][base]) * 0.5
+        y = (cols["gymin"][base] + cols["gymax"][base]) * 0.5
+    else:
+        x = cols["x"][base]
+        y = cols["y"][base]
+    return m, x, y
+
+
+@partial(jax.jit, static_argnames=("tile", "width", "height", "extent_mode"))
+def tile_density(
+    cols, tile_ids, boxes, windows, grid_bounds, *, tile, width, height, extent_mode=False
+):
+    """[height, width] f32 density grid over ``grid_bounds`` (x0,y0,x1,y1).
+
+    Each matching row inside the grid envelope adds weight 1 to its pixel
+    (reference GridSnap cell assignment). Rows outside the envelope are
+    dropped, not clamped — DensityScan only renders within the bounds.
+    """
+    m, x, y = _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode)
+    x0, y0, x1, y1 = grid_bounds[0], grid_bounds[1], grid_bounds[2], grid_bounds[3]
+    m = m & (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    px = jnp.clip(((x - x0) / (x1 - x0) * width).astype(jnp.int32), 0, width - 1)
+    py = jnp.clip(((y - y0) / (y1 - y0) * height).astype(jnp.int32), 0, height - 1)
+    flat = py * width + px
+    grid = jnp.zeros(height * width, jnp.float32).at[flat.ravel()].add(
+        m.ravel().astype(jnp.float32)
+    )
+    return grid.reshape(height, width)
+
+
+@partial(jax.jit, static_argnames=("tile", "extent_mode"))
+def tile_bounds_stats(cols, tile_ids, boxes, windows, *, tile, extent_mode=False):
+    """(count i32, xmin, xmax, ymin, ymax f32) over matching rows — the
+    device fast path for Count() / MinMax(geom) stat queries (reference
+    StatsScan with a Count/MinMax stat). Empty scans return inverted
+    (+inf, -inf) bounds."""
+    m, x, y = _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode)
+    inf = jnp.float32(jnp.inf)
+    count = m.sum(dtype=jnp.int32)
+    xmin = jnp.where(m, x, inf).min()
+    xmax = jnp.where(m, x, -inf).max()
+    ymin = jnp.where(m, y, inf).min()
+    ymax = jnp.where(m, y, -inf).max()
+    return count, xmin, xmax, ymin, ymax

@@ -199,6 +199,55 @@ class IndexTable(SortedKeys):
             )
         )
 
+    def bounds_stats(self, config: ScanConfig):
+        """(count, xmin, xmax, ymin, ymax) of matching rows on device (the
+        StatsScan Count/MinMax(geom) fast path; loose f32 semantics).
+        Returns (0, None) bounds when nothing matches."""
+        from geomesa_tpu.scan import aggregations
+
+        if config.disjoint or self.n == 0:
+            return 0, None
+        tiles = self.candidate_tiles(config)
+        if len(tiles) == 0:
+            return 0, None
+        cnt, xmin, xmax, ymin, ymax = aggregations.tile_bounds_stats(
+            self.cols,
+            kernels.pad_tiles(tiles),
+            kernels.pad_boxes(config.boxes) if config.boxes is not None else None,
+            kernels.pad_windows(config.windows) if config.windows is not None else None,
+            tile=self.tile,
+            extent_mode=config.extent_mode,
+        )
+        cnt = int(cnt)
+        if cnt == 0:
+            return 0, None
+        return cnt, (float(xmin), float(ymin), float(xmax), float(ymax))
+
+    def density(
+        self, config: ScanConfig, bounds, width: int, height: int
+    ) -> np.ndarray:
+        """[height, width] density grid over ``bounds`` computed on device
+        (the DensityScan push-down tier; see geomesa_tpu.scan.aggregations)."""
+        from geomesa_tpu.scan import aggregations
+
+        if config.disjoint or self.n == 0:
+            return np.zeros((height, width), dtype=np.float32)
+        tiles = self.candidate_tiles(config)
+        if len(tiles) == 0:
+            return np.zeros((height, width), dtype=np.float32)
+        grid = aggregations.tile_density(
+            self.cols,
+            kernels.pad_tiles(tiles),
+            kernels.pad_boxes(config.boxes) if config.boxes is not None else None,
+            kernels.pad_windows(config.windows) if config.windows is not None else None,
+            jnp.asarray(np.asarray(bounds, dtype=np.float32)),
+            tile=self.tile,
+            width=width,
+            height=height,
+            extent_mode=config.extent_mode,
+        )
+        return np.asarray(grid)
+
     @property
     def nbytes_device(self) -> int:
         return sum(int(v.nbytes) for v in self.cols.values())

@@ -1,0 +1,2 @@
+"""Foundation utilities (the geomesa-utils analogue): BIN format, geohash,
+in-memory spatial index, byte/lexicoder helpers."""

@@ -1,0 +1,240 @@
+"""Aggregation push-down: density grids, stats scans, BIN export, hints.
+
+Each device aggregation is checked against a NumPy recomputation over the
+same (exact-refined) query results, single-device and on the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.parallel import make_mesh
+from geomesa_tpu.planning.hints import QueryHints
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.stats import stat_spec
+from geomesa_tpu.utils import bin_format
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+Q_ST = "bbox(geom, -60, -40, 60, 40) AND dtg DURING 2024-01-03T00:00:00Z/2024-01-20T12:00:00Z"
+ENV = (-60.0, -40.0, 60.0, 40.0)
+
+
+def _store(mesh=None, n=5000, tile=64):
+    sft = FeatureType.from_spec("pts", SPEC)
+    ds = DataStore(tile=tile, mesh=mesh)
+    ds.create_schema(sft)
+    rng = np.random.default_rng(3)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    fc = FeatureCollection.from_columns(
+        sft,
+        [str(i) for i in range(n)],
+        {
+            "name": np.array([f"n{i % 7}" for i in range(n)]),
+            "age": np.arange(n) % 90,
+            "dtg": t0 + rng.integers(0, 45 * 86400_000, n),
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        },
+    )
+    ds.write("pts", fc)
+    return ds
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return _store()
+
+
+def _expected_grid(fc, env, w, h, weight=None):
+    x0, y0, x1, y1 = env
+    col = fc.geom_column
+    x, y = col.x, col.y
+    wt = np.asarray(fc.columns[weight], np.float64) if weight else np.ones(len(fc))
+    g = np.zeros(h * w)
+    m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    px = np.clip(((x - x0) / (x1 - x0) * w).astype(np.int64), 0, w - 1)
+    py = np.clip(((y - y0) / (y1 - y0) * h).astype(np.int64), 0, h - 1)
+    np.add.at(g, (py * w + px)[m], wt[m])
+    return g.reshape(h, w)
+
+
+class TestDensity:
+    def test_device_matches_brute_force(self, ds):
+        grid = ds.density("pts", Q_ST, envelope=ENV, width=32, height=16)
+        exact = _expected_grid(ds.query("pts", Q_ST), ENV, 32, 16)
+        assert grid.shape == (16, 32)
+        np.testing.assert_allclose(grid, exact)
+
+    def test_device_path_taken(self, ds):
+        # spatiotemporal-only filter -> device path (no host gather): verify
+        # via the plan gate used by DataStore.density
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.planning.planner import _filter_leaf_kinds
+
+        f = ecql.parse(Q_ST)
+        assert _filter_leaf_kinds(f, "geom", "dtg") == {"spatial", "temporal"}
+        f2 = ecql.parse(Q_ST + " AND age < 30")
+        assert _filter_leaf_kinds(f2, "geom", "dtg") is None
+
+    def test_host_fallback_weighted(self, ds):
+        q = Q_ST + " AND age < 30"
+        grid = ds.density("pts", q, envelope=ENV, width=16, height=16, weight="age")
+        exact = _expected_grid(ds.query("pts", q), ENV, 16, 16, weight="age")
+        np.testing.assert_allclose(grid, exact)
+
+    def test_distributed_matches_single(self, ds):
+        dds = _store(make_mesh(8))
+        g1 = ds.density("pts", Q_ST, envelope=ENV, width=32, height=16)
+        g8 = dds.density("pts", Q_ST, envelope=ENV, width=32, height=16)
+        np.testing.assert_allclose(g1, g8)
+
+    def test_total_mass_is_hit_count_inside_env(self, ds):
+        grid = ds.density("pts", Q_ST, envelope=ENV, width=64, height=64)
+        assert grid.sum() == len(ds.query("pts", Q_ST))
+
+
+class TestStats:
+    def test_count_minmax(self, ds):
+        out = ds.stats_query("pts", "Count();MinMax(age)", Q_ST)
+        hits = ds.query("pts", Q_ST)
+        assert out[0].count == len(hits)
+        assert out[1].bounds == (
+            np.asarray(hits.columns["age"]).min(),
+            np.asarray(hits.columns["age"]).max(),
+        )
+
+    def test_enumeration_groupby(self, ds):
+        out = ds.stats_query("pts", "Enumeration(name)", Q_ST)
+        hits = ds.query("pts", Q_ST)
+        vals, cnts = np.unique(np.asarray(hits.columns["name"]), return_counts=True)
+        assert dict(out[0].top(100)) == dict(zip(vals.tolist(), cnts.tolist()))
+
+        grouped = ds.stats_query("pts", "GroupBy(name,Count())", Q_ST)[0]
+        assert {k: v[0].count for k, v in grouped.items()} == dict(
+            zip(vals.tolist(), cnts.tolist())
+        )
+
+    def test_histogram_spec(self):
+        fc = FeatureCollection.from_columns(
+            FeatureType.from_spec("t", "v:Int,*geom:Point:srid=4326"),
+            ["a", "b", "c", "d"],
+            {"v": [1, 2, 8, 9], "geom": (np.zeros(4), np.zeros(4))},
+        )
+        (h,) = stat_spec.evaluate("Histogram(v,2,0,10)", fc)
+        assert h.counts.tolist() == [2, 2]
+
+    def test_bad_spec(self):
+        with pytest.raises(ValueError):
+            stat_spec.parse("Bogus(x)")
+
+
+class TestBinFormat:
+    def test_roundtrip_16(self):
+        lon = np.array([10.5, -20.25])
+        lat = np.array([1.5, 2.5])
+        dtg = np.array([1_700_000_000_123, 1_700_000_111_999])
+        data = bin_format.encode(lon, lat, dtg, np.array(["a", "b"]))
+        assert len(data) == 32
+        out = bin_format.decode(data)
+        np.testing.assert_allclose(out["lon"], lon.astype(np.float32))
+        np.testing.assert_allclose(out["lat"], lat.astype(np.float32))
+        np.testing.assert_array_equal(out["dtg_s"], dtg // 1000)
+        assert out["track"][0] != out["track"][1]
+
+    def test_roundtrip_24_sorted(self):
+        lon = np.array([1.0, 2.0, 3.0])
+        lat = np.zeros(3)
+        dtg = np.array([3_000, 1_000, 2_000], dtype=np.int64)
+        data = bin_format.encode(
+            lon, lat, dtg, np.arange(3), label=np.array([7, 8, 9]), sort=True
+        )
+        assert len(data) == 72
+        out = bin_format.decode(data, label=True)
+        assert out["dtg_s"].tolist() == [1, 2, 3]
+        assert out["label"].tolist() == [8, 9, 7]
+
+    def test_store_bin_query(self, ds):
+        data = ds.bin_query("pts", Q_ST, track="name")
+        hits = ds.query("pts", Q_ST)
+        assert len(data) == 16 * len(hits)
+        out = bin_format.decode(data)
+        assert len(np.unique(out["track"])) == len(
+            np.unique(np.asarray(hits.columns["name"]))
+        )
+
+
+class TestHints:
+    def test_transforms_and_sort(self, ds):
+        out = ds.query(
+            "pts", Q_ST, hints=QueryHints(transforms=["age", "geom"], sort_by="-age")
+        )
+        assert set(out.columns) == {"age", "geom"}
+        ages = np.asarray(out.columns["age"])
+        assert (np.diff(ages) <= 0).all()
+
+    def test_sampling(self, ds):
+        full = ds.query("pts", Q_ST)
+        half = ds.query("pts", Q_ST, hints=QueryHints(sample=0.5))
+        assert 0 < len(half) <= len(full) // 2 + 1
+        strat = ds.query("pts", Q_ST, hints=QueryHints(sample=0.25, sample_by="name"))
+        # every surviving group came from the full result's groups
+        assert set(np.asarray(strat.columns["name"])) <= set(
+            np.asarray(full.columns["name"])
+        )
+
+    def test_loose_superset(self, ds):
+        exact = ds.query("pts", Q_ST)
+        loose = ds.query("pts", Q_ST, hints=QueryHints(loose=True))
+        assert set(exact.ids.tolist()) <= set(loose.ids.tolist())
+        # widening is one f32 ulp: loose adds at most a sliver
+        assert len(loose) - len(exact) <= 5
+
+    def test_bad_sample(self, ds):
+        with pytest.raises(ValueError):
+            ds.query("pts", Q_ST, hints=QueryHints(sample=1.5))
+
+    def test_atemporal_index_cannot_claim_temporal_filter(self, ds):
+        # a z2 config (windows=None) must not satisfy a temporal filter even
+        # though its time_precise flag is vacuously True
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.planning.planner import mask_decides_filter
+
+        f = ecql.parse(Q_ST)
+        sft = ds.get_schema("pts")
+        z2 = next(i for i in ds.indexes("pts") if i.name == "z2")
+        z3 = next(i for i in ds.indexes("pts") if i.name == "z3")
+        assert not mask_decides_filter(f, z2.scan_config(f), sft)
+        assert mask_decides_filter(f, z3.scan_config(f), sft)
+
+    def test_stable_descending_sort(self):
+        sft = FeatureType.from_spec("t", "v:Int,*geom:Point:srid=4326")
+        fc = FeatureCollection.from_columns(
+            sft,
+            ["a", "b", "c", "d"],
+            {"v": [2, 1, 2, 1], "geom": (np.zeros(4), np.zeros(4))},
+        )
+        out = fc.sort_values("-v")
+        # ties keep original order: 2s are (a, c), 1s are (b, d)
+        assert out.ids.tolist() == ["a", "c", "b", "d"]
+
+
+class TestBounds:
+    def test_estimate_matches_exact(self, ds):
+        est = ds.bounds("pts", Q_ST, estimate=True)
+        exact = ds.bounds("pts", Q_ST, estimate=False)
+        assert est is not None and exact is not None
+        # estimate is f32-loose; both must agree to f32 resolution
+        np.testing.assert_allclose(est, exact, rtol=1e-6)
+
+    def test_empty(self, ds):
+        assert ds.bounds("pts", "bbox(geom, 179.99, 89.99, 180, 90)") is None
+
+    def test_estimate_count_stat(self, ds):
+        (est,) = ds.stats_query("pts", "Count()", Q_ST, estimate=True)
+        (exact,) = ds.stats_query("pts", "Count()", Q_ST)
+        assert abs(est.count - exact.count) <= 5  # loose f32 widening
+
+
+class TestEmptyResults:
+    def test_empty_bin_query(self, ds):
+        assert ds.bin_query("pts", "bbox(geom, 179.99, 89.99, 180, 90)") == b""
