@@ -22,7 +22,7 @@ import numpy as np
 
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include, PointColumn
-from geomesa_tpu.index import XZ2Index, XZ3Index, Z2Index, Z3Index
+from geomesa_tpu.index import AttributeIndex, XZ2Index, XZ3Index, Z2Index, Z3Index
 from geomesa_tpu.planning.explain import Explainer
 from geomesa_tpu.planning.planner import QueryGuardError, QueryPlan, QueryPlanner
 from geomesa_tpu.sft import FeatureType
@@ -78,12 +78,17 @@ class DataStore:
             if sft.dtg_field is not None:
                 indexes.append(XZ3Index(sft))
             indexes.append(XZ2Index(sft))
+        for attr in sft.indexed_attributes():
+            indexes.append(AttributeIndex(sft, attr))
         # reference `geomesa.indices.enabled` user-data hint
         # (utils/geotools/SimpleFeatureTypes Configs.EnabledIndices)
         enabled = sft.user_data.get("geomesa.indices.enabled")
         if enabled:
             names = {s.strip() for s in str(enabled).split(",")}
-            indexes = [i for i in indexes if i.name in names]
+            # "attr" enables every attribute index (reference names them all "attr")
+            indexes = [
+                i for i in indexes if i.name in names or i.name.split("_")[0] in names
+            ]
             if not indexes:
                 raise ValueError(f"no supported index in {enabled!r}")
         return indexes
@@ -279,8 +284,8 @@ class DataStore:
         if isinstance(f, str):
             f = ecql.parse(f)
         terms = stat_spec.parse(spec)
+        plan = self.planner.plan(type_name, f)
         if estimate and all(t.kind == "count" for t in terms):
-            plan = self.planner.plan(type_name, f)
             if plan.index is not None and mask_decides_filter(
                 f, plan.config, self._schemas[type_name]
             ):
@@ -295,7 +300,7 @@ class DataStore:
                     c.count = n
                     out.append(c)
                 return out
-        return stat_spec.evaluate(spec, self.query(type_name, f))
+        return stat_spec.evaluate_terms(terms, self.planner.execute(plan))
 
     def bounds(
         self, type_name: str, f: "Filter | str" = INCLUDE, estimate: bool = True

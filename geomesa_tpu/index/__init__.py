@@ -12,6 +12,7 @@ replaces the server-side row filter (Z3Filter et al.).
 """
 
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
+from geomesa_tpu.index.attribute import AttributeIndex
 from geomesa_tpu.index.z2 import Z2Index
 from geomesa_tpu.index.z3 import Z3Index
 from geomesa_tpu.index.xz2 import XZ2Index
@@ -21,6 +22,7 @@ __all__ = [
     "IndexKeySpace",
     "ScanConfig",
     "WriteKeys",
+    "AttributeIndex",
     "Z2Index",
     "Z3Index",
     "XZ2Index",

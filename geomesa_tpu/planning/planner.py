@@ -32,6 +32,12 @@ from geomesa_tpu.planning.explain import Explainer, ExplainNull
 INDEX_PRIORITY = {"z3": 1.1, "xz3": 1.1, "z2": 2.0, "xz2": 2.0, "attr": 2.5, "id": 0.5}
 
 
+def index_priority(name: str) -> float:
+    """Cost multiplier for an index name; attribute indexes are named
+    ``attr_<attribute>`` and share the ``attr`` multiplier."""
+    return INDEX_PRIORITY.get(name, INDEX_PRIORITY.get(name.split("_")[0], 3.0))
+
+
 @dataclass
 class QueryPlan:
     """A chosen execution strategy for one query."""
@@ -174,7 +180,7 @@ class QueryPlanner:
         the sum of the searchsorted row spans the ranges cover, since the
         sorted keys are host-resident; the sketch estimate (Z3Histogram)
         and the bare priority constant are fallbacks."""
-        mult = INDEX_PRIORITY.get(index_name, 3.0)
+        mult = index_priority(index_name)
         try:
             table = self.store.table(type_name, index_name)
         except KeyError:

@@ -63,12 +63,26 @@ def _in_windows(cols: dict, windows: jnp.ndarray) -> jnp.ndarray:
 
 
 def _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode):
-    """[T, tile] membership mask + the [T, tile] global row index matrix."""
+    """[T, tile] membership mask + the [T, tile] global row index matrix.
+
+    The mask always includes a row-validity test derived from the pad
+    sentinels (x/gxmin = inf, tbin = -1), so scans with no device predicate
+    at all — e.g. a pure attribute-range scan whose pruned tiles are taken
+    wholesale — cannot match pad rows.
+    """
     base = jnp.maximum(tile_ids, 0).astype(jnp.int32)[:, None] * tile + jnp.arange(
         tile, dtype=jnp.int32
     )
     gathered = {k: v[base] for k, v in cols.items()}
-    m = tile_ids[:, None] >= 0
+    if "x" in gathered:
+        valid = jnp.isfinite(gathered["x"])
+    elif "gxmin" in gathered:
+        valid = jnp.isfinite(gathered["gxmin"])
+    elif "tbin" in gathered:
+        valid = gathered["tbin"] >= 0
+    else:
+        valid = jnp.ones(base.shape, dtype=bool)
+    m = (tile_ids[:, None] >= 0) & valid
     if boxes is not None:
         m = m & _in_boxes(gathered, boxes, extent_mode)
     if windows is not None:

@@ -97,7 +97,8 @@ def parse_one(spec: str) -> _Eval:
     if name == "minmax":
         return _Eval("minmax", _strip(args[0]), MinMax)
     if name in ("enumeration", "enum"):
-        return _Eval("topk", _strip(args[0]), lambda: TopK(k=1 << 30))
+        # exact counts: disable both the top-k trim and the cap eviction
+        return _Eval("topk", _strip(args[0]), lambda: TopK(k=1 << 30, cap=1 << 30))
     if name == "topk":
         k = int(args[1]) if len(args) > 1 else 10
         return _Eval("topk", _strip(args[0]), lambda: TopK(k=k))
@@ -108,7 +109,8 @@ def parse_one(spec: str) -> _Eval:
         bins, lo, hi = int(args[1]), float(args[2]), float(args[3])
         return _Eval("histogram", _strip(args[0]), lambda: Histogram(bins, lo, hi))
     if name == "groupby":
-        return _Eval("groupby", _strip(args[0]), dict, sub=",".join(args[1:]))
+        # sub-stats re-enter the term grammar, which is ';'-separated
+        return _Eval("groupby", _strip(args[0]), dict, sub=";".join(args[1:]))
     raise ValueError(f"unknown stat {name!r}")
 
 
@@ -116,11 +118,14 @@ def parse(spec: str) -> list[_Eval]:
     return [parse_one(s) for s in spec.split(";") if s.strip()]
 
 
+def evaluate_terms(terms: list, fc) -> list:
+    return [term.observe(fc) for term in terms]
+
+
 def evaluate(spec: str, fc) -> list:
     """Evaluate a stat spec string over a FeatureCollection; returns one
     sketch (or GroupBy dict) per ';'-separated term."""
-    out = [term.observe(fc) for term in parse(spec)]
-    return out
+    return evaluate_terms(parse(spec), fc)
 
 
 def to_json(results: list) -> list:

@@ -28,18 +28,17 @@ RECORD_LABEL = np.dtype(
 
 
 def track_ids(col: np.ndarray) -> np.ndarray:
-    """i32 correlation ids from an arbitrary column (vectorized FNV-1a)."""
+    """i32 correlation ids from an arbitrary column (vectorized FNV-1a over
+    the full fixed-width value, so long values sharing a prefix still get
+    distinct ids)."""
     col = np.asarray(col)
     if col.dtype.kind in "iu":
         return col.astype(np.int64).astype(np.int32)
     if len(col) == 0:
         return np.zeros(0, dtype=np.int32)
-    b = np.frombuffer(col.astype("U16").tobytes(), dtype=np.uint32).reshape(
-        len(col), -1
-    ).astype(np.uint64)
-    h = np.full(len(col), 0xCBF29CE484222325, dtype=np.uint64)
-    for j in range(b.shape[1]):
-        h = (h ^ b[:, j]) * np.uint64(0x100000001B3)
+    from geomesa_tpu.stats.sketches import _fnv_fold
+
+    h = _fnv_fold(col)
     return (h & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
 
 

@@ -1,0 +1,71 @@
+"""Order-preserving u64 lexicoding of attribute values.
+
+Reference: the attribute index lexicodes values into sortable row-key
+strings (AttributeIndexKey.scala:21-70 over org.locationtech.geomesa.utils
+lexicoders). The TPU redesign lexicodes into one u64 sort key — weakly
+order-preserving (v1 <= v2 implies code(v1) <= code(v2)), so searchsorted
+range pruning over the sorted key column is a correct superset and exact
+semantics come from host refinement:
+
+- strings: first 8 UTF-8 bytes big-endian (longer strings collide onto
+  their prefix — collisions only widen the scanned span)
+- signed ints: sign-bit flip
+- floats: IEEE-754 total-order trick (flip sign bit for positives, all
+  bits for negatives)
+- dates: epoch-millis as signed ints
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+SIGN = np.uint64(0x8000000000000000)
+
+
+def lex_int(col) -> np.ndarray:
+    c = np.asarray(col).astype(np.int64)
+    return c.view(np.uint64) ^ SIGN
+
+
+def lex_float(col) -> np.ndarray:
+    c = np.asarray(col, dtype=np.float64)
+    b = c.view(np.uint64)
+    neg = (b & SIGN) != 0
+    return np.where(neg, ~b, b | SIGN)
+
+
+def lex_string(col) -> np.ndarray:
+    c = np.asarray(col)
+    n = len(c)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    # fixed-width first-8-bytes view, big-endian fold
+    raw = np.char.encode(c.astype("U8"), "utf-8")
+    out = np.zeros(n, dtype=np.uint64)
+    for i, v in enumerate(raw):  # result/ingest batches; vectorized enough upstream
+        out[i] = int.from_bytes(v[:8].ljust(8, b"\0"), "big")
+    return out
+
+
+def lex_column(col, attr_type: str) -> np.ndarray:
+    """Lexicode one column according to its SFT attribute type."""
+    if attr_type in ("Integer", "Int", "Long", "Date"):
+        return lex_int(col)
+    if attr_type in ("Float", "Double"):
+        return lex_float(col)
+    return lex_string(col)
+
+
+def lex_value(v, attr_type: str):
+    """Lexicode one scalar (query bounds); None maps to the open extreme."""
+    return lex_column(np.array([v]), attr_type)[0]
+
+
+def bounds_to_range(lo, hi, attr_type: str) -> tuple[np.uint64, np.uint64]:
+    """Inclusive [lo, hi] u64 scan range for attribute value bounds; None
+    means unbounded on that side. Exclusive query bounds still map to the
+    inclusive code range (string prefixes collide; refinement is exact)."""
+    code_lo = np.uint64(0) if lo is None else lex_value(lo, attr_type)
+    code_hi = U64_MAX if hi is None else lex_value(hi, attr_type)
+    return code_lo, code_hi
