@@ -1,0 +1,55 @@
+"""Query audit log: per-query events with plan + timing + hit counts.
+
+Reference: /root/reference/geomesa-index-api/src/main/scala/org/
+locationtech/geomesa/index/audit/AuditWriter.scala:31-63 + AuditedEvent.
+The reference writes asynchronously to a backend table; here events append
+to an in-process ring (bounded) and can be drained as dicts — the hook for
+any external sink.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AuditedEvent:
+    """One query's audit record (reference QueryEvent)."""
+
+    type_name: str
+    filter: str
+    strategy: str
+    n_ranges: int
+    hits: int
+    planning_ms: float
+    scanning_ms: float
+    timestamp: float = field(default_factory=time.time)
+
+    def to_json(self) -> dict:
+        return {
+            "typeName": self.type_name,
+            "filter": self.filter,
+            "strategy": self.strategy,
+            "ranges": self.n_ranges,
+            "hits": self.hits,
+            "planTimeMillis": round(self.planning_ms, 3),
+            "scanTimeMillis": round(self.scanning_ms, 3),
+            "date": self.timestamp,
+        }
+
+
+class AuditWriter:
+    """Bounded in-memory audit sink (drop-oldest)."""
+
+    def __init__(self, capacity: int = 10_000):
+        self.events: deque[AuditedEvent] = deque(maxlen=capacity)
+
+    def write(self, event: AuditedEvent) -> None:
+        self.events.append(event)
+
+    def drain(self) -> list[dict]:
+        out = [e.to_json() for e in self.events]
+        self.events.clear()
+        return out

@@ -24,7 +24,7 @@ from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include, PointColumn
 from geomesa_tpu.index import AttributeIndex, XZ2Index, XZ3Index, Z2Index, Z3Index
 from geomesa_tpu.planning.explain import Explainer
-from geomesa_tpu.planning.planner import QueryGuardError, QueryPlan, QueryPlanner
+from geomesa_tpu.planning.planner import QueryPlanner
 from geomesa_tpu.sft import FeatureType
 from geomesa_tpu.storage.table import IndexTable
 
@@ -37,10 +37,16 @@ class DataStore:
         block_full_table_scans: bool = False,
         tile: int | None = None,
         mesh=None,
+        guards: Sequence | None = None,
+        interceptors: Sequence | None = None,
+        audit=None,
+        metrics=None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh``; when given, index
         tables shard over it and scans run as shard_map collectives
-        (geomesa_tpu.parallel)."""
+        (geomesa_tpu.parallel). ``guards``/``interceptors`` are
+        geomesa_tpu.planning.guards hooks; ``audit`` an AuditWriter;
+        ``metrics`` a MetricsRegistry."""
         self._schemas: dict[str, FeatureType] = {}
         self._features: dict[str, FeatureCollection] = {}
         self._indexes: dict[str, list] = {}
@@ -50,6 +56,10 @@ class DataStore:
         self.block_full_table_scans = block_full_table_scans
         self.tile = tile
         self.mesh = mesh
+        self.guards = list(guards or [])
+        self.interceptors = list(interceptors or [])
+        self.audit = audit
+        self.metrics = metrics
         self.planner = QueryPlanner(self)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
@@ -196,15 +206,28 @@ class DataStore:
     def stats_for(self, type_name: str):
         return self._stats.get(type_name)
 
-    def guard_full_scan(self, type_name: str, f: Filter) -> None:
-        """Reference FullTableScanQueryGuard (planning/guard/
-        FullTableScanQueryGuard.scala:39-48): block unindexable scans when
-        configured."""
-        if self.block_full_table_scans and not isinstance(f, Include):
-            raise QueryGuardError(
-                f"query on {type_name!r} requires a full-table scan, which is "
-                "disabled (block_full_table_scans=True)"
-            )
+    def apply_interceptors(self, type_name: str, f: Filter) -> Filter:
+        """Run filter-rewriting interceptors in order (reference
+        QueryInterceptor SPI, hooked at QueryPlanner.scala:155)."""
+        for ic in self.interceptors:
+            f = ic.rewrite(type_name, f)
+        return f
+
+    def apply_guards(self, plan) -> None:
+        """Run every configured guard over a finished plan; guards raise
+        QueryGuardError to reject (reference planning/guard/). The
+        ``block_full_table_scans`` flag is read at query time so it can be
+        toggled on a live store."""
+        from geomesa_tpu.planning.guards import FullTableScanGuard
+
+        sft = self._schemas[plan.type_name]
+        guards = list(self.guards)
+        if self.block_full_table_scans and not any(
+            isinstance(g, FullTableScanGuard) for g in guards
+        ):
+            guards.append(FullTableScanGuard())
+        for g in guards:
+            g.guard(plan, sft)
 
     # -- queries ---------------------------------------------------------
     def query(
@@ -219,6 +242,31 @@ class DataStore:
         ``hints`` is an optional geomesa_tpu.planning.hints.QueryHints."""
         plan = self.planner.plan(type_name, f, limit=limit, explain=explain)
         return self.planner.execute(plan, explain=explain, hints=hints)
+
+    def record_query(self, plan, hits: int, scan_s: float) -> None:
+        """Audit + metrics sink for every executed plan — the planner calls
+        this from execute(), and the aggregation fast paths call it
+        directly, so density/stats scans are audited like row queries
+        (reference AuditWriter covers all query types)."""
+        if self.metrics is not None:
+            self.metrics.counter("geomesa.query.count")
+            self.metrics.counter("geomesa.query.hits", max(hits, 0))
+            self.metrics.timers["geomesa.query.plan"].update(plan.planning_s)
+            self.metrics.timers["geomesa.query.scan"].update(scan_s)
+        if self.audit is not None:
+            from geomesa_tpu.audit import AuditedEvent
+
+            self.audit.write(
+                AuditedEvent(
+                    type_name=plan.type_name,
+                    filter=str(plan.filter),
+                    strategy=plan.strategy,
+                    n_ranges=plan.config.n_ranges if plan.config is not None else 0,
+                    hits=hits,
+                    planning_ms=plan.planning_s * 1e3,
+                    scanning_ms=scan_s * 1e3,
+                )
+            )
 
     # -- aggregation push-down (reference iterators/ + coprocessor tier) --
     def density(
@@ -247,18 +295,23 @@ class DataStore:
             f = ecql.parse(f)
         if envelope is None:
             envelope = (-180.0, -90.0, 180.0, 90.0)
+        import time as _time
+
         plan = self.planner.plan(type_name, f)
         cfg = plan.config
+        # gate on plan.filter: interceptors may have rewritten the query
         device_ok = (
             plan.index is not None
             and weight is None
-            and mask_decides_filter(f, cfg, self._schemas[type_name])
+            and mask_decides_filter(plan.filter, cfg, self._schemas[type_name])
         )
         if device_ok:
             if cfg.disjoint:
                 return np.zeros((height, width), dtype=np.float32)
-            table = self.table(type_name, plan.index)
-            return table.density(cfg, envelope, width, height)
+            t0 = _time.perf_counter()
+            grid = self.table(type_name, plan.index).density(cfg, envelope, width, height)
+            self.record_query(plan, int(grid.sum()), _time.perf_counter() - t0)
+            return grid
         out = self.planner.execute(plan)
         return _host_density(out, envelope, width, height, weight)
 
@@ -283,17 +336,21 @@ class DataStore:
 
         if isinstance(f, str):
             f = ecql.parse(f)
+        import time as _time
+
         terms = stat_spec.parse(spec)
         plan = self.planner.plan(type_name, f)
         if estimate and all(t.kind == "count" for t in terms):
             if plan.index is not None and mask_decides_filter(
-                f, plan.config, self._schemas[type_name]
+                plan.filter, plan.config, self._schemas[type_name]
             ):
+                t0 = _time.perf_counter()
                 n = (
                     0
                     if plan.config.disjoint
                     else self.table(type_name, plan.index).count(plan.config)
                 )
+                self.record_query(plan, n, _time.perf_counter() - t0)
                 out = []
                 for _ in terms:
                     c = CountStat()
@@ -317,15 +374,19 @@ class DataStore:
         if isinstance(f, str):
             f = ecql.parse(f)
         if estimate and not isinstance(f, Include):
+            import time as _time
+
             plan = self.planner.plan(type_name, f)
             if plan.index is not None and mask_decides_filter(
-                f, plan.config, self._schemas[type_name]
+                plan.filter, plan.config, self._schemas[type_name]
             ):
                 table = self.table(type_name, plan.index)
                 if plan.config.disjoint:
                     return None
                 if hasattr(table, "bounds_stats"):
+                    t0 = _time.perf_counter()
                     cnt, env = table.bounds_stats(plan.config)
+                    self.record_query(plan, cnt, _time.perf_counter() - t0)
                     return env
         out = self.query(type_name, f)
         if len(out) == 0:

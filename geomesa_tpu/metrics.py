@@ -1,0 +1,88 @@
+"""Metrics registry: counters, gauges, timers.
+
+Reference: geomesa-metrics (/root/reference/geomesa-metrics/
+geomesa-metrics-micrometer/.../MicrometerSetup.scala) — dropwizard/
+micrometer registries. The TPU build keeps one process-local registry with
+the same three instrument kinds; ``snapshot()`` is the scrape surface for
+any exporter (prometheus text rendering included for parity with the
+reference's default registry).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Timer:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def update(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Process-local metrics: counter / gauge / timer by dotted name."""
+
+    def __init__(self):
+        self.counters: dict[str, int] = defaultdict(int)
+        self.gauges: dict[str, float] = {}
+        self.timers: dict[str, Timer] = defaultdict(Timer)
+
+    def counter(self, name: str, inc: int = 1) -> None:
+        self.counters[name] += inc
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    @contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timers[name].update(time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "timers": {
+                k: {"count": t.count, "mean_s": t.mean_s, "max_s": t.max_s}
+                for k, t in self.timers.items()
+            },
+        }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of the registry."""
+        lines = []
+        for k, v in sorted(self.counters.items()):
+            lines.append(f"# TYPE {_prom(k)} counter")
+            lines.append(f"{_prom(k)} {v}")
+        for k, v in sorted(self.gauges.items()):
+            lines.append(f"# TYPE {_prom(k)} gauge")
+            lines.append(f"{_prom(k)} {v}")
+        for k, t in sorted(self.timers.items()):
+            base = _prom(k)
+            lines.append(f"# TYPE {base}_seconds summary")
+            lines.append(f"{base}_seconds_count {t.count}")
+            lines.append(f"{base}_seconds_sum {t.total_s}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+REGISTRY = MetricsRegistry()

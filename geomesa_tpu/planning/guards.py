@@ -1,0 +1,129 @@
+"""Query guards and interceptors: reject or rewrite dangerous queries
+before they scan.
+
+Reference: the planner's guard SPI (/root/reference/geomesa-index-api/src/
+main/scala/org/locationtech/geomesa/index/planning/guard/ —
+FullTableScanQueryGuard.scala:39-48, TemporalQueryGuard.scala,
+GraduatedQueryGuard.scala) and QueryInterceptor.scala, hooked at
+QueryPlanner.scala:155. Guards inspect the *plan* (chosen strategy +
+extracted values) and raise QueryGuardError; interceptors rewrite the
+filter before planning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, Sequence, runtime_checkable
+
+from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
+from geomesa_tpu.filter.predicates import Filter, Include
+from geomesa_tpu.planning.planner import QueryGuardError, QueryPlan
+
+WHOLE_WORLD_AREA = 360.0 * 180.0
+
+
+@runtime_checkable
+class QueryInterceptor(Protocol):
+    """Rewrites a filter before planning (reference QueryInterceptor SPI).
+    Return the (possibly unchanged) filter, or raise QueryGuardError."""
+
+    def rewrite(self, type_name: str, f: Filter) -> Filter: ...
+
+
+@runtime_checkable
+class QueryGuard(Protocol):
+    """Inspects a finished plan; raises QueryGuardError to reject it."""
+
+    def guard(self, plan: QueryPlan, sft) -> None: ...
+
+
+class FullTableScanGuard:
+    """Reject plans that fall through to a full-table scan (reference
+    FullTableScanQueryGuard.scala:39-48). Include — an explicit
+    "everything" query — is allowed, matching the reference."""
+
+    def guard(self, plan: QueryPlan, sft) -> None:
+        if plan.index is None and plan.ids is None and not isinstance(plan.filter, Include):
+            raise QueryGuardError(
+                f"query on {plan.type_name!r} requires a full-table scan, "
+                "which is disabled"
+            )
+
+
+@dataclass
+class TemporalQueryGuard:
+    """Require a bounded temporal constraint no longer than ``max_ms``
+    (reference TemporalQueryGuard: `geomesa.guard.temporal.max.duration`).
+    Applies only to schemas with a time attribute."""
+
+    max_ms: int
+
+    def guard(self, plan: QueryPlan, sft) -> None:
+        if sft.dtg_field is None or plan.ids is not None:
+            return
+        intervals = extract_intervals(plan.filter, sft.dtg_field)
+        if intervals.disjoint:
+            return
+        if not intervals.values:
+            raise QueryGuardError(
+                f"query on {plan.type_name!r} requires a temporal filter on "
+                f"{sft.dtg_field!r}"
+            )
+        span = sum(iv.hi - iv.lo for iv in intervals.values)
+        if span > self.max_ms:
+            raise QueryGuardError(
+                f"temporal filter spans {span}ms, over the {self.max_ms}ms limit"
+            )
+
+
+@dataclass
+class SizeBound:
+    """One graduated tier: queries within ``area_deg2`` (None = any extent)
+    may span at most ``max_duration_ms`` (None = unbounded)."""
+
+    area_deg2: float | None
+    max_duration_ms: int | None
+
+
+@dataclass
+class GraduatedQueryGuard:
+    """Stricter duration limits for larger spatial extents (reference
+    GraduatedQueryGuard: small boxes may query long histories, wide boxes
+    only short ones). ``bounds`` must be ordered smallest-area first."""
+
+    bounds: Sequence[SizeBound]
+
+    def guard(self, plan: QueryPlan, sft) -> None:
+        if plan.ids is not None or sft.geom_field is None:
+            return
+        geoms = extract_geometries(plan.filter, sft.geom_field)
+        if geoms.disjoint:
+            return
+        if geoms.values:
+            area = sum(
+                (x1 - x0) * (y1 - y0) for x0, y0, x1, y1 in geometry_bounds(geoms)
+            )
+        else:
+            area = WHOLE_WORLD_AREA
+        limit = None
+        for b in self.bounds:
+            if b.area_deg2 is None or area <= b.area_deg2:
+                limit = b.max_duration_ms
+                break
+        if limit is None:
+            return
+        if sft.dtg_field is None:
+            return
+        intervals = extract_intervals(plan.filter, sft.dtg_field)
+        if intervals.disjoint:
+            return
+        if not intervals.values:
+            raise QueryGuardError(
+                f"queries over {area:.1f} deg^2 require a temporal filter"
+            )
+        span = sum(iv.hi - iv.lo for iv in intervals.values)
+        if span > limit:
+            raise QueryGuardError(
+                f"queries over {area:.1f} deg^2 may span at most {limit}ms "
+                f"(got {span}ms)"
+            )

@@ -48,6 +48,7 @@ class QueryPlan:
     config: Optional[ScanConfig]
     ids: Optional[list] = None  # id-lookup plan
     limit: Optional[int] = None
+    planning_s: float = 0.0  # wall-clock spent planning (audit/metrics)
 
     @property
     def strategy(self) -> str:
@@ -136,11 +137,23 @@ class QueryPlanner:
         limit: Optional[int] = None,
         explain: Explainer | None = None,
     ) -> QueryPlan:
+        import time
+
+        t0 = time.perf_counter()
         exp = explain or ExplainNull()
         if isinstance(f, str):
             f = ecql.parse(f)
+        f = self.store.apply_interceptors(type_name, f)
         exp(f"Planning query on '{type_name}': {type(f).__name__}")
 
+        plan = self._select(type_name, f, limit, exp)
+        self.store.apply_guards(plan)
+        plan.planning_s = time.perf_counter() - t0
+        return plan
+
+    def _select(
+        self, type_name: str, f: Filter, limit: Optional[int], exp
+    ) -> QueryPlan:
         # id filters take absolute priority (reference IdFilterStrategy)
         ids = extract_ids(f)
         if ids.disjoint:
@@ -166,7 +179,6 @@ class QueryPlanner:
             )
         if not options:
             exp("Strategy: full-table host scan (no index serves this filter)")
-            self.store.guard_full_scan(type_name, f)
             return QueryPlan(type_name, f, None, None, limit=limit)
         options.sort(key=lambda o: o[0])
         cost, name, cfg = options[0]
@@ -190,6 +202,19 @@ class QueryPlanner:
 
     # -- execution -------------------------------------------------------
     def execute(
+        self,
+        plan: QueryPlan,
+        explain: Explainer | None = None,
+        hints=None,
+    ) -> FeatureCollection:
+        import time
+
+        t0 = time.perf_counter()
+        out = self._execute(plan, explain, hints)
+        self.store.record_query(plan, len(out), time.perf_counter() - t0)
+        return out
+
+    def _execute(
         self,
         plan: QueryPlan,
         explain: Explainer | None = None,

@@ -134,22 +134,6 @@ class Histogram:
         }
 
 
-def _fnv_fold(col: np.ndarray) -> np.ndarray:
-    """Vectorized FNV-style fold over the full fixed-width UTF-32 view.
-    Zero (padding) words are skipped so the hash of a value is independent
-    of the column's string width — the same value hashes identically
-    whether observed in a U4 column or estimated from a U1 scalar."""
-    c = col if col.dtype.kind == "U" else col.astype(str)
-    width = max(1, c.dtype.itemsize // 4)
-    b = np.frombuffer(c.tobytes(), dtype=np.uint32).reshape(len(c), width).astype(np.uint64)
-    h = np.full(len(c), 0xCBF29CE484222325, dtype=np.uint64)
-    prime = np.uint64(0x100000001B3)
-    for j in range(b.shape[1]):
-        w = b[:, j]
-        h = np.where(w != 0, (h ^ w) * prime, h)
-    return h
-
-
 def _cm_hashes(keys: np.ndarray, depth: int, width: int) -> np.ndarray:
     """[depth, n] multiply-shift hashes of u64 keys."""
     keys = keys.astype(np.uint64)
@@ -170,7 +154,9 @@ def _to_u64_keys(col: np.ndarray) -> np.ndarray:
         return col.astype(np.uint64)
     if col.dtype.kind == "f":
         return col.astype(np.float64).view(np.uint64)
-    return _fnv_fold(col)
+    from geomesa_tpu.utils.hashing import fnv_fold
+
+    return fnv_fold(col)
 
 
 class Frequency:

@@ -36,9 +36,9 @@ def track_ids(col: np.ndarray) -> np.ndarray:
         return col.astype(np.int64).astype(np.int32)
     if len(col) == 0:
         return np.zeros(0, dtype=np.int32)
-    from geomesa_tpu.stats.sketches import _fnv_fold
+    from geomesa_tpu.utils.hashing import fnv_fold
 
-    h = _fnv_fold(col)
+    h = fnv_fold(col)
     return (h & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
 
 
@@ -48,11 +48,10 @@ def label_u64(col: np.ndarray) -> np.ndarray:
     col = np.asarray(col)
     if col.dtype.kind in "iu":
         return col.astype(np.uint64)
-    raw = np.char.encode(col.astype("U8"), "utf-8")
-    out = np.zeros(len(col), dtype=np.uint64)
-    for i, v in enumerate(raw):  # ragged bytes; n is a result batch, not the table
-        out[i] = int.from_bytes(v[:8].ljust(8, b"\0"), "little")
-    return out
+    if len(col) == 0:
+        return np.zeros(0, dtype=np.uint64)
+    raw = np.char.encode(col.astype("U8"), "utf-8").astype("S8")
+    return np.frombuffer(raw.tobytes(), dtype="<u8").astype(np.uint64)
 
 
 def encode(

@@ -40,12 +40,12 @@ def lex_string(col) -> np.ndarray:
     n = len(c)
     if n == 0:
         return np.zeros(0, dtype=np.uint64)
-    # fixed-width first-8-bytes view, big-endian fold
-    raw = np.char.encode(c.astype("U8"), "utf-8")
-    out = np.zeros(n, dtype=np.uint64)
-    for i, v in enumerate(raw):  # result/ingest batches; vectorized enough upstream
-        out[i] = int.from_bytes(v[:8].ljust(8, b"\0"), "big")
-    return out
+    # vectorized: encode the first 8 chars, truncate/null-pad to an S8 view,
+    # read big-endian (byte order of UTF-8 == code-point order, so the
+    # result is weakly order-preserving even when truncation splits a
+    # multi-byte sequence)
+    raw = np.char.encode(c.astype("U8"), "utf-8").astype("S8")
+    return np.frombuffer(raw.tobytes(), dtype=">u8").astype(np.uint64)
 
 
 def lex_column(col, attr_type: str) -> np.ndarray:

@@ -1,0 +1,126 @@
+"""Query guards, interceptors, audit, and metrics."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.audit import AuditWriter
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import And, BBox, Filter
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.planning.guards import (
+    FullTableScanGuard,
+    GraduatedQueryGuard,
+    SizeBound,
+    TemporalQueryGuard,
+)
+from geomesa_tpu.planning.planner import QueryGuardError
+from geomesa_tpu.sft import FeatureType
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+DAY = 86400_000
+
+
+def _store(**kw):
+    sft = FeatureType.from_spec("g", SPEC)
+    ds = DataStore(tile=64, **kw)
+    ds.create_schema(sft)
+    n = 500
+    rng = np.random.default_rng(0)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    ds.write(
+        "g",
+        FeatureCollection.from_columns(
+            sft,
+            [str(i) for i in range(n)],
+            {
+                "name": np.array(["x"] * n),
+                "dtg": t0 + rng.integers(0, 30 * DAY, n),
+                "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+            },
+        ),
+    )
+    return ds
+
+
+Q_OK = "bbox(geom, 0, 0, 10, 10) AND dtg DURING 2024-01-02T00:00:00Z/2024-01-04T00:00:00Z"
+Q_LONG = "bbox(geom, 0, 0, 10, 10) AND dtg DURING 2024-01-01T00:00:00Z/2024-01-25T00:00:00Z"
+Q_WIDE_LONG = "bbox(geom, -170, -80, 170, 80) AND dtg DURING 2024-01-01T00:00:00Z/2024-01-25T00:00:00Z"
+
+
+class TestGuards:
+    def test_full_table_scan_guard(self):
+        ds = _store(guards=[FullTableScanGuard()])
+        with pytest.raises(QueryGuardError):
+            ds.query("g", "name = 'x'")  # name not indexed -> full scan
+        assert len(ds.query("g", Q_OK)) >= 0  # indexed path still fine
+        assert len(ds.query("g")) == 500  # Include is allowed
+
+    def test_block_full_table_scans_compat(self):
+        ds = _store(block_full_table_scans=True)
+        with pytest.raises(QueryGuardError):
+            ds.query("g", "name = 'x'")
+
+    def test_temporal_guard(self):
+        ds = _store(guards=[TemporalQueryGuard(max_ms=7 * DAY)])
+        assert len(ds.query("g", Q_OK)) >= 0
+        with pytest.raises(QueryGuardError):
+            ds.query("g", Q_LONG)
+        with pytest.raises(QueryGuardError):
+            ds.query("g", "bbox(geom, 0, 0, 10, 10)")  # unbounded time
+
+    def test_graduated_guard(self):
+        ds = _store(
+            guards=[
+                GraduatedQueryGuard(
+                    [
+                        SizeBound(400.0, 60 * DAY),  # small boxes: long history ok
+                        SizeBound(None, 3 * DAY),  # anything bigger: 3 days max
+                    ]
+                )
+            ]
+        )
+        assert len(ds.query("g", Q_LONG)) >= 0  # 100 deg^2, within tier 1
+        with pytest.raises(QueryGuardError):
+            ds.query("g", Q_WIDE_LONG)  # huge box + 24 days
+
+    def test_interceptor_rewrites(self):
+        class ForceBox:
+            def rewrite(self, type_name: str, f: Filter) -> Filter:
+                return And((BBox("geom", 0.0, 0.0, 20.0, 20.0), f))
+
+        ds = _store(interceptors=[ForceBox()])
+        out = ds.query("g")
+        x = out.columns["geom"].x
+        y = out.columns["geom"].y
+        assert ((x >= 0) & (x <= 20) & (y >= 0) & (y <= 20)).all()
+
+
+class TestAuditMetrics:
+    def test_audit_events(self):
+        audit = AuditWriter()
+        ds = _store(audit=audit)
+        ds.query("g", Q_OK)
+        ds.query("g", "name = 'x'")
+        events = audit.drain()
+        assert len(events) == 2
+        assert events[0]["strategy"] == "z3"
+        assert events[1]["strategy"] == "full-scan"
+        assert events[0]["planTimeMillis"] >= 0
+        assert audit.drain() == []
+
+    def test_metrics(self):
+        reg = MetricsRegistry()
+        ds = _store(metrics=reg)
+        ds.query("g", Q_OK)
+        snap = reg.snapshot()
+        assert snap["counters"]["geomesa.query.count"] == 1
+        assert snap["timers"]["geomesa.query.scan"]["count"] == 1
+        text = reg.render_prometheus()
+        assert "geomesa_query_count 1" in text
+
+    def test_timer_context(self):
+        reg = MetricsRegistry()
+        with reg.time("op"):
+            pass
+        assert reg.timers["op"].count == 1
