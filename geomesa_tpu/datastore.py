@@ -16,6 +16,7 @@ lookup is pointer-chasing, not a scan).
 
 from __future__ import annotations
 
+import time
 from typing import Iterable, Mapping, Optional, Sequence
 
 import numpy as np
@@ -295,8 +296,6 @@ class DataStore:
             f = ecql.parse(f)
         if envelope is None:
             envelope = (-180.0, -90.0, 180.0, 90.0)
-        import time as _time
-
         plan = self.planner.plan(type_name, f)
         cfg = plan.config
         # gate on plan.filter: interceptors may have rewritten the query
@@ -307,10 +306,11 @@ class DataStore:
         )
         if device_ok:
             if cfg.disjoint:
+                self.record_query(plan, 0, 0.0)
                 return np.zeros((height, width), dtype=np.float32)
-            t0 = _time.perf_counter()
+            t0 = time.perf_counter()
             grid = self.table(type_name, plan.index).density(cfg, envelope, width, height)
-            self.record_query(plan, int(grid.sum()), _time.perf_counter() - t0)
+            self.record_query(plan, int(grid.sum()), time.perf_counter() - t0)
             return grid
         out = self.planner.execute(plan)
         return _host_density(out, envelope, width, height, weight)
@@ -336,21 +336,19 @@ class DataStore:
 
         if isinstance(f, str):
             f = ecql.parse(f)
-        import time as _time
-
         terms = stat_spec.parse(spec)
         plan = self.planner.plan(type_name, f)
         if estimate and all(t.kind == "count" for t in terms):
             if plan.index is not None and mask_decides_filter(
                 plan.filter, plan.config, self._schemas[type_name]
             ):
-                t0 = _time.perf_counter()
+                t0 = time.perf_counter()
                 n = (
                     0
                     if plan.config.disjoint
                     else self.table(type_name, plan.index).count(plan.config)
                 )
-                self.record_query(plan, n, _time.perf_counter() - t0)
+                self.record_query(plan, n, time.perf_counter() - t0)
                 out = []
                 for _ in terms:
                     c = CountStat()
@@ -373,35 +371,24 @@ class DataStore:
 
         if isinstance(f, str):
             f = ecql.parse(f)
-        if estimate and not isinstance(f, Include):
-            import time as _time
-
-            plan = self.planner.plan(type_name, f)
-            if plan.index is not None and mask_decides_filter(
-                plan.filter, plan.config, self._schemas[type_name]
-            ):
-                table = self.table(type_name, plan.index)
-                if plan.config.disjoint:
-                    return None
-                if hasattr(table, "bounds_stats"):
-                    t0 = _time.perf_counter()
-                    cnt, env = table.bounds_stats(plan.config)
-                    self.record_query(plan, cnt, _time.perf_counter() - t0)
-                    return env
-        out = self.query(type_name, f)
-        if len(out) == 0:
-            return None
-        col = out.geom_column
-        if isinstance(col, PointColumn):
-            return (
-                float(col.x.min()), float(col.y.min()),
-                float(col.x.max()), float(col.y.max()),
-            )
-        b = col.bboxes.astype(np.float64)
-        return (
-            float(b[:, 0].min()), float(b[:, 1].min()),
-            float(b[:, 2].max()), float(b[:, 3].max()),
-        )
+        if isinstance(f, Include):
+            out = self.query(type_name, f)
+            return _exact_bounds(out)
+        plan = self.planner.plan(type_name, f)
+        if estimate and plan.index is not None and mask_decides_filter(
+            plan.filter, plan.config, self._schemas[type_name]
+        ):
+            table = self.table(type_name, plan.index)
+            if plan.config.disjoint:
+                self.record_query(plan, 0, 0.0)
+                return None
+            if hasattr(table, "bounds_stats"):
+                t0 = time.perf_counter()
+                cnt, env = table.bounds_stats(plan.config)
+                self.record_query(plan, cnt, time.perf_counter() - t0)
+                return env
+        out = self.planner.execute(plan)
+        return _exact_bounds(out)
 
     def bin_query(
         self,
@@ -469,6 +456,23 @@ class DataStore:
         if plan.config is not None and not plan.config.disjoint:
             exp(f"Ranges: {plan.config.n_ranges}")
         return exp.render()
+
+
+def _exact_bounds(fc: FeatureCollection) -> Optional[tuple]:
+    """Exact envelope of a result batch's geometries (bboxes for extents)."""
+    if len(fc) == 0:
+        return None
+    col = fc.geom_column
+    if isinstance(col, PointColumn):
+        return (
+            float(col.x.min()), float(col.y.min()),
+            float(col.x.max()), float(col.y.max()),
+        )
+    b = col.bboxes.astype(np.float64)
+    return (
+        float(b[:, 0].min()), float(b[:, 1].min()),
+        float(b[:, 2].max()), float(b[:, 3].max()),
+    )
 
 
 def _host_density(fc: FeatureCollection, envelope, width: int, height: int, weight: str | None) -> np.ndarray:

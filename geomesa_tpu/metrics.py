@@ -83,6 +83,3 @@ class MetricsRegistry:
 
 def _prom(name: str) -> str:
     return name.replace(".", "_").replace("-", "_")
-
-
-REGISTRY = MetricsRegistry()

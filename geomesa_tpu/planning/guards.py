@@ -22,6 +22,29 @@ from geomesa_tpu.planning.planner import QueryGuardError, QueryPlan
 WHOLE_WORLD_AREA = 360.0 * 180.0
 
 
+def _union_area(boxes) -> float:
+    """Exact union area of axis-aligned boxes via coordinate compression
+    (OR'd boxes may overlap; summing would double-count and falsely trip
+    the guard)."""
+    import numpy as np
+
+    b = np.asarray(list(boxes), dtype=np.float64).reshape(-1, 4)
+    xs = np.unique(np.concatenate([b[:, 0], b[:, 2]]))
+    ys = np.unique(np.concatenate([b[:, 1], b[:, 3]]))
+    if len(xs) < 2 or len(ys) < 2:
+        return 0.0
+    cx = (xs[:-1] + xs[1:]) / 2
+    cy = (ys[:-1] + ys[1:]) / 2
+    covered = np.zeros((len(cy), len(cx)), dtype=bool)
+    for x0, y0, x1, y1 in b:
+        covered |= (
+            ((cx >= x0) & (cx <= x1))[None, :] & ((cy >= y0) & (cy <= y1))[:, None]
+        )
+    wx = np.diff(xs)[None, :]
+    wy = np.diff(ys)[:, None]
+    return float((covered * wx * wy).sum())
+
+
 @runtime_checkable
 class QueryInterceptor(Protocol):
     """Rewrites a filter before planning (reference QueryInterceptor SPI).
@@ -100,9 +123,7 @@ class GraduatedQueryGuard:
         if geoms.disjoint:
             return
         if geoms.values:
-            area = sum(
-                (x1 - x0) * (y1 - y0) for x0, y0, x1, y1 in geometry_bounds(geoms)
-            )
+            area = _union_area(geometry_bounds(geoms))
         else:
             area = WHOLE_WORLD_AREA
         limit = None

@@ -13,6 +13,7 @@ f64) -> limit.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -137,8 +138,6 @@ class QueryPlanner:
         limit: Optional[int] = None,
         explain: Explainer | None = None,
     ) -> QueryPlan:
-        import time
-
         t0 = time.perf_counter()
         exp = explain or ExplainNull()
         if isinstance(f, str):
@@ -207,8 +206,6 @@ class QueryPlanner:
         explain: Explainer | None = None,
         hints=None,
     ) -> FeatureCollection:
-        import time
-
         t0 = time.perf_counter()
         out = self._execute(plan, explain, hints)
         self.store.record_query(plan, len(out), time.perf_counter() - t0)

@@ -1,0 +1,72 @@
+"""k-nearest-neighbour search over an indexed store.
+
+Reference: KNearestNeighborSearchProcess (/root/reference/geomesa-process/
+src/main/scala/org/locationtech/geomesa/process/query/
+KNearestNeighborSearchProcess.scala:40) — seeds a search envelope from an
+estimated distance, queries the store, and widens the window until k
+neighbours are found or the cutoff is hit. Same expanding-window protocol
+here; per-candidate distances are one vectorized haversine over the
+gathered batch rather than a per-feature priority queue.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import And, BBox, Filter, Include
+
+EARTH_RADIUS_M = 6_371_000.0
+
+
+def haversine_m(lon1, lat1, lon2, lat2) -> np.ndarray:
+    """Great-circle distance in meters (vectorized)."""
+    lon1, lat1, lon2, lat2 = (np.radians(np.asarray(v, dtype=np.float64)) for v in (lon1, lat1, lon2, lat2))
+    dlon = lon2 - lon1
+    dlat = lat2 - lat1
+    a = np.sin(dlat / 2) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin(dlon / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.minimum(1.0, np.sqrt(a)))
+
+
+def _meters_to_degrees(m: float, lat: float) -> float:
+    """Conservative (over-wide) degree radius for a meter distance."""
+    lat_deg = m / 111_320.0
+    lon_deg = lat_deg / max(0.01, np.cos(np.radians(min(abs(lat), 89.0))))
+    return float(max(lat_deg, lon_deg))
+
+
+def knn_search(
+    store,
+    type_name: str,
+    x: float,
+    y: float,
+    k: int,
+    estimated_distance_m: float = 10_000.0,
+    max_distance_m: float = 1_000_000.0,
+    filter: Filter = Include(),
+) -> FeatureCollection:
+    """The k features nearest (x, y), ordered nearest-first.
+
+    Expands the query window from ``estimated_distance_m`` by doubling
+    until k in-radius hits exist or ``max_distance_m`` is reached
+    (reference's KNNQuery window protocol).
+    """
+    sft = store.get_schema(type_name)
+    geom = sft.geom_field
+    radius = float(estimated_distance_m)
+    while True:
+        deg = _meters_to_degrees(radius, y)
+        box = BBox(geom, x - deg, max(y - deg, -90.0), x + deg, min(y + deg, 90.0))
+        f = box if isinstance(filter, Include) else And((box, filter))
+        out = store.query(type_name, f)
+        if len(out):
+            cx, cy = out.representative_xy()
+            d = haversine_m(x, y, cx, cy)
+            in_radius = d <= radius
+            if in_radius.sum() >= k or radius >= max_distance_m:
+                keep = np.nonzero(in_radius)[0]
+                order = keep[np.argsort(d[keep], kind="stable")][:k]
+                return out.take(order)
+        elif radius >= max_distance_m:
+            return out
+        radius = min(radius * 2.0, max_distance_m)

@@ -1,0 +1,52 @@
+"""Proximity search: features within a distance of a set of input points.
+
+Reference: ProximitySearchProcess (/root/reference/geomesa-process/src/
+main/scala/org/locationtech/geomesa/process/query/
+ProximitySearchProcess.scala) — buffers each input geometry and unions the
+results. Here: one store query over the union of buffered bboxes, then a
+vectorized min-distance-to-any-input refinement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import And, BBox, Filter, Include, Or
+from geomesa_tpu.process.knn import _meters_to_degrees, haversine_m
+
+
+def proximity_search(
+    store,
+    type_name: str,
+    points: "np.ndarray | list",
+    distance_m: float,
+    filter: Filter = Include(),
+) -> FeatureCollection:
+    """Features within ``distance_m`` meters of any of the (x, y) points."""
+    pts = np.asarray(points, dtype=np.float64).reshape(-1, 2)
+    if len(pts) == 0:
+        return _empty(store, type_name)
+    sft = store.get_schema(type_name)
+    geom = sft.geom_field
+    boxes = []
+    for x, y in pts:
+        deg = _meters_to_degrees(distance_m, y)
+        boxes.append(
+            BBox(geom, x - deg, max(y - deg, -90.0), x + deg, min(y + deg, 90.0))
+        )
+    spatial: Filter = boxes[0] if len(boxes) == 1 else Or(tuple(boxes))
+    f = spatial if isinstance(filter, Include) else And((spatial, filter))
+    out = store.query(type_name, f)
+    if len(out) == 0:
+        return out
+    cx, cy = out.representative_xy()
+    # [n, p] pairwise distances; keep rows within range of any input
+    d = haversine_m(
+        cx[:, None], cy[:, None], pts[None, :, 0], pts[None, :, 1]
+    )
+    return out.mask(d.min(axis=1) <= distance_m)
+
+
+def _empty(store, type_name: str) -> FeatureCollection:
+    return store.features(type_name).take(np.zeros(0, dtype=np.int64))

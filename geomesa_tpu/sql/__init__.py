@@ -1,0 +1,12 @@
+"""SQL-style spatial analytics: the geomesa-spark analogue.
+
+- ``functions``: the ST_* function library (spark-jts UDFs,
+  /root/reference/geomesa-spark/geomesa-spark-jts/.../udf/)
+- ``join``: grid-partitioned spatial join (GeoMesaJoinRelation,
+  /root/reference/geomesa-spark/geomesa-spark-sql/.../GeoMesaRelation.scala:69-91)
+"""
+
+from geomesa_tpu.sql.functions import FUNCTIONS, st_call
+from geomesa_tpu.sql.join import spatial_join
+
+__all__ = ["FUNCTIONS", "st_call", "spatial_join"]

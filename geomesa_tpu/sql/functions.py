@@ -1,0 +1,300 @@
+"""ST_* spatial function library.
+
+Reference: the ~60 spark-jts UDFs (/root/reference/geomesa-spark/
+geomesa-spark-jts/src/main/scala/org/locationtech/geomesa/spark/jts/udf/ —
+GeometricConstructorFunctions, GeometricAccessorFunctions,
+SpatialRelationFunctions, GeometricOutputFunctions,
+GeometricProcessingFunctions). Functions take/return Geometry scalars or
+lists of geometries (columnar batches map over them); every function is
+registered in ``FUNCTIONS`` for name-based dispatch (``st_call``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.process.knn import haversine_m
+
+FUNCTIONS: dict[str, Callable] = {}
+
+
+def _register(fn: Callable) -> Callable:
+    FUNCTIONS[fn.__name__] = fn
+    return fn
+
+
+def st_call(name: str, *args):
+    """Dispatch an ST_ function by (case-insensitive) name."""
+    fn = FUNCTIONS.get(name.lower())
+    if fn is None:
+        raise KeyError(f"unknown function {name!r}")
+    return fn(*args)
+
+
+# -- constructors (GeometricConstructorFunctions) ------------------------
+
+@_register
+def st_point(x: float, y: float) -> geo.Point:
+    return geo.Point(float(x), float(y))
+
+
+@_register
+def st_makepoint(x: float, y: float) -> geo.Point:
+    return geo.Point(float(x), float(y))
+
+
+@_register
+def st_makebbox(xmin: float, ymin: float, xmax: float, ymax: float) -> geo.Polygon:
+    return geo.box(xmin, ymin, xmax, ymax)
+
+
+@_register
+def st_makeline(points: Sequence) -> geo.LineString:
+    coords = [(p.x, p.y) if isinstance(p, geo.Point) else tuple(p) for p in points]
+    return geo.LineString(np.asarray(coords, dtype=np.float64))
+
+
+@_register
+def st_makepolygon(shell: "geo.LineString | Sequence") -> geo.Polygon:
+    ring = shell.coords if isinstance(shell, geo.LineString) else np.asarray(shell)
+    return geo.Polygon(ring)
+
+
+@_register
+def st_geomfromwkt(wkt: str) -> geo.Geometry:
+    return geo.from_wkt(wkt)
+
+
+@_register
+def st_geomfromwkb(wkb: bytes) -> geo.Geometry:
+    return geo.from_wkb(wkb)
+
+
+# -- accessors (GeometricAccessorFunctions) ------------------------------
+
+@_register
+def st_x(g: geo.Geometry) -> float:
+    if not isinstance(g, geo.Point):
+        raise TypeError("st_x requires a Point")
+    return g.x
+
+
+@_register
+def st_y(g: geo.Geometry) -> float:
+    if not isinstance(g, geo.Point):
+        raise TypeError("st_y requires a Point")
+    return g.y
+
+
+@_register
+def st_envelope(g: geo.Geometry) -> geo.Polygon:
+    return geo.box(*g.bounds())
+
+
+@_register
+def st_geometrytype(g: geo.Geometry) -> str:
+    return g.geom_type
+
+
+@_register
+def st_numpoints(g: geo.Geometry) -> int:
+    return g._coord_count()
+
+
+@_register
+def st_isvalid(g: geo.Geometry) -> bool:
+    b = g.bounds()
+    return all(math.isfinite(v) for v in b)
+
+
+@_register
+def st_area(g: geo.Geometry) -> float:
+    if isinstance(g, geo.Polygon):
+        return g.area
+    if isinstance(g, geo.MultiPolygon):
+        return sum(p.area for p in g.parts)
+    return 0.0
+
+
+@_register
+def st_length(g: geo.Geometry) -> float:
+    if isinstance(g, geo.LineString):
+        return g.length
+    if isinstance(g, geo.MultiLineString):
+        return sum(p.length for p in g.parts)
+    return 0.0
+
+
+@_register
+def st_centroid(g: geo.Geometry) -> geo.Point:
+    if isinstance(g, geo.Point):
+        return g
+    if isinstance(g, geo.Polygon):
+        return _polygon_centroid(g)
+    if isinstance(g, geo.LineString):
+        c = g.coords
+        seg = np.linalg.norm(np.diff(c, axis=0), axis=1)
+        if seg.sum() == 0:
+            return geo.Point(float(c[0, 0]), float(c[0, 1]))
+        mid = (c[:-1] + c[1:]) / 2
+        w = seg / seg.sum()
+        return geo.Point(float((mid[:, 0] * w).sum()), float((mid[:, 1] * w).sum()))
+    # multis: area/length/count-weighted mean of part centroids
+    if isinstance(g, (geo.MultiPoint, geo.MultiLineString, geo.MultiPolygon)):
+        pts = [st_centroid(p) for p in g.parts]
+        ws = [max(st_area(p) + st_length(p), 1e-30) for p in g.parts]
+        tot = sum(ws)
+        return geo.Point(
+            sum(p.x * w for p, w in zip(pts, ws)) / tot,
+            sum(p.y * w for p, w in zip(pts, ws)) / tot,
+        )
+    x0, y0, x1, y1 = g.bounds()
+    return geo.Point((x0 + x1) / 2, (y0 + y1) / 2)
+
+
+def _polygon_centroid(p: geo.Polygon) -> geo.Point:
+    def ring_terms(ring):
+        x, y = ring[:, 0], ring[:, 1]
+        x1, y1 = np.roll(x, -1), np.roll(y, -1)
+        cross = x * y1 - x1 * y
+        a = cross.sum() / 2.0
+        if a == 0:
+            return 0.0, x.mean(), y.mean()
+        cx = ((x + x1) * cross).sum() / (6 * a)
+        cy = ((y + y1) * cross).sum() / (6 * a)
+        return a, cx, cy
+
+    a0, cx0, cy0 = ring_terms(p.shell)
+    area, mx, my = abs(a0), abs(a0) * cx0, abs(a0) * cy0
+    for h in p.holes:
+        ah, cxh, cyh = ring_terms(h)
+        area -= abs(ah)
+        mx -= abs(ah) * cxh
+        my -= abs(ah) * cyh
+    if area <= 0:
+        x0, y0, x1, y1 = p.bounds()
+        return geo.Point((x0 + x1) / 2, (y0 + y1) / 2)
+    return geo.Point(mx / area, my / area)
+
+
+@_register
+def st_exteriorring(g: geo.Polygon) -> geo.LineString:
+    return geo.LineString(g.shell)
+
+
+# -- relations (SpatialRelationFunctions) --------------------------------
+
+@_register
+def st_intersects(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return geo.intersects(a, b)
+
+
+@_register
+def st_disjoint(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return not geo.intersects(a, b)
+
+
+@_register
+def st_contains(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return geo.contains(a, b)
+
+
+@_register
+def st_within(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return geo.contains(b, a)
+
+
+@_register
+def st_covers(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return geo.contains(a, b)
+
+
+@_register
+def st_distance(a: geo.Geometry, b: geo.Geometry) -> float:
+    return geo.distance(a, b)
+
+
+@_register
+def st_distancespheroid(a: geo.Geometry, b: geo.Geometry) -> float:
+    """Meters between representative points (great-circle; the reference
+    delegates to geodetic JTS calculators)."""
+    ax, ay = _rep(a)
+    bx, by = _rep(b)
+    return float(haversine_m(ax, ay, bx, by))
+
+
+@_register
+def st_dwithin(a: geo.Geometry, b: geo.Geometry, d: float) -> bool:
+    return geo.distance(a, b) <= d
+
+
+@_register
+def st_equals(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return a == b
+
+
+@_register
+def st_overlaps(a: geo.Geometry, b: geo.Geometry) -> bool:
+    return (
+        geo.intersects(a, b)
+        and not geo.contains(a, b)
+        and not geo.contains(b, a)
+    )
+
+
+def _rep(g: geo.Geometry):
+    if isinstance(g, geo.Point):
+        return g.x, g.y
+    x0, y0, x1, y1 = g.bounds()
+    return (x0 + x1) / 2, (y0 + y1) / 2
+
+
+# -- outputs / processing ------------------------------------------------
+
+@_register
+def st_astext(g: geo.Geometry) -> str:
+    return geo.to_wkt(g)
+
+
+@_register
+def st_asbinary(g: geo.Geometry) -> bytes:
+    return geo.to_wkb(g)
+
+
+@_register
+def st_bufferpoint(g: geo.Point, meters: float, segments: int = 32) -> geo.Polygon:
+    """Geodesic-ish circular buffer of a point (reference ST_BufferPoint):
+    a ring of ``segments`` vertices at the meter radius."""
+    lat_deg = meters / 111_320.0
+    lon_deg = lat_deg / max(0.01, math.cos(math.radians(min(abs(g.y), 89.0))))
+    t = np.linspace(0, 2 * np.pi, segments, endpoint=False)
+    ring = np.stack([g.x + lon_deg * np.cos(t), g.y + lat_deg * np.sin(t)], axis=1)
+    return geo.Polygon(ring)
+
+
+@_register
+def st_translate(g: geo.Geometry, dx: float, dy: float) -> geo.Geometry:
+    return geo.from_wkb(_translate_wkb(geo.to_wkb(g), dx, dy))
+
+
+def _translate_wkb(wkb: bytes, dx: float, dy: float) -> bytes:
+    g = geo.from_wkb(wkb)
+
+    def shift(ring):
+        out = np.asarray(ring, dtype=np.float64).copy()
+        out[:, 0] += dx
+        out[:, 1] += dy
+        return out
+
+    if isinstance(g, geo.Point):
+        return geo.to_wkb(geo.Point(g.x + dx, g.y + dy))
+    if isinstance(g, geo.LineString):
+        return geo.to_wkb(geo.LineString(shift(g.coords)))
+    if isinstance(g, geo.Polygon):
+        return geo.to_wkb(geo.Polygon(shift(g.shell), [shift(h) for h in g.holes]))
+    parts = [geo.from_wkb(_translate_wkb(geo.to_wkb(p), dx, dy)) for p in g.parts]
+    return geo.to_wkb(type(g)(parts))

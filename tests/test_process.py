@@ -1,0 +1,113 @@
+"""Processes (kNN, proximity, tube select, unique) against brute force."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.process import knn_search, proximity_search, tube_select, unique_values
+from geomesa_tpu.process.knn import haversine_m
+from geomesa_tpu.sft import FeatureType
+
+SPEC = "kind:String,dtg:Date,*geom:Point:srid=4326"
+DAY = 86400_000
+
+
+@pytest.fixture(scope="module")
+def ds():
+    sft = FeatureType.from_spec("p", SPEC)
+    store = DataStore(tile=64)
+    store.create_schema(sft)
+    n = 4000
+    rng = np.random.default_rng(7)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    x = rng.uniform(-10, 10, n)
+    y = rng.uniform(-10, 10, n)
+    t = t0 + rng.integers(0, 10 * DAY, n)
+    fc = FeatureCollection.from_columns(
+        sft,
+        [str(i) for i in range(n)],
+        {
+            "kind": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+            "dtg": t,
+            "geom": (x, y),
+        },
+    )
+    store.write("p", fc)
+    return store, fc, (x, y, t, t0)
+
+
+class TestKnn:
+    def test_matches_brute_force(self, ds):
+        store, fc, (x, y, _, _) = ds
+        out = knn_search(store, "p", 1.0, 2.0, k=15, estimated_distance_m=5_000)
+        d = haversine_m(1.0, 2.0, x, y)
+        want = np.argsort(d, kind="stable")[:15]
+        got = sorted(out.ids.tolist())
+        assert got == sorted(fc.ids[want].tolist())
+        # ordered nearest-first
+        dx, dy = out.representative_xy()
+        dists = haversine_m(1.0, 2.0, dx, dy)
+        assert (np.diff(dists) >= 0).all()
+
+    def test_k_larger_than_data(self, ds):
+        store, fc, _ = ds
+        out = knn_search(
+            store, "p", 0.0, 0.0, k=10**6, max_distance_m=5_000_000
+        )
+        assert len(out) == len(fc)
+
+    def test_with_filter(self, ds):
+        store, fc, (x, y, _, _) = ds
+        from geomesa_tpu.filter import ecql
+
+        out = knn_search(store, "p", 0.0, 0.0, k=5, filter=ecql.parse("kind = 'a'"))
+        assert set(np.asarray(out.columns["kind"])) == {"a"}
+        kinds = np.asarray(fc.columns["kind"])
+        d = haversine_m(0.0, 0.0, x, y)
+        d[kinds != "a"] = np.inf
+        want = np.argsort(d, kind="stable")[:5]
+        assert sorted(out.ids.tolist()) == sorted(fc.ids[want].tolist())
+
+
+class TestProximity:
+    def test_matches_brute_force(self, ds):
+        store, fc, (x, y, _, _) = ds
+        pts = [(0.0, 0.0), (5.0, 5.0)]
+        out = proximity_search(store, "p", pts, distance_m=100_000)
+        d = np.minimum(
+            haversine_m(0.0, 0.0, x, y), haversine_m(5.0, 5.0, x, y)
+        )
+        truth = d <= 100_000
+        assert sorted(out.ids.tolist()) == sorted(fc.ids[truth].tolist())
+
+    def test_empty_inputs(self, ds):
+        store, _, _ = ds
+        assert len(proximity_search(store, "p", [], 1000)) == 0
+
+
+class TestTube:
+    def test_corridor(self, ds):
+        store, fc, (x, y, t, t0) = ds
+        track_xy = [(-5.0, -5.0), (0.0, 0.0), (5.0, 5.0)]
+        track_t = [t0, t0 + 5 * DAY, t0 + 10 * DAY]
+        out = tube_select(store, "p", track_xy, track_t, buffer_m=150_000)
+        # brute force: distance to interpolated position at each row's time
+        px = np.interp(t, np.array(track_t), np.array([p[0] for p in track_xy]))
+        py = np.interp(t, np.array(track_t), np.array([p[1] for p in track_xy]))
+        truth = haversine_m(x, y, px, py) <= 150_000
+        assert sorted(out.ids.tolist()) == sorted(fc.ids[truth].tolist())
+
+    def test_bad_track(self, ds):
+        store, _, _ = ds
+        with pytest.raises(ValueError):
+            tube_select(store, "p", [(0, 0)], [0], buffer_m=100)
+
+
+class TestUnique:
+    def test_counts(self, ds):
+        store, fc, _ = ds
+        pairs = unique_values(store, "p", "kind", sort_by_count=True)
+        vals, cnts = np.unique(np.asarray(fc.columns["kind"]), return_counts=True)
+        assert dict(pairs) == dict(zip(vals.tolist(), cnts.tolist()))
+        assert pairs[0][1] == max(cnts)

@@ -1,0 +1,126 @@
+"""ST_ function library and grid-partitioned spatial join."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.sql import FUNCTIONS, spatial_join, st_call
+from geomesa_tpu.sql import functions as F
+
+
+class TestFunctions:
+    def test_registry_size(self):
+        assert len(FUNCTIONS) >= 30
+
+    def test_constructors(self):
+        p = st_call("ST_Point", 1.0, 2.0)
+        assert (p.x, p.y) == (1.0, 2.0)
+        b = F.st_makebbox(0, 0, 2, 2)
+        assert b.bounds() == (0, 0, 2, 2)
+        line = F.st_makeline([F.st_point(0, 0), F.st_point(3, 4)])
+        assert F.st_length(line) == 5.0
+        g = F.st_geomfromwkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))")
+        assert F.st_area(g) == 16.0
+
+    def test_accessors(self):
+        g = geo.box(0, 0, 2, 4)
+        assert F.st_geometrytype(g) == "Polygon"
+        env = F.st_envelope(g)
+        assert env.bounds() == (0, 0, 2, 4)
+        c = F.st_centroid(g)
+        assert (round(c.x, 9), round(c.y, 9)) == (1.0, 2.0)
+
+    def test_centroid_with_hole(self):
+        outer = np.array([[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]], float)
+        hole = np.array([[6, 4], [9, 4], [9, 6], [6, 6], [6, 4]], float)
+        c = F.st_centroid(geo.Polygon(outer, [hole]))
+        assert c.x < 5.0  # hole on the right pulls centroid left
+        assert abs(c.y - 5.0) < 1e-9
+
+    def test_relations(self):
+        a = geo.box(0, 0, 4, 4)
+        b = geo.box(2, 2, 6, 6)
+        c = geo.box(10, 10, 11, 11)
+        assert F.st_intersects(a, b) and not F.st_intersects(a, c)
+        assert F.st_disjoint(a, c)
+        assert F.st_contains(a, geo.Point(1, 1))
+        assert F.st_within(geo.Point(1, 1), a)
+        assert F.st_overlaps(a, b) and not F.st_overlaps(a, c)
+        assert F.st_distance(a, c) == pytest.approx(np.hypot(6, 6))
+        assert F.st_dwithin(a, b, 0.1)
+
+    def test_outputs(self):
+        g = geo.Point(3.5, -2.25)
+        assert geo.from_wkt(F.st_astext(g)) == g
+        assert geo.from_wkb(F.st_asbinary(g)) == g
+
+    def test_buffer_point(self):
+        ring = F.st_bufferpoint(geo.Point(0, 0), 111_320.0)
+        x0, y0, x1, y1 = ring.bounds()
+        assert 0.9 < y1 < 1.1 and -1.1 < y0 < -0.9
+
+    def test_translate(self):
+        g = geo.box(0, 0, 1, 1)
+        t = F.st_translate(g, 5, -2)
+        assert t.bounds() == (5, -2, 6, -1)
+
+    def test_unknown(self):
+        with pytest.raises(KeyError):
+            st_call("ST_Bogus", 1)
+
+
+def _points_fc(xy, name="pts"):
+    sft = FeatureType.from_spec(name, "*geom:Point:srid=4326")
+    xy = np.asarray(xy, dtype=np.float64)
+    return FeatureCollection.from_columns(
+        sft, np.arange(len(xy)).astype(str), {"geom": (xy[:, 0], xy[:, 1])}
+    )
+
+
+def _polys_fc(polys, name="polys"):
+    sft = FeatureType.from_spec(name, "*geom:Polygon:srid=4326")
+    return FeatureCollection.from_columns(
+        sft, np.arange(len(polys)).astype(str), {"geom": polys}
+    )
+
+
+class TestSpatialJoin:
+    def test_points_in_polygons(self):
+        rng = np.random.default_rng(1)
+        pts = rng.uniform(0, 10, (500, 2))
+        polys = [geo.box(0, 0, 3, 3), geo.box(5, 5, 9, 9), geo.box(2, 2, 4, 4)]
+        li, ri = spatial_join(_polys_fc(polys), _points_fc(pts), "contains")
+        # brute force
+        want = set()
+        for i, p in enumerate(polys):
+            x0, y0, x1, y1 = p.bounds()
+            for j, (x, y) in enumerate(pts):
+                if x0 <= x <= x1 and y0 <= y <= y1:
+                    want.add((i, j))
+        assert set(zip(li.tolist(), ri.tolist())) == want
+
+    def test_intersects_polygons(self):
+        a = [geo.box(0, 0, 2, 2), geo.box(10, 10, 12, 12)]
+        b = [geo.box(1, 1, 3, 3), geo.box(20, 20, 21, 21), geo.box(11, 9, 13, 11)]
+        li, ri = spatial_join(_polys_fc(a), _polys_fc(b, "b"), "intersects")
+        assert set(zip(li.tolist(), ri.tolist())) == {(0, 0), (1, 2)}
+
+    def test_dwithin_points(self):
+        a = _points_fc([(0, 0), (5, 5)])
+        b = _points_fc([(0.5, 0.0), (4.0, 4.0), (30, 30)], "b")
+        li, ri = spatial_join(a, b, "dwithin", max_distance=1.6)
+        assert set(zip(li.tolist(), ri.tolist())) == {(0, 0), (1, 1)}
+
+    def test_empty(self):
+        a = _points_fc(np.zeros((0, 2)))
+        b = _points_fc([(1, 1)])
+        li, ri = spatial_join(a, b)
+        assert len(li) == 0 and len(ri) == 0
+
+    def test_disjoint_envelopes(self):
+        a = _points_fc([(0, 0)])
+        b = _points_fc([(50, 50)], "b")
+        li, _ = spatial_join(a, b)
+        assert len(li) == 0
