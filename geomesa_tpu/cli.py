@@ -1,0 +1,243 @@
+"""Command-line interface: catalog management, ingest, export, explain,
+stats.
+
+Reference: geomesa-tools' JCommander command tree (/root/reference/
+geomesa-tools/src/main/scala/org/locationtech/geomesa/tools/Runner.scala:
+30-70 — create-schema / ingest / export / explain / stats-* / ...). The
+catalog (`-c`) is a persistence directory (geomesa_tpu.storage.persist):
+commands load the store, run, and save back when they mutate.
+
+    python -m geomesa_tpu.cli create-schema -c /data/cat -f gdelt \
+        -s "dtg:Date,*geom:Point:srid=4326"
+    python -m geomesa_tpu.cli ingest -c /data/cat -f gdelt --infer data.csv
+    python -m geomesa_tpu.cli export -c /data/cat -f gdelt \
+        -q "bbox(geom,-10,-10,10,10)" --format geojson
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from geomesa_tpu.storage import persist
+
+
+def _load(args):
+    return persist.load(args.catalog)
+
+
+def cmd_version(args) -> int:
+    from geomesa_tpu import __version__
+
+    print(f"geomesa_tpu {__version__}")
+    return 0
+
+
+def cmd_env(args) -> int:
+    import jax
+
+    print(f"devices: {jax.devices()}")
+    print(f"backend: {jax.default_backend()}")
+    return 0
+
+
+def cmd_create_schema(args) -> int:
+    import os
+
+    from geomesa_tpu.datastore import DataStore
+
+    if os.path.exists(f"{args.catalog}/metadata.json"):
+        ds = _load(args)
+    else:
+        ds = DataStore()
+    ds.create_schema(args.feature_name, args.spec)
+    persist.save(ds, args.catalog)
+    print(f"created schema '{args.feature_name}'")
+    return 0
+
+
+def cmd_get_type_names(args) -> int:
+    for n in _load(args).type_names():
+        print(n)
+    return 0
+
+
+def cmd_describe_schema(args) -> int:
+    sft = _load(args).get_schema(args.feature_name)
+    for a in sft.attributes:
+        flags = []
+        if a.name == sft.geom_field:
+            flags.append("default geometry")
+        if a.indexed:
+            flags.append("indexed")
+        extra = f" ({', '.join(flags)})" if flags else ""
+        print(f"{a.name}: {a.type}{extra}")
+    return 0
+
+
+def cmd_delete_schema(args) -> int:
+    ds = _load(args)
+    ds.delete_schema(args.feature_name)
+    persist.save(ds, args.catalog)
+    print(f"deleted schema '{args.feature_name}'")
+    return 0
+
+
+def _converter_from_file(sft, path: str):
+    from geomesa_tpu.io.converters import Converter, FieldSpec
+
+    with open(path) as fh:
+        conf = json.load(fh)
+    return Converter(
+        sft=sft,
+        fields=[FieldSpec(f["name"], f["transform"]) for f in conf["fields"]],
+        id_field=conf.get("id-field"),
+        fmt=conf.get("format", "delimited"),
+        delimiter=conf.get("delimiter", ","),
+        skip_lines=int(conf.get("skip-lines", 0)),
+    )
+
+
+def cmd_ingest(args) -> int:
+    import os
+
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.io.converters import infer_schema
+
+    if os.path.exists(f"{args.catalog}/metadata.json"):
+        ds = _load(args)
+    else:
+        ds = DataStore()
+
+    total = 0
+    for path in args.files:
+        with open(path) as fh:
+            data = fh.read()
+        if args.infer:
+            import csv as _csv
+            import io as _io
+
+            rows = [r for r in _csv.reader(_io.StringIO(data)) if r]
+            header = rows[0] if args.header else None
+            body = rows[1:] if args.header else rows
+            sft, conv = infer_schema(args.feature_name, body, header=header)
+            if args.feature_name not in ds.type_names():
+                ds.create_schema(sft)
+            if args.header:
+                conv.skip_lines = 1
+        else:
+            sft = ds.get_schema(args.feature_name)
+            conv = _converter_from_file(sft, args.converter)
+        fc = conv.convert(data)
+        if conv._id_expr is None:
+            # default running-index ids restart per file; offset by the
+            # store's current size so repeat ingests stay unique
+            base = len(ds.features(args.feature_name))
+            fc = type(fc)(
+                fc.sft,
+                np.array([str(base + i) for i in range(len(fc))]),
+                fc.columns,
+            )
+        n = ds.write(args.feature_name, fc)  # duplicate-id check stays on
+        total += n
+        if conv.errors:
+            print(f"{path}: {conv.errors} records failed to parse", file=sys.stderr)
+    persist.save(ds, args.catalog)
+    print(f"ingested {total} features into '{args.feature_name}'")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from geomesa_tpu.io.exporters import export
+
+    ds = _load(args)
+    out = ds.query(args.feature_name, args.cql or "INCLUDE", limit=args.max_features)
+    payload = export(out, args.format)
+    if args.output:
+        mode = "wb" if isinstance(payload, bytes) else "w"
+        with open(args.output, mode) as fh:
+            fh.write(payload)
+        print(f"exported {len(out)} features to {args.output}")
+    else:
+        sys.stdout.write(payload if isinstance(payload, str) else payload.hex())
+    return 0
+
+
+def cmd_explain(args) -> int:
+    print(_load(args).explain(args.feature_name, args.cql))
+    return 0
+
+
+def cmd_stats(args) -> int:
+    from geomesa_tpu.stats import stat_spec
+
+    ds = _load(args)
+    results = ds.stats_query(args.feature_name, args.spec, args.cql or "INCLUDE")
+    print(json.dumps(stat_spec.to_json(results), default=str))
+    return 0
+
+
+def cmd_count(args) -> int:
+    print(_load(args).count(args.feature_name, args.cql or "INCLUDE"))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, *, catalog=True, feature=False):
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+        if catalog:
+            sp.add_argument("-c", "--catalog", required=True, help="store directory")
+        if feature:
+            sp.add_argument("-f", "--feature-name", required=True)
+        return sp
+
+    add("version", cmd_version, catalog=False)
+    add("env", cmd_env, catalog=False)
+
+    sp = add("create-schema", cmd_create_schema, feature=True)
+    sp.add_argument("-s", "--spec", required=True)
+
+    add("get-type-names", cmd_get_type_names)
+    add("describe-schema", cmd_describe_schema, feature=True)
+    add("delete-schema", cmd_delete_schema, feature=True)
+
+    sp = add("ingest", cmd_ingest, feature=True)
+    how = sp.add_mutually_exclusive_group(required=True)
+    how.add_argument("--converter", help="converter config (json)")
+    how.add_argument("--infer", action="store_true", help="infer schema from csv")
+    sp.add_argument("--header", action="store_true", help="first row is a header")
+    sp.add_argument("files", nargs="+")
+
+    sp = add("export", cmd_export, feature=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("--format", default="csv")
+    sp.add_argument("-o", "--output")
+    sp.add_argument("-m", "--max-features", type=int)
+
+    sp = add("explain", cmd_explain, feature=True)
+    sp.add_argument("-q", "--cql", required=True)
+
+    sp = add("stats", cmd_stats, feature=True)
+    sp.add_argument("--spec", default="Count()")
+    sp.add_argument("-q", "--cql")
+
+    sp = add("count", cmd_count, feature=True)
+    sp.add_argument("-q", "--cql")
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+"""IO tier: exporters and ingest converters (the geomesa-features
+exporters + geomesa-convert analogue, SURVEY.md §2.3/§2.5)."""
+
+from geomesa_tpu.io.exporters import export
+from geomesa_tpu.io.converters import Converter, infer_schema
+
+__all__ = ["export", "Converter", "infer_schema"]

@@ -1,0 +1,313 @@
+"""Ingest converters: config-driven parsing of delimited text and JSON
+into feature batches.
+
+Reference: geomesa-convert (/root/reference/geomesa-convert/
+geomesa-convert-common/src/main/scala/org/locationtech/geomesa/convert2/
+SimpleFeatureConverter.scala:28, transforms/Expression.scala,
+TypeInference.scala). The reference's HOCON config + expression DSL maps
+to a Converter built from field specs using the same expression shapes:
+
+    $1                      column reference (1-based, $0 = whole record)
+    $1::int  $2::double     casts (::int ::long ::double ::string)
+    point($1, $2)           geometry constructors (also geomFromWkt($1))
+    datetime($3)            ISO-8601 -> epoch millis
+    concat($1, '-', $2)     string concat; 'lit' literals
+    md5($1) / uuid()        id functions
+
+JSON records address fields with $.a.b paths instead of $N.
+Type inference (``infer_schema``) mirrors TypeInference: trial-parse
+columns as int -> double -> date -> string, geometry from lon/lat pairs.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import hashlib
+import io
+import json
+import re
+import uuid as _uuid
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+
+# -- expression DSL ------------------------------------------------------
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<col>\$\d+)|(?P<path>\$(?:\.\w+)+)|(?P<name>\w+)\s*\(|(?P<lit>'[^']*')"
+    r"|(?P<num>-?\d+(?:\.\d+)?)|(?P<close>\))|(?P<comma>,)|(?P<cast>::\w+))"
+)
+
+
+@dataclass
+class Expression:
+    """A compiled field expression: record -> value."""
+
+    fn: Callable
+    text: str
+
+    def __call__(self, rec):
+        return self.fn(rec)
+
+
+def _get_path(rec, path: Sequence[str]):
+    cur = rec
+    for p in path:
+        if cur is None:
+            return None
+        cur = cur.get(p) if isinstance(cur, dict) else None
+    return cur
+
+
+_CASTS = {
+    "int": lambda v: int(float(v)),
+    "long": lambda v: int(float(v)),
+    "float": float,
+    "double": float,
+    "string": str,
+}
+
+
+def _compile_fns(name: str, args: list):
+    if name == "point":
+        return lambda rec: geo.Point(float(args[0](rec)), float(args[1](rec)))
+    if name in ("geomfromwkt", "geometry"):
+        return lambda rec: geo.from_wkt(str(args[0](rec)))
+    if name in ("datetime", "date", "isodate"):
+        from geomesa_tpu.filter.ecql import parse_dt_millis
+
+        return lambda rec: parse_dt_millis(str(args[0](rec)))
+    if name == "millisecondstodate":
+        return lambda rec: int(args[0](rec))
+    if name == "concat":
+        return lambda rec: "".join(str(a(rec)) for a in args)
+    if name in ("tolowercase", "lowercase"):
+        return lambda rec: str(args[0](rec)).lower()
+    if name in ("touppercase", "uppercase"):
+        return lambda rec: str(args[0](rec)).upper()
+    if name == "trim":
+        return lambda rec: str(args[0](rec)).strip()
+    if name == "md5":
+        return lambda rec: hashlib.md5(str(args[0](rec)).encode()).hexdigest()
+    if name == "uuid":
+        return lambda rec: str(_uuid.uuid4())
+    raise ValueError(f"unknown transform function {name!r}")
+
+
+def compile_expression(text: str) -> Expression:
+    """Compile one expression string into a callable."""
+    pos = 0
+
+    def parse() -> Callable:
+        nonlocal pos
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError(f"bad expression at {text[pos:]!r}")
+        pos = m.end()
+        if m.group("col"):
+            idx = int(m.group("col")[1:])
+            base = (lambda rec: rec[idx - 1]) if idx > 0 else (lambda rec: rec)
+        elif m.group("path"):
+            path = m.group("path")[2:].split(".")
+            base = lambda rec: _get_path(rec, path)
+        elif m.group("lit"):
+            v = m.group("lit")[1:-1]
+            base = lambda rec: v
+        elif m.group("num"):
+            v = float(m.group("num")) if "." in m.group("num") else int(m.group("num"))
+            base = lambda rec: v
+        elif m.group("name"):
+            fname = m.group("name").lower()
+            args: list = []
+            while True:
+                m2 = _TOKEN.match(text, pos)
+                if m2 and m2.group("close"):
+                    pos = m2.end()
+                    break
+                args.append(parse())
+                m3 = _TOKEN.match(text, pos)
+                if m3 and m3.group("comma"):
+                    pos = m3.end()
+                elif m3 and m3.group("close"):
+                    pos = m3.end()
+                    break
+                else:
+                    raise ValueError(f"expected , or ) at {text[pos:]!r}")
+            base = _compile_fns(fname, args)
+        else:
+            raise ValueError(f"bad expression at {text[pos:]!r}")
+        # optional cast suffix
+        m4 = _TOKEN.match(text, pos)
+        if m4 and m4.group("cast"):
+            pos = m4.end()
+            cast = _CASTS.get(m4.group("cast")[2:].lower())
+            if cast is None:
+                raise ValueError(f"unknown cast {m4.group('cast')!r}")
+            inner = base
+            base = lambda rec: cast(inner(rec))
+        return base
+
+    fn = parse()
+    if pos != len(text) and text[pos:].strip():
+        raise ValueError(f"trailing input in expression: {text[pos:]!r}")
+    return Expression(fn, text)
+
+
+# -- converter -----------------------------------------------------------
+
+@dataclass
+class FieldSpec:
+    name: str
+    transform: str  # expression string
+
+
+@dataclass
+class Converter:
+    """Config-driven converter: parse records, evaluate field expressions,
+    emit a FeatureCollection (reference SimpleFeatureConverter.process)."""
+
+    sft: FeatureType
+    fields: Sequence[FieldSpec]
+    id_field: str | None = None  # expression; None = running index
+    fmt: str = "delimited"  # "delimited" | "json"
+    delimiter: str = ","
+    skip_lines: int = 0  # header rows to drop (delimited)
+    drop_errors: bool = True  # skip unparseable records vs raise
+
+    def __post_init__(self):
+        self._exprs = [(f.name, compile_expression(f.transform)) for f in self.fields]
+        self._id_expr = compile_expression(self.id_field) if self.id_field else None
+        self.errors = 0
+
+    def convert(self, data: "str | bytes | io.IOBase") -> FeatureCollection:
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")
+        if not isinstance(data, str):
+            data = data.read()
+            if isinstance(data, bytes):
+                data = data.decode("utf-8")
+        records = self._parse(data)
+        rows = []
+        ids = []
+        self.errors = 0
+        for i, rec in enumerate(records):
+            try:
+                row = {name: expr(rec) for name, expr in self._exprs}
+                rid = str(self._id_expr(rec)) if self._id_expr else str(i)
+            except Exception:
+                if self.drop_errors:
+                    self.errors += 1
+                    continue
+                raise
+            rows.append(row)
+            ids.append(rid)
+        return FeatureCollection.from_rows(self.sft, rows, ids=ids)
+
+    def _parse(self, data: str):
+        if self.fmt == "delimited":
+            reader = _csv.reader(io.StringIO(data), delimiter=self.delimiter)
+            for i, rec in enumerate(reader):
+                if i < self.skip_lines or not rec:
+                    continue
+                yield rec
+        elif self.fmt == "json":
+            doc = json.loads(data)
+            if isinstance(doc, dict):
+                doc = [doc]
+            yield from doc
+        else:
+            raise ValueError(f"unknown converter format {self.fmt!r}")
+
+
+# -- type inference ------------------------------------------------------
+
+def infer_schema(
+    name: str,
+    rows: Sequence[Sequence[str]],
+    header: Sequence[str] | None = None,
+) -> tuple[FeatureType, Converter]:
+    """Infer a schema + converter from delimited sample rows (reference
+    TypeInference.scala): trial-parse int -> double -> date -> string;
+    adjacent lon/lat-range double columns become the point geometry."""
+    if not rows:
+        raise ValueError("no sample rows")
+    n_cols = len(rows[0])
+    names = list(header) if header else [f"col{i}" for i in range(n_cols)]
+    kinds = []
+    for c in range(n_cols):
+        vals = [r[c] for r in rows if len(r) > c]
+        kinds.append(_infer_kind(vals))
+    # geometry: a name-hinted (lon, lat) numeric pair wins; otherwise the
+    # first adjacent in-range Double pair (rows may be ragged; only rows
+    # long enough vote). Int-only pairs need the name hint — bare small-int
+    # columns (counts, ages) would false-positive on the range test.
+    lon_names = {"lon", "long", "longitude", "x"}
+    lat_names = {"lat", "latitude", "y"}
+
+    def in_range(c) -> bool:
+        full = [r for r in rows if len(r) > c + 1]
+        if not full:
+            return False
+        xs = np.array([float(r[c]) for r in full])
+        ys = np.array([float(r[c + 1]) for r in full])
+        return bool((np.abs(xs) <= 180).all() and (np.abs(ys) <= 90).all())
+
+    geom_pair = None
+    for c in range(n_cols - 1):
+        if (
+            names[c].lower() in lon_names
+            and names[c + 1].lower() in lat_names
+            and kinds[c] in ("Int", "Double")
+            and kinds[c + 1] in ("Int", "Double")
+            and in_range(c)
+        ):
+            geom_pair = c
+            break
+    if geom_pair is None:
+        for c in range(n_cols - 1):
+            if kinds[c] == "Double" and kinds[c + 1] == "Double" and in_range(c):
+                geom_pair = c
+                break
+    parts = []
+    fields = []
+    for c in range(n_cols):
+        if geom_pair is not None and c == geom_pair:
+            parts.append("*geom:Point:srid=4326")
+            fields.append(FieldSpec("geom", f"point(${c + 1}, ${c + 2})"))
+            continue
+        if geom_pair is not None and c == geom_pair + 1:
+            continue
+        t = kinds[c]
+        spec_t = {"Int": "Integer", "Double": "Double", "Date": "Date"}.get(t, "String")
+        parts.append(f"{names[c]}:{spec_t}")
+        cast = {"Int": "::int", "Double": "::double"}.get(t, "")
+        expr = f"datetime(${c + 1})" if t == "Date" else f"${c + 1}{cast}"
+        fields.append(FieldSpec(names[c], expr))
+    sft = FeatureType.from_spec(name, ",".join(parts))
+    return sft, Converter(sft=sft, fields=fields)
+
+
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}([T ]\d{2}:\d{2}(:\d{2})?(\.\d+)?Z?)?$")
+
+
+def _infer_kind(vals: Sequence[str]) -> str:
+    def all_match(fn) -> bool:
+        try:
+            for v in vals:
+                fn(v)
+            return True
+        except (ValueError, TypeError):
+            return False
+
+    if all_match(int):
+        return "Int"
+    if all_match(float):
+        return "Double"
+    if all(_DATE_RE.match(str(v)) for v in vals):
+        return "Date"
+    return "String"

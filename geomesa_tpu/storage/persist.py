@@ -1,0 +1,111 @@
+"""Store persistence: save/load a DataStore's schemas and data to disk.
+
+Reference: the filesystem datastore (geomesa-fs, SURVEY.md §2.4) — a
+directory layout of metadata + columnar data files
+(/root/reference/geomesa-fs/geomesa-fs-storage/geomesa-fs-storage-common/
+src/main/scala/org/locationtech/geomesa/fs/storage/common/metadata/
+FileBasedMetadata.scala, parquet/ParquetFileSystemStorage.scala). The TPU
+redesign persists each feature type as one .npz of its columns (the
+Parquet-file analogue: columnar, compressed) plus a JSON metadata document
+(schema spec + user data), and rebuilds index tables on load — indexes are
+derived state, exactly as the reference rebuilds query state from
+metadata + files.
+
+Layout:  <root>/metadata.json
+         <root>/<type_name>.npz
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import PointColumn
+from geomesa_tpu.sft import FeatureType
+
+FORMAT_VERSION = 1
+
+
+def save(store, root: str) -> None:
+    """Persist every schema + feature batch under ``root``."""
+    os.makedirs(root, exist_ok=True)
+    meta: dict = {"version": FORMAT_VERSION, "types": {}}
+    for name in store.type_names():
+        sft = store.get_schema(name)
+        meta["types"][name] = {
+            "spec": sft.to_spec(),
+            "user_data": {str(k): str(v) for k, v in sft.user_data.items()},
+        }
+        fc = store.features(name)
+        np.savez_compressed(
+            os.path.join(root, f"{name}.npz"), **_pack_columns(sft, fc)
+        )
+    tmp = os.path.join(root, "metadata.json.tmp")
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh, indent=2)
+    os.replace(tmp, os.path.join(root, "metadata.json"))
+
+
+def load(root: str, **store_kwargs):
+    """Rebuild a DataStore (indexes re-derived) from a saved directory."""
+    from geomesa_tpu.datastore import DataStore
+
+    with open(os.path.join(root, "metadata.json")) as fh:
+        meta = json.load(fh)
+    if meta.get("version") != FORMAT_VERSION:
+        raise ValueError(f"unsupported store format {meta.get('version')!r}")
+    store = DataStore(**store_kwargs)
+    for name, info in meta["types"].items():
+        sft = FeatureType.from_spec(name, info["spec"])
+        sft.user_data.update(info.get("user_data", {}))
+        store.create_schema(sft)
+        with np.load(os.path.join(root, f"{name}.npz"), allow_pickle=False) as z:
+            fc = _unpack_columns(sft, z)
+        if len(fc):
+            store.write(name, fc, check_ids=False)
+    return store
+
+
+def _pack_columns(sft: FeatureType, fc: FeatureCollection) -> dict:
+    out: dict = {"__ids__": fc.ids}
+    for name, col in fc.columns.items():
+        if isinstance(col, PointColumn):
+            out[f"pt:{name}:x"] = col.x
+            out[f"pt:{name}:y"] = col.y
+        elif isinstance(col, geo.PackedGeometryColumn):
+            out[f"pg:{name}:coords"] = col.coords
+            out[f"pg:{name}:ring_offsets"] = col.ring_offsets
+            out[f"pg:{name}:part_ring_offsets"] = col.part_ring_offsets
+            out[f"pg:{name}:geom_part_offsets"] = col.geom_part_offsets
+            out[f"pg:{name}:types"] = col.types
+            out[f"pg:{name}:bboxes"] = col.bboxes
+        else:
+            out[f"col:{name}"] = np.asarray(col)
+    return out
+
+
+def _unpack_columns(sft: FeatureType, z) -> FeatureCollection:
+    cols: dict = {}
+    names = set(z.files)
+    for attr in sft.attributes:
+        n = attr.name
+        if f"pt:{n}:x" in names:
+            cols[n] = PointColumn(z[f"pt:{n}:x"], z[f"pt:{n}:y"])
+        elif f"pg:{n}:coords" in names:
+            cols[n] = geo.PackedGeometryColumn(
+                coords=z[f"pg:{n}:coords"],
+                ring_offsets=z[f"pg:{n}:ring_offsets"],
+                part_ring_offsets=z[f"pg:{n}:part_ring_offsets"],
+                geom_part_offsets=z[f"pg:{n}:geom_part_offsets"],
+                types=z[f"pg:{n}:types"],
+                bboxes=z[f"pg:{n}:bboxes"],
+            )
+        elif f"col:{n}" in names:
+            cols[n] = z[f"col:{n}"]
+        else:
+            raise KeyError(f"column {n!r} missing from saved store")
+    return FeatureCollection(sft, z["__ids__"], cols)

@@ -1,0 +1,223 @@
+"""IO tier: persistence round-trips, exporters, converters, CLI."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import cli, geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.io.converters import Converter, FieldSpec, compile_expression, infer_schema
+from geomesa_tpu.io.exporters import export
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.storage import persist
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _store(n=200):
+    sft = FeatureType.from_spec("t", SPEC)
+    ds = DataStore(tile=64)
+    ds.create_schema(sft)
+    rng = np.random.default_rng(0)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    fc = FeatureCollection.from_columns(
+        sft,
+        [f"f{i}" for i in range(n)],
+        {
+            "name": np.array([f"n{i % 5}" for i in range(n)]),
+            "age": np.arange(n) % 90,
+            "dtg": t0 + rng.integers(0, 10 * 86400_000, n),
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        },
+    )
+    ds.write("t", fc)
+    return ds
+
+
+class TestPersist:
+    def test_roundtrip_points(self, tmp_path):
+        ds = _store()
+        persist.save(ds, str(tmp_path / "cat"))
+        ds2 = persist.load(str(tmp_path / "cat"))
+        assert ds2.type_names() == ["t"]
+        q = "bbox(geom, -50, -50, 50, 50) AND age < 40"
+        a = sorted(ds.query("t", q).ids.tolist())
+        b = sorted(ds2.query("t", q).ids.tolist())
+        assert a == b and len(a) > 0
+
+    def test_roundtrip_extents(self, tmp_path):
+        sft = FeatureType.from_spec("poly", "*geom:Polygon:srid=4326")
+        ds = DataStore(tile=64)
+        ds.create_schema(sft)
+        polys = [geo.box(i, i, i + 2, i + 2) for i in range(20)]
+        ds.write(
+            "poly",
+            FeatureCollection.from_columns(
+                sft, [str(i) for i in range(20)], {"geom": polys}
+            ),
+        )
+        persist.save(ds, str(tmp_path / "cat"))
+        ds2 = persist.load(str(tmp_path / "cat"))
+        got = ds2.query("poly", "bbox(geom, 5, 5, 8, 8)")
+        want = ds.query("poly", "bbox(geom, 5, 5, 8, 8)")
+        assert sorted(got.ids.tolist()) == sorted(want.ids.tolist())
+
+    def test_empty_type(self, tmp_path):
+        ds = DataStore()
+        ds.create_schema(FeatureType.from_spec("e", SPEC))
+        persist.save(ds, str(tmp_path / "cat"))
+        ds2 = persist.load(str(tmp_path / "cat"))
+        assert len(ds2.query("e")) == 0
+
+
+class TestExporters:
+    def test_csv(self):
+        ds = _store(5)
+        text = export(ds.query("t"), "csv")
+        lines = text.strip().split("\n")
+        assert lines[0] == "id,name,age,dtg,geom"
+        assert len(lines) == 6
+        assert "POINT (" in lines[1]
+
+    def test_geojson(self):
+        ds = _store(5)
+        doc = json.loads(export(ds.query("t"), "geojson"))
+        assert doc["type"] == "FeatureCollection"
+        assert len(doc["features"]) == 5
+        f0 = doc["features"][0]
+        assert f0["geometry"]["type"] == "Point"
+        assert set(f0["properties"]) == {"name", "age", "dtg"}
+
+    def test_wkt_and_json(self):
+        ds = _store(3)
+        assert export(ds.query("t"), "wkt").count("POINT") == 3
+        rows = json.loads(export(ds.query("t"), "json"))
+        assert len(rows) == 3 and "__id__" in rows[0]
+
+    def test_geojson_polygon(self):
+        sft = FeatureType.from_spec("p", "*geom:Polygon:srid=4326")
+        fc = FeatureCollection.from_columns(sft, ["a"], {"geom": [geo.box(0, 0, 1, 1)]})
+        doc = json.loads(export(fc, "geojson"))
+        assert doc["features"][0]["geometry"]["type"] == "Polygon"
+
+    def test_unknown_format(self):
+        ds = _store(1)
+        with pytest.raises(ValueError):
+            export(ds.query("t"), "shapefile")
+
+
+class TestExpressions:
+    def test_columns_and_casts(self):
+        e = compile_expression("$2::int")
+        assert e(["a", "41"]) == 41
+        assert compile_expression("$1::double")(["2.5"]) == 2.5
+
+    def test_functions(self):
+        p = compile_expression("point($1, $2)")(["1.5", "-2"])
+        assert (p.x, p.y) == (1.5, -2.0)
+        assert compile_expression("concat($1, '-', $2)")(["a", "b"]) == "a-b"
+        dt = compile_expression("datetime($1)")(["2024-01-02T03:04:05Z"])
+        assert dt == int(np.datetime64("2024-01-02T03:04:05", "ms").astype(np.int64))
+        assert compile_expression("md5($1)")(["x"]) == compile_expression("md5($1)")(["x"])
+
+    def test_json_path(self):
+        e = compile_expression("$.props.name")
+        assert e({"props": {"name": "z"}}) == "z"
+
+    def test_bad_expression(self):
+        with pytest.raises(ValueError):
+            compile_expression("nope!!(")
+
+
+class TestConverter:
+    CSV = "id,name,lon,lat,when\n1,alpha,10.5,-3.25,2024-01-02T00:00:00Z\n2,beta,20,40,2024-02-03T00:00:00Z\n"
+
+    def test_delimited(self):
+        sft = FeatureType.from_spec("c", "name:String,dtg:Date,*geom:Point:srid=4326")
+        conv = Converter(
+            sft=sft,
+            fields=[
+                FieldSpec("name", "$2"),
+                FieldSpec("dtg", "datetime($5)"),
+                FieldSpec("geom", "point($3, $4)"),
+            ],
+            id_field="$1",
+            skip_lines=1,
+        )
+        fc = conv.convert(self.CSV)
+        assert len(fc) == 2
+        assert fc.ids.tolist() == ["1", "2"]
+        assert fc.columns["geom"].x.tolist() == [10.5, 20.0]
+
+    def test_error_rows_dropped(self):
+        sft = FeatureType.from_spec("c", "age:Int,*geom:Point:srid=4326")
+        conv = Converter(
+            sft=sft,
+            fields=[FieldSpec("age", "$1::int"), FieldSpec("geom", "point($2, $3)")],
+        )
+        fc = conv.convert("5,1,2\nbad,3,4\n7,5,6\n")
+        assert len(fc) == 2 and conv.errors == 1
+
+    def test_json_converter(self):
+        sft = FeatureType.from_spec("j", "name:String,*geom:Point:srid=4326")
+        conv = Converter(
+            sft=sft,
+            fields=[
+                FieldSpec("name", "$.properties.name"),
+                FieldSpec("geom", "point($.x, $.y)"),
+            ],
+            fmt="json",
+        )
+        fc = conv.convert(json.dumps([
+            {"properties": {"name": "a"}, "x": 1, "y": 2},
+            {"properties": {"name": "b"}, "x": 3, "y": 4},
+        ]))
+        assert fc.columns["geom"].y.tolist() == [2.0, 4.0]
+
+    def test_infer(self):
+        rows = [
+            ["alpha", "3", "10.5", "-3.25", "2024-01-02T00:00:00Z"],
+            ["beta", "4", "20.0", "40.0", "2024-02-03T00:00:00Z"],
+        ]
+        sft, conv = infer_schema("inf", rows, header=["name", "n", "lon", "lat", "when"])
+        types = {a.name: a.type for a in sft.attributes}
+        assert types["name"] == "String" and types["n"] == "Integer"
+        assert types["when"] == "Date" and "geom" in types
+        fc = conv.convert("alpha,3,10.5,-3.25,2024-01-02T00:00:00Z\n")
+        assert fc.columns["geom"].x.tolist() == [10.5]
+
+
+class TestCli:
+    def test_workflow(self, tmp_path, capsys):
+        cat = str(tmp_path / "cat")
+        csv_file = tmp_path / "data.csv"
+        csv_file.write_text(
+            "name,lon,lat,when\nalpha,1.5,2.5,2024-01-02T00:00:00Z\n"
+            "beta,-3.0,4.0,2024-02-03T00:00:00Z\n"
+        )
+        assert cli.main(["ingest", "-c", cat, "-f", "obs", "--infer", "--header", str(csv_file)]) == 0
+        assert cli.main(["get-type-names", "-c", cat]) == 0
+        assert cli.main(["describe-schema", "-c", cat, "-f", "obs"]) == 0
+        assert cli.main(["count", "-c", cat, "-f", "obs"]) == 0
+        assert cli.main(["explain", "-c", cat, "-f", "obs", "-q", "bbox(geom,0,0,5,5)"]) == 0
+        out_file = str(tmp_path / "out.geojson")
+        assert cli.main([
+            "export", "-c", cat, "-f", "obs", "--format", "geojson", "-o", out_file,
+        ]) == 0
+        doc = json.loads(open(out_file).read())
+        assert len(doc["features"]) == 2
+        assert cli.main(["stats", "-c", cat, "-f", "obs", "--spec", "Count()"]) == 0
+        captured = capsys.readouterr()
+        assert "ingested 2 features" in captured.out
+        assert '"count": 2' in captured.out
+
+    def test_create_and_delete(self, tmp_path, capsys):
+        cat = str(tmp_path / "cat")
+        assert cli.main([
+            "create-schema", "-c", cat, "-f", "s", "-s", "dtg:Date,*geom:Point:srid=4326",
+        ]) == 0
+        assert cli.main(["delete-schema", "-c", cat, "-f", "s"]) == 0
+        assert cli.main(["get-type-names", "-c", cat]) == 0
+        assert "created schema" in capsys.readouterr().out
