@@ -42,6 +42,7 @@ class DataStore:
         interceptors: Sequence | None = None,
         audit=None,
         metrics=None,
+        auths: Sequence[str] | None = None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh``; when given, index
         tables shard over it and scans run as shard_map collectives
@@ -61,6 +62,9 @@ class DataStore:
         self.interceptors = list(interceptors or [])
         self.audit = audit
         self.metrics = metrics
+        # None = security disabled; [] = only public rows (reference
+        # AuthorizationsProvider semantics)
+        self.auths = auths
         self.planner = QueryPlanner(self)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
@@ -207,6 +211,17 @@ class DataStore:
     def stats_for(self, type_name: str):
         return self._stats.get(type_name)
 
+    def _vis_active(self, type_name: str) -> bool:
+        """True when row-level visibility applies: auths configured and the
+        schema names a visibility field. Aggregate device fast paths must
+        then be skipped — the scan mask cannot evaluate visibility, so
+        those paths would leak restricted rows into counts/grids/bounds."""
+        from geomesa_tpu.security import VIS_FIELD_KEY
+
+        return self.auths is not None and bool(
+            self._schemas[type_name].user_data.get(VIS_FIELD_KEY)
+        )
+
     def apply_interceptors(self, type_name: str, f: Filter) -> Filter:
         """Run filter-rewriting interceptors in order (reference
         QueryInterceptor SPI, hooked at QueryPlanner.scala:155)."""
@@ -302,6 +317,7 @@ class DataStore:
         device_ok = (
             plan.index is not None
             and weight is None
+            and not self._vis_active(type_name)
             and mask_decides_filter(plan.filter, cfg, self._schemas[type_name])
         )
         if device_ok:
@@ -339,8 +355,12 @@ class DataStore:
         terms = stat_spec.parse(spec)
         plan = self.planner.plan(type_name, f)
         if estimate and all(t.kind == "count" for t in terms):
-            if plan.index is not None and mask_decides_filter(
-                plan.filter, plan.config, self._schemas[type_name]
+            if (
+                plan.index is not None
+                and not self._vis_active(type_name)
+                and mask_decides_filter(
+                    plan.filter, plan.config, self._schemas[type_name]
+                )
             ):
                 t0 = time.perf_counter()
                 n = (
@@ -375,8 +395,11 @@ class DataStore:
             out = self.query(type_name, f)
             return _exact_bounds(out)
         plan = self.planner.plan(type_name, f)
-        if estimate and plan.index is not None and mask_decides_filter(
-            plan.filter, plan.config, self._schemas[type_name]
+        if (
+            estimate
+            and plan.index is not None
+            and not self._vis_active(type_name)
+            and mask_decides_filter(plan.filter, plan.config, self._schemas[type_name])
         ):
             table = self.table(type_name, plan.index)
             if plan.config.disjoint:
@@ -417,7 +440,7 @@ class DataStore:
 
     def count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
         """Exact hit count (scan + refine)."""
-        if isinstance(f, Include):
+        if isinstance(f, Include) and not self._vis_active(type_name):
             return len(self.features(type_name))
         return len(self.query(type_name, f))
 
@@ -430,6 +453,8 @@ class DataStore:
 
         if isinstance(f, str):
             f = ecql.parse(f)
+        if self._vis_active(type_name):
+            return self.count(type_name, f)  # sketches can't see visibility
         if isinstance(f, Include):
             return len(self.features(type_name))
         stats = self.stats_for(type_name)

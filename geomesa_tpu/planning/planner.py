@@ -227,6 +227,9 @@ class QueryPlanner:
             with exp.span("Full-table host scan"):
                 mask = plan.filter.evaluate(fc.batch)
             return self._post(fc.mask(mask), plan, hints, exp)
+        elif plan.index is not None and len(fc) == 0:
+            # schema exists but nothing written yet: no index tables
+            candidates = fc
         else:
             table = self.store.table(plan.type_name, plan.index)
             with exp.span(f"Device scan [{plan.index}]"):
@@ -254,9 +257,20 @@ class QueryPlanner:
         return self._post(candidates, plan, hints, exp)
 
     def _post(self, out: FeatureCollection, plan, hints, exp):
-        """Client-side reduce pipeline: sample -> sort -> limit -> project
-        (reference QueryPlanner.scala:66-102 runs the same stages after the
-        scan: reducer, sort, maxFeatures, projection)."""
+        """Client-side reduce pipeline: visibility -> sample -> sort ->
+        limit -> project (reference QueryPlanner.scala:66-102 runs the same
+        stages after the scan: reducer, sort, maxFeatures, projection)."""
+        # row-level security: mask rows whose visibility label the store's
+        # auths cannot satisfy (reference VisibilityEvaluator tier)
+        auths = getattr(self.store, "auths", None)
+        if auths is not None:
+            from geomesa_tpu.security import VIS_FIELD_KEY, visibility_mask
+
+            sft = self.store.get_schema(plan.type_name)
+            vis_field = sft.user_data.get(VIS_FIELD_KEY)
+            if vis_field and len(out):
+                out = out.mask(visibility_mask(out.columns[vis_field], auths))
+                exp(f"Visibility filter: {len(out)} visible")
         exp(f"Hits: {len(out)}")
         if hints is not None:
             hints.validate()

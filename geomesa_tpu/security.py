@@ -1,0 +1,109 @@
+"""Visibility security: per-feature labels evaluated against auths.
+
+Reference: geomesa-security (/root/reference/geomesa-security/src/main/
+scala/org/locationtech/geomesa/security/ — VisibilityEvaluator.scala,
+AuthorizationsProvider). Visibility expressions use the Accumulo grammar:
+
+    admin                  requires the "admin" auth
+    admin&user             both
+    admin|ops              either
+    a&(b|c)                grouping; & binds tighter than |
+
+Empty visibility = visible to everyone. A store configured with ``auths``
+masks every query result through the evaluator (row-level security); the
+visibility column is named by the schema's ``geomesa.vis.field`` user-data
+key.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+
+import numpy as np
+
+VIS_FIELD_KEY = "geomesa.vis.field"
+
+_TOKEN = re.compile(r"\s*(?:(?P<label>[\w.\-:]+)|(?P<op>[&|()]))")
+
+
+@lru_cache(maxsize=4096)
+def _compile(expression: str):
+    """Parse a visibility expression into a nested tuple AST."""
+    pos = 0
+    text = expression
+
+    def parse_or():
+        nonlocal pos
+        left = parse_and()
+        while True:
+            m = _TOKEN.match(text, pos)
+            if m and m.group("op") == "|":
+                pos = m.end()
+                left = ("or", left, parse_and())
+            else:
+                return left
+
+    def parse_and():
+        nonlocal pos
+        left = parse_atom()
+        while True:
+            m = _TOKEN.match(text, pos)
+            if m and m.group("op") == "&":
+                pos = m.end()
+                left = ("and", left, parse_atom())
+            else:
+                return left
+
+    def parse_atom():
+        nonlocal pos
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError(f"bad visibility {expression!r} at {text[pos:]!r}")
+        if m.group("label"):
+            pos = m.end()
+            return ("label", m.group("label"))
+        if m.group("op") == "(":
+            pos = m.end()
+            inner = parse_or()
+            m2 = _TOKEN.match(text, pos)
+            if not m2 or m2.group("op") != ")":
+                raise ValueError(f"unbalanced parens in {expression!r}")
+            pos = m2.end()
+            return inner
+        raise ValueError(f"bad visibility {expression!r} at {text[pos:]!r}")
+
+    ast = parse_or()
+    if text[pos:].strip():
+        # any leftover input is an error — a silently-truncated label like
+        # "admin,ops" would otherwise grant access on its first token
+        raise ValueError(f"trailing input in visibility {expression!r}")
+    return ast
+
+
+def _eval(ast, auths: frozenset) -> bool:
+    kind = ast[0]
+    if kind == "label":
+        return ast[1] in auths
+    if kind == "and":
+        return _eval(ast[1], auths) and _eval(ast[2], auths)
+    return _eval(ast[1], auths) or _eval(ast[2], auths)
+
+
+def visible(expression: str, auths) -> bool:
+    """Can ``auths`` see a feature labeled ``expression``? Empty/blank
+    labels are public (reference VisibilityEvaluator)."""
+    if not expression or not expression.strip():
+        return True
+    return _eval(_compile(expression.strip()), frozenset(auths))
+
+
+def visibility_mask(labels: np.ndarray, auths) -> np.ndarray:
+    """Boolean mask over a visibility-label column (distinct labels are
+    few; evaluate each once)."""
+    labels = np.asarray(labels)
+    auths = frozenset(auths)
+    out = np.zeros(len(labels), dtype=bool)
+    for v in np.unique(labels):
+        out[labels == v] = visible(str(v), auths)
+    return out

@@ -1,0 +1,190 @@
+"""Streaming hot tier: a live, mutable feature cache with expiry and
+event listeners, plus the hot/cold Lambda store.
+
+Reference: the Kafka datastore keeps the *current state* of a stream in an
+in-memory grid-indexed cache — KafkaFeatureCacheImpl over BucketIndex
+(/root/reference/geomesa-kafka/geomesa-kafka-datastore/src/main/scala/org/
+locationtech/geomesa/kafka/index/KafkaFeatureCacheImpl.scala:30-120),
+queried by a LocalQueryRunner; the Lambda store merges that transient tier
+with a persistent store and periodically persists
+(/root/reference/geomesa-lambda/geomesa-lambda-datastore/src/main/scala/
+org/locationtech/geomesa/lambda/data/LambdaDataStore.scala). The TPU
+redesign keeps the upsert/expiry/listener contract; queries snapshot the
+live state into a columnar batch and run the same filter evaluation as
+the main store's refinement tier.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import Filter, Include, INCLUDE
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.utils.spatial_index import BucketIndex
+
+
+class StreamingFeatureCache:
+    """Live keyed feature state over a bucket grid (KafkaFeatureCacheImpl).
+
+    - ``upsert(rows)``: latest message per id wins
+    - ``delete(ids)`` / ``clear()``
+    - ``expiry_ms``: features older than this (by ingest wall-clock) are
+      swept by ``expire()`` (reference feature-expiry config)
+    - listeners: callables ``(event, id, row)`` with event in
+      {"added", "updated", "removed", "expired"} (reference
+      KafkaFeatureCache listeners)
+    """
+
+    def __init__(self, sft: FeatureType, expiry_ms: Optional[int] = None,
+                 grid: tuple[int, int] = (360, 180)):
+        self.sft = sft
+        self.expiry_ms = expiry_ms
+        self.index = BucketIndex(*grid)
+        self._rows: dict[str, dict] = {}
+        self._ingest_ms: dict[str, int] = {}
+        self._next_id = 0  # monotonic: survives deletes without colliding
+        self.listeners: list[Callable] = []
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def _notify(self, event: str, fid: str, row) -> None:
+        for fn in self.listeners:
+            fn(event, fid, row)
+
+    def _bbox(self, row: Mapping) -> tuple:
+        # upsert has already converted WKT strings to Geometry objects
+        return row[self.sft.geom_field].bounds()
+
+    def upsert(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
+        """Apply a batch of messages; returns the number applied."""
+        now = int(_time.time() * 1000)
+        for i, row in enumerate(rows):
+            if ids is not None:
+                fid = str(ids[i])
+            elif "__id__" in row:
+                fid = str(row["__id__"])
+            else:
+                fid = str(self._next_id)
+                self._next_id += 1
+            row = {k: v for k, v in row.items() if k != "__id__"}
+            from geomesa_tpu import geometry as geo
+
+            g = row.get(self.sft.geom_field)
+            if isinstance(g, str):
+                row[self.sft.geom_field] = geo.from_wkt(g)
+            event = "updated" if fid in self._rows else "added"
+            self._rows[fid] = row
+            self._ingest_ms[fid] = now
+            self.index.insert(fid, self._bbox(row))
+            self._notify(event, fid, row)
+        return len(rows)
+
+    def delete(self, ids: Sequence[str]) -> int:
+        n = 0
+        for fid in ids:
+            fid = str(fid)
+            row = self._rows.pop(fid, None)
+            if row is not None:
+                self._ingest_ms.pop(fid, None)
+                self.index.remove(fid)
+                self._notify("removed", fid, row)
+                n += 1
+        return n
+
+    def clear(self) -> None:
+        for fid in list(self._rows):
+            self.delete([fid])
+
+    def expire(self, now_ms: Optional[int] = None) -> int:
+        """Sweep features older than expiry_ms; returns count expired."""
+        if self.expiry_ms is None:
+            return 0
+        now = int(_time.time() * 1000) if now_ms is None else now_ms
+        cutoff = now - self.expiry_ms
+        stale = [fid for fid, t in self._ingest_ms.items() if t <= cutoff]
+        for fid in stale:
+            row = self._rows.pop(fid)
+            self._ingest_ms.pop(fid)
+            self.index.remove(fid)
+            self._notify("expired", fid, row)
+        return len(stale)
+
+    # -- queries ---------------------------------------------------------
+    def snapshot(self, ids: Sequence[str] | None = None) -> FeatureCollection:
+        """Columnar snapshot of (a subset of) the live state."""
+        if ids is None:
+            ids = list(self._rows)
+        rows = [self._rows[f] for f in ids]
+        return FeatureCollection.from_rows(self.sft, rows, ids=list(ids))
+
+    def query(self, f: "Filter | str" = INCLUDE) -> FeatureCollection:
+        """Filter the live state (LocalQueryRunner: bucket-index spatial
+        pre-prune when the filter has a bbox, then exact evaluation)."""
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.filter.extract import extract_geometries, geometry_bounds
+
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        ids: Sequence[str] | None = None
+        if self.sft.geom_field and not isinstance(f, Include):
+            geoms = extract_geometries(f, self.sft.geom_field)
+            if geoms.disjoint:
+                return self.snapshot([])
+            if geoms.values:
+                hit: set = set()
+                for b in geometry_bounds(geoms):
+                    hit.update(self.index.query(b))
+                ids = sorted(hit)
+        fc = self.snapshot(ids)
+        if isinstance(f, Include) or len(fc) == 0:
+            return fc
+        return fc.mask(f.evaluate(fc.batch))
+
+
+class LambdaStore:
+    """Hot/cold hybrid: transient streaming cache + persistent DataStore
+    (reference LambdaDataStore). Writes land hot; ``persist_hot()`` flushes
+    the hot tier into the cold store (the reference's periodic persistence
+    with offset tracking collapses to an explicit, idempotent flush);
+    queries merge both tiers with hot-wins-by-id semantics.
+    """
+
+    def __init__(self, cold, type_name: str, expiry_ms: Optional[int] = None):
+        self.cold = cold
+        self.type_name = type_name
+        self.hot = StreamingFeatureCache(cold.get_schema(type_name), expiry_ms)
+
+    def write(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
+        return self.hot.upsert(rows, ids)
+
+    def persist_hot(self) -> int:
+        """Flush hot state into the cold store; returns rows persisted."""
+        fc = self.hot.snapshot()
+        if len(fc) == 0:
+            return 0
+        existing = set(self.cold.features(self.type_name).ids.tolist())
+        dup = [i for i in fc.ids.tolist() if i in existing]
+        if dup:
+            raise ValueError(f"ids already persisted: {dup[:5]}")
+        self.cold.write(self.type_name, fc)
+        self.hot.clear()
+        return len(fc)
+
+    def query(self, f: "Filter | str" = INCLUDE) -> FeatureCollection:
+        hot = self.hot.query(f)
+        cold = self.cold.query(self.type_name, f)
+        if len(hot) == 0:
+            return cold
+        hot_ids = set(hot.ids.tolist())
+        cold_keep = ~np.isin(cold.ids, list(hot_ids))
+        if cold_keep.all() and len(cold) == 0:
+            return hot
+        return FeatureCollection.concat([hot, cold.mask(cold_keep)])
+
+    def count(self, f: "Filter | str" = INCLUDE) -> int:
+        return len(self.query(f))

@@ -1,0 +1,83 @@
+"""In-memory bucket grid spatial index.
+
+Reference: BucketIndex (/root/reference/geomesa-utils-parent/geomesa-utils/
+src/main/scala/org/locationtech/geomesa/utils/index/BucketIndex.scala:
+30-75) — a fixed grid of buckets over an envelope backing the Kafka
+feature cache. Same design: O(1) insert/remove by (id, x, y), bbox query
+collects the covered buckets. Extents insert into every covered bucket
+(the SizeSeparatedBucketIndex case collapses to multi-bucket insertion).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BucketIndex:
+    """Grid-bucketed point/extent index keyed by feature id."""
+
+    def __init__(
+        self,
+        nx: int = 360,
+        ny: int = 180,
+        envelope: tuple = (-180.0, -90.0, 180.0, 90.0),
+    ):
+        self.nx, self.ny = nx, ny
+        self.x0, self.y0, self.x1, self.y1 = (float(v) for v in envelope)
+        self._buckets: dict[int, set] = {}
+        self._entries: dict[object, tuple] = {}  # id -> (bbox, bucket ids)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def _cells(self, bbox) -> np.ndarray:
+        x0, y0, x1, y1 = bbox
+        i0 = int(np.clip((x0 - self.x0) / (self.x1 - self.x0) * self.nx, 0, self.nx - 1))
+        i1 = int(np.clip((x1 - self.x0) / (self.x1 - self.x0) * self.nx, 0, self.nx - 1))
+        j0 = int(np.clip((y0 - self.y0) / (self.y1 - self.y0) * self.ny, 0, self.ny - 1))
+        j1 = int(np.clip((y1 - self.y0) / (self.y1 - self.y0) * self.ny, 0, self.ny - 1))
+        ii, jj = np.meshgrid(np.arange(i0, i1 + 1), np.arange(j0, j1 + 1))
+        return (jj * self.nx + ii).ravel()
+
+    def insert(self, key, bbox) -> None:
+        """Insert/replace an entry; bbox = (xmin, ymin, xmax, ymax) (a
+        point's bbox is degenerate)."""
+        if key in self._entries:
+            self.remove(key)
+        cells = self._cells(bbox)
+        for c in cells.tolist():
+            self._buckets.setdefault(c, set()).add(key)
+        self._entries[key] = (tuple(float(v) for v in bbox), cells)
+
+    def remove(self, key) -> bool:
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        for c in entry[1].tolist():
+            b = self._buckets.get(c)
+            if b is not None:
+                b.discard(key)
+                if not b:
+                    del self._buckets[c]
+        return True
+
+    def query(self, bbox) -> list:
+        """Keys whose bbox intersects the query bbox."""
+        x0, y0, x1, y1 = bbox
+        seen: set = set()
+        out = []
+        for c in self._cells(bbox).tolist():
+            for key in self._buckets.get(c, ()):
+                if key in seen:
+                    continue
+                seen.add(key)
+                b = self._entries[key][0]
+                if b[0] <= x1 and b[2] >= x0 and b[1] <= y1 and b[3] >= y0:
+                    out.append(key)
+        return out
+
+    def keys(self):
+        return self._entries.keys()
