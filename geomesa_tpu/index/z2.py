@@ -36,8 +36,18 @@ class Z2Index:
         col = fc.columns[self.geom]
         if not isinstance(col, PointColumn):
             raise TypeError("z2 index requires a point geometry column")
-        z = self.sfc.index(col.x, col.y)
         n = len(col)
+
+        from geomesa_tpu import native
+
+        fused = native.z2_write_keys(col.x, col.y)
+        if fused is not None:
+            z, device_cols = fused
+            return WriteKeys(
+                bins=np.zeros(n, dtype=np.int32), zs=z, device_cols=device_cols
+            )
+
+        z = self.sfc.index(col.x, col.y)
         return WriteKeys(
             bins=np.zeros(n, dtype=np.int32),
             zs=z.astype(np.uint64),

@@ -16,7 +16,7 @@ from typing import Optional
 
 import numpy as np
 
-from geomesa_tpu.curve.binnedtime import BinnedTime, MAX_BIN, TimePeriod
+from geomesa_tpu.curve.binnedtime import BinnedTime, MAX_BIN, MAX_OFFSET, TimePeriod
 from geomesa_tpu.curve.z3sfc import Z3SFC
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
@@ -48,6 +48,19 @@ class Z3Index:
         if not isinstance(col, PointColumn):
             raise TypeError("z3 index requires a point geometry column")
         millis = np.asarray(fc.columns[self.dtg], dtype=np.int64)
+
+        # fused native encoder (bit-exact with the numpy path below; only
+        # fixed-width periods — see geomesa_tpu.native)
+        from geomesa_tpu import native
+
+        fused = native.z3_write_keys(
+            col.x, col.y, millis, self.period.value,
+            MAX_OFFSET[self.period], MAX_BIN,
+        )
+        if fused is not None:
+            bins, zs, device_cols = fused
+            return WriteKeys(bins=bins, zs=zs, device_cols=device_cols)
+
         binned = self.binner.to_binned(millis)
         z = self.sfc.index(col.x, col.y, binned.offset.astype(np.float64))
         return WriteKeys(

@@ -1,0 +1,170 @@
+"""Native (C++) runtime tier: build-on-demand, ctypes-bound, with exact
+numpy fallback.
+
+The compute path is JAX/XLA (device); this is the *host runtime* native
+tier — the analogue of the reference's server-side JVM plugin code for the
+ingest hot loop (see geomesa_native.cpp). The library builds lazily with
+g++ the first time it's needed and caches next to the source; every entry
+point has a pure-numpy fallback, so the package works identically without
+a toolchain (set GEOMESA_TPU_NO_NATIVE=1 to force the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "geomesa_native.cpp"
+_LIB = _DIR / "build" / "libgeomesa_native.so"
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None | bool" = None  # None = untried, False = unavailable
+
+
+def _build() -> bool:
+    _LIB.parent.mkdir(exist_ok=True)
+    base = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_LIB)]
+    for extra in (["-fopenmp"], []):  # prefer threaded; fall back
+        try:
+            r = subprocess.run(
+                base[:2] + extra + base[2:],
+                capture_output=True,
+                timeout=120,
+            )
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+    return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib if _lib is not False else None
+    with _lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+            _lib = False
+            return None
+        try:
+            if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+                if not _build():
+                    _lib = False
+                    return None
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            _lib = False
+            return None
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.morton2.argtypes = [u64p, u64p, ctypes.c_int64, u64p]
+        lib.morton2_decode.argtypes = [u64p, ctypes.c_int64, u64p, u64p]
+        lib.morton3.argtypes = [u64p, u64p, u64p, ctypes.c_int64, u64p]
+        lib.morton3_decode.argtypes = [u64p, ctypes.c_int64, u64p, u64p, u64p]
+        lib.z3_write_keys.argtypes = [
+            f64p, f64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_int32, u64p, i32p, f32p, f32p, i32p,
+        ]
+        lib.z3_write_keys.restype = ctypes.c_int32
+        lib.z2_write_keys.argtypes = [f64p, f64p, ctypes.c_int64, u64p, f32p, f32p]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def morton2(x, y) -> "np.ndarray | None":
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.uint64)
+    y = np.ascontiguousarray(y, dtype=np.uint64)
+    out = np.empty(len(x), dtype=np.uint64)
+    lib.morton2(x, y, len(x), out)
+    return out
+
+
+def morton3(x, y, t) -> "np.ndarray | None":
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.uint64)
+    y = np.ascontiguousarray(y, dtype=np.uint64)
+    t = np.ascontiguousarray(t, dtype=np.uint64)
+    out = np.empty(len(x), dtype=np.uint64)
+    lib.morton3(x, y, t, len(x), out)
+    return out
+
+
+def morton3_decode(z):
+    lib = _load()
+    if lib is None:
+        return None
+    z = np.ascontiguousarray(z, dtype=np.uint64)
+    x = np.empty(len(z), dtype=np.uint64)
+    y = np.empty(len(z), dtype=np.uint64)
+    t = np.empty(len(z), dtype=np.uint64)
+    lib.morton3_decode(z, len(z), x, y, t)
+    return x, y, t
+
+
+# fixed-width periods the native binning supports: millis/bin, offset divisor
+_FIXED_PERIODS = {"day": (86_400_000, 1), "week": (604_800_000, 1000)}
+
+
+def z3_write_keys(x, y, millis, period: str, max_offset: int, max_bin: int):
+    """Fused (bins, zs, device cols) for fixed-width periods, or None when
+    native is unavailable / the period is calendar-based."""
+    lib = _load()
+    cfg = _FIXED_PERIODS.get(period)
+    if lib is None or cfg is None:
+        return None
+    bin_ms, off_div = cfg
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    millis = np.ascontiguousarray(millis, dtype=np.int64)
+    n = len(x)
+    z = np.empty(n, dtype=np.uint64)
+    bins = np.empty(n, dtype=np.int32)
+    xf = np.empty(n, dtype=np.float32)
+    yf = np.empty(n, dtype=np.float32)
+    toff = np.empty(n, dtype=np.int32)
+    status = lib.z3_write_keys(
+        x, y, millis, n, bin_ms, off_div, float(max_offset), max_bin,
+        z, bins, xf, yf, toff,
+    )
+    if status == 1:
+        raise ValueError(f"pre-epoch timestamp(s) not supported by period {period}")
+    if status == 2:
+        raise ValueError(
+            f"timestamp(s) past the max representable date for period {period}"
+        )
+    return bins, z, {"x": xf, "y": yf, "tbin": bins, "toff": toff}
+
+
+def z2_write_keys(x, y):
+    """Fused (zs, device cols) for the z2 index, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    n = len(x)
+    z = np.empty(n, dtype=np.uint64)
+    xf = np.empty(n, dtype=np.float32)
+    yf = np.empty(n, dtype=np.float32)
+    lib.z2_write_keys(x, y, n, z, xf, yf)
+    return z, {"x": xf, "y": yf}

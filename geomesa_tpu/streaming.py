@@ -21,6 +21,7 @@ from typing import Callable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import Filter, Include, INCLUDE
 from geomesa_tpu.sft import FeatureType
@@ -72,8 +73,6 @@ class StreamingFeatureCache:
                 fid = str(self._next_id)
                 self._next_id += 1
             row = {k: v for k, v in row.items() if k != "__id__"}
-            from geomesa_tpu import geometry as geo
-
             g = row.get(self.sft.geom_field)
             if isinstance(g, str):
                 row[self.sft.geom_field] = geo.from_wkt(g)
@@ -178,13 +177,17 @@ class LambdaStore:
     def query(self, f: "Filter | str" = INCLUDE) -> FeatureCollection:
         hot = self.hot.query(f)
         cold = self.cold.query(self.type_name, f)
+        # shadow cold rows by EVERY live hot id, not just the hot hits: a
+        # hot update that moved a feature out of the query window must hide
+        # the stale persisted row too (hot-wins-by-id)
+        live = set(self.hot._rows)
+        if live and len(cold):
+            cold = cold.mask(~np.isin(cold.ids, list(live)))
         if len(hot) == 0:
             return cold
-        hot_ids = set(hot.ids.tolist())
-        cold_keep = ~np.isin(cold.ids, list(hot_ids))
-        if cold_keep.all() and len(cold) == 0:
+        if len(cold) == 0:
             return hot
-        return FeatureCollection.concat([hot, cold.mask(cold_keep)])
+        return FeatureCollection.concat([hot, cold])
 
     def count(self, f: "Filter | str" = INCLUDE) -> int:
         return len(self.query(f))

@@ -1,0 +1,121 @@
+"""Native C++ encoder: bit-exact parity with the numpy curve path."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import native
+from geomesa_tpu.curve.binnedtime import BinnedTime, MAX_BIN, MAX_OFFSET, TimePeriod
+from geomesa_tpu.curve.z2sfc import Z2SFC
+from geomesa_tpu.curve.z3sfc import Z3SFC
+from geomesa_tpu.curve.zorder import Z2, Z3
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def test_morton2_parity():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 1 << 31, 10_000).astype(np.uint64)
+    y = rng.integers(0, 1 << 31, 10_000).astype(np.uint64)
+    np.testing.assert_array_equal(native.morton2(x, y), Z2.index(x, y))
+
+
+def test_morton3_parity_and_decode():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 1 << 21, 10_000).astype(np.uint64)
+    y = rng.integers(0, 1 << 21, 10_000).astype(np.uint64)
+    t = rng.integers(0, 1 << 21, 10_000).astype(np.uint64)
+    z = native.morton3(x, y, t)
+    np.testing.assert_array_equal(z, Z3.index(x, y, t))
+    dx, dy, dt = native.morton3_decode(z)
+    np.testing.assert_array_equal(dx, x)
+    np.testing.assert_array_equal(dy, y)
+    np.testing.assert_array_equal(dt, t)
+
+
+@pytest.mark.parametrize("period", ["day", "week"])
+def test_z3_write_keys_parity(period):
+    rng = np.random.default_rng(2)
+    n = 20_000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    # include exact boundary values where float rounding bites
+    x[:4] = [-180.0, 180.0, 0.0, -0.0]
+    y[:4] = [-90.0, 90.0, 0.0, 179.9999 % 90]
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    millis = t0 + rng.integers(0, 400 * 86400_000, n)
+    millis[:2] = [0, t0]
+
+    out = native.z3_write_keys(x, y, millis, period, MAX_OFFSET[TimePeriod(period)], MAX_BIN)
+    assert out is not None
+    bins, zs, cols = out
+
+    sfc = Z3SFC.for_period(period)
+    binner = BinnedTime(period)
+    binned = binner.to_binned(millis)
+    want_z = sfc.index(x, y, binned.offset.astype(np.float64))
+    np.testing.assert_array_equal(zs, want_z.astype(np.uint64))
+    np.testing.assert_array_equal(bins, binned.bin.astype(np.int32))
+    np.testing.assert_array_equal(cols["toff"], binned.offset.astype(np.int32))
+    np.testing.assert_array_equal(cols["x"], x.astype(np.float32))
+
+
+def test_z3_write_keys_rejects_bad_dates():
+    with pytest.raises(ValueError):
+        native.z3_write_keys(
+            np.zeros(1), np.zeros(1), np.array([-5]), "week",
+            MAX_OFFSET[TimePeriod.WEEK], MAX_BIN,
+        )
+    far = np.array([(MAX_BIN + 10) * 7 * 86_400_000], dtype=np.int64)
+    with pytest.raises(ValueError):
+        native.z3_write_keys(
+            np.zeros(1), np.zeros(1), far, "week",
+            MAX_OFFSET[TimePeriod.WEEK], MAX_BIN,
+        )
+
+
+def test_z3_calendar_period_falls_back():
+    assert (
+        native.z3_write_keys(np.zeros(1), np.zeros(1), np.array([0]), "month", 1, 1)
+        is None
+    )
+
+
+def test_z2_write_keys_parity():
+    rng = np.random.default_rng(3)
+    n = 20_000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    x[:2] = [-180.0, 180.0]
+    y[:2] = [-90.0, 90.0]
+    z, cols = native.z2_write_keys(x, y)
+    want = Z2SFC().index(x, y)
+    np.testing.assert_array_equal(z, want.astype(np.uint64))
+    np.testing.assert_array_equal(cols["y"], y.astype(np.float32))
+
+
+def test_store_query_identical_with_and_without_native(monkeypatch):
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+
+    def build():
+        sft = FeatureType.from_spec("n", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore(tile=64)
+        ds.create_schema(sft)
+        rng = np.random.default_rng(4)
+        n = 2000
+        t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+        ds.write("n", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"dtg": t0 + rng.integers(0, 20 * 86400_000, n),
+             "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n))},
+        ))
+        return ds
+
+    q = "bbox(geom, -30, -20, 40, 35) AND dtg DURING 2024-01-03T00:00:00Z/2024-01-12T00:00:00Z"
+    with_native = sorted(build().query("n", q).ids.tolist())
+    monkeypatch.setattr(native, "_lib", False)
+    without = sorted(build().query("n", q).ids.tolist())
+    assert with_native == without and len(with_native) > 0
