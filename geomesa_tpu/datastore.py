@@ -23,7 +23,7 @@ import numpy as np
 
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include, PointColumn
-from geomesa_tpu.index import AttributeIndex, XZ2Index, XZ3Index, Z2Index, Z3Index
+from geomesa_tpu.index import AttributeIndex, S2Index, S3Index, XZ2Index, XZ3Index, Z2Index, Z3Index
 from geomesa_tpu.planning.explain import Explainer
 from geomesa_tpu.planning.planner import QueryPlanner
 from geomesa_tpu.sft import FeatureType
@@ -85,10 +85,13 @@ class DataStore:
 
     def _choose_indexes(self, sft: FeatureType) -> list:
         indexes: list = []
+        extras: list = []  # opt-in only (reference gates S2/S3 the same way)
         if sft.is_points:
             if sft.dtg_field is not None:
                 indexes.append(Z3Index(sft))
+                extras.append(S3Index(sft))
             indexes.append(Z2Index(sft))
+            extras.append(S2Index(sft))
         else:
             if sft.dtg_field is not None:
                 indexes.append(XZ3Index(sft))
@@ -102,7 +105,9 @@ class DataStore:
             names = {s.strip() for s in str(enabled).split(",")}
             # "attr" enables every attribute index (reference names them all "attr")
             indexes = [
-                i for i in indexes if i.name in names or i.name.split("_")[0] in names
+                i
+                for i in indexes + extras
+                if i.name in names or i.name.split("_")[0] in names
             ]
             if not indexes:
                 raise ValueError(f"no supported index in {enabled!r}")
@@ -150,9 +155,12 @@ class DataStore:
         )
         if check_ids and len(np.unique(merged.ids)) != len(merged):
             raise ValueError("duplicate feature ids in write batch")
-        self._features[type_name] = merged
-        self._id_map[type_name] = None  # rebuilt lazily on first id lookup
-        stats = self._update_stats(type_name, features)
+
+        # build everything BEFORE mutating store state: a failing encoder
+        # (bad dates, unsupported geometry) must leave the store untouched,
+        # not half-written (features visible but index tables stale)
+        stats = self._build_stats(type_name, features)
+        new_tables: dict[str, IndexTable] = {}
         for idx in self._indexes[type_name]:
             keys = idx.write_keys(merged)
             if idx.name == "z3" and len(keys.zs):
@@ -171,19 +179,26 @@ class DataStore:
                 table = DistributedIndexTable(idx, keys, self.mesh, **kwargs)
             else:
                 table = IndexTable(idx, keys, **kwargs)
-            self._tables[(type_name, idx.name)] = table
+            new_tables[idx.name] = table
+
+        # commit
+        self._features[type_name] = merged
+        self._id_map[type_name] = None  # rebuilt lazily on first id lookup
+        self._stats[type_name] = stats
+        for name, table in new_tables.items():
+            self._tables[(type_name, name)] = table
         return len(features)
 
-    def _update_stats(self, type_name: str, delta: FeatureCollection):
+    def _build_stats(self, type_name: str, delta: FeatureCollection):
         """Incremental: sketch the delta batch, merge into existing stats
-        (the reference's MetadataBackedStats merge-on-write)."""
+        (the reference's MetadataBackedStats merge-on-write). Pure — the
+        caller commits the result."""
         from geomesa_tpu.stats.store import StatsStore
 
         stats = StatsStore.build(self._schemas[type_name], delta)
         prev = self._stats.get(type_name)
         if prev is not None:
             stats = prev.merge(stats)
-        self._stats[type_name] = stats
         return stats
 
     # -- planner hooks ---------------------------------------------------

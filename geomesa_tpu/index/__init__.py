@@ -13,6 +13,7 @@ replaces the server-side row filter (Z3Filter et al.).
 
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
 from geomesa_tpu.index.attribute import AttributeIndex
+from geomesa_tpu.index.s2 import S2Index, S3Index
 from geomesa_tpu.index.z2 import Z2Index
 from geomesa_tpu.index.z3 import Z3Index
 from geomesa_tpu.index.xz2 import XZ2Index
@@ -23,6 +24,8 @@ __all__ = [
     "ScanConfig",
     "WriteKeys",
     "AttributeIndex",
+    "S2Index",
+    "S3Index",
     "Z2Index",
     "Z3Index",
     "XZ2Index",

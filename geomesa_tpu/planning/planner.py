@@ -30,7 +30,11 @@ from geomesa_tpu.planning.explain import Explainer, ExplainNull
 # mirrors the reference's cost multipliers (SpatioTemporalFilterStrategy:
 # z3 = 1.1 with bounded time; SpatialFilterStrategy z2 = 2.0; attribute =
 # 1.0 with equality...). Lower = preferred.
-INDEX_PRIORITY = {"z3": 1.1, "xz3": 1.1, "z2": 2.0, "xz2": 2.0, "attr": 2.5, "id": 0.5}
+INDEX_PRIORITY = {
+    "z3": 1.1, "xz3": 1.1, "s3": 1.2,
+    "z2": 2.0, "xz2": 2.0, "s2": 2.1,
+    "attr": 2.5, "id": 0.5,
+}
 
 
 def index_priority(name: str) -> float:

@@ -1,0 +1,140 @@
+"""S2 curve: roundtrip, covering correctness (brute force), store paths."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import s2 as s2mod
+from geomesa_tpu.curve.s2 import S2SFC, cell_id_from_lonlat, cell_center_lonlat, cell_range
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+
+
+def _rand_lonlat(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)
+
+
+class TestCellIds:
+    def test_roundtrip_center_close(self):
+        lon, lat = _rand_lonlat(2000)
+        cells = cell_id_from_lonlat(lon, lat)
+        clon, clat = cell_center_lonlat(cells)
+        # a leaf cell is ~1e-7 degrees across; centers must be within a cell
+        assert np.abs(clat - lat).max() < 1e-5
+        dlon = np.abs(((clon - lon) + 180) % 360 - 180) * np.cos(np.radians(lat))
+        assert dlon.max() < 1e-5
+
+    def test_leaf_ids_distinct_and_valid(self):
+        lon, lat = _rand_lonlat(5000, seed=1)
+        cells = cell_id_from_lonlat(lon, lat)
+        assert len(np.unique(cells)) > 4990  # collisions ~ impossible
+        assert (cells & np.uint64(1)).all()  # leaf ids end in 1
+        faces = cells >> np.uint64(61)
+        assert faces.max() <= 5
+
+    def test_locality(self):
+        # nearby points share long cell-id prefixes more than far ones
+        a = cell_id_from_lonlat(np.array([10.0]), np.array([10.0]))[0]
+        b = cell_id_from_lonlat(np.array([10.0001]), np.array([10.0001]))[0]
+        c = cell_id_from_lonlat(np.array([-120.0]), np.array([-45.0]))[0]
+        near = int(a ^ b).bit_length()
+        far = int(a ^ c).bit_length()
+        assert near < far
+
+    def test_coarse_level_ranges_nest(self):
+        lon, lat = np.array([42.5]), np.array([-13.25])
+        leaf = cell_id_from_lonlat(lon, lat)[0]
+        for level in (5, 10, 20):
+            coarse = cell_id_from_lonlat(lon, lat, level=level)[0]
+            lo, hi = cell_range(np.array([coarse]))
+            assert lo[0] <= leaf <= hi[0]
+
+
+BOXES = [
+    (-10.0, -10.0, 10.0, 10.0),
+    (100.0, 30.0, 140.0, 70.0),     # reaches the north polar face
+    (-179.0, -89.0, 179.0, -50.0),  # south polar band
+    (170.0, -20.0, 180.0, 20.0),    # hugs the antimeridian
+    (-170.0, 10.0, 170.0, 12.0),    # wide band wrapping most faces
+    (0.0, 80.0, 360.0 - 359.0, 90.0),
+    (-45.1, 44.9, -44.9, 45.1),     # face corner
+]
+
+
+class TestCovering:
+    @pytest.mark.parametrize("box", BOXES)
+    def test_no_misses(self, box):
+        xmin, ymin, xmax, ymax = box
+        rng = np.random.default_rng(7)
+        n = 4000
+        lon = rng.uniform(xmin, xmax, n)
+        lat = rng.uniform(ymin, ymax, n)
+        cells = cell_id_from_lonlat(lon, lat)
+        sfc = S2SFC()
+        ranges = sfc.ranges([box])
+        assert ranges
+        lows = np.array([r.lower for r in ranges], dtype=np.uint64)
+        highs = np.array([r.upper for r in ranges], dtype=np.uint64)
+        idx = np.searchsorted(lows, cells, side="right") - 1
+        ok = (idx >= 0) & (cells <= highs[np.clip(idx, 0, len(highs) - 1)])
+        assert ok.all(), f"{(~ok).sum()} points outside covering for {box}"
+
+    def test_range_budget(self):
+        sfc = S2SFC(max_cells=64)
+        ranges = sfc.ranges([(-170.0, -80.0, 170.0, 80.0)])
+        assert 0 < len(ranges) <= 8 * 64  # merged, bounded
+
+    def test_inverted_box_raises(self):
+        with pytest.raises(ValueError):
+            S2SFC().ranges([(10, 0, -10, 5)])
+
+
+class TestStoreIntegration:
+    def _store(self, enabled):
+        spec = f"dtg:Date,*geom:Point:srid=4326;geomesa.indices.enabled={enabled}"
+        sft = FeatureType.from_spec("s2t", spec)
+        ds = DataStore(tile=64)
+        ds.create_schema(sft)
+        n = 3000
+        rng = np.random.default_rng(3)
+        t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        t = t0 + rng.integers(0, 20 * 86400_000, n)
+        ds.write("s2t", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)], {"dtg": t, "geom": (x, y)}
+        ))
+        return ds, (x, y, t)
+
+    def test_s2_query_matches_brute_force(self):
+        ds, (x, y, t) = self._store("s2")
+        assert [i.name for i in ds.indexes("s2t")] == ["s2"]
+        hits = ds.query("s2t", "bbox(geom, -30, 20, 40, 60)")
+        truth = (x >= -30) & (x <= 40) & (y >= 20) & (y <= 60)
+        assert sorted(hits.ids.tolist()) == sorted(
+            np.arange(len(x)).astype(str)[truth].tolist()
+        )
+
+    def test_s3_query_matches_brute_force(self):
+        ds, (x, y, t) = self._store("s3")
+        assert [i.name for i in ds.indexes("s2t")] == ["s3"]
+        lo = np.datetime64("2024-01-03T00:00:00", "ms").astype(np.int64)
+        hi = np.datetime64("2024-01-12T00:00:00", "ms").astype(np.int64)
+        q = (
+            "bbox(geom, -60, -40, 60, 40) AND dtg DURING "
+            "2024-01-03T00:00:00Z/2024-01-12T00:00:00Z"
+        )
+        hits = ds.query("s2t", q)
+        truth = (
+            (x >= -60) & (x <= 60) & (y >= -40) & (y <= 40) & (t >= lo) & (t < hi)
+        )
+        assert sorted(hits.ids.tolist()) == sorted(
+            np.arange(len(x)).astype(str)[truth].tolist()
+        )
+
+    def test_default_indexes_unchanged(self):
+        sft = FeatureType.from_spec("p", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        assert [i.name for i in ds.indexes("p")] == ["z3", "z2"]
