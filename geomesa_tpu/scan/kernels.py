@@ -102,25 +102,70 @@ def compact_rows(m, base, cap):
     return count, rows
 
 
-@partial(jax.jit, static_argnames=("tile", "cap", "extent_mode"))
-def tile_scan(cols, tile_ids, boxes, windows, *, tile, cap, extent_mode=False):
+def pallas_mode(tile: int, n_pad: int) -> str | None:
+    """Whether the Pallas scan kernel should run for this table layout:
+    "tpu" (compiled), "interpret" (CPU, forced via GEOMESA_TPU_PALLAS=1),
+    or None for the XLA gather path. GEOMESA_TPU_PALLAS=0 disables."""
+    import os
+
+    env = os.environ.get("GEOMESA_TPU_PALLAS")
+    if env == "0":
+        return None
+    from geomesa_tpu.scan import pallas_kernels
+
+    if not pallas_kernels.supported(tile, n_pad):
+        return None
+    if jax.default_backend() == "tpu":
+        return "tpu"
+    return "interpret" if env == "1" else None
+
+
+def _mask_dispatch(cols, tile_ids, boxes, windows, tile, extent_mode, pallas):
+    if pallas:
+        from geomesa_tpu.scan import pallas_kernels
+
+        names = tuple(sorted(cols))
+        blocks = tuple(
+            cols[k].reshape(-1, tile // pallas_kernels.LANES, pallas_kernels.LANES)
+            for k in names
+        )
+        m = pallas_kernels.pallas_tile_mask(
+            blocks,
+            tile_ids,
+            boxes,
+            windows,
+            tile=tile,
+            extent_mode=extent_mode,
+            col_names=names,
+            interpret=(pallas == "interpret"),
+        )
+        base = jnp.maximum(tile_ids, 0).astype(jnp.int32)[:, None] * tile + jnp.arange(
+            tile, dtype=jnp.int32
+        )
+        return m, base
+    return _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode)
+
+
+@partial(jax.jit, static_argnames=("tile", "cap", "extent_mode", "pallas"))
+def tile_scan(cols, tile_ids, boxes, windows, *, tile, cap, extent_mode=False, pallas=None):
     """Gather-scan candidate tiles; return (count, matching row ids).
 
     - cols: dict of [N_pad] device columns (pad rows carry sentinels that
       can never match)
     - tile_ids: i32 [T], sorted ascending, -1 = pad slot
     - boxes: f32 [B, 4] or None; windows: i32 [W, 3] or None
+    - pallas: None | "tpu" | "interpret" (see pallas_mode)
     - returns (count i32, rows i32 [cap] — global row indices ascending,
       -1 past count; if count > cap the caller re-runs with a larger cap)
     """
-    m, base = _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode)
+    m, base = _mask_dispatch(cols, tile_ids, boxes, windows, tile, extent_mode, pallas)
     return compact_rows(m, base, cap)
 
 
-@partial(jax.jit, static_argnames=("tile", "extent_mode"))
-def tile_count(cols, tile_ids, boxes, windows, *, tile, extent_mode=False):
+@partial(jax.jit, static_argnames=("tile", "extent_mode", "pallas"))
+def tile_count(cols, tile_ids, boxes, windows, *, tile, extent_mode=False, pallas=None):
     """Count-only scan (no gather): the loose/estimate fast path."""
-    m, _ = _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode)
+    m, _ = _mask_dispatch(cols, tile_ids, boxes, windows, tile, extent_mode, pallas)
     return m.sum(dtype=jnp.int32)
 
 

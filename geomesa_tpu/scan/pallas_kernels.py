@@ -1,0 +1,152 @@
+"""Pallas TPU kernel for the tile-scan membership mask.
+
+The XLA path (geomesa_tpu.scan.kernels) gathers candidate tiles with a
+materialized [T, tile] index matrix — one big HBM gather. This Pallas
+variant turns tile pruning into *block scheduling*: candidate tile ids are
+scalar-prefetched, and each grid step's BlockSpec index_map DMAs exactly
+that tile's rows from HBM into VMEM (the seek-to-range behavior of the
+reference's tablet servers, expressed as data movement). The membership
+predicate (Z3Filter semantics — any-box AND any-window) evaluates on the
+VPU per block.
+
+Used automatically on TPU for tiles that satisfy the (8, 128) f32 layout
+constraint; `interpret=True` runs the same kernel on CPU for tests. The
+compacted-row extraction stays in XLA (jnp.nonzero) either way.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBLANES = 8
+
+
+def supported(tile: int, n_pad: int) -> bool:
+    """f32 layout constraint: blocks are (tile // 128, 128)."""
+    return tile % (LANES * SUBLANES) == 0 and n_pad % tile == 0
+
+
+def _mask_kernel(has_boxes, has_windows, extent_mode, n_cols, col_names):
+    """Build the per-tile kernel for one static configuration."""
+
+    def kernel(tids_ref, *refs):
+        cols = {name: refs[k] for k, name in enumerate(col_names)}
+        boxes_ref = refs[n_cols] if has_boxes else None
+        windows_ref = refs[n_cols + int(has_boxes)] if has_windows else None
+        out_ref = refs[-1]
+        i = pl.program_id(0)
+        tile_ok = tids_ref[i] >= 0
+
+        if extent_mode:
+            gxmin = cols["gxmin"][:]
+            valid = jnp.isfinite(gxmin)
+        elif "x" in cols:
+            valid = jnp.isfinite(cols["x"][:])
+        else:
+            valid = cols["tbin"][:] >= 0
+        m = valid & tile_ok
+
+        if has_boxes:
+            b = boxes_ref[:]  # [B, 4]
+            hit = jnp.zeros(m.shape, dtype=jnp.bool_)
+            B = b.shape[0]
+            if extent_mode:
+                gx0 = cols["gxmin"][:]
+                gy0 = cols["gymin"][:]
+                gx1 = cols["gxmax"][:]
+                gy1 = cols["gymax"][:]
+                for k in range(B):  # B is a small padded constant
+                    hit = hit | (
+                        (gx0 <= b[k, 2]) & (gx1 >= b[k, 0])
+                        & (gy0 <= b[k, 3]) & (gy1 >= b[k, 1])
+                    )
+            else:
+                x = cols["x"][:]
+                y = cols["y"][:]
+                for k in range(B):
+                    hit = hit | (
+                        (x >= b[k, 0]) & (x <= b[k, 2])
+                        & (y >= b[k, 1]) & (y <= b[k, 3])
+                    )
+            m = m & hit
+        if has_windows:
+            w = windows_ref[:]  # [W, 3]
+            tbin = cols["tbin"][:]
+            toff = cols["toff"][:]
+            hit = jnp.zeros(m.shape, dtype=jnp.bool_)
+            for k in range(w.shape[0]):
+                hit = hit | ((tbin == w[k, 0]) & (toff >= w[k, 1]) & (toff <= w[k, 2]))
+            m = m & hit
+        # f32 mask: bool/int8 blocks hit stricter sublane tiling constraints
+        out_ref[:] = m.astype(jnp.float32)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tile", "extent_mode", "col_names", "interpret"),
+)
+def pallas_tile_mask(
+    cols_tuple,
+    tile_ids,
+    boxes,
+    windows,
+    *,
+    tile: int,
+    extent_mode: bool,
+    col_names: tuple,
+    interpret: bool = False,
+):
+    """[T, tile] membership mask over candidate tiles.
+
+    - cols_tuple: per-name [n_tiles, rows, LANES] f32/i32 arrays (rows =
+      tile // LANES), ordered by ``col_names``
+    - tile_ids: i32 [T] sorted, -1 pads (prefetched; drives the index_map)
+    """
+    T = tile_ids.shape[0]
+    rows = tile // LANES
+    n_cols = len(col_names)
+
+    def col_index(i, tids):
+        return (jnp.maximum(tids[i], 0), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, rows, LANES), col_index) for _ in range(n_cols)
+    ]
+    operands = list(cols_tuple)
+    if boxes is not None:
+        in_specs.append(pl.BlockSpec(boxes.shape, lambda i, tids: (0, 0)))
+        operands.append(boxes)
+    if windows is not None:
+        in_specs.append(pl.BlockSpec(windows.shape, lambda i, tids: (0, 0)))
+        operands.append(windows)
+
+    kernel = _mask_kernel(
+        boxes is not None, windows is not None, extent_mode, n_cols, col_names
+    )
+
+    def wrapped(tids_ref, *refs):
+        # reshape each column block [1, rows, LANES] view via refs directly
+        kernel(tids_ref, *refs)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, rows, LANES), lambda i, tids: (i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        wrapped,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, rows, LANES), jnp.float32),
+        interpret=interpret,
+    )(tile_ids, *operands)
+    return out.reshape(T, tile) != 0.0

@@ -161,6 +161,7 @@ class IndexTable(SortedKeys):
         )
         cap = kernels.pad_pow2(cap_hint, 4096)
         max_possible = len(tiles) * self.tile
+        pallas = kernels.pallas_mode(self.tile, self.n_pad)
         while True:
             count, rows = kernels.tile_scan(
                 self.cols,
@@ -170,6 +171,7 @@ class IndexTable(SortedKeys):
                 tile=self.tile,
                 cap=min(cap, kernels.pad_pow2(max_possible, 4096)),
                 extent_mode=config.extent_mode,
+                pallas=pallas,
             )
             count = int(count)
             if count <= cap or cap >= max_possible:
@@ -196,6 +198,7 @@ class IndexTable(SortedKeys):
                 else None,
                 tile=self.tile,
                 extent_mode=config.extent_mode,
+                pallas=kernels.pallas_mode(self.tile, self.n_pad),
             )
         )
 

@@ -1,0 +1,119 @@
+"""Pallas scan kernel: parity with the XLA gather path (interpret mode on
+the CPU test platform; the same kernel compiles on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from geomesa_tpu.scan import kernels, pallas_kernels
+
+TILE = 1024  # multiple of 8 * 128
+
+
+def _cols(n_pad, with_time=True, extent=False, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {}
+    if extent:
+        x0 = rng.uniform(-180, 179, n_pad).astype(np.float32)
+        y0 = rng.uniform(-90, 89, n_pad).astype(np.float32)
+        cols["gxmin"] = x0
+        cols["gymin"] = y0
+        cols["gxmax"] = x0 + rng.uniform(0, 5, n_pad).astype(np.float32)
+        cols["gymax"] = y0 + rng.uniform(0, 5, n_pad).astype(np.float32)
+    else:
+        cols["x"] = rng.uniform(-180, 180, n_pad).astype(np.float32)
+        cols["y"] = rng.uniform(-90, 90, n_pad).astype(np.float32)
+    if with_time:
+        cols["tbin"] = rng.integers(2800, 2805, n_pad).astype(np.int32)
+        cols["toff"] = rng.integers(0, 604800, n_pad).astype(np.int32)
+    # sentinel-pad the tail like IndexTable does
+    for k in ("x", "gxmin"):
+        if k in cols:
+            cols[k][-7:] = np.inf
+    if "tbin" in cols:
+        cols["tbin"][-7:] = -1
+    return {k: jnp.asarray(v) for k, v in cols.items()}
+
+
+def _mask_pair(cols, tile_ids, boxes, windows, extent=False):
+    m_x, base_x = kernels._tile_mask(cols, tile_ids, boxes, windows, TILE, extent)
+    names = tuple(sorted(cols))
+    blocks = tuple(cols[k].reshape(-1, TILE // 128, 128) for k in names)
+    m_p = pallas_kernels.pallas_tile_mask(
+        blocks, tile_ids, boxes, windows,
+        tile=TILE, extent_mode=extent, col_names=names, interpret=True,
+    )
+    return np.asarray(m_x), np.asarray(m_p), base_x
+
+
+class TestPallasParity:
+    def test_boxes_and_windows(self):
+        cols = _cols(8 * TILE)
+        tile_ids = kernels.pad_tiles(np.array([0, 2, 3, 7]))
+        boxes = kernels.pad_boxes(np.array([[-20.0, -10.0, 40.0, 35.0], [100.0, 0.0, 160.0, 50.0]]))
+        windows = kernels.pad_windows(np.array([[2801, 0, 604799], [2803, 1000, 300000]]))
+        mx, mp, _ = _mask_pair(cols, tile_ids, boxes, windows)
+        assert mx.any()
+        np.testing.assert_array_equal(mx, mp)
+
+    def test_boxes_only(self):
+        cols = _cols(4 * TILE, with_time=False)
+        tile_ids = kernels.pad_tiles(np.array([1, 3]))
+        boxes = kernels.pad_boxes(np.array([[-50.0, -50.0, 50.0, 50.0]]))
+        mx, mp, _ = _mask_pair(cols, tile_ids, boxes, None)
+        np.testing.assert_array_equal(mx, mp)
+
+    def test_no_predicates_validity_only(self):
+        cols = _cols(2 * TILE, with_time=False)
+        tile_ids = kernels.pad_tiles(np.array([0, 1]))
+        mx, mp, _ = _mask_pair(cols, tile_ids, None, None)
+        # pad rows (inf sentinels) excluded in both
+        assert mx.sum() == 2 * TILE - 7
+        np.testing.assert_array_equal(mx, mp)
+
+    def test_extent_mode(self):
+        cols = _cols(4 * TILE, with_time=False, extent=True)
+        tile_ids = kernels.pad_tiles(np.array([0, 2]))
+        boxes = kernels.pad_boxes(np.array([[-30.0, -30.0, 30.0, 30.0]]))
+        mx, mp, _ = _mask_pair(cols, tile_ids, boxes, None, extent=True)
+        assert mx.any()
+        np.testing.assert_array_equal(mx, mp)
+
+    def test_supported_layouts(self):
+        assert pallas_kernels.supported(1024, 8192)
+        assert not pallas_kernels.supported(64, 8192)  # too small
+        assert not pallas_kernels.supported(1000, 8000)  # not lane-aligned
+
+
+class TestStoreParity:
+    def test_full_query_path_interpret(self, monkeypatch):
+        """Whole store query with the Pallas kernel forced on (interpret)."""
+        monkeypatch.setenv("GEOMESA_TPU_PALLAS", "1")
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.sft import FeatureType
+
+        sft = FeatureType.from_spec("p", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore(tile=TILE)
+        ds.create_schema(sft)
+        n = 5000
+        rng = np.random.default_rng(9)
+        t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        t = t0 + rng.integers(0, 20 * 86400_000, n)
+        ds.write("p", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)], {"dtg": t, "geom": (x, y)}
+        ))
+        lo = np.datetime64("2024-01-03T00:00:00", "ms").astype(np.int64)
+        hi = np.datetime64("2024-01-12T00:00:00", "ms").astype(np.int64)
+        q = (
+            "bbox(geom, -60, -40, 60, 40) AND dtg DURING "
+            "2024-01-03T00:00:00Z/2024-01-12T00:00:00Z"
+        )
+        hits = ds.query("p", q)
+        truth = (x >= -60) & (x <= 60) & (y >= -40) & (y <= 40) & (t >= lo) & (t < hi)
+        assert sorted(hits.ids.tolist()) == sorted(
+            np.arange(n).astype(str)[truth].tolist()
+        )
