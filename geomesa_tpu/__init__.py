@@ -18,6 +18,36 @@ on host (thousands of ops), the scan runs on device (millions of rows).
 
 __version__ = "0.1.0"
 
+import os as _os
+
+_cache_enabled = False
+
+
+def enable_compile_cache():
+    """Persistent XLA compilation cache: scan-kernel shapes are static per
+    table, so every process after the first hits the disk cache instead of
+    paying the 20-40 s tunnel compiles. Called lazily from the first device
+    table build — NOT at import, so host-only paths never pay the jax
+    import (GEOMESA_TPU_NO_COMPILE_CACHE=1 disables)."""
+    global _cache_enabled
+    if _cache_enabled or _os.environ.get("GEOMESA_TPU_NO_COMPILE_CACHE"):
+        return
+    _cache_enabled = True
+    try:
+        import jax
+
+        repo_default = _os.path.join(
+            _os.path.dirname(_os.path.dirname(__file__)), ".jax_cache"
+        )
+        if not _os.access(_os.path.dirname(repo_default), _os.W_OK):
+            repo_default = _os.path.expanduser("~/.cache/geomesa_tpu/jax")
+        cache = _os.environ.get("JAX_COMPILATION_CACHE_DIR", repo_default)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
+
+
 from geomesa_tpu.sft import FeatureType, AttributeDescriptor
 from geomesa_tpu.datastore import DataStore
 from geomesa_tpu.features import FeatureCollection

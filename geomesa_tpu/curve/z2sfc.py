@@ -46,20 +46,28 @@ class Z2SFC:
         bounds: Sequence[tuple[float, float, float, float]],
         max_ranges: int | None = None,
         max_recurse: int | None = None,
+        inner: bool = False,
     ) -> list[IndexRange]:
         """Covering z-ranges for (xmin, ymin, xmax, ymax) boxes.
 
         Boxes must be axis-ordered (min <= max per dimension); callers split
         antimeridian-crossing boxes into two, as the reference's do.
+        ``inner=True``: classify containment 2 cells inward so contained
+        rows are certain f64 hits (see Z3SFC.ranges).
         """
         boxes = []
+        inner_boxes: list[ZBox] | None = [] if inner else None
         for (xmin, ymin, xmax, ymax) in bounds:
             if xmin > xmax or ymin > ymax:
                 raise ValueError(f"inverted bbox: {(xmin, ymin, xmax, ymax)}")
-            boxes.append(
-                ZBox(
-                    (int(self.lon.normalize(xmin)), int(self.lat.normalize(ymin))),
-                    (int(self.lon.normalize(xmax)), int(self.lat.normalize(ymax))),
+            lo = (int(self.lon.normalize(xmin)), int(self.lat.normalize(ymin)))
+            hi = (int(self.lon.normalize(xmax)), int(self.lat.normalize(ymax)))
+            boxes.append(ZBox(lo, hi))
+            if inner:
+                inner_boxes.append(
+                    ZBox(tuple(v + 2 for v in lo), tuple(max(v - 2, 0) for v in hi))
                 )
-            )
-        return zranges(Z2, boxes, max_ranges=max_ranges, max_recurse=max_recurse)
+        return zranges(
+            Z2, boxes, max_ranges=max_ranges, max_recurse=max_recurse,
+            inner_boxes=inner_boxes,
+        )

@@ -66,31 +66,43 @@ class Z3SFC:
         times: Sequence[tuple[float, float]],
         max_ranges: int | None = None,
         max_recurse: int | None = None,
+        inner: bool = False,
     ) -> list[IndexRange]:
         """Covering z-ranges for spatial boxes x time-offset windows.
 
         Reference Z3SFC.ranges:59-67 — the cartesian product of spatial
         bounds and (in-bin) time windows becomes one ZBox each.
+
+        ``inner=True`` additionally classifies containment against ordinals
+        shrunk 2 cells inward per dimension, making contained-range rows
+        certain f64 hits (ScanConfig.contained_exact). The 2-cell margin
+        absorbs normalize() floor rounding on both the query bounds and the
+        stored values.
         """
         boxes = []
+        inner_boxes: list[ZBox] | None = [] if inner else None
         for (xmin, ymin, xmax, ymax) in bounds:
             if xmin > xmax or ymin > ymax:
                 raise ValueError(f"inverted bbox: {(xmin, ymin, xmax, ymax)}")
             for (tmin, tmax) in times:
                 if tmin > tmax:
                     raise ValueError(f"inverted time window: {(tmin, tmax)}")
-                boxes.append(
-                    ZBox(
-                        (
-                            int(self.lon.normalize(xmin)),
-                            int(self.lat.normalize(ymin)),
-                            int(self.time.normalize(tmin)),
-                        ),
-                        (
-                            int(self.lon.normalize(xmax)),
-                            int(self.lat.normalize(ymax)),
-                            int(self.time.normalize(tmax)),
-                        ),
-                    )
+                lo = (
+                    int(self.lon.normalize(xmin)),
+                    int(self.lat.normalize(ymin)),
+                    int(self.time.normalize(tmin)),
                 )
-        return zranges(Z3, boxes, max_ranges=max_ranges, max_recurse=max_recurse)
+                hi = (
+                    int(self.lon.normalize(xmax)),
+                    int(self.lat.normalize(ymax)),
+                    int(self.time.normalize(tmax)),
+                )
+                boxes.append(ZBox(lo, hi))
+                if inner:
+                    inner_boxes.append(
+                        ZBox(tuple(v + 2 for v in lo), tuple(max(v - 2, 0) for v in hi))
+                    )
+        return zranges(
+            Z3, boxes, max_ranges=max_ranges, max_recurse=max_recurse,
+            inner_boxes=inner_boxes,
+        )

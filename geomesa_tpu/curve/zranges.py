@@ -56,11 +56,19 @@ def zranges(
     boxes: Sequence[ZBox],
     max_ranges: int | None = None,
     max_recurse: int | None = None,
+    inner_boxes: "Sequence[ZBox] | None" = None,
 ) -> list[IndexRange]:
     """Covering z-ranges for the union of ``boxes`` on ``curve``.
 
     curve: Z2 or Z3 from geomesa_tpu.curve.zorder (needs .dims,
     .bits_per_dim, .index, .decode).
+
+    ``inner_boxes`` (aligned with ``boxes``) classify *containment*: a cell
+    is contained only when fully inside some inner box. Callers pass boxes
+    shrunk below the f64 query bounds so contained-range rows are certain
+    hits needing no refinement; default (None) classifies against the outer
+    boxes — ordinal-level containment, the reference ZN.zranges behavior.
+    Inner boxes may be inverted (mins > maxes) to mean "never contained".
     """
     if not boxes:
         return []
@@ -80,6 +88,24 @@ def zranges(
 
     mins = np.array([b.mins for b in boxes], dtype=np.uint64)  # [nbox, dims]
     maxes = np.array([b.maxes for b in boxes], dtype=np.uint64)
+    if inner_boxes is None:
+        imins, imaxes = mins, maxes
+    else:
+        # inverted inner dims (mins > maxes) never contain anything
+        imins = np.array([b.mins for b in inner_boxes], dtype=np.uint64)
+        imaxes = np.array([b.maxes for b in inner_boxes], dtype=np.uint64)
+
+    from geomesa_tpu import native
+
+    nat = native.zranges(
+        dims, bits_per_dim, mins, maxes, imins, imaxes, max_ranges, max_recurse
+    )
+    if nat is not None:
+        lo, hi, cont = nat
+        return [
+            IndexRange(int(l), int(h), bool(c))
+            for l, h, c in zip(lo.tolist(), hi.tolist(), cont.tolist())
+        ]
 
     zmins = [int(curve.index(*b.mins)) for b in boxes]
     zmaxes = [int(curve.index(*b.maxes)) for b in boxes]
@@ -101,8 +127,9 @@ def zranges(
         return lo, hi
 
     def classify(lo: np.ndarray, hi: np.ndarray) -> int:
-        """2 = fully contained in some box, 1 = overlaps some box, 0 = disjoint."""
-        contained = np.all((lo >= mins) & (hi <= maxes), axis=1)
+        """2 = fully contained in some inner box, 1 = overlaps some box,
+        0 = disjoint."""
+        contained = np.all((lo >= imins) & (hi <= imaxes), axis=1)
         if contained.any():
             return 2
         overlaps = np.all((lo <= maxes) & (hi >= mins), axis=1)
@@ -220,10 +247,11 @@ def merge_ranges(ranges: list[IndexRange], max_ranges: int | None = None) -> lis
     merged: list[IndexRange] = [ranges[0]]
     for r in ranges[1:]:
         last = merged[-1]
-        if r.lower <= last.upper + 1:
-            merged[-1] = IndexRange(
-                last.lower, max(last.upper, r.upper), last.contained and r.contained
-            )
+        # merge only same-kind neighbors: a contained range keeps its
+        # no-refinement guarantee instead of degrading when glued to an
+        # overlapping one (BFS cells are disjoint, so ranges only touch)
+        if r.lower <= last.upper + 1 and r.contained == last.contained:
+            merged[-1] = IndexRange(last.lower, max(last.upper, r.upper), last.contained)
         else:
             merged.append(r)
     if max_ranges is not None and len(merged) > max_ranges:

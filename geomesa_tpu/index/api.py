@@ -63,6 +63,20 @@ class ScanConfig:
     geom_precise: bool = True
     time_precise: bool = True
     disjoint: bool = False
+    # -- exactness tier (round-3; reference contained-range semantics,
+    # ZN.scala:110-242, + useFullFilter, Z3IndexKeySpace.scala:240-254) --
+    # per-range contained flags: rows in contained ranges are certain hits
+    # when contained_exact (ranges were classified against shrunk *inner*
+    # ordinals, so containment holds at f64, not just ordinal, precision)
+    range_contained: Optional[np.ndarray] = None
+    contained_exact: bool = False
+    # inner (shrunk) predicate bounds: rows passing them are certain f64
+    # hits -> host refinement touches only wide & ~inner boundary rows
+    boxes_inner: Optional[np.ndarray] = None
+    windows_inner: Optional[np.ndarray] = None
+    # row spans are exact (attribute-index primary ranges): clip kernel
+    # hits back to the spans (block granularity over-scans)
+    clip_rows: bool = False
 
     @staticmethod
     def empty(index: str) -> "ScanConfig":
@@ -87,6 +101,22 @@ def widen_boxes(bounds) -> np.ndarray:
     b = np.asarray(bounds, dtype=np.float64).reshape(-1, 4)
     lo = np.nextafter(b[:, :2].astype(np.float32), np.float32(-np.inf))
     hi = np.nextafter(b[:, 2:].astype(np.float32), np.float32(np.inf))
+    return np.concatenate([lo, hi], axis=1).astype(np.float32)
+
+
+def shrink_boxes(bounds) -> np.ndarray:
+    """f64 boxes -> f32 boxes shrunk two ulps inward (subset semantics).
+
+    A stored f32 coordinate x32 = round(x64) differs from the true f64
+    value by at most half an ulp; a point passing the 2-ulp-shrunk box test
+    therefore passes the true f64 box test — the device *inner* mask, whose
+    hits skip host refinement entirely."""
+    b = np.asarray(bounds, dtype=np.float64).reshape(-1, 4)
+    lo = b[:, :2].astype(np.float32)
+    hi = b[:, 2:].astype(np.float32)
+    for _ in range(2):
+        lo = np.nextafter(lo, np.float32(np.inf))
+        hi = np.nextafter(hi, np.float32(-np.inf))
     return np.concatenate([lo, hi], axis=1).astype(np.float32)
 
 

@@ -131,4 +131,7 @@ class AttributeIndex:
             extent_mode=extent,
             geom_precise=geom_precise,
             time_precise=time_precise,
+            # value-range spans are row-exact: kernel hits (block granular)
+            # must clip back to them before refinement
+            clip_rows=True,
         )

@@ -64,11 +64,13 @@ class Z2Index:
         if not geoms.values:
             return None  # no spatial constraint: a z2 scan would be full-table
         bounds = geometry_bounds(geoms)
-        ranges = self.sfc.ranges(bounds)
+        ranges = self.sfc.ranges(bounds, inner=True)
         if not ranges:
             return ScanConfig.empty(self.name)
+        from geomesa_tpu.index.api import shrink_boxes
         from geomesa_tpu.index.z3 import _bounds_only
 
+        geom_precise = geoms.precise and _bounds_only(geoms.values)
         return ScanConfig(
             index=self.name,
             range_bins=np.zeros(len(ranges), dtype=np.int32),
@@ -76,5 +78,8 @@ class Z2Index:
             range_hi=np.array([r.upper for r in ranges], dtype=np.uint64),
             boxes=widen_boxes(bounds),
             windows=None,
-            geom_precise=geoms.precise and _bounds_only(geoms.values),
+            geom_precise=geom_precise,
+            range_contained=np.array([r.contained for r in ranges], dtype=bool),
+            contained_exact=bool(geom_precise),
+            boxes_inner=shrink_boxes(bounds),
         )

@@ -21,10 +21,21 @@ from geomesa_tpu.curve.z3sfc import Z3SFC
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter, PointColumn
-from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.index.api import (
+    IndexKeySpace, ScanConfig, WriteKeys, shrink_boxes, widen_boxes,
+)
 from geomesa_tpu.sft import FeatureType
 
 WHOLE_WORLD = (-180.0, -90.0, 180.0, 90.0)
+
+# query-endpoint alignment unit: ms per offset unit (BinnedTime offsets are
+# ms/sec/sec/min for day/week/month/year)
+_OFFSET_UNIT_MS = {
+    TimePeriod.DAY: 1,
+    TimePeriod.WEEK: 1000,
+    TimePeriod.MONTH: 1000,
+    TimePeriod.YEAR: 60_000,
+}
 
 
 class Z3Index:
@@ -87,34 +98,58 @@ class Z3Index:
         bounds = geometry_bounds(geoms) if geoms.values else [WHOLE_WORLD]
 
         # per-bin time windows (reference timesByBin, Z3IndexKeySpace:132-158)
+        # plus the *inner* windows: offsets certain to lie inside the query
+        # at millisecond precision (offsets are unit-floored at ingest, so
+        # an unaligned query endpoint leaves one boundary offset uncertain)
+        unit = _OFFSET_UNIT_MS[self.period]
         bins_list, lo_list, hi_list = [], [], []
+        ilo_list, ihi_list = [], []
         for iv in intervals.values:
             b, lo, hi = self.binner.bins_for_interval(iv.lo, iv.hi - 1)
+            ilo, ihi = lo.copy(), hi.copy()
+            if int(iv.lo) % unit != 0:
+                ilo[0] += 1
+            if int(iv.hi) % unit != 0:
+                ihi[-1] -= 1
             bins_list.append(b)
             lo_list.append(lo)
             hi_list.append(hi)
+            ilo_list.append(ilo)
+            ihi_list.append(ihi)
         bins = np.concatenate(bins_list)
         los = np.concatenate(lo_list)
         his = np.concatenate(hi_list)
+        ilos = np.concatenate(ilo_list)
+        ihis = np.concatenate(ihi_list)
 
         # z-ranges: one decomposition per distinct (lo, hi) offset window —
         # interior bins all share the full-offset window, so a long interval
         # costs one BFS, not one per bin (the reference recomputes per bin;
         # sharing is the columnar win since ranges are bin-independent)
-        range_bins, range_lo, range_hi = [], [], []
+        range_bins, range_lo, range_hi, range_cont = [], [], [], []
         windows = np.stack([bins, los, his], axis=1).astype(np.int64)
+        windows_inner = np.stack([bins, ilos, ihis], axis=1).astype(np.int64)
         for lo_off, hi_off in set(zip(los.tolist(), his.tolist())):
-            ranges = self.sfc.ranges(bounds, [(float(lo_off), float(hi_off))])
+            ranges = self.sfc.ranges(
+                bounds, [(float(lo_off), float(hi_off))], inner=True
+            )
             if not ranges:
                 continue
             rlo = np.array([r.lower for r in ranges], dtype=np.uint64)
             rhi = np.array([r.upper for r in ranges], dtype=np.uint64)
-            for b in bins[(los == lo_off) & (his == hi_off)]:
-                range_bins.append(np.full(len(rlo), b, dtype=np.int32))
+            # the 2-cell inner margin (Z3SFC.ranges inner=True) exceeds one
+            # offset unit in every period, so contained cells' offsets are
+            # strictly inside the query interval even when its endpoints are
+            # not offset-aligned — contained rows are certain at ms precision
+            rc = np.array([r.contained for r in ranges], dtype=bool)
+            for k in np.flatnonzero((los == lo_off) & (his == hi_off)):
+                range_bins.append(np.full(len(rlo), bins[k], dtype=np.int32))
                 range_lo.append(rlo)
                 range_hi.append(rhi)
+                range_cont.append(rc)
         if not range_bins:
             return ScanConfig.empty(self.name)
+        geom_precise = geoms.precise and _bounds_only(geoms.values)
         return ScanConfig(
             index=self.name,
             range_bins=np.concatenate(range_bins),
@@ -122,8 +157,15 @@ class Z3Index:
             range_hi=np.concatenate(range_hi),
             boxes=widen_boxes(bounds),
             windows=windows.astype(np.int32),
-            geom_precise=geoms.precise and _bounds_only(geoms.values),
+            geom_precise=geom_precise,
             time_precise=intervals.precise,
+            range_contained=np.concatenate(range_cont),
+            # contained certainty additionally requires the *filter* to be
+            # decided by bbox+interval alone — the planner checks kinds; here
+            # we require the geometry values themselves to be plain boxes
+            contained_exact=bool(geom_precise and intervals.precise),
+            boxes_inner=shrink_boxes(bounds),
+            windows_inner=windows_inner.astype(np.int32),
         )
 
 

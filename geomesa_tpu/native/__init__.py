@@ -78,6 +78,21 @@ def _load():
         ]
         lib.z3_write_keys.restype = ctypes.c_int32
         lib.z2_write_keys.argtypes = [f64p, f64p, ctypes.c_int64, u64p, f32p, f32p]
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.sort_bins_z.argtypes = [i32p, u64p, ctypes.c_int64, u32p]
+        for name, tp in (
+            ("gather_f32", f32p), ("gather_i32", i32p), ("gather_i64", i64p),
+            ("gather_u64", u64p), ("gather_f64", f64p),
+        ):
+            getattr(lib, name).argtypes = [tp, u32p, ctypes.c_int64, tp]
+        lib.zranges_cpp.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            u64p, u64p, u64p, u64p,
+            ctypes.c_int64, ctypes.c_int64,
+            u64p, u64p, u8p, ctypes.c_int64,
+        ]
+        lib.zranges_cpp.restype = ctypes.c_int64
         _lib = lib
         return lib
 
@@ -168,3 +183,68 @@ def z2_write_keys(x, y):
     yf = np.empty(n, dtype=np.float32)
     lib.z2_write_keys(x, y, n, z, xf, yf)
     return z, {"x": xf, "y": yf}
+
+
+def sort_bins_z(bins, zs) -> "np.ndarray | None":
+    """Stable argsort by (bin, z) via LSD radix — the ingest sort hot path
+    (np.lexsort replacement; ~10x at 100M rows). Returns uint32 perm, or
+    None when native is unavailable or n >= 2^32."""
+    lib = _load()
+    if lib is None or len(zs) >= (1 << 32):
+        return None
+    bins = np.ascontiguousarray(bins, dtype=np.int32)
+    zs = np.ascontiguousarray(zs, dtype=np.uint64)
+    perm = np.empty(len(zs), dtype=np.uint32)
+    lib.sort_bins_z(bins, zs, len(zs), perm)
+    return perm
+
+
+_GATHERS = {
+    np.dtype(np.float32): "gather_f32",
+    np.dtype(np.int32): "gather_i32",
+    np.dtype(np.int64): "gather_i64",
+    np.dtype(np.uint64): "gather_u64",
+    np.dtype(np.float64): "gather_f64",
+}
+
+
+def take(src: np.ndarray, idx: np.ndarray) -> "np.ndarray | None":
+    """out[i] = src[idx[i]] for the supported dtypes, or None."""
+    lib = _load()
+    name = _GATHERS.get(src.dtype)
+    if lib is None or name is None or src.ndim != 1:
+        return None
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.uint32)
+    out = np.empty(len(idx), dtype=src.dtype)
+    getattr(lib, name)(src, idx, len(idx), out)
+    return out
+
+
+def zranges(dims, bits_per_dim, mins, maxes, inner_mins, inner_maxes,
+            max_ranges, max_recurse):
+    """Covering z-ranges of a union of ordinal boxes (C++ BFS + zdiv
+    tightening; see geomesa_native.cpp zranges_cpp). Containment is
+    classified against the inner boxes. Returns (lo u64[k], hi u64[k],
+    contained bool[k]) or None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    mins = np.ascontiguousarray(mins, dtype=np.uint64)
+    maxes = np.ascontiguousarray(maxes, dtype=np.uint64)
+    inner_mins = np.ascontiguousarray(inner_mins, dtype=np.uint64)
+    inner_maxes = np.ascontiguousarray(inner_maxes, dtype=np.uint64)
+    nbox = len(mins) // dims if mins.ndim == 1 else len(mins)
+    cap = max(int(max_ranges) * 2 + 64, 256)
+    lo = np.empty(cap, dtype=np.uint64)
+    hi = np.empty(cap, dtype=np.uint64)
+    cont = np.empty(cap, dtype=np.uint8)
+    n = lib.zranges_cpp(
+        dims, bits_per_dim, nbox,
+        mins.reshape(-1), maxes.reshape(-1),
+        inner_mins.reshape(-1), inner_maxes.reshape(-1),
+        int(max_ranges), int(max_recurse), lo, hi, cont, cap,
+    )
+    if n < 0:
+        return None
+    return lo[:n].copy(), hi[:n].copy(), cont[:n].astype(bool)

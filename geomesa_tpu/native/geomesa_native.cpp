@@ -14,8 +14,12 @@
 //
 // Build: g++ -O3 -shared -fPIC [-fopenmp] geomesa_native.cpp -o libgeomesa_native.so
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstring>
+#include <utility>
+#include <vector>
 
 extern "C" {
 
@@ -165,3 +169,322 @@ void z2_write_keys(const double* x, const double* y, int64_t n, uint64_t* out_z,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------ radix sort
+// Ingest-path argsort by (bin, z): LSD radix with u32 payload, replacing
+// np.lexsort's comparison sort (the reference gets sorted order for free
+// from its KV backends; here the sorted columnar table is built in one
+// batch pass — SURVEY §7 hard part (c)). 8-bit digits; passes whose
+// histogram collapses to a single bucket are skipped (high z bytes and
+// small bin counts make most of the 10 nominal passes no-ops).
+
+
+static int radix_pass_u64(const uint64_t* key, const uint32_t* idx, int64_t n,
+                          int shift, uint64_t* key_out, uint32_t* idx_out) {
+  int64_t hist[256] = {0};
+  for (int64_t i = 0; i < n; ++i) hist[(key[i] >> shift) & 0xFF]++;
+  int nonzero = 0;
+  for (int b = 0; b < 256; ++b) nonzero += hist[b] != 0;
+  if (nonzero <= 1) return 0;  // all keys share this byte: skip
+  int64_t offs[256];
+  int64_t acc = 0;
+  for (int b = 0; b < 256; ++b) { offs[b] = acc; acc += hist[b]; }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t& o = offs[(key[i] >> shift) & 0xFF];
+    key_out[o] = key[i];
+    idx_out[o] = idx[i];
+    ++o;
+  }
+  return 1;
+}
+
+// argsort by (bins asc, zs asc), stable; out_perm must hold n uint32.
+extern "C" void sort_bins_z(const int32_t* bins, const uint64_t* zs, int64_t n,
+                 uint32_t* out_perm) {
+  std::vector<uint64_t> ka(n), kb(n);
+  std::vector<uint32_t> ia(n), ib(n);
+  for (int64_t i = 0; i < n; ++i) { ka[i] = zs[i]; ia[i] = (uint32_t)i; }
+  uint64_t* k0 = ka.data(); uint64_t* k1 = kb.data();
+  uint32_t* i0 = ia.data(); uint32_t* i1 = ib.data();
+  for (int shift = 0; shift < 64; shift += 8) {
+    if (radix_pass_u64(k0, i0, n, shift, k1, i1)) {
+      std::swap(k0, k1);
+      std::swap(i0, i1);
+    }
+  }
+  // bin passes: rebuild key as bin (u16 range) of the current order
+  for (int64_t i = 0; i < n; ++i) k0[i] = (uint64_t)(uint32_t)bins[i0[i]];
+  for (int shift = 0; shift < 32; shift += 8) {
+    if (radix_pass_u64(k0, i0, n, shift, k1, i1)) {
+      std::swap(k0, k1);
+      std::swap(i0, i1);
+    }
+  }
+  std::memcpy(out_perm, i0, n * sizeof(uint32_t));
+}
+
+// permutation gathers for building sorted device/host columns
+extern "C" void gather_f32(const float* src, const uint32_t* idx, int64_t n, float* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[idx[i]];
+}
+extern "C" void gather_i32(const int32_t* src, const uint32_t* idx, int64_t n, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[idx[i]];
+}
+extern "C" void gather_i64(const int64_t* src, const uint32_t* idx, int64_t n, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[idx[i]];
+}
+extern "C" void gather_u64(const uint64_t* src, const uint32_t* idx, int64_t n, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[idx[i]];
+}
+extern "C" void gather_f64(const double* src, const uint32_t* idx, int64_t n, double* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[idx[i]];
+}
+
+// -------------------------------------------------------- z-range BFS
+// Query planning hot path: covering z-ranges for a union of ordinal boxes
+// (reference ZN.zranges quad/oct BFS + Tropf/Herzog zdiv tightening,
+// geomesa-z3/.../sfcurve/ZN.scala:110-242, :309-361). The Python
+// implementation (curve/zranges.py) costs 100-300 ms per query; this is
+// the same algorithm in C++ at <1 ms. Containment is classified against a
+// separate *inner* ordinal box so that contained-range rows are certain
+// hits at f64 precision (ScanConfig.contained -> no refinement).
+
+struct ZCurveOps {
+  int dims;
+  int bits_per_dim;
+  uint64_t (*split)(uint64_t);
+  uint64_t (*combine)(uint64_t);
+};
+
+static uint64_t z2_index_(const uint64_t* p) { return split2(p[0]) | (split2(p[1]) << 1); }
+static uint64_t z3_index_(const uint64_t* p) {
+  return split3(p[0]) | (split3(p[1]) << 1) | (split3(p[2]) << 2);
+}
+
+static void z_decode(const ZCurveOps& ops, uint64_t z, uint64_t* out) {
+  for (int d = 0; d < ops.dims; ++d) out[d] = ops.combine(z >> d);
+}
+
+static uint64_t z_index(const ZCurveOps& ops, const uint64_t* p) {
+  return ops.dims == 2 ? z2_index_(p) : z3_index_(p);
+}
+
+// 2 = cell fully inside some inner box, 1 = overlaps some outer box, 0 = no
+static int classify(const uint64_t* lo, const uint64_t* hi, int dims, int64_t nbox,
+                    const uint64_t* mins, const uint64_t* maxes,
+                    const uint64_t* imins, const uint64_t* imaxes) {
+  for (int64_t b = 0; b < nbox; ++b) {
+    bool contained = true;
+    for (int d = 0; d < dims; ++d)
+      if (lo[d] < imins[b * dims + d] || hi[d] > imaxes[b * dims + d]) {
+        contained = false;
+        break;
+      }
+    if (contained) return 2;
+  }
+  for (int64_t b = 0; b < nbox; ++b) {
+    bool overlap = true;
+    for (int d = 0; d < dims; ++d)
+      if (lo[d] > maxes[b * dims + d] || hi[d] < mins[b * dims + d]) {
+        overlap = false;
+        break;
+      }
+    if (overlap) return 1;
+  }
+  return 0;
+}
+
+struct ZRange { uint64_t lo, hi; uint8_t contained; };
+
+// Tropf/Herzog LITMAX/BIGMIN: mirrors curve/zorder.py zdiv.
+static void zdiv_cpp(const ZCurveOps& ops, uint64_t zmin, uint64_t zmax,
+                     uint64_t zval, uint64_t* litmax_out, uint64_t* bigmin_out) {
+  int dims = ops.dims;
+  int total = dims * ops.bits_per_dim;
+  uint64_t litmax = zmin, bigmin = zmax;
+  uint64_t zmin_ = zmin, zmax_ = zmax;
+  for (int i = total - 1; i >= 0; --i) {
+    uint64_t bit = 1ull << i;
+    int dim = i % dims;
+    int bl = i / dims + 1;  // 1-based dim-local bit index
+    int v = (zval & bit) ? 1 : 0;
+    int mn = (zmin_ & bit) ? 1 : 0;
+    int mx = (zmax_ & bit) ? 1 : 0;
+    uint64_t mask = ops.split((1ull << bl) - 1) << dim;
+    if (v == 0 && mn == 0 && mx == 1) {
+      uint64_t pat_hi = ops.split(1ull << (bl - 1)) << dim;
+      uint64_t pat_lo = ops.split(((1ull << (bl - 1)) - 1)) << dim;
+      bigmin = (zmin_ & ~mask) | pat_hi;
+      zmax_ = (zmax_ & ~mask) | pat_lo;
+    } else if (v == 0 && mn == 1 && mx == 1) {
+      bigmin = zmin_;
+      break;
+    } else if (v == 1 && mn == 0 && mx == 0) {
+      litmax = zmax_;
+      break;
+    } else if (v == 1 && mn == 0 && mx == 1) {
+      uint64_t pat_hi = ops.split(1ull << (bl - 1)) << dim;
+      uint64_t pat_lo = ops.split(((1ull << (bl - 1)) - 1)) << dim;
+      litmax = (zmax_ & ~mask) | pat_lo;
+      zmin_ = (zmin_ & ~mask) | pat_hi;
+    }
+  }
+  *litmax_out = litmax;
+  *bigmin_out = bigmin;
+}
+
+static bool in_some_box(const ZCurveOps& ops, uint64_t z, int64_t nbox,
+                        const uint64_t* mins, const uint64_t* maxes) {
+  uint64_t pt[3];
+  z_decode(ops, z, pt);
+  for (int64_t b = 0; b < nbox; ++b) {
+    bool in = true;
+    for (int d = 0; d < ops.dims; ++d)
+      if (pt[d] < mins[b * ops.dims + d] || pt[d] > maxes[b * ops.dims + d]) {
+        in = false;
+        break;
+      }
+    if (in) return true;
+  }
+  return false;
+}
+
+// Covering ranges for a union of ordinal boxes. Returns the number of
+// ranges written (<= cap), or -1 if cap was too small.
+extern "C" int64_t zranges_cpp(int32_t dims, int32_t bits_per_dim, int64_t nbox,
+                    const uint64_t* mins, const uint64_t* maxes,
+                    const uint64_t* imins, const uint64_t* imaxes,
+                    int64_t max_ranges, int64_t max_recurse,
+                    uint64_t* out_lo, uint64_t* out_hi, uint8_t* out_cont,
+                    int64_t cap) {
+  ZCurveOps ops = dims == 2 ? ZCurveOps{2, bits_per_dim, split2, combine2}
+                            : ZCurveOps{3, bits_per_dim, split3, combine3};
+  int total = dims * bits_per_dim;
+  int children = 1 << dims;
+
+  // corner z's + longest common prefix aligned to dims bits
+  std::vector<uint64_t> zmins(nbox), zmaxes(nbox);
+  for (int64_t b = 0; b < nbox; ++b) {
+    zmins[b] = z_index(ops, mins + b * dims);
+    zmaxes[b] = z_index(ops, maxes + b * dims);
+  }
+  int offset = total;
+  while (offset > 0) {
+    int nxt = offset - dims;
+    uint64_t bits = zmins[0] >> nxt;
+    bool same = true;
+    for (int64_t b = 0; b < nbox && same; ++b)
+      same = (zmins[b] >> nxt) == bits && (zmaxes[b] >> nxt) == bits;
+    if (same) offset = nxt; else break;
+  }
+  uint64_t prefix = (zmins[0] >> offset) << offset;
+
+  std::vector<ZRange> ranges;
+  std::vector<std::pair<uint64_t, int>> level{{prefix, offset}}, nxt_level;
+  uint64_t lo_pt[3], hi_pt[3];
+  int recursions = 0;
+  while (!level.empty() && recursions < max_recurse &&
+         (int64_t)(ranges.size() + level.size() * children) < max_ranges * 2) {
+    nxt_level.clear();
+    for (auto& cell : level) {
+      uint64_t zp = cell.first;
+      int free_bits = cell.second;
+      if (free_bits == 0) {
+        z_decode(ops, zp, lo_pt);
+        int c = classify(lo_pt, lo_pt, dims, nbox, mins, maxes, imins, imaxes);
+        if (c) ranges.push_back({zp, zp, (uint8_t)(c == 2)});
+        continue;
+      }
+      int child_bits = free_bits - dims;
+      for (int q = 0; q < children; ++q) {
+        uint64_t cp = zp | ((uint64_t)q << child_bits);
+        uint64_t cmax = cp | ((child_bits ? (1ull << child_bits) : 0) - (child_bits ? 1ull : 0));
+        z_decode(ops, cp, lo_pt);
+        z_decode(ops, cmax, hi_pt);
+        int c = classify(lo_pt, hi_pt, dims, nbox, mins, maxes, imins, imaxes);
+        if (c == 2) {
+          ranges.push_back({cp, cmax, 1});
+        } else if (c == 1) {
+          if (child_bits == 0) ranges.push_back({cp, cp, 0});
+          else nxt_level.push_back({cp, child_bits});
+        }
+      }
+    }
+    level.swap(nxt_level);
+    ++recursions;
+  }
+  for (auto& cell : level)
+    ranges.push_back({cell.first, cell.first | ((1ull << cell.second) - 1), 0});
+
+  // sort + merge adjacent/overlapping
+  std::sort(ranges.begin(), ranges.end(), [](const ZRange& a, const ZRange& b) {
+    return a.lo != b.lo ? a.lo < b.lo : a.hi < b.hi;
+  });
+  // merge only same-kind neighbors (BFS cells are disjoint, so ranges can
+  // only be adjacent): a contained range glued to an overlapping one keeps
+  // its no-refinement guarantee instead of degrading the pair
+  std::vector<ZRange> merged;
+  for (auto& r : ranges) {
+    if (!merged.empty() && merged.back().hi != ~0ull &&
+        r.lo <= merged.back().hi + 1 && r.contained == merged.back().contained) {
+      if (r.hi > merged.back().hi) merged.back().hi = r.hi;
+    } else {
+      merged.push_back(r);
+    }
+  }
+  // reduce below max_ranges by closing the smallest gaps first
+  while ((int64_t)merged.size() > max_ranges) {
+    // single pass: close all gaps below a threshold found by nth_element
+    int64_t k = merged.size() - max_ranges;
+    std::vector<uint64_t> gaps(merged.size() - 1);
+    for (size_t i = 0; i + 1 < merged.size(); ++i)
+      gaps[i] = merged[i + 1].lo - merged[i].hi;
+    std::vector<uint64_t> g2(gaps);
+    std::nth_element(g2.begin(), g2.begin() + (k - 1), g2.end());
+    uint64_t cutoff = g2[k - 1];
+    std::vector<ZRange> out;
+    out.push_back(merged[0]);
+    int64_t closed = 0;
+    for (size_t i = 1; i < merged.size(); ++i) {
+      if (closed < k && gaps[i - 1] <= cutoff) {
+        out.back().hi = merged[i].hi > out.back().hi ? merged[i].hi : out.back().hi;
+        out.back().contained = 0;
+        ++closed;
+      } else {
+        out.push_back(merged[i]);
+      }
+    }
+    merged.swap(out);
+  }
+
+  // tighten endpoints to in-union z-values (zdiv post-pass; mirrors
+  // curve/zranges.py _tighten_ranges against the *outer* boxes)
+  std::vector<ZRange> out;
+  for (auto& r : merged) {
+    bool has_lo = false, has_hi = false;
+    uint64_t lo = 0, hi = 0;
+    for (int64_t b = 0; b < nbox; ++b) {
+      uint64_t zmin = zmins[b], zmax = zmaxes[b];
+      if (zmax < r.lo || zmin > r.hi) continue;
+      uint64_t cand;
+      if (r.lo <= zmin) cand = zmin;
+      else if (in_some_box(ops, r.lo, 1, mins + b * dims, maxes + b * dims)) cand = r.lo;
+      else { uint64_t lm, bm; zdiv_cpp(ops, zmin, zmax, r.lo, &lm, &bm); cand = bm; }
+      if (cand <= r.hi && (!has_lo || cand < lo)) { lo = cand; has_lo = true; }
+      if (r.hi >= zmax) cand = zmax;
+      else if (in_some_box(ops, r.hi, 1, mins + b * dims, maxes + b * dims)) cand = r.hi;
+      else { uint64_t lm, bm; zdiv_cpp(ops, zmin, zmax, r.hi, &lm, &bm); cand = lm; }
+      if (cand >= r.lo && (!has_hi || cand > hi)) { hi = cand; has_hi = true; }
+    }
+    if (!has_lo || !has_hi || lo > hi) continue;
+    out.push_back({lo, hi, r.contained});
+  }
+
+  if ((int64_t)out.size() > cap) return -1;
+  for (size_t i = 0; i < out.size(); ++i) {
+    out_lo[i] = out[i].lo;
+    out_hi[i] = out[i].hi;
+    out_cont[i] = out[i].contained;
+  }
+  return (int64_t)out.size();
+}

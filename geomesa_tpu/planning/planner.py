@@ -224,6 +224,7 @@ class QueryPlanner:
         exp = explain or ExplainNull()
         fc = self.store.features(plan.type_name)
 
+        certain = None
         if plan.ids is not None:  # id lookup
             ordinals = self.store.id_lookup(plan.type_name, plan.ids)
             candidates = fc.take(ordinals)
@@ -237,23 +238,36 @@ class QueryPlanner:
         else:
             table = self.store.table(plan.type_name, plan.index)
             with exp.span(f"Device scan [{plan.index}]"):
-                cap = plan.limit if plan.limit else 4096
-                ordinals = table.scan(plan.config, cap_hint=max(cap, 4096))
+                res = table.scan(plan.config)
+            if isinstance(res, tuple):
+                ordinals, certain = res
+            else:  # distributed table: no certainty tier yet
+                ordinals, certain = res, None
             exp(f"Candidates: {len(ordinals)}")
             candidates = fc.take(ordinals)
 
-        # LOOSE_BBOX fast path: skip exact host refinement when the widened
-        # device mask already decides the whole filter (reference
-        # Z3IndexKeySpace.useFullFilter + the loose-bbox query hint)
-        loose_ok = (
-            hints is not None
-            and getattr(hints, "loose", False)
-            and mask_decides_filter(
-                plan.filter, plan.config, self.store.get_schema(plan.type_name)
-            )
+        # Refinement tiers (reference Z3IndexKeySpace.useFullFilter,
+        # Z3IndexKeySpace.scala:240-254, automatic since round 3):
+        # - the device mask decides the filter: only *uncertain* boundary
+        #   rows (wide & ~inner; f32/offset rounding) re-check on host;
+        # - `loose` hint: accept the widened mask outright (reference
+        #   LOOSE_BBOX semantics);
+        # - otherwise: exact full-filter refinement over all candidates.
+        decided = mask_decides_filter(
+            plan.filter, plan.config, self.store.get_schema(plan.type_name)
         )
-        if loose_ok:
+        loose_ok = hints is not None and getattr(hints, "loose", False) and decided
+        if loose_ok or (decided and isinstance(plan.filter, Include)):
             exp("Loose mode: device mask accepted without refinement")
+        elif decided and certain is not None:
+            unc = np.flatnonzero(~certain)
+            exp(f"Refinement: {len(unc)} uncertain of {len(certain)} candidates")
+            if len(unc):
+                with exp.span("Boundary refinement"):
+                    sub_mask = plan.filter.evaluate(candidates.take(unc).batch)
+                keep = certain.copy()
+                keep[unc] = sub_mask
+                candidates = candidates.mask(keep)
         elif not isinstance(plan.filter, Include):
             with exp.span("Residual filter refinement"):
                 mask = plan.filter.evaluate(candidates.batch)

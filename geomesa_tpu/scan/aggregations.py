@@ -48,6 +48,10 @@ def tile_density(
     (reference GridSnap cell assignment). Rows outside the envelope are
     dropped, not clamped — DensityScan only renders within the bounds.
     """
+    return _density(cols, tile_ids, boxes, windows, grid_bounds, tile, width, height, extent_mode)
+
+
+def _density(cols, tile_ids, boxes, windows, grid_bounds, tile, width, height, extent_mode):
     m, x, y = _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode)
     x0, y0, x1, y1 = grid_bounds[0], grid_bounds[1], grid_bounds[2], grid_bounds[3]
     m = m & (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
@@ -60,12 +64,31 @@ def tile_density(
     return grid.reshape(height, width)
 
 
+@partial(jax.jit, static_argnames=("tile", "width", "height", "extent_mode"))
+def block_density(cols3, tile_ids, boxes, windows, grid_bounds, *, tile, width, height, extent_mode=False):
+    """tile_density over the [n_blocks, SUB, 128] block layout (flattened
+    in-graph; the reshape is free inside XLA)."""
+    cols = {k: v.reshape(-1) for k, v in cols3.items()}
+    return _density(cols, tile_ids, boxes, windows, grid_bounds, tile, width, height, extent_mode)
+
+
+@partial(jax.jit, static_argnames=("tile", "extent_mode"))
+def block_bounds_stats(cols3, tile_ids, boxes, windows, *, tile, extent_mode=False):
+    """tile_bounds_stats over the block layout."""
+    cols = {k: v.reshape(-1) for k, v in cols3.items()}
+    return _bounds_stats(cols, tile_ids, boxes, windows, tile, extent_mode)
+
+
 @partial(jax.jit, static_argnames=("tile", "extent_mode"))
 def tile_bounds_stats(cols, tile_ids, boxes, windows, *, tile, extent_mode=False):
     """(count i32, xmin, xmax, ymin, ymax f32) over matching rows — the
     device fast path for Count() / MinMax(geom) stat queries (reference
     StatsScan with a Count/MinMax stat). Empty scans return inverted
     (+inf, -inf) bounds."""
+    return _bounds_stats(cols, tile_ids, boxes, windows, tile, extent_mode)
+
+
+def _bounds_stats(cols, tile_ids, boxes, windows, tile, extent_mode):
     m, x, y = _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode)
     inf = jnp.float32(jnp.inf)
     count = m.sum(dtype=jnp.int32)

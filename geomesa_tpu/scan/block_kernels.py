@@ -1,0 +1,409 @@
+"""Candidate-block scan kernels: one device call per query, bitmask out.
+
+This is the round-3 redesign of the scan hot path, driven by measured link
+characteristics of the tunneled TPU (see PERF.md):
+
+- explicit ``device_put``/``jnp.asarray`` costs ~66 ms per call, but numpy
+  arrays passed *as jit arguments* transfer in ~0.05 ms -> all query
+  parameters ride the dispatch;
+- every device->host pull pays a ~66 ms floor at ~30 MB/s, but one batched
+  ``jax.device_get`` of several outputs pays the floor once -> one pull per
+  query, sized in KB;
+- HBM streams at ~460 GB/s but gathers/scatters (``jnp.nonzero``, fancy
+  indexing) run ~1000x slower -> no gathers, no nonzero: the kernel DMAs
+  whole candidate blocks picked by a scalar-prefetched id list and writes
+  *packed bitmasks*, decoded on host with ``np.unpackbits``.
+
+Layout: device columns are [n_blocks, SUB, 128] (BLOCK = SUB*128 rows,
+row-major: local row = sublane*128 + lane). The host prunes the sorted
+table to candidate blocks via searchsorted z-ranges (the tablet-server
+seek analogue; reference scans ranges via
+geomesa-index-api/.../index/utils/...ScanPlan with per-range seeks), pads
+the block-id list to a static bucket M, and gets back two bit planes:
+
+- ``wide``: f32/i32 predicate over widened bounds — superset of true hits
+  (reference Z3Filter.inBounds semantics, index/filters/Z3Filter.scala:19-65);
+- ``inner``: predicate over shrunk bounds — rows certain to be true hits
+  at f64 precision, so host refinement touches only ``wide & ~inner`` rows
+  (the automatic useFullFilter tier, Z3IndexKeySpace.scala:240-254).
+
+Every shape is static per (table, M-bucket): zero recompiles at query time.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 128
+BLOCK = 16384  # default rows per scan block (4096 minimum: SUB % 32 == 0)
+M_BUCKETS = (32, 256, 1024, 4096)  # candidate-block list sizes (static)
+
+# column-set signatures -> ordered device column names
+POINT_COLS = ("x", "y")
+POINT_TIME_COLS = ("x", "y", "tbin", "toff")
+EXTENT_COLS = ("gxmin", "gymin", "gxmax", "gymax")
+EXTENT_TIME_COLS = EXTENT_COLS + ("tbin", "toff")
+
+
+def use_pallas() -> bool:
+    """Pallas path: real TPU, or interpret mode under GEOMESA_TPU_PALLAS=1."""
+    env = os.environ.get("GEOMESA_TPU_PALLAS")
+    if env == "0":
+        return False
+    return jax.default_backend() == "tpu" or env == "1"
+
+
+# --------------------------------------------------------------- params
+
+
+def pack_boxes(wide: np.ndarray | None, inner: np.ndarray | None) -> np.ndarray:
+    """[8, 128] f32 param block: lanes 0-3 wide box, 4-7 inner box.
+
+    Pad slots can never match: wide xmin=+inf/xmax=-inf. Overflow past the
+    8 kernel slots takes the safe direction per plane: wide boxes collapse
+    into their bounding union (superset -> refined), inner boxes drop the
+    smallest (subset -> rows just lose the certainty shortcut).
+    """
+    p = np.zeros((8, LANES), np.float32)
+    p[:, 0] = np.inf
+    p[:, 2] = -np.inf
+    p[:, 4] = np.inf
+    p[:, 6] = -np.inf
+    if wide is not None and len(wide):
+        w = np.asarray(wide, np.float32)
+        if len(w) > 8:
+            union = np.array(
+                [[w[7:, 0].min(), w[7:, 1].min(), w[7:, 2].max(), w[7:, 3].max()]],
+                np.float32,
+            )
+            w = np.concatenate([w[:7], union])
+        p[: len(w), 0:4] = w
+    if inner is not None and len(inner):
+        i = np.asarray(inner, np.float32)
+        if len(i) > 8:
+            areas = np.maximum(i[:, 2] - i[:, 0], 0) * np.maximum(i[:, 3] - i[:, 1], 0)
+            i = i[np.argsort(-areas)[:8]]
+        p[: len(i), 4:8] = i
+    return p
+
+
+def pack_windows(wide: np.ndarray | None, inner: np.ndarray | None) -> np.ndarray:
+    """[8, 128] i32 param block: lanes 0-3 wide slot, 4-7 inner slot.
+
+    A slot is (bin_lo, bin_hi, off_lo, off_hi), all inclusive: the merged
+    form of the reference's per-bin windows (timesByBin) — one interval
+    covering bins [b0, b1] costs at most 3 slots (partial first bin,
+    full-interior run, partial last bin). Pad slots have bin_lo=1 > bin_hi=0.
+    """
+    p = np.zeros((8, LANES), np.int32)
+    p[:, 0] = 1
+    p[:, 1] = 0
+    p[:, 4] = 1
+    p[:, 5] = 0
+    if wide is not None and len(wide):
+        p[: len(wide), 0:4] = wide
+    if inner is not None and len(inner):
+        p[: len(inner), 4:8] = inner
+    return p
+
+
+def merge_window_slots(
+    windows: np.ndarray | None, overflow: str = "widen"
+) -> np.ndarray | None:
+    """Per-bin [W, 3] (bin, off_lo, off_hi) windows -> merged [k, 4] slots
+    (bin_lo, bin_hi, off_lo, off_hi), consecutive bins with identical
+    offset ranges collapsed into one slot.
+
+    If k would exceed the 8 kernel slots, ``overflow`` picks the safe
+    direction for the plane being built:
+    - "widen" (wide plane): union adjacent slots — a *superset*, corrected
+      by refinement;
+    - "drop" (inner plane): discard the smallest slots — a *subset*, so no
+      row is ever wrongly marked certain; dropped rows just get refined.
+    """
+    if windows is None or len(windows) == 0:
+        return None
+    w = np.asarray(windows)
+    order = np.lexsort((w[:, 1], w[:, 0]))
+    w = w[order]
+    slots: list[list[int]] = []
+    for b, lo, hi in w.tolist():
+        if slots and slots[-1][1] == b - 1 and slots[-1][2] == lo and slots[-1][3] == hi:
+            slots[-1][1] = b
+        else:
+            slots.append([b, b, lo, hi])
+    if len(slots) > 8 and overflow == "drop":
+        slots.sort(key=lambda s: (s[1] - s[0]) * (s[3] - s[2] + 1), reverse=True)
+        slots = sorted(slots[:8])
+    while len(slots) > 8:
+        # widen: merge the two adjacent slots with the smallest bin gap
+        gaps = [slots[i + 1][0] - slots[i][1] for i in range(len(slots) - 1)]
+        i = int(np.argmin(gaps))
+        a, b = slots[i], slots[i + 1]
+        slots[i : i + 2] = [[a[0], b[1], min(a[2], b[2]), max(a[3], b[3])]]
+    return np.array(slots, dtype=np.int32)
+
+
+def merge_window_slots_wide(config) -> np.ndarray | None:
+    return merge_window_slots(config.windows, overflow="widen")
+
+
+def merge_window_slots_inner(config) -> np.ndarray | None:
+    """Inner slots from config.windows_inner; None (no certainty) when the
+    index did not compute inner windows. Degenerate inner windows
+    (off_lo > off_hi) never match — their rows stay uncertain. Overflow
+    drops slots (subset) — widening an inner window would mark non-hits
+    certain."""
+    if config.windows_inner is None:
+        return None
+    w = np.asarray(config.windows_inner)
+    w = w[w[:, 1] <= w[:, 2]] if len(w) else w
+    return merge_window_slots(w, overflow="drop") if len(w) else None
+
+
+# --------------------------------------------------------------- kernels
+
+
+def _masks(cols: dict, boxes, wins, has_boxes: bool, has_windows: bool, extent: bool):
+    """(wide, inner) boolean masks for one block's columns.
+
+    ``boxes``/``wins`` support scalar indexing (Pallas refs or jnp arrays).
+    Unrolled over the 8 static slots — pad slots never match.
+    In extent mode the inner plane is all-false (bbox-intersects certainty
+    needs the actual geometry; XZ hits always refine, like the reference's
+    XZ filters which are never "precise").
+    """
+    one = None
+    w_parts = []
+    i_parts = []
+    if has_boxes:
+        if extent:
+            gx0, gy0 = cols["gxmin"], cols["gymin"]
+            gx1, gy1 = cols["gxmax"], cols["gymax"]
+            hit = jnp.zeros(gx0.shape, dtype=jnp.bool_)
+            for k in range(8):
+                hit |= (
+                    (gx0 <= boxes[k, 2])
+                    & (gx1 >= boxes[k, 0])
+                    & (gy0 <= boxes[k, 3])
+                    & (gy1 >= boxes[k, 1])
+                )
+            w_parts.append(hit)
+            i_parts.append(jnp.zeros(gx0.shape, dtype=jnp.bool_))
+            one = gx0
+        else:
+            x, y = cols["x"], cols["y"]
+            wide = jnp.zeros(x.shape, dtype=jnp.bool_)
+            inner = jnp.zeros(x.shape, dtype=jnp.bool_)
+            for k in range(8):
+                wide |= (
+                    (x >= boxes[k, 0]) & (x <= boxes[k, 2])
+                    & (y >= boxes[k, 1]) & (y <= boxes[k, 3])
+                )
+                inner |= (
+                    (x >= boxes[k, 4]) & (x <= boxes[k, 6])
+                    & (y >= boxes[k, 5]) & (y <= boxes[k, 7])
+                )
+            w_parts.append(wide)
+            i_parts.append(inner)
+            one = x
+    if has_windows:
+        tb, to = cols["tbin"], cols["toff"]
+        wide = jnp.zeros(tb.shape, dtype=jnp.bool_)
+        inner = jnp.zeros(tb.shape, dtype=jnp.bool_)
+        for k in range(8):
+            wide |= (
+                (tb >= wins[k, 0]) & (tb <= wins[k, 1])
+                & (to >= wins[k, 2]) & (to <= wins[k, 3])
+            )
+            inner |= (
+                (tb >= wins[k, 4]) & (tb <= wins[k, 5])
+                & (to >= wins[k, 6]) & (to <= wins[k, 7])
+            )
+        w_parts.append(wide)
+        i_parts.append(inner)
+    w = w_parts[0]
+    i = i_parts[0]
+    for p, q in zip(w_parts[1:], i_parts[1:]):
+        w = w & p
+        i = i & q
+    return w, i
+
+
+_SHIFTS = None
+
+
+def _pack_bits(m, pack):
+    """[SUB, 128] bool -> [pack, 128] i32: bit b of word [j, lane] is local
+    row (j*32 + b)*128 + lane. (i32 because Mosaic lacks unsigned reduces;
+    the bit pattern is what matters.)"""
+    u = m.astype(jnp.int32).reshape(pack, 32, LANES)
+    shifts = jnp.arange(32, dtype=jnp.int32)[None, :, None]
+    return (u << shifts).sum(axis=1, dtype=jnp.int32)
+
+
+def _make_pallas_kernel(col_names, has_boxes, has_windows, extent, pack):
+    n = len(col_names)
+
+    def kernel(bids_ref, boxes_ref, wins_ref, *refs):
+        cols = {name: refs[k][0] for k, name in enumerate(col_names)}
+        outw_ref, outi_ref = refs[n], refs[n + 1]
+        w, i = _masks(cols, boxes_ref, wins_ref, has_boxes, has_windows, extent)
+        outw_ref[0] = _pack_bits(w, pack)
+        outi_ref[0] = _pack_bits(i, pack)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "interpret"),
+)
+def _pallas_block_scan(
+    cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent, interpret
+):
+    """cols3: tuple of [n_blocks, SUB, 128] device arrays ordered by
+    col_names. bids: i32 [M] candidate block ids (pads repeat block 0; host
+    ignores pad slots). Returns (wide, inner) [M, PACK, 128] i32 planes."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M = bids.shape[0]
+    SUB = cols3[0].shape[1]
+    PACK = SUB // 32
+    kernel = _make_pallas_kernel(col_names, has_boxes, has_windows, extent, PACK)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(M,),
+        in_specs=[
+            pl.BlockSpec((8, LANES), lambda i, bids: (0, 0)),
+            pl.BlockSpec((8, LANES), lambda i, bids: (0, 0)),
+        ]
+        + [
+            pl.BlockSpec((1, SUB, LANES), lambda i, bids: (bids[i], 0, 0))
+            for _ in col_names
+        ],
+        out_specs=[
+            pl.BlockSpec((1, PACK, LANES), lambda i, bids: (i, 0, 0)),
+            pl.BlockSpec((1, PACK, LANES), lambda i, bids: (i, 0, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(bids, boxes, wins, *cols3)
+
+
+@partial(
+    jax.jit, static_argnames=("col_names", "has_boxes", "has_windows", "extent")
+)
+def _xla_block_scan(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent):
+    """Same contract as the Pallas kernel via plain XLA (gather of candidate
+    blocks). Used on CPU (tests) and as a portability fallback; the gather
+    is slow on TPU, fine on CPU."""
+    gathered = {name: c[bids] for name, c in zip(col_names, cols3)}
+    w, i = _masks(gathered, boxes, wins, has_boxes, has_windows, extent)
+    shifts = jnp.arange(32, dtype=jnp.int32)[None, None, :, None]
+    M = bids.shape[0]
+    PACK = cols3[0].shape[1] // 32
+
+    def pack(m):
+        u = m.astype(jnp.int32).reshape(M, PACK, 32, LANES)
+        return (u << shifts).sum(axis=2, dtype=jnp.int32)
+
+    return pack(w), pack(i)
+
+
+def block_scan(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent):
+    """Dispatch to Pallas (TPU) / interpret / XLA by backend. All shapes
+    static: (len(bids), col_names, flags) determine the compiled variant."""
+    if use_pallas():
+        interpret = jax.default_backend() != "tpu"
+        return _pallas_block_scan(
+            cols3, bids, boxes, wins,
+            col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent, interpret=interpret,
+        )
+    return _xla_block_scan(
+        cols3, bids, boxes, wins,
+        col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
+        extent=extent,
+    )
+
+
+# --------------------------------------------------------------- decode
+
+
+def _unpack_plane(plane: np.ndarray, n_real: int) -> np.ndarray:
+    """[M, pack, 128] i32 plane -> [n_real, block] bool rows (inverts
+    _pack_bits: bit b of word [blk, j, lane] = local row (j*32+b)*128+lane)."""
+    pack = plane.shape[1]
+    p = np.ascontiguousarray(plane[:n_real])
+    bits = np.unpackbits(
+        p.view(np.uint8).reshape(n_real, pack, LANES, 4), axis=-1, bitorder="little"
+    )  # [m, pack, 128, 32]
+    return bits.transpose(0, 1, 3, 2).reshape(n_real, pack * 32 * LANES)
+
+
+def decode_bits(plane: np.ndarray, bids: np.ndarray, n_real: int) -> np.ndarray:
+    """[M, pack, 128] i32 plane -> ascending global row ids (i64)."""
+    if n_real == 0:
+        return np.zeros(0, np.int64)
+    block = plane.shape[1] * 32 * LANES
+    flat = _unpack_plane(plane, n_real)
+    blk, local = np.nonzero(flat)
+    rows = bids[:n_real][blk].astype(np.int64) * block + local
+    return np.sort(rows) if not _bids_sorted(bids, n_real) else rows
+
+
+def decode_bits_pair(wide_plane, inner_plane, bids, n_real):
+    """(rows, certain) — rows ascending, certain[i] True when row i is in
+    the inner plane (no host refinement needed)."""
+    if n_real == 0:
+        return np.zeros(0, np.int64), np.zeros(0, bool)
+    block = wide_plane.shape[1] * 32 * LANES
+    wb = _unpack_plane(wide_plane, n_real)
+    ib = _unpack_plane(inner_plane, n_real)
+    blk, local = np.nonzero(wb)
+    rows = bids[:n_real][blk].astype(np.int64) * block + local
+    certain = ib[blk, local].astype(bool)
+    if not _bids_sorted(bids, n_real):
+        order = np.argsort(rows, kind="stable")
+        rows, certain = rows[order], certain[order]
+    return rows, certain
+
+
+def _bids_sorted(bids: np.ndarray, n_real: int) -> bool:
+    b = bids[:n_real]
+    return bool(np.all(b[1:] > b[:-1])) if len(b) > 1 else True
+
+
+def pad_bids(blocks: np.ndarray, n_blocks_table: int) -> tuple[np.ndarray, int]:
+    """Pad a sorted block-id list to the next static M bucket (pads repeat
+    block 0; decode ignores them). Returns (padded [M] i32, n_real).
+
+    Beyond the largest bucket the caller passes the full block list; the
+    bucket is then the next power of two >= n_blocks_table — still one
+    static shape per table."""
+    n = len(blocks)
+    for m in M_BUCKETS:
+        if n <= m:
+            out = np.zeros(m, np.int32)
+            out[:n] = blocks
+            return out, n
+    m = 1
+    while m < n:
+        m *= 2
+    out = np.zeros(m, np.int32)
+    out[:n] = blocks
+    return out, n

@@ -6,35 +6,35 @@ reference/geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/
 z3/Z3IndexKeySpace.scala:63-95). Here the same logical layout is a
 struct-of-arrays table sorted lexicographically by (bin, z):
 
-- host side: the sort keys (bins i32, zs u64), the per-bin segment offsets,
-  and the permutation back to the backing FeatureCollection — used for
-  range -> row-span -> tile pruning (the analogue of seeking scan ranges in
-  a tablet server);
-- device side: the predicate columns the scan kernel tests, padded to a
-  multiple of the tile size with never-matching sentinels and pushed to
-  device memory once at build.
+- host side: the sort keys (bins i32, zs u64), per-bin segment offsets, and
+  the permutation back to the backing FeatureCollection — used for
+  range -> row-span -> block pruning (the analogue of seeking scan ranges
+  in a tablet server). The sort itself is the native radix argsort
+  (geomesa_tpu.native.sort_bins_z) — the LSM "flush" hot path;
+- device side: the predicate columns, laid out [n_blocks, SUB, 128]
+  (BLOCK = SUB*128 rows) so candidate blocks DMA straight into VMEM for
+  the Pallas bitmask kernel (geomesa_tpu.scan.block_kernels). Pad rows
+  carry never-matching sentinels.
 
-Mutability: like an LSM store, appends land in the build path (write() in
-the DataStore concatenates + re-sorts the delta with the existing table —
-the Lambda-store hot/cold pattern; see geomesa_tpu.datastore).
+Query execution (round-3 redesign, see PERF.md): ONE device call + ONE
+batched pull per query. The host turns covering z-ranges into row spans
+(searchsorted) and block ids; rows in *contained* ranges (reference
+ZN.zranges contained semantics, ZN.scala:110-242 — classified here against
+shrunk inner ordinals so containment is exact at f64) are taken from the
+spans directly with no device work and no refinement; remaining blocks go
+through the kernel, which returns wide + inner bit planes. Host refinement
+then touches only `wide & ~inner` boundary rows.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
-from geomesa_tpu.scan import kernels
+from geomesa_tpu.scan import block_kernels as bk
 
-DEFAULT_TILE = 2048
-# tile-prune only when candidates are under this fraction of the table;
-# past it a straight linear scan is cheaper than a big gather
-FULL_SCAN_FRACTION = 0.5
+DEFAULT_TILE = 2048  # distributed-table tile granularity (parallel.dtable)
+
 
 _SENTINELS = {
     "x": np.float32(np.inf),
@@ -60,10 +60,14 @@ class SortedKeys:
         n = len(keys.bins)
         self.n = n
 
-        order = np.lexsort((keys.zs, keys.bins))
-        self.bins = keys.bins[order]
-        self.zs = keys.zs[order]
-        self.perm = order.astype(np.int64)  # table row -> feature ordinal
+        from geomesa_tpu import native
+
+        perm = native.sort_bins_z(keys.bins, keys.zs)
+        if perm is None:
+            perm = np.lexsort((keys.zs, keys.bins))
+        self.perm = perm  # table row -> feature ordinal (u32 or i64)
+        self.bins = _take(keys.bins, perm)
+        self.zs = _take(keys.zs, perm)
 
         # per-bin segments for searchsorted pruning
         self.ubins, starts = np.unique(self.bins, return_index=True)
@@ -75,14 +79,27 @@ class SortedKeys:
         cols = {}
         for name, col in keys.device_cols.items():
             out = np.full(n_pad, _SENTINELS[name], dtype=col.dtype)
-            out[: self.n] = col[self.perm]
+            out[: self.n] = _take(col, self.perm)
             cols[name] = out
         return cols
 
     # -- pruning ---------------------------------------------------------
     def candidate_spans(self, config: ScanConfig) -> list[tuple[int, int]]:
-        """Merged, sorted row spans [lo, hi) covering the scan ranges."""
-        spans: list[tuple[int, int]] = []
+        """Merged, sorted row spans [lo, hi) covering ALL scan ranges
+        (contained + overlapping) — the cost estimator's input."""
+        overlap, contained = self.candidate_spans_split(config)
+        return _merge_spans(overlap + contained)
+
+    def candidate_spans_split(self, config: ScanConfig):
+        """(overlap_spans, contained_spans): row spans [lo, hi) of the
+        non-contained vs contained scan ranges. Contained ranges' rows are
+        certain hits (no device predicate, no refinement) when
+        ``config.contained_exact`` — otherwise they are folded into the
+        overlap set by the caller."""
+        cont_flags = config.range_contained
+        use_contained = config.contained_exact and cont_flags is not None
+        overlap: list[tuple[int, int]] = []
+        contained: list[tuple[int, int]] = []
         for b in np.unique(config.range_bins):
             i = int(np.searchsorted(self.ubins, b))
             if i >= len(self.ubins) or self.ubins[i] != b:
@@ -92,34 +109,83 @@ class SortedKeys:
             seg = self.zs[s:e]
             lo = np.searchsorted(seg, config.range_lo[sel], side="left") + s
             hi = np.searchsorted(seg, config.range_hi[sel], side="right") + s
-            for a, z in zip(lo.tolist(), hi.tolist()):
-                if z > a:
-                    spans.append((a, z))
-        spans.sort()
-        merged: list[tuple[int, int]] = []
-        for a, z in spans:
-            if merged and a <= merged[-1][1]:
-                merged[-1] = (merged[-1][0], max(merged[-1][1], z))
+            if use_contained:
+                cf = cont_flags[sel]
             else:
-                merged.append((a, z))
-        return merged
+                cf = np.zeros(int(sel.sum()), dtype=bool)
+            for a, z, c in zip(lo.tolist(), hi.tolist(), cf.tolist()):
+                if z > a:
+                    (contained if c else overlap).append((a, z))
+        return _merge_spans(overlap), _merge_spans(contained)
 
     def candidate_tiles(self, config: ScanConfig) -> np.ndarray:
-        """Sorted unique tile ids covering the scan ranges (subclasses set
-        ``n_tiles``); falls back to every tile when pruning would not pay
-        off (past FULL_SCAN_FRACTION a linear scan beats a big gather)."""
+        """Sorted unique tile ids (granularity ``self.tile``) covering all
+        scan ranges — the distributed table's pruning input."""
         spans = self.candidate_spans(config)
         if not spans:
             return np.zeros(0, dtype=np.int64)
-        tiles: list[np.ndarray] = []
-        covered = 0
-        for a, z in spans:
-            t0, t1 = a // self.tile, (z - 1) // self.tile
-            tiles.append(np.arange(t0, t1 + 1, dtype=np.int64))
-            covered += t1 - t0 + 1
-            if covered >= self.n_tiles * FULL_SCAN_FRACTION:
-                return np.arange(self.n_tiles, dtype=np.int64)
+        tiles = [
+            np.arange(a // self.tile, (z - 1) // self.tile + 1, dtype=np.int64)
+            for a, z in spans
+        ]
         return np.unique(np.concatenate(tiles))
+
+
+def _take(col: np.ndarray, perm: np.ndarray) -> np.ndarray:
+    from geomesa_tpu import native
+
+    if perm.dtype == np.uint32:
+        out = native.take(col, perm)
+        if out is not None:
+            return out
+    return col[perm]
+
+
+def _merge_spans(spans: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    if not spans:
+        return []
+    spans = sorted(spans)
+    merged = [spans[0]]
+    for a, z in spans[1:]:
+        if a <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], z))
+        else:
+            merged.append((a, z))
+    return merged
+
+
+def _span_rows(spans: list[tuple[int, int]]) -> np.ndarray:
+    if not spans:
+        return np.zeros(0, np.int64)
+    return np.concatenate([np.arange(a, z, dtype=np.int64) for a, z in spans])
+
+
+def _popcount_rows(a: np.ndarray) -> np.ndarray:
+    """[n, ...] i32 bit planes -> [n] total set bits (numpy<2 compatible)."""
+    flat = np.ascontiguousarray(a).reshape(len(a), -1)
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(flat).sum(axis=1)
+    return np.unpackbits(flat.view(np.uint8), axis=1).sum(axis=1)
+
+
+def _spans_intersect(rng: tuple[int, int], spans: list[tuple[int, int]]) -> bool:
+    """True when [rng.lo, rng.hi) intersects any [lo, hi) span."""
+    lo, hi = rng
+    for a, z in spans:
+        if a < hi and z > lo:
+            return True
+    return False
+
+
+def _rows_in_spans(rows: np.ndarray, spans: list[tuple[int, int]]) -> np.ndarray:
+    """Boolean mask: which sorted ``rows`` fall inside any [lo, hi) span."""
+    if not spans or len(rows) == 0:
+        return np.zeros(len(rows), dtype=bool)
+    los = np.array([s[0] for s in spans], dtype=np.int64)
+    his = np.array([s[1] for s in spans], dtype=np.int64)
+    idx = np.searchsorted(los, rows, side="right") - 1
+    ok = idx >= 0
+    return ok & (rows < his[np.clip(idx, 0, len(his) - 1)])
 
 
 class IndexTable(SortedKeys):
@@ -129,128 +195,214 @@ class IndexTable(SortedKeys):
         self,
         keyspace: IndexKeySpace,
         keys: WriteKeys,
-        tile: int = DEFAULT_TILE,
+        tile: int | None = None,
         device=None,
     ):
-        super().__init__(keyspace, keys, tile)
+        # device scan granularity: BLOCK rows (Pallas layout constraint:
+        # SUB multiple of 32 sublanes); `tile` requests are rounded up
+        block = bk.BLOCK if tile is None else max(4096, -(-int(tile) // 4096) * 4096)
+        super().__init__(keyspace, keys, block)
+        self.block = block
+        self.sub = block // bk.LANES
 
-        # device columns, padded to a whole number of tiles
-        n_pad = max(tile, ((self.n + tile - 1) // tile) * tile)
+        import jax
+
+        import geomesa_tpu
+
+        geomesa_tpu.enable_compile_cache()
+        n_pad = max(block, -(-self.n // block) * block)
         self.n_pad = n_pad
-        self.n_tiles = n_pad // tile
+        self.n_blocks = n_pad // block
         cols = self.pad_cols(keys, n_pad)
-        self.cols = {
-            k: (jax.device_put(v, device) if device else jnp.asarray(v))
-            for k, v in cols.items()
-        }
-        self.host_cols = cols
+        self.col_names = tuple(sorted(cols))
+        self.cols3 = {}
+        for k, v in cols.items():
+            v3 = v.reshape(self.n_blocks, self.sub, bk.LANES)
+            self.cols3[k] = jax.device_put(v3, device) if device else jax.device_put(v3)
+        self.extent = "gxmin" in cols
 
     # -- scanning --------------------------------------------------------
-    def scan(self, config: ScanConfig, cap_hint: int = 4096) -> np.ndarray:
-        """Run the device scan; return matching *feature ordinals* (into the
-        backing FeatureCollection), ascending in table order."""
+    def candidate_blocks(self, spans: list[tuple[int, int]]) -> np.ndarray:
+        if not spans:
+            return np.zeros(0, np.int64)
+        ids = [
+            np.arange(a // self.block, (z - 1) // self.block + 1, dtype=np.int64)
+            for a, z in spans
+        ]
+        return np.unique(np.concatenate(ids))
+
+    def scan(self, config: ScanConfig, deadline=None) -> tuple[np.ndarray, np.ndarray]:
+        """One-call device scan. Returns (ordinals, certain):
+
+        - ``ordinals``: feature ordinals of all candidate hits, ascending in
+          table order (wide predicate — a superset of true hits only where
+          ``certain`` is False);
+        - ``certain``: per-row True when the row is a guaranteed f64-exact
+          hit of the index's spatial/temporal constraint (inner predicate or
+          contained range) — the planner refines only the rest.
+        """
         if config.disjoint or self.n == 0:
-            return np.zeros(0, dtype=np.int64)
-        tiles = self.candidate_tiles(config)
-        if len(tiles) == 0:
-            return np.zeros(0, dtype=np.int64)
-        tile_ids = kernels.pad_tiles(tiles)
+            return np.zeros(0, np.int64), np.zeros(0, bool)
+        overlap, contained = self.candidate_spans_split(config)
+        cont_rows = _span_rows(contained)
+        has_pred = config.boxes is not None or config.windows is not None
+
+        if not has_pred:
+            # pure range scan (attribute index primary): spans are row-exact
+            rows = np.union1d(_span_rows(overlap), cont_rows) if overlap else cont_rows
+            return self.perm[rows].astype(np.int64), np.ones(len(rows), bool)
+
+        blocks = self.candidate_blocks(overlap)
+        if len(blocks) == 0:
+            return self.perm[cont_rows].astype(np.int64), np.ones(len(cont_rows), bool)
+
+        rows, certain = self._device_scan(blocks, config)
+        if config.clip_rows:
+            keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
+            rows, certain = rows[keep], certain[keep]
+        if len(cont_rows):
+            # kernel rows inside contained spans are duplicates of cont_rows
+            dup = _rows_in_spans(rows, contained)
+            rows = np.concatenate([rows[~dup], cont_rows])
+            certain = np.concatenate([certain[~dup], np.ones(len(cont_rows), bool)])
+            order = np.argsort(rows, kind="stable")
+            rows, certain = rows[order], certain[order]
+        return self.perm[rows].astype(np.int64), certain
+
+    def _device_scan(self, blocks: np.ndarray, config: ScanConfig):
+        """Kernel call over candidate blocks -> (rows, certain)."""
+        import jax
+
+        if len(blocks) > bk.M_BUCKETS[-1]:
+            blocks = np.arange(self.n_blocks, dtype=np.int64)  # full scan
+        bids, n_real = bk.pad_bids(blocks, self.n_blocks)
+        boxes = bk.pack_boxes(config.boxes, config.boxes_inner)
+        wins = bk.pack_windows(
+            bk.merge_window_slots_wide(config), bk.merge_window_slots_inner(config)
+        )
+        wide, inner = bk.block_scan(
+            tuple(self.cols3[k] for k in self.col_names),
+            bids,
+            boxes,
+            wins,
+            col_names=self.col_names,
+            has_boxes=config.boxes is not None,
+            has_windows=config.windows is not None,
+            extent=self.extent,
+        )
+        wide_h, inner_h = jax.device_get((wide, inner))
+        return bk.decode_bits_pair(np.asarray(wide_h), np.asarray(inner_h), bids, n_real)
+
+    def count(self, config: ScanConfig) -> int:
+        """Wide-predicate hit count (superset semantics where the config is
+        imprecise; exact counting goes through scan + refinement).
+
+        Avoids materializing row ids: contained spans count by length, and
+        kernel blocks that don't straddle a contained span count by
+        popcounting their wide bit plane."""
+        if config.disjoint or self.n == 0:
+            return 0
+        overlap, contained = self.candidate_spans_split(config)
+        cont_total = sum(z - a for a, z in contained)
+        has_pred = config.boxes is not None or config.windows is not None
+        if not has_pred:
+            return cont_total + sum(z - a for a, z in overlap)
+        if config.clip_rows:  # span-exact clipping needs the rows
+            rows, _ = self.scan(config)
+            return len(rows)
+        blocks = self.candidate_blocks(overlap)
+        if len(blocks) == 0:
+            return cont_total
+
+        import jax
+
+        if len(blocks) > bk.M_BUCKETS[-1]:
+            blocks = np.arange(self.n_blocks, dtype=np.int64)
+        bids, n_real = bk.pad_bids(blocks, self.n_blocks)
+        boxes = bk.pack_boxes(config.boxes, config.boxes_inner)
+        wins = bk.pack_windows(
+            bk.merge_window_slots_wide(config), bk.merge_window_slots_inner(config)
+        )
+        wide, _inner = bk.block_scan(
+            tuple(self.cols3[k] for k in self.col_names),
+            bids, boxes, wins,
+            col_names=self.col_names,
+            has_boxes=config.boxes is not None,
+            has_windows=config.windows is not None,
+            extent=self.extent,
+        )
+        plane = np.asarray(jax.device_get(wide))[:n_real]
+        pops = _popcount_rows(plane)
+        if not contained:
+            return int(pops.sum())
+        # blocks straddling a contained span double-count its rows: decode
+        # just those blocks and drop their in-span hits
+        b = bids[:n_real].astype(np.int64)
+        straddle = np.array(
+            [_spans_intersect((x * self.block, (x + 1) * self.block), contained) for x in b]
+        )
+        total = int(pops[~straddle].sum()) + cont_total
+        for k in np.flatnonzero(straddle):
+            rows = bk.decode_bits(plane[k : k + 1], b[k : k + 1].astype(np.int32), 1)
+            total += int((~_rows_in_spans(rows, contained)).sum())
+        return total
+
+    # -- aggregation push-down (flat adapters over the block layout) ------
+    def _flat_args(self, config: ScanConfig):
+        from geomesa_tpu.scan import kernels
+
+        overlap, contained = self.candidate_spans_split(config)
+        spans = _merge_spans(overlap + contained)
+        blocks = self.candidate_blocks(spans)
+        if len(blocks) == 0:
+            return None
+        tile_ids = kernels.pad_tiles(blocks)
         boxes = kernels.pad_boxes(config.boxes) if config.boxes is not None else None
         windows = (
             kernels.pad_windows(config.windows) if config.windows is not None else None
         )
-        cap = kernels.pad_pow2(cap_hint, 4096)
-        max_possible = len(tiles) * self.tile
-        pallas = kernels.pallas_mode(self.tile, self.n_pad)
-        while True:
-            count, rows = kernels.tile_scan(
-                self.cols,
-                tile_ids,
-                boxes,
-                windows,
-                tile=self.tile,
-                cap=min(cap, kernels.pad_pow2(max_possible, 4096)),
-                extent_mode=config.extent_mode,
-                pallas=pallas,
-            )
-            count = int(count)
-            if count <= cap or cap >= max_possible:
-                break
-            cap = kernels.pad_pow2(count, cap * 4)
-        rows = np.asarray(rows[:count])
-        return self.perm[rows]
-
-    def count(self, config: ScanConfig) -> int:
-        """Count rows matching the device predicate (loose semantics: f32
-        widened boxes; exact counting goes through scan + refinement)."""
-        if config.disjoint or self.n == 0:
-            return 0
-        tiles = self.candidate_tiles(config)
-        if len(tiles) == 0:
-            return 0
-        return int(
-            kernels.tile_count(
-                self.cols,
-                kernels.pad_tiles(tiles),
-                kernels.pad_boxes(config.boxes) if config.boxes is not None else None,
-                kernels.pad_windows(config.windows)
-                if config.windows is not None
-                else None,
-                tile=self.tile,
-                extent_mode=config.extent_mode,
-                pallas=kernels.pallas_mode(self.tile, self.n_pad),
-            )
-        )
+        return tile_ids, boxes, windows
 
     def bounds_stats(self, config: ScanConfig):
-        """(count, xmin, xmax, ymin, ymax) of matching rows on device (the
-        StatsScan Count/MinMax(geom) fast path; loose f32 semantics).
-        Returns (0, None) bounds when nothing matches."""
+        """(count, (xmin, ymin, xmax, ymax)) of matching rows on device (the
+        StatsScan Count/MinMax(geom) fast path; loose f32 semantics)."""
         from geomesa_tpu.scan import aggregations
 
         if config.disjoint or self.n == 0:
             return 0, None
-        tiles = self.candidate_tiles(config)
-        if len(tiles) == 0:
+        args = self._flat_args(config)
+        if args is None:
             return 0, None
-        cnt, xmin, xmax, ymin, ymax = aggregations.tile_bounds_stats(
-            self.cols,
-            kernels.pad_tiles(tiles),
-            kernels.pad_boxes(config.boxes) if config.boxes is not None else None,
-            kernels.pad_windows(config.windows) if config.windows is not None else None,
-            tile=self.tile,
-            extent_mode=config.extent_mode,
+        tile_ids, boxes, windows = args
+        cnt, xmin, xmax, ymin, ymax = aggregations.block_bounds_stats(
+            self.cols3, tile_ids, boxes, windows,
+            tile=self.block, extent_mode=self.extent,
         )
         cnt = int(cnt)
         if cnt == 0:
             return 0, None
         return cnt, (float(xmin), float(ymin), float(xmax), float(ymax))
 
-    def density(
-        self, config: ScanConfig, bounds, width: int, height: int
-    ) -> np.ndarray:
+    def density(self, config: ScanConfig, bounds, width: int, height: int) -> np.ndarray:
         """[height, width] density grid over ``bounds`` computed on device
         (the DensityScan push-down tier; see geomesa_tpu.scan.aggregations)."""
+        import jax.numpy as jnp
+
         from geomesa_tpu.scan import aggregations
 
         if config.disjoint or self.n == 0:
             return np.zeros((height, width), dtype=np.float32)
-        tiles = self.candidate_tiles(config)
-        if len(tiles) == 0:
+        args = self._flat_args(config)
+        if args is None:
             return np.zeros((height, width), dtype=np.float32)
-        grid = aggregations.tile_density(
-            self.cols,
-            kernels.pad_tiles(tiles),
-            kernels.pad_boxes(config.boxes) if config.boxes is not None else None,
-            kernels.pad_windows(config.windows) if config.windows is not None else None,
-            jnp.asarray(np.asarray(bounds, dtype=np.float32)),
-            tile=self.tile,
-            width=width,
-            height=height,
-            extent_mode=config.extent_mode,
+        tile_ids, boxes, windows = args
+        grid = aggregations.block_density(
+            self.cols3, tile_ids, boxes, windows,
+            np.asarray(bounds, dtype=np.float32),
+            tile=self.block, width=width, height=height, extent_mode=self.extent,
         )
         return np.asarray(grid)
 
     @property
     def nbytes_device(self) -> int:
-        return sum(int(v.nbytes) for v in self.cols.values())
+        return sum(int(v.nbytes) for v in self.cols3.values())

@@ -1,0 +1,136 @@
+"""Profile the TPU link: dispatch RTT, h2d/d2h bandwidth, scan compute rate.
+
+Run on the real chip to size the query-path design (how many round trips a
+query can afford; whether a full linear scan beats a gather)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(fn, n=10, warm=2):
+    for _ in range(warm):
+        fn()
+    s = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - s) / n
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev}, backend: {jax.default_backend()}")
+
+    # 1. dispatch RTT: trivial jit, block
+    one = jnp.ones((8,), jnp.float32)
+    f = jax.jit(lambda x: x + 1)
+    f(one).block_until_ready()
+    rtt = t(lambda: f(one).block_until_ready(), n=20)
+    print(f"jit dispatch+sync RTT: {rtt*1e3:.2f} ms")
+
+    # 2. d2h transfer: scalar, 4KB, 4MB, 64MB
+    for nbytes in (4, 4 << 10, 4 << 20, 64 << 20):
+        n = nbytes // 4
+        a = jax.device_put(np.zeros(n, np.int32))
+        a.block_until_ready()
+        dt = t(lambda: np.asarray(a), n=5)
+        print(f"d2h {nbytes:>10} B: {dt*1e3:8.2f} ms  ({nbytes/dt/1e9:6.2f} GB/s)")
+
+    # 3. h2d transfer
+    for nbytes in (4 << 10, 4 << 20, 64 << 20):
+        n = nbytes // 4
+        h = np.zeros(n, np.int32)
+        dt = t(lambda: jax.device_put(h).block_until_ready(), n=5)
+        print(f"h2d {nbytes:>10} B: {dt*1e3:8.2f} ms  ({nbytes/dt/1e9:6.2f} GB/s)")
+
+    # 4. full-table scan: mask+count over 128M rows x (2 f32 + 2 i32)
+    N = 128 * 1024 * 1024
+    cols = {
+        "x": jax.device_put(np.random.default_rng(0).uniform(-180, 180, N).astype(np.float32)),
+        "y": jax.device_put(np.random.default_rng(1).uniform(-90, 90, N).astype(np.float32)),
+        "tbin": jax.device_put(np.zeros(N, np.int32)),
+        "toff": jax.device_put(np.random.default_rng(2).integers(0, 1 << 20, N).astype(np.int32)),
+    }
+    for v in cols.values():
+        v.block_until_ready()
+    nbytes = sum(int(v.nbytes) for v in cols.values())
+    print(f"table bytes: {nbytes/1e9:.2f} GB")
+
+    boxes = jnp.asarray(np.array([[-10, -10, 10, 10]] * 8, np.float32))
+    windows = jnp.asarray(np.array([[0, 0, 1 << 19]] * 8, np.int32))
+
+    @jax.jit
+    def count_scan(cols, boxes, windows):
+        x, y, tb, to = cols["x"], cols["y"], cols["tbin"], cols["toff"]
+        m = jnp.zeros(x.shape, bool)
+        for i in range(boxes.shape[0]):
+            m = m | ((x >= boxes[i, 0]) & (x <= boxes[i, 2]) & (y >= boxes[i, 1]) & (y <= boxes[i, 3]))
+        mw = jnp.zeros(x.shape, bool)
+        for i in range(windows.shape[0]):
+            mw = mw | ((tb == windows[i, 0]) & (to >= windows[i, 1]) & (to <= windows[i, 2]))
+        return (m & mw).sum(dtype=jnp.int32)
+
+    count_scan(cols, boxes, windows).block_until_ready()
+    dt = t(lambda: count_scan(cols, boxes, windows).block_until_ready(), n=10)
+    print(f"count scan 128M rows: {dt*1e3:.2f} ms  ({nbytes/dt/1e9:.1f} GB/s effective)")
+
+    # 5. count + nonzero compact at CAP=1M
+    CAP = 1 << 20
+
+    @jax.jit
+    def scan_compact(cols, boxes, windows):
+        x, y, tb, to = cols["x"], cols["y"], cols["tbin"], cols["toff"]
+        m = jnp.zeros(x.shape, bool)
+        for i in range(boxes.shape[0]):
+            m = m | ((x >= boxes[i, 0]) & (x <= boxes[i, 2]) & (y >= boxes[i, 1]) & (y <= boxes[i, 3]))
+        for i in range(windows.shape[0]):
+            pass
+        mw = jnp.zeros(x.shape, bool)
+        for i in range(windows.shape[0]):
+            mw = mw | ((tb == windows[i, 0]) & (to >= windows[i, 1]) & (to <= windows[i, 2]))
+        m = m & mw
+        count = m.sum(dtype=jnp.int32)
+        (idx,) = jnp.nonzero(m, size=CAP, fill_value=-1)
+        return count, idx
+
+    c, idx = scan_compact(cols, boxes, windows)
+    c.block_until_ready()
+    dt = t(lambda: jax.block_until_ready(scan_compact(cols, boxes, windows)), n=10)
+    print(f"scan+nonzero(1M) 128M rows: {dt*1e3:.2f} ms  ({nbytes/dt/1e9:.1f} GB/s effective)")
+
+    # 6. end-to-end query shape: dispatch + d2h of count + d2h of 64K rows
+    def full_query():
+        c, idx = scan_compact(cols, boxes, windows)
+        n = int(c)
+        rows = np.asarray(idx[: 64 * 1024])
+        return n, rows
+
+    dt = t(full_query, n=10)
+    print(f"end-to-end (scan + count sync + 256KB rows d2h): {dt*1e3:.2f} ms")
+
+    # 7. gather-based tile scan comparison (1/8 of table via 2048-tiles)
+    T = N // 2048 // 8
+    tiles = jnp.asarray(np.arange(T, dtype=np.int32) * 8)
+
+    @jax.jit
+    def gather_scan(cols, tiles, boxes, windows):
+        base = tiles[:, None] * 2048 + jnp.arange(2048, dtype=jnp.int32)
+        g = {k: v[base] for k, v in cols.items()}
+        x, y, tb, to = g["x"], g["y"], g["tbin"], g["toff"]
+        m = jnp.zeros(x.shape, bool)
+        for i in range(boxes.shape[0]):
+            m = m | ((x >= boxes[i, 0]) & (x <= boxes[i, 2]) & (y >= boxes[i, 1]) & (y <= boxes[i, 3]))
+        mw = jnp.zeros(x.shape, bool)
+        for i in range(windows.shape[0]):
+            mw = mw | ((tb == windows[i, 0]) & (to >= windows[i, 1]) & (to <= windows[i, 2]))
+        return (m & mw).sum(dtype=jnp.int32)
+
+    gather_scan(cols, tiles, boxes, windows).block_until_ready()
+    dt = t(lambda: gather_scan(cols, tiles, boxes, windows).block_until_ready(), n=10)
+    print(f"gather scan 1/8 table ({T} tiles): {dt*1e3:.2f} ms  ({nbytes/8/dt/1e9:.1f} GB/s effective)")
+
+
+if __name__ == "__main__":
+    main()

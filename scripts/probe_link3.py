@@ -1,0 +1,153 @@
+"""Probe 3: scalar-arg upload cost, multi-arg h2d, and the full candidate
+query design: fused mask -> per-tile counts -> tile-level sort compaction ->
+gather packed bits of hit tiles -> one pull."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+def t(fn, n=10, warm=2):
+    for _ in range(warm):
+        fn()
+    s = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - s) / n
+
+
+def main():
+    N = 128 * 1024 * 1024
+    TILE = 2048
+    n_tiles = N // TILE
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.uniform(-180, 180, N).astype(np.float32))
+    jax.block_until_ready(x)
+
+    f1 = jax.jit(lambda x, s: (x >= s).sum(dtype=jnp.int32))
+    f1(x, 0.5).block_until_ready()
+    dt = t(lambda: f1(x, float(np.random.uniform())).block_until_ready(), n=10)
+    print(f"jit with 1 fresh python-float arg: {dt*1e3:.2f} ms")
+
+    f40 = jax.jit(lambda x, *s: (x >= sum(s)).sum(dtype=jnp.int32))
+    args = [float(v) for v in np.random.uniform(size=40)]
+    f40(x, *args).block_until_ready()
+    dt = t(
+        lambda: f40(x, *[float(v) for v in np.random.uniform(size=40)]).block_until_ready(),
+        n=10,
+    )
+    print(f"jit with 40 fresh python-float args: {dt*1e3:.2f} ms")
+
+    f2 = jax.jit(lambda x, a, b: (x >= a[0]).sum(dtype=jnp.int32) + b[0])
+    a = np.zeros(16, np.float32)
+    b = np.zeros(24, np.int32)
+    f2(x, a, b).block_until_ready()
+    dt = t(
+        lambda: f2(
+            x,
+            np.random.uniform(size=16).astype(np.float32),
+            np.random.randint(0, 5, 24).astype(np.int32),
+        ).block_until_ready(),
+        n=10,
+    )
+    print(f"jit with 2 fresh small numpy args: {dt*1e3:.2f} ms")
+
+    # full mock query: resident cols, packed params, tile compaction, one pull
+    cols = {
+        "x": x,
+        "y": jax.device_put(rng.uniform(-90, 90, N).astype(np.float32)),
+        "tbin": jax.device_put(rng.integers(0, 17, N).astype(np.int32)),
+        "toff": jax.device_put(rng.integers(0, 1 << 20, N).astype(np.int32)),
+    }
+    jax.block_until_ready(list(cols.values()))
+    nbytes = sum(int(v.nbytes) for v in cols.values())
+    M = 1024  # hit-tile slots
+
+    @partial(jax.jit, static_argnames=("nb", "nw"))
+    def query_kernel(x, y, tb, to, params, *, nb=4, nw=8):
+        boxes = jax.lax.bitcast_convert_type(params[: nb * 4], jnp.float32).reshape(nb, 4)
+        windows = params[nb * 4 : nb * 4 + nw * 3].astype(jnp.int32).reshape(nw, 3)
+        x2 = x.reshape(n_tiles, TILE)
+        y2 = y.reshape(n_tiles, TILE)
+        tb2 = tb.reshape(n_tiles, TILE)
+        to2 = to.reshape(n_tiles, TILE)
+        m = jnp.zeros((n_tiles, TILE), bool)
+        for i in range(nb):
+            m |= (x2 >= boxes[i, 0]) & (x2 <= boxes[i, 2]) & (y2 >= boxes[i, 1]) & (y2 <= boxes[i, 3])
+        mw = jnp.zeros((n_tiles, TILE), bool)
+        for i in range(nw):
+            mw |= (tb2 == windows[i, 0]) & (to2 >= windows[i, 1]) & (to2 <= windows[i, 2])
+        m &= mw
+        tile_counts = m.sum(axis=1, dtype=jnp.int32)
+        total = tile_counts.sum()
+        # pack bits: [n_tiles, TILE/32] i32
+        bits = m.reshape(n_tiles, TILE // 32, 32).astype(jnp.uint32)
+        packed = (bits << jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
+            axis=2, dtype=jnp.uint32
+        )
+        # tile-level compaction: sort tile ids by (has-hits desc, id asc)
+        key = jnp.where(tile_counts > 0, jnp.arange(n_tiles, dtype=jnp.int32), jnp.int32(1 << 30))
+        hit_ids = jax.lax.sort(key)[:M]
+        safe = jnp.where(hit_ids < n_tiles, hit_ids, 0)
+        out_bits = packed[safe]  # [M, 64] u32
+        out_counts = tile_counts[safe]
+        n_hit_tiles = (tile_counts > 0).sum(dtype=jnp.int32)
+        return total, n_hit_tiles, hit_ids, out_bits, out_counts
+
+    def pack_params(boxes, windows, nb=4, nw=8):
+        p = np.zeros(nb * 4 + nw * 3, np.uint32)
+        b = np.full((nb, 4), np.nan, np.float32)
+        b[:, 0] = np.inf
+        b[:, 2] = -np.inf
+        b[: len(boxes)] = boxes
+        p[: nb * 4] = b.reshape(-1).view(np.uint32)
+        w = np.zeros((nw, 3), np.int32)
+        w[:, 0] = -1
+        w[: len(windows)] = windows
+        p[nb * 4 :] = w.reshape(-1).view(np.uint32)
+        return p
+
+    boxes = np.array([[-10.0, -10.0, 10.0, 10.0]], np.float32)
+    windows = np.array([[3, 0, 1 << 18]], np.int32)
+
+    def run_query():
+        qx = np.random.uniform(-90, 90)
+        b = boxes + np.float32(qx) * np.array([1, 0, 1, 0], np.float32)
+        p = pack_params(b, windows)
+        total, nh, hit_ids, out_bits, out_counts = query_kernel(
+            cols["x"], cols["y"], cols["tbin"], cols["toff"], p
+        )
+        total = int(total)
+        nh = int(nh)
+        ids = np.asarray(hit_ids)
+        bits = np.asarray(out_bits)
+        # host decode: rows of the first few tiles
+        rows = []
+        for k in range(min(nh, M)):
+            seg = np.unpackbits(np.ascontiguousarray(bits[k]).view(np.uint8), bitorder="little")
+            rows.append(np.flatnonzero(seg) + ids[k] * TILE)
+        nrows = sum(len(r) for r in rows)
+        return total, nh, nrows
+
+    r = run_query()
+    print(f"mock query result: total={r[0]}, hit_tiles={r[1]}, decoded={r[2]}")
+    dt = t(run_query, n=10)
+    print(f"mock query end-to-end: {dt*1e3:.2f} ms  (scan {nbytes/1e9:.1f} GB -> {nbytes/dt/1e9:.0f} GB/s equiv)")
+
+    # kernel-only (no pulls)
+    p = pack_params(boxes, windows)
+    dt = t(lambda: jax.block_until_ready(query_kernel(cols["x"], cols["y"], cols["tbin"], cols["toff"], p)), n=10)
+    print(f"kernel-only (incl. param h2d): {dt*1e3:.2f} ms")
+
+    # kernel with resident params (pure compute)
+    pd = jax.device_put(p)
+    pd.block_until_ready()
+    dt = t(lambda: jax.block_until_ready(query_kernel(cols["x"], cols["y"], cols["tbin"], cols["toff"], pd)), n=10)
+    print(f"kernel-only (resident params): {dt*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

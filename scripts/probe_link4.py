@@ -1,0 +1,95 @@
+"""Probe 4: d2h pull floor anatomy — single vs multi-array pulls, async
+copy_to_host, device_get batching, pull-size scaling."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(fn, n=10, warm=2):
+    for _ in range(warm):
+        fn()
+    s = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - s) / n
+
+
+def main():
+    N = 16 * 1024 * 1024
+    a = jax.device_put(np.arange(N, dtype=np.int32))
+    a.block_until_ready()
+    bump = jax.jit(lambda x, i: x + i)
+
+    # pull floor vs size, fresh array each time (single pull per call)
+    for nbytes in (4, 4096, 262144, 1 << 20, 4 << 20):
+        n = max(nbytes // 4, 1)
+        i = [0]
+
+        def run():
+            out = bump(a, i[0])[:n]
+            i[0] += 1
+            return np.asarray(out)
+
+        dt = t(run, n=8)
+        print(f"jit+pull {nbytes:>9} B: {dt*1e3:8.2f} ms")
+
+    # multi-array pull: 4 arrays sequential np.asarray vs device_get batch
+    f4 = jax.jit(lambda x, i: (x[:1] + i, x[:1024] + i, x[:65536] + i, x[: 1 << 18] + i))
+    i = [100]
+
+    def seq_pull():
+        outs = f4(a, i[0])
+        i[0] += 1
+        return [np.asarray(o) for o in outs]
+
+    dt = t(seq_pull, n=8)
+    print(f"4 outputs, sequential np.asarray: {dt*1e3:.2f} ms")
+
+    def batch_pull():
+        outs = f4(a, i[0])
+        i[0] += 1
+        return jax.device_get(outs)
+
+    dt = t(batch_pull, n=8)
+    print(f"4 outputs, jax.device_get(tuple): {dt*1e3:.2f} ms")
+
+    def async_pull():
+        outs = f4(a, i[0])
+        i[0] += 1
+        for o in outs:
+            o.copy_to_host_async()
+        return [np.asarray(o) for o in outs]
+
+    dt = t(async_pull, n=8)
+    print(f"4 outputs, copy_to_host_async then asarray: {dt*1e3:.2f} ms")
+
+    # single concatenated output
+    fc_ = jax.jit(
+        lambda x, i: jnp.concatenate([x[:1] + i, x[:1024] + i, x[:65536] + i, x[: 1 << 18] + i])
+    )
+
+    def concat_pull():
+        out = fc_(a, i[0])
+        i[0] += 1
+        return np.asarray(out)
+
+    dt = t(concat_pull, n=8)
+    print(f"1 concatenated output ({(1+1024+65536+(1<<18))*4} B): {dt*1e3:.2f} ms")
+
+    # scalar-only pull (.item())
+    fs = jax.jit(lambda x, i: (x.sum() + i).astype(jnp.int32))
+
+    def item_pull():
+        out = fs(a, i[0])
+        i[0] += 1
+        return out.item()
+
+    dt = t(item_pull, n=8)
+    print(f"scalar .item() pull: {dt*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,11 +88,21 @@ class TestMergeRanges:
         merged = merge_ranges(rs)
         assert [(r.lower, r.upper) for r in merged] == [(0, 20), (22, 30)]
 
-    def test_merge_adjacent(self):
-        rs = [IndexRange(0, 10, True), IndexRange(11, 20, False)]
+    def test_merge_adjacent_same_kind(self):
+        rs = [IndexRange(0, 10, False), IndexRange(11, 20, False)]
         merged = merge_ranges(rs)
         assert [(r.lower, r.upper) for r in merged] == [(0, 20)]
         assert not merged[0].contained
+
+    def test_adjacent_mixed_kind_not_merged(self):
+        # a contained range keeps its no-refinement guarantee: merging it
+        # into an overlapping neighbor would force refinement of its rows
+        rs = [IndexRange(0, 10, True), IndexRange(11, 20, False)]
+        merged = merge_ranges(rs)
+        assert [(r.lower, r.upper, r.contained) for r in merged] == [
+            (0, 10, True),
+            (11, 20, False),
+        ]
 
     def test_cap_closes_smallest_gaps(self):
         rs = [IndexRange(0, 1, True), IndexRange(5, 6, True), IndexRange(100, 101, True)]
