@@ -126,6 +126,17 @@ def cmd_ingest(args) -> int:
             sft, conv = infer_schema(args.feature_name, body, header=header)
             if args.feature_name not in ds.type_names():
                 ds.create_schema(sft)
+            else:
+                # a later file must infer the same shape as the stored
+                # schema — silently concatenating mismatched columns (Int
+                # vs Double, different geometry pair) corrupts the store
+                stored = ds.get_schema(args.feature_name).to_spec()
+                if sft.to_spec() != stored:
+                    raise SystemExit(
+                        f"inferred schema for {path!r} does not match the "
+                        f"existing {args.feature_name!r} schema:\n"
+                        f"  inferred: {sft.to_spec()}\n  stored:   {stored}"
+                    )
             if args.header:
                 conv.skip_lines = 1
         else:

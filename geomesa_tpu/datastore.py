@@ -30,6 +30,20 @@ from geomesa_tpu.sft import FeatureType
 from geomesa_tpu.storage.table import IndexTable
 
 
+def _slice_keys(keys, start: int):
+    """WriteKeys rows [start:] (delta-tier view of a partially-compacted
+    chunk)."""
+    if start == 0:
+        return keys
+    from geomesa_tpu.index.api import WriteKeys
+
+    return WriteKeys(
+        bins=keys.bins[start:],
+        zs=keys.zs[start:],
+        device_cols={k: v[start:] for k, v in keys.device_cols.items()},
+    )
+
+
 class DataStore:
     """In-process TPU-backed feature store."""
 
@@ -50,10 +64,24 @@ class DataStore:
         geomesa_tpu.planning.guards hooks; ``audit`` an AuditWriter;
         ``metrics`` a MetricsRegistry."""
         self._schemas: dict[str, FeatureType] = {}
-        self._features: dict[str, FeatureCollection] = {}
+        # features live as a list of write-batch chunks (LSM memtable
+        # pattern): writes append O(batch); the concatenated view is built
+        # lazily and cached for readers
+        self._chunks: dict[str, list[FeatureCollection]] = {}
+        self._full: dict[str, FeatureCollection | None] = {}
         self._indexes: dict[str, list] = {}
         self._tables: dict[tuple[str, str], IndexTable] = {}
-        self._id_map: dict[str, dict[str, int] | None] = {}
+        # per-index write keys, chunked like features; rows past _main_rows
+        # form the host delta tier (storage.delta)
+        self._key_chunks: dict[tuple[str, str], list] = {}
+        self._main_rows: dict[str, int] = {}
+        # id lookup: lazily-built per-chunk sorted id columns (no python
+        # dict — a 100M-row dict would be a multi-GB host stall — and no
+        # global re-argsort per write: each chunk sorts once)
+        self._id_sorted: dict[str, list[tuple[np.ndarray, np.ndarray]]] = {}
+        # cached concat of the un-compacted key chunks, per index
+        # (invalidated by write/compact so table() is allocation-free)
+        self._delta_cache: dict[tuple[str, str], tuple[int, int, object]] = {}
         self._stats: dict[str, object] = {}
         self.block_full_table_scans = block_full_table_scans
         self.tile = tile
@@ -80,7 +108,10 @@ class DataStore:
             raise ValueError(f"schema {sft.name!r} has no geometry attribute")
         self._schemas[sft.name] = sft
         self._indexes[sft.name] = self._choose_indexes(sft)
-        self._id_map[sft.name] = {}
+        self._chunks[sft.name] = []
+        self._full[sft.name] = None
+        self._main_rows[sft.name] = 0
+        self._id_sorted[sft.name] = None
         return sft
 
     def _choose_indexes(self, sft: FeatureType) -> list:
@@ -122,72 +153,181 @@ class DataStore:
     def delete_schema(self, type_name: str) -> None:
         """Drop a schema and all its data (reference removeSchema)."""
         self._schemas.pop(type_name)
-        self._features.pop(type_name, None)
-        self._id_map.pop(type_name, None)
+        self._chunks.pop(type_name, None)
+        self._full.pop(type_name, None)
+        self._main_rows.pop(type_name, None)
+        self._id_sorted.pop(type_name, None)
         self._stats.pop(type_name, None)
         for idx in self._indexes.pop(type_name, []):
             self._tables.pop((type_name, idx.name), None)
+            self._key_chunks.pop((type_name, idx.name), None)
 
     # -- ingest ----------------------------------------------------------
+    # delta tier compaction threshold: rebuild the device table when the
+    # host delta exceeds max(MIN, total/8) rows (LSM minor-compaction ratio)
+    COMPACT_MIN_ROWS = 262_144
+
     def write(
         self,
         type_name: str,
         features: "FeatureCollection | Sequence[Mapping]",
         check_ids: bool = True,
     ) -> int:
-        """Append a batch of features and rebuild the index tables.
+        """Append a batch of features.
 
-        Bulk-oriented like an LSM memtable flush: the batch is merged with
-        the existing collection and every index re-sorts. (The reference
-        gets incremental sorted inserts from the backing KV store; here a
-        sorted merge is a cheap device-friendly operation and batches are
-        the expected ingest unit.) ``check_ids=False`` skips the duplicate
-        id check for large bulk loads with known-unique ids.
+        LSM-shaped (SURVEY §7 hard part (c)): the batch's index keys are
+        encoded O(batch) and appended to a host *delta* tier; the sorted
+        device table only rebuilds (native radix sort) when the delta
+        outgrows its threshold, so steady-state write cost is proportional
+        to the batch, not the table. ``check_ids=False`` skips the
+        duplicate id check for large bulk loads with known-unique ids.
         """
         sft = self._schemas[type_name]
         if not isinstance(features, FeatureCollection):
             features = FeatureCollection.from_rows(sft, features)
         if len(features) == 0:
             return 0
-        existing = self._features.get(type_name)
-        merged = (
-            features if existing is None else FeatureCollection.concat([existing, features])
-        )
-        if check_ids and len(np.unique(merged.ids)) != len(merged):
-            raise ValueError("duplicate feature ids in write batch")
+        if check_ids:
+            self._check_ids(type_name, features)
 
         # build everything BEFORE mutating store state: a failing encoder
         # (bad dates, unsupported geometry) must leave the store untouched,
         # not half-written (features visible but index tables stale)
         stats = self._build_stats(type_name, features)
-        new_tables: dict[str, IndexTable] = {}
+        new_keys: dict[str, object] = {}
         for idx in self._indexes[type_name]:
-            keys = idx.write_keys(merged)
+            keys = idx.write_keys(features)
+            new_keys[idx.name] = keys
             if idx.name == "z3" and len(keys.zs):
                 # sketch sees only the delta batch (the store-level sketch
                 # accumulates); cell width is codec-defined (3 x per-dim
                 # precision), NOT data-dependent, so cells stay aligned
-                dkeys = keys if existing is None else idx.write_keys(features)
                 stats.observe_index_keys(
-                    idx.name, dkeys.bins, dkeys.zs,
+                    idx.name, keys.bins, keys.zs,
                     3 * getattr(idx.sfc, "precision", 21),
                 )
-            kwargs: dict = {"tile": self.tile} if self.tile else {}
+
+        # commit
+        self._chunks[type_name].append(features)
+        self._full[type_name] = None
+        self._id_sorted[type_name] = None
+        self._stats[type_name] = stats
+        for name, keys in new_keys.items():
+            self._key_chunks.setdefault((type_name, name), []).append(keys)
+
+        total = sum(len(c) for c in self._chunks[type_name])
+        delta_rows = total - self._main_rows[type_name]
+        if (
+            self.mesh is not None
+            or self._main_rows[type_name] == 0
+            or delta_rows > max(self.COMPACT_MIN_ROWS, total // 8)
+        ):
+            self.compact(type_name)
+        return len(features)
+
+    def delete_features(self, type_name: str, f: "Filter | str") -> int:
+        """Remove features matching a filter; returns the count removed
+        (reference GeoTools removeFeatures / GeoMesaFeatureStore).
+
+        Rebuilds the columnar chunks and index tables without the removed
+        rows (a major compaction); statistics are re-sketched from the
+        survivors since sketches cannot subtract."""
+        out = self.query(type_name, f)
+        if len(out) == 0:
+            return 0
+        ordinals = self.id_lookup(type_name, out.ids)
+        full = self.features(type_name)
+        keep = np.ones(len(full), dtype=bool)
+        keep[ordinals] = False
+        new_full = full.mask(keep)
+        self._chunks[type_name] = [new_full] if len(new_full) else []
+        self._full[type_name] = None
+        self._id_sorted[type_name] = None
+        for idx in self._indexes[type_name]:
+            key = (type_name, idx.name)
+            parts = self._key_chunks.get(key)
+            if parts:
+                from geomesa_tpu.storage.delta import concat_keys
+
+                keys = concat_keys(parts)
+                from geomesa_tpu.index.api import WriteKeys
+
+                self._key_chunks[key] = [
+                    WriteKeys(
+                        bins=keys.bins[keep],
+                        zs=keys.zs[keep],
+                        device_cols={k: v[keep] for k, v in keys.device_cols.items()},
+                    )
+                ]
+        self._stats[type_name] = (
+            self._build_stats_fresh(type_name, new_full) if len(new_full) else None
+        )
+        self._main_rows[type_name] = 0  # force table rebuild
+        self.compact(type_name)
+        return int((~keep).sum())
+
+    def _build_stats_fresh(self, type_name: str, fc: FeatureCollection):
+        from geomesa_tpu.stats.store import StatsStore
+
+        stats = StatsStore.build(self._schemas[type_name], fc)
+        for idx in self._indexes[type_name]:
+            if idx.name == "z3" and len(fc):
+                keys = idx.write_keys(fc)
+                stats.observe_index_keys(
+                    idx.name, keys.bins, keys.zs,
+                    3 * getattr(idx.sfc, "precision", 21),
+                )
+        return stats
+
+    def compact(self, type_name: str) -> None:
+        """Merge the delta tier into the sorted device tables (LSM minor
+        compaction; the reference's backends compact SSTables server-side).
+        Also collapses the feature chunks into one collection."""
+        from geomesa_tpu.storage.delta import concat_keys
+
+        full = self.features(type_name)
+        self._chunks[type_name] = [full] if len(full) else []
+        kwargs: dict = {"tile": self.tile} if self.tile else {}
+        for idx in self._indexes[type_name]:
+            parts = self._key_chunks.get((type_name, idx.name))
+            if not parts:
+                continue
+            keys = concat_keys(parts)
+            self._key_chunks[(type_name, idx.name)] = [keys]
             if self.mesh is not None:
                 from geomesa_tpu.parallel import DistributedIndexTable
 
                 table = DistributedIndexTable(idx, keys, self.mesh, **kwargs)
             else:
                 table = IndexTable(idx, keys, **kwargs)
-            new_tables[idx.name] = table
+            self._tables[(type_name, idx.name)] = table
+        self._main_rows[type_name] = len(full)
 
-        # commit
-        self._features[type_name] = merged
-        self._id_map[type_name] = None  # rebuilt lazily on first id lookup
-        self._stats[type_name] = stats
-        for name, table in new_tables.items():
-            self._tables[(type_name, name)] = table
-        return len(features)
+    def _check_ids(self, type_name: str, batch: FeatureCollection) -> None:
+        ids = np.asarray(batch.ids)
+        if len(np.unique(ids)) != len(ids):
+            raise ValueError("duplicate feature ids in write batch")
+        existing = self._id_index(type_name)
+        if existing is not None and len(existing[0]):
+            sorted_ids = existing[0]
+            pos = np.searchsorted(sorted_ids, ids)
+            pos = np.clip(pos, 0, len(sorted_ids) - 1)
+            if np.any(sorted_ids[pos] == ids):
+                raise ValueError("duplicate feature ids in write batch")
+
+    def _id_index(self, type_name: str):
+        """(sorted ids, argsort order) for id lookups — built lazily, no
+        python dict (VERDICT r2: a dict over 100M ids is a multi-GB stall)."""
+        cached = self._id_sorted.get(type_name)
+        if cached is None:
+            fc = self.features(type_name)
+            if len(fc) == 0:
+                cached = (np.zeros(0, dtype=fc.ids.dtype), np.zeros(0, np.int64))
+            else:
+                order = np.argsort(fc.ids, kind="stable")
+                cached = (fc.ids[order], order)
+            self._id_sorted[type_name] = cached
+        return cached
 
     def _build_stats(self, type_name: str, delta: FeatureCollection):
         """Incremental: sketch the delta batch, merge into existing stats
@@ -205,23 +345,54 @@ class DataStore:
     def indexes(self, type_name: str) -> list:
         return self._indexes[type_name]
 
-    def table(self, type_name: str, index_name: str) -> IndexTable:
-        return self._tables[(type_name, index_name)]
+    def table(self, type_name: str, index_name: str):
+        """The scan surface for one index: the device table, wrapped with
+        the host delta tier when un-compacted writes exist."""
+        table = self._tables[(type_name, index_name)]
+        main_rows = self._main_rows.get(type_name, 0)
+        total = sum(len(c) for c in self._chunks.get(type_name, []))
+        if total > main_rows:
+            from geomesa_tpu.storage.delta import TieredTable, concat_keys
+
+            parts = self._key_chunks[(type_name, index_name)]
+            # delta = rows past the compacted prefix, found by walking the
+            # key chunks (chunk boundaries align with feature chunks)
+            delta_parts, seen = [], 0
+            for p in parts:
+                n = len(p.zs)
+                if seen + n > main_rows:
+                    delta_parts.append(_slice_keys(p, max(main_rows - seen, 0)))
+                seen += n
+            return TieredTable(table, concat_keys(delta_parts), main_rows)
+        return table
 
     def features(self, type_name: str) -> FeatureCollection:
-        fc = self._features.get(type_name)
-        if fc is None:
+        chunks = self._chunks.get(type_name, [])
+        if not chunks:
             sft = self._schemas[type_name]
             return FeatureCollection.from_rows(sft, [])
-        return fc
+        if len(chunks) == 1:
+            return chunks[0]
+        full = self._full.get(type_name)
+        if full is None or len(full) != sum(len(c) for c in chunks):
+            full = FeatureCollection.concat(chunks)
+            self._full[type_name] = full
+        return full
 
     def id_lookup(self, type_name: str, ids: Iterable[str]) -> np.ndarray:
-        m = self._id_map.get(type_name)
-        if m is None:
-            fc = self._features.get(type_name)
-            m = {} if fc is None else {str(i): k for k, i in enumerate(fc.ids)}
-            self._id_map[type_name] = m
-        return np.array([m[i] for i in ids if i in m], dtype=np.int64)
+        sorted_ids, order = self._id_index(type_name)
+        if len(sorted_ids) == 0:
+            return np.zeros(0, dtype=np.int64)
+        want = np.asarray(list(ids))
+        if want.dtype.kind != sorted_ids.dtype.kind:
+            try:
+                want = want.astype(sorted_ids.dtype)
+            except (ValueError, TypeError):
+                return np.zeros(0, dtype=np.int64)
+        pos = np.searchsorted(sorted_ids, want)
+        pos = np.clip(pos, 0, len(sorted_ids) - 1)
+        hit = sorted_ids[pos] == want
+        return order[pos[hit]].astype(np.int64)
 
     def stats_for(self, type_name: str):
         return self._stats.get(type_name)

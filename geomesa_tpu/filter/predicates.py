@@ -382,7 +382,14 @@ class IdFilter(Filter):
         fids = batch.get("__id__")
         if fids is None:
             raise KeyError("batch has no __id__ column for id filter")
-        return np.isin(np.asarray(fids), np.asarray(list(self.ids)))
+        fids = np.asarray(fids)
+        want = np.asarray(list(self.ids))
+        if fids.dtype.kind != want.dtype.kind:
+            # ECQL id literals are strings; stored ids may be numeric —
+            # compare canonically as strings
+            fids = fids.astype(str)
+            want = want.astype(str)
+        return np.isin(fids, want)
 
 
 # ---------------------------------------------------------------------------

@@ -53,7 +53,8 @@ def knn_search(
     """
     sft = store.get_schema(type_name)
     geom = sft.geom_field
-    radius = float(estimated_distance_m)
+    # clamp to a positive start: radius 0 would never grow (min(0*2, max))
+    radius = min(max(float(estimated_distance_m), 1.0), float(max_distance_m))
     while True:
         deg = _meters_to_degrees(radius, y)
         box = BBox(geom, x - deg, max(y - deg, -90.0), x + deg, min(y + deg, 90.0))

@@ -1,0 +1,172 @@
+"""LSM-style delta tier: recent writes live host-side until compaction.
+
+The reference gets incremental sorted inserts for free from its KV backends
+(Accumulo/HBase memtables + minor compaction); the TPU analogue is a small
+host-resident unsorted delta per index that absorbs appends, scanned
+exactly with vectorized NumPy, while the big sorted device table (the
+"SSTable") only rebuilds when the delta outgrows its threshold — write()
+cost is proportional to the batch, not the table (SURVEY §7 hard part (c);
+reference Lambda hot/cold tiering, lambda/data/LambdaDataStore.scala).
+
+Delta hits are always re-refined by the planner (certain=False): the host
+predicate here mirrors the kernel's *wide* semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.index.api import ScanConfig, WriteKeys
+
+
+def concat_keys(parts: list[WriteKeys]) -> WriteKeys:
+    if len(parts) == 1:
+        return parts[0]
+    return WriteKeys(
+        bins=np.concatenate([p.bins for p in parts]),
+        zs=np.concatenate([p.zs for p in parts]),
+        device_cols={
+            name: np.concatenate([p.device_cols[name] for p in parts])
+            for name in parts[0].device_cols
+        },
+    )
+
+
+def delta_wide_mask(config: ScanConfig, keys: WriteKeys) -> np.ndarray:
+    """Wide-predicate mask over delta rows (bit-compatible with the kernel's
+    wide plane: f32 widened boxes, per-bin windows, bbox-intersects for
+    extents; value-range check for predicate-free attribute scans)."""
+    cols = keys.device_cols
+    n = len(keys.zs)
+    m = np.ones(n, dtype=bool)
+    if config.boxes is not None:
+        if "gxmin" in cols:
+            hit = np.zeros(n, dtype=bool)
+            for x0, y0, x1, y1 in np.asarray(config.boxes, np.float32):
+                hit |= (
+                    (cols["gxmin"] <= x1)
+                    & (cols["gxmax"] >= x0)
+                    & (cols["gymin"] <= y1)
+                    & (cols["gymax"] >= y0)
+                )
+        else:
+            x, y = cols["x"], cols["y"]
+            hit = np.zeros(n, dtype=bool)
+            for x0, y0, x1, y1 in np.asarray(config.boxes, np.float32):
+                hit |= (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+        m &= hit
+    if config.windows is not None:
+        tb, to = cols["tbin"], cols["toff"]
+        hit = np.zeros(n, dtype=bool)
+        for b, lo, hi in np.asarray(config.windows, np.int64):
+            hit |= (tb == b) & (to >= lo) & (to <= hi)
+        m &= hit
+    if config.boxes is None and config.windows is None:
+        # pure range scan (attribute primary): match the sort-key ranges
+        hit = np.zeros(n, dtype=bool)
+        zs = keys.zs
+        for b, lo, hi in zip(
+            config.range_bins.tolist(),
+            config.range_lo.tolist(),
+            config.range_hi.tolist(),
+        ):
+            hit |= (keys.bins == b) & (zs >= lo) & (zs <= hi)
+        m &= hit
+    elif config.clip_rows:
+        # attribute index with secondary predicate: rows must also be in a
+        # value range
+        hit = np.zeros(n, dtype=bool)
+        zs = keys.zs
+        for b, lo, hi in zip(
+            config.range_bins.tolist(),
+            config.range_lo.tolist(),
+            config.range_hi.tolist(),
+        ):
+            hit |= (keys.bins == b) & (zs >= lo) & (zs <= hi)
+        m &= hit
+    return m
+
+
+class TieredTable:
+    """Main device table + host delta, presenting the IndexTable scan
+    surface. Delta hits are uncertain (always refined)."""
+
+    def __init__(self, main, delta_keys: WriteKeys, base_ordinal: int):
+        self.main = main
+        self.delta = delta_keys
+        self.base = base_ordinal
+        self.keyspace = main.keyspace
+
+    @property
+    def n(self) -> int:
+        return self.main.n + len(self.delta.zs)
+
+    def _delta_hits(self, config: ScanConfig) -> np.ndarray:
+        if config.disjoint or len(self.delta.zs) == 0:
+            return np.zeros(0, np.int64)
+        return self.base + np.flatnonzero(delta_wide_mask(config, self.delta))
+
+    def scan(self, config: ScanConfig):
+        ordinals, certain = self.main.scan(config)
+        d = self._delta_hits(config)
+        if len(d) == 0:
+            return ordinals, certain
+        return (
+            np.concatenate([ordinals, d]),
+            np.concatenate([certain, np.zeros(len(d), bool)]),
+        )
+
+    def count(self, config: ScanConfig) -> int:
+        return self.main.count(config) + len(self._delta_hits(config))
+
+    def candidate_spans(self, config: ScanConfig):
+        """Cost-estimator view: main spans plus the whole delta as one
+        pseudo-span (a cheap upper bound — the delta is scanned linearly)."""
+        spans = list(self.main.candidate_spans(config))
+        if len(self.delta.zs):
+            spans.append((self.main.n, self.main.n + len(self.delta.zs)))
+        return spans
+
+    def bounds_stats(self, config: ScanConfig):
+        cnt, env = self.main.bounds_stats(config)
+        d = self._delta_hits(config)
+        if len(d) == 0:
+            return cnt, env
+        local = d - self.base
+        cols = self.delta.device_cols
+        if "x" in cols:
+            x, y = cols["x"][local], cols["y"][local]
+        else:
+            x = (cols["gxmin"][local] + cols["gxmax"][local]) * 0.5
+            y = (cols["gymin"][local] + cols["gymax"][local]) * 0.5
+        denv = (float(x.min()), float(y.min()), float(x.max()), float(y.max()))
+        if env is None:
+            return cnt + len(d), denv
+        return cnt + len(d), (
+            min(env[0], denv[0]), min(env[1], denv[1]),
+            max(env[2], denv[2]), max(env[3], denv[3]),
+        )
+
+    def density(self, config: ScanConfig, bounds, width: int, height: int):
+        grid = self.main.density(config, bounds, width, height)
+        d = self._delta_hits(config)
+        if len(d):
+            local = d - self.base
+            cols = self.delta.device_cols
+            if "x" in cols:
+                x, y = cols["x"][local], cols["y"][local]
+            else:
+                x = (cols["gxmin"][local] + cols["gxmax"][local]) * 0.5
+                y = (cols["gymin"][local] + cols["gymax"][local]) * 0.5
+            x0, y0, x1, y1 = (float(v) for v in bounds)
+            inb = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+            px = np.clip(((x - x0) / (x1 - x0) * width).astype(np.int64), 0, width - 1)
+            py = np.clip(((y - y0) / (y1 - y0) * height).astype(np.int64), 0, height - 1)
+            flat = grid.reshape(-1)
+            np.add.at(flat, (py * width + px)[inb], np.float32(1))
+            grid = flat.reshape(height, width)
+        return grid
+
+    @property
+    def nbytes_device(self) -> int:
+        return self.main.nbytes_device

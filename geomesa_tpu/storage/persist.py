@@ -30,11 +30,21 @@ from geomesa_tpu.sft import FeatureType
 FORMAT_VERSION = 1
 
 
+import re
+
+_SAFE_NAME = re.compile(r"^[A-Za-z0-9_.-]+$")
+
+
 def save(store, root: str) -> None:
     """Persist every schema + feature batch under ``root``."""
     os.makedirs(root, exist_ok=True)
     meta: dict = {"version": FORMAT_VERSION, "types": {}}
     for name in store.type_names():
+        if not _SAFE_NAME.match(name):
+            raise ValueError(
+                f"feature type name {name!r} is not filesystem-safe "
+                "([A-Za-z0-9_.-] only) — cannot persist"
+            )
         sft = store.get_schema(name)
         meta["types"][name] = {
             "spec": sft.to_spec(),
@@ -60,6 +70,8 @@ def load(root: str, **store_kwargs):
         raise ValueError(f"unsupported store format {meta.get('version')!r}")
     store = DataStore(**store_kwargs)
     for name, info in meta["types"].items():
+        if not _SAFE_NAME.match(name):
+            raise ValueError(f"unsafe feature type name in metadata: {name!r}")
         sft = FeatureType.from_spec(name, info["spec"])
         sft.user_data.update(info.get("user_data", {}))
         store.create_schema(sft)

@@ -162,16 +162,24 @@ class LambdaStore:
         return self.hot.upsert(rows, ids)
 
     def persist_hot(self) -> int:
-        """Flush hot state into the cold store; returns rows persisted."""
+        """Flush hot state into the cold store; returns rows persisted.
+
+        Ids already persisted are *updates*: the stale cold rows are
+        removed and re-written from the hot copy (the reference
+        LambdaDataStore persists updates as its primary loop — raising on
+        them, as before round 3, both wedged the flush and silently lost
+        updates under expiry)."""
         fc = self.hot.snapshot()
         if len(fc) == 0:
             return 0
-        existing = set(self.cold.features(self.type_name).ids.tolist())
-        dup = [i for i in fc.ids.tolist() if i in existing]
-        if dup:
-            raise ValueError(f"ids already persisted: {dup[:5]}")
+        ids = [str(i) for i in fc.ids.tolist()]
+        existing = set(str(i) for i in self.cold.features(self.type_name).ids.tolist())
+        updated = [i for i in ids if i in existing]
+        if updated:
+            quoted = ", ".join(f"'{i}'" for i in updated)
+            self.cold.delete_features(self.type_name, f"IN ({quoted})")
         self.cold.write(self.type_name, fc)
-        self.hot.clear()
+        self.hot.delete(ids)
         return len(fc)
 
     def query(self, f: "Filter | str" = INCLUDE) -> FeatureCollection:

@@ -95,14 +95,20 @@ class TestLambdaStore:
         assert len(lam.hot) == 0
         # now served from cold
         assert lam.count("bbox(geom, -1, -1, 1, 1)") == 1
-        # hot update wins over persisted cold row — but persisting again
-        # with the same id is rejected (offsets analogue)
+        # hot update wins over persisted cold row; persisting again
+        # replaces the stale cold copy (reference LambdaDataStore persists
+        # updates — its primary loop; advisor r2 medium fix)
         lam.write([_row("h2", 0.5, 0.5)], ids=["hot1"])
         out = lam.query("bbox(geom, -1, -1, 1, 1)")
         assert len(out) == 1
         assert np.asarray(out.columns["name"])[0] == "h2"
-        with pytest.raises(ValueError):
-            lam.persist_hot()
+        assert lam.persist_hot() == 1
+        assert len(lam.hot) == 0
+        out = lam.query("bbox(geom, -1, -1, 1, 1)")
+        assert len(out) == 1
+        assert np.asarray(out.columns["name"])[0] == "h2"
+        # the update survives a further flush cycle with nothing hot
+        assert lam.persist_hot() == 0
 
 
 class TestSecurity:
