@@ -217,10 +217,10 @@ class DataStore:
 
         total = sum(len(c) for c in self._chunks[type_name])
         delta_rows = total - self._main_rows[type_name]
-        if (
-            self.mesh is not None
-            or self._main_rows[type_name] == 0
-            or delta_rows > max(self.COMPACT_MIN_ROWS, total // 8)
+        # mesh stores use the same delta tier as single-chip stores (round 3
+        # force-compacted every mesh write; the shared engine removed that)
+        if self._main_rows[type_name] == 0 or delta_rows > max(
+            self.COMPACT_MIN_ROWS, total // 8
         ):
             self.compact(type_name)
         return len(features)

@@ -1,18 +1,26 @@
 """DistributedIndexTable: one index sharded over a device mesh.
 
-Layout: the sorted table is cut into fixed-size tiles which are dealt
-round-robin across the mesh axis (global tile t -> device ``t % D``, local
-slot ``t // D``). Round-robin is the ShardStrategy analogue (/root/
-reference/geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/
-api/ShardStrategy.scala:21-80): because consecutive z-runs interleave
-across chips, any query's candidate ranges fan out over the whole mesh
-instead of hot-spotting one device.
+Layout: the sorted table's scan blocks are dealt round-robin across the
+mesh axis (global block g -> device ``g % D``, local slot ``g // D``).
+Round-robin is the ShardStrategy analogue (/root/reference/geomesa-index-
+api/src/main/scala/org/locationtech/geomesa/index/api/ShardStrategy.scala:
+21-80): consecutive z-runs interleave across chips, so any query's
+candidate ranges fan out over the whole mesh instead of hot-spotting one
+device.
 
-Scan execution is a ``shard_map`` program: every device masks its own
-candidate tiles (same fused predicate as the single-device kernel), counts
-merge with ``psum`` and row ids with ``all_gather`` over ICI — the
-coprocessor-aggregation tier of the reference (rpc/coprocessor/
-GeoMesaCoprocessor.scala:28-79) collapsed into XLA collectives.
+Execution is the SAME block-bitmask engine as the single-chip table
+(scan.block_kernels; the reference runs one push-down tier on every region
+server, geomesa-hbase-rpc/.../coprocessor/GeoMesaCoprocessor.scala:28-79):
+this class only overrides the device hooks of storage.table.IndexTable —
+every device DMAs its own candidate blocks via the scalar-prefetched
+Pallas kernel under ``shard_map`` and emits packed wide+inner bit planes
+at a mesh-wide static M bucket. All shapes are static per (table, bucket,
+predicate flags): zero query-time recompiles (the round-2 cap-retry loop
+is gone), all query parameters ride the jit dispatch (no per-call
+device_put), and ONE batched pull returns every device's planes, sized in
+KB. Aggregations (pops/density/bounds) run the shared kernels per shard
+and merge with ``psum`` or a host fold — the coprocessor-aggregation tier
+collapsed into XLA collectives over ICI.
 """
 
 from __future__ import annotations
@@ -20,231 +28,221 @@ from __future__ import annotations
 from functools import lru_cache
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
-from geomesa_tpu.scan import kernels
-from geomesa_tpu.scan.kernels import pad_pow2
-from geomesa_tpu.storage.table import DEFAULT_TILE, SortedKeys
+from geomesa_tpu.scan import aggregations
+from geomesa_tpu.scan import block_kernels as bk
+from geomesa_tpu.storage.table import IndexTable
 
 
-@lru_cache(maxsize=64)
-def _build_scan(mesh, names, tile, cap, extent_mode, has_boxes, has_windows, count_only):
-    """jit(shard_map(local scan)) for one static configuration.
-
-    Local in-block shapes: cols [1, L], tile_ids [1, T]; boxes/windows are
-    replicated. Outputs are replicated: per-device counts [D] and, unless
-    count_only, per-device local row ids [D, cap] (-1 past each count).
-    """
+@lru_cache(maxsize=256)
+def _dist_scan(mesh, names, has_boxes, has_windows, extent):
+    """jit(shard_map): per-device block-bitmask scan -> (wide, inner)
+    planes [D, M, PACK, 128], sharded along the mesh axis so the host's one
+    device_get is the only cross-host movement."""
     axis = mesh.axis_names[0]
 
-    def body(tile_ids, boxes, windows, *col_arrays):
-        cols = {k: v[0] for k, v in zip(names, col_arrays)}
-        m, base = kernels._tile_mask(
-            cols,
-            tile_ids[0],
-            boxes if has_boxes else None,
-            windows if has_windows else None,
-            tile,
-            extent_mode,
+    def body(bids, boxes, wins, *cols):
+        w, i = bk.block_scan(
+            tuple(c[0] for c in cols), bids[0], boxes, wins,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent,
         )
-        cnt = m.sum(dtype=jnp.int32)
-        cnt_all = lax.all_gather(cnt, axis)
-        if count_only:
-            return (cnt_all,)
-        _, rows = kernels.compact_rows(m, base, cap)
-        rows_all = lax.all_gather(rows, axis)
-        return cnt_all, rows_all
+        return w[None], i[None]
 
-    n_cols = len(names)
-    in_specs = (P(axis, None), P(), P()) + (P(axis, None),) * n_cols
-    out_specs = (P(),) if count_only else (P(), P())
+    in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
     return jax.jit(
         jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+            body, mesh=mesh, in_specs=in_specs, out_specs=(P(axis), P(axis)),
+            check_vma=False,
         )
     )
 
 
-@lru_cache(maxsize=64)
-def _build_density(mesh, names, tile, width, height, extent_mode, has_boxes, has_windows):
-    """jit(shard_map(local density + psum)): every device renders its own
-    candidate tiles onto the grid, partial grids merge over ICI with psum —
-    the coprocessor-aggregation merge collapsed into one collective."""
-    from geomesa_tpu.scan import aggregations
-
+@lru_cache(maxsize=256)
+def _dist_pops(mesh, names, has_boxes, has_windows, extent):
+    """jit(shard_map): per-device per-block wide popcounts [D, M] i32 —
+    count queries pull D*M ints, never planes."""
     axis = mesh.axis_names[0]
 
-    def body(tile_ids, boxes, windows, grid_bounds, *col_arrays):
-        cols = {k: v[0] for k, v in zip(names, col_arrays)}
-        grid = aggregations.tile_density(
-            cols,
-            tile_ids[0],
-            boxes if has_boxes else None,
-            windows if has_windows else None,
-            grid_bounds,
-            tile=tile,
-            width=width,
-            height=height,
-            extent_mode=extent_mode,
+    def body(bids, boxes, wins, *cols):
+        w, _ = bk.block_scan(
+            tuple(c[0] for c in cols), jax.numpy.maximum(bids[0], 0), boxes, wins,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent,
+        )
+        return aggregations._popcount_slots(w)[None]
+
+    in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(axis), check_vma=False
+        )
+    )
+
+
+@lru_cache(maxsize=256)
+def _dist_density(mesh, names, has_boxes, has_windows, extent, width, height):
+    """jit(shard_map): per-device density grid, psum-merged over ICI."""
+    axis = mesh.axis_names[0]
+
+    def body(bids, boxes, wins, gb, *cols):
+        grid = aggregations.block_density(
+            tuple(c[0] for c in cols), bids[0], boxes, wins, gb,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent, width=width, height=height,
         )
         return lax.psum(grid, axis)
 
-    n_cols = len(names)
-    in_specs = (P(axis, None), P(), P(), P()) + (P(axis, None),) * n_cols
+    in_specs = (P(axis), P(), P(), P()) + (P(axis),) * len(names)
     return jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False)
+        jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
+        )
     )
 
 
-class DistributedIndexTable(SortedKeys):
-    """Sorted columnar index table sharded over a 1-D mesh."""
+@lru_cache(maxsize=256)
+def _dist_bounds(mesh, names, has_boxes, has_windows, extent):
+    """jit(shard_map): per-device per-slot bounds stats [D, M, 8]."""
+    axis = mesh.axis_names[0]
+
+    def body(bids, boxes, wins, *cols):
+        stats = aggregations.block_bounds(
+            tuple(c[0] for c in cols), bids[0], boxes, wins,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent,
+        )
+        return stats[None]
+
+    in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(axis), check_vma=False
+        )
+    )
+
+
+class DistributedIndexTable(IndexTable):
+    """Sorted columnar index table sharded over a 1-D mesh. Shares the
+    entire scan engine with IndexTable; only the layout and device hooks
+    differ."""
 
     def __init__(
         self,
         keyspace: IndexKeySpace,
         keys: WriteKeys,
         mesh: Mesh,
-        tile: int = DEFAULT_TILE,
+        tile: int | None = None,
     ):
-        super().__init__(keyspace, keys, tile)
         self.mesh = mesh
-        self.n_devices = mesh.devices.size
+        self.n_devices = int(mesh.devices.size)
+        self.axis = mesh.axis_names[0]
+        super().__init__(keyspace, keys, tile=tile)
+
+    # -- layout hooks ----------------------------------------------------
+    def _round_blocks(self, n_blocks: int) -> int:
         D = self.n_devices
+        return -(-n_blocks // D) * D
 
-        # pad tiles to a multiple of D, deal round-robin
-        n_tiles = max(1, -(-self.n // tile))
-        n_tiles = -(-n_tiles // D) * D
-        self.n_tiles = n_tiles
-        self.n_pad = n_tiles * tile
-        self.tiles_per_device = n_tiles // D
-        L = self.tiles_per_device * tile
-
-        cols = self.pad_cols(keys, self.n_pad)
-        # [n_tiles, tile] -> deal: stacked[d, j] = global tile j*D + d
-        deal = (
-            np.arange(n_tiles).reshape(self.tiles_per_device, D).T
-        )  # [D, tiles_per_device]
-        spec = NamedSharding(mesh, P(mesh.axis_names[0], None))
-        self.col_names = tuple(sorted(cols))
-        self.cols = {
-            k: jax.device_put(
-                cols[k].reshape(n_tiles, tile)[deal].reshape(D, L), spec
-            )
-            for k in self.col_names
-        }
-        self._shard_spec = spec
-        self._rep_spec = NamedSharding(mesh, P())
-
-    # -- pruning ---------------------------------------------------------
-    def candidate_tiles_per_device(self, config: ScanConfig) -> np.ndarray | None:
-        """[D, T_pad] local tile slots covering the scan ranges (-1 = pad),
-        or None when nothing matches. Global tile expansion is shared with
-        the single-device table (SortedKeys.candidate_tiles); only the
-        round-robin deal is distributed-specific."""
+    def _place_cols(self, cols: dict, device=None) -> None:
         D = self.n_devices
-        gtiles = self.candidate_tiles(config)
-        if len(gtiles) == 0:
-            return None
-        # global tile t -> (device t % D, local slot t // D)
-        per_dev = [gtiles[gtiles % D == d] // D for d in range(D)]
-        t_pad = pad_pow2(max(len(p) for p in per_dev), 4, factor=4)
-        out = np.full((D, t_pad), -1, dtype=np.int32)
-        for d, p in enumerate(per_dev):
-            out[d, : len(p)] = p
-        return out
+        nb = self.n_blocks
+        self.blocks_local = nb // D
+        # deal[d, j] = global block j*D + d
+        deal = np.arange(nb).reshape(self.blocks_local, D).T
+        spec = NamedSharding(self.mesh, P(self.axis))
+        self.cols3 = {}
+        for k, v in cols.items():
+            v4 = v.reshape(nb, self.sub, bk.LANES)[deal]  # [D, nb/D, SUB, L]
+            self.cols3[k] = jax.device_put(v4, spec)
 
-    # -- scanning --------------------------------------------------------
-    def _args(self, config: ScanConfig, tiles: np.ndarray):
-        boxes = (
-            kernels.pad_boxes(config.boxes)
-            if config.boxes is not None
-            else jnp.zeros((1, 4), jnp.float32)
-        )
-        windows = (
-            kernels.pad_windows(config.windows)
-            if config.windows is not None
-            else jnp.zeros((1, 3), jnp.int32)
-        )
-        tiles_dev = jax.device_put(tiles, self._shard_spec)
-        boxes = jax.device_put(boxes, self._rep_spec)
-        windows = jax.device_put(windows, self._rep_spec)
-        return tiles_dev, boxes, windows
-
-    def scan(self, config: ScanConfig, cap_hint: int = 4096) -> np.ndarray:
-        """Distributed scan; returns matching feature ordinals ascending in
-        table order, exactly matching the single-device result."""
-        if config.disjoint or self.n == 0:
-            return np.zeros(0, dtype=np.int64)
-        tiles = self.candidate_tiles_per_device(config)
-        if tiles is None:
-            return np.zeros(0, dtype=np.int64)
+    # -- candidate split -------------------------------------------------
+    def _split_blocks(self, blocks: np.ndarray, pad: int = 0):
+        """Global candidate blocks -> ([D, M] i32 local block ids padded to
+        one mesh-wide static bucket, per-device real counts [D]). Past the
+        largest bucket every device scans all its local blocks."""
         D = self.n_devices
-        has_boxes = config.boxes is not None
-        has_windows = config.windows is not None
-        max_possible = int((tiles >= 0).sum(axis=1).max()) * self.tile
-        cap = min(pad_pow2(cap_hint, 4096), pad_pow2(max_possible, 4096))
-        col_args = tuple(self.cols[k] for k in self.col_names)
-        while True:
-            fn = _build_scan(
-                self.mesh, self.col_names, self.tile, cap,
-                config.extent_mode, has_boxes, has_windows, False,
-            )
-            tiles_dev, boxes, windows = self._args(config, tiles)
-            cnt_all, rows_all = fn(tiles_dev, boxes, windows, *col_args)
-            cnt_all = np.asarray(cnt_all)
-            if cnt_all.max(initial=0) <= cap or cap >= max_possible:
-                break
-            cap = pad_pow2(int(cnt_all.max()), cap * 4)
-        rows_all = np.asarray(rows_all)
-        out: list[np.ndarray] = []
+        per = [blocks[blocks % D == d] // D for d in range(D)]
+        mx = max(len(p) for p in per)
+        if mx > bk.M_BUCKETS[-1]:
+            per = [np.arange(self.blocks_local, dtype=np.int64)] * D
+            mx = self.blocks_local
+        m = bk.bucket_of(mx)
+        bids2 = np.full((D, m), pad, np.int32)
+        n_real = np.zeros(D, np.int64)
+        for d, p in enumerate(per):
+            bids2[d, : len(p)] = p
+            n_real[d] = len(p)
+        return bids2, n_real
+
+    def _merge_device_rows(self, parts):
+        """[(rows, certain)] per device (each ascending) -> globally
+        ascending (rows, certain)."""
+        parts = [(r, c) for r, c in parts if len(r)]
+        if not parts:
+            return np.zeros(0, np.int64), np.zeros(0, bool)
+        rows = np.concatenate([r for r, _ in parts])
+        cert = np.concatenate([c for _, c in parts])
+        order = np.argsort(rows, kind="stable")
+        return rows[order], cert[order]
+
+    # -- device hooks ----------------------------------------------------
+    def _device_scan(self, blocks: np.ndarray, config: ScanConfig):
+        D = self.n_devices
+        bids2, n_real = self._split_blocks(blocks)
+        boxes, wins = self._params(config)
+        kw = self._kernel_kwargs(config)
+        fn = _dist_scan(self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        wide, inner = fn(bids2, boxes, wins, *self._cols_args())
+        wide_h, inner_h = jax.device_get((wide, inner))
+        wide_h, inner_h = np.asarray(wide_h), np.asarray(inner_h)
+        parts = []
         for d in range(D):
-            local = rows_all[d, : cnt_all[d]].astype(np.int64)
-            # local row -> global padded row: tile slot j, offset o
-            j, o = local // self.tile, local % self.tile
-            out.append((j * D + d) * self.tile + o)
-        rows = np.sort(np.concatenate(out)) if out else np.zeros(0, np.int64)
-        return self.perm[rows]
+            nr = int(n_real[d])
+            if nr == 0:
+                continue
+            gb = bids2[d].astype(np.int64) * D + d  # local slot -> global block
+            parts.append(bk.decode_bits_pair(wide_h[d], inner_h[d], gb, nr))
+        return self._merge_device_rows(parts)
 
-    def count(self, config: ScanConfig) -> int:
-        """Loose count via psum-merged per-device counts."""
-        if config.disjoint or self.n == 0:
-            return 0
-        tiles = self.candidate_tiles_per_device(config)
-        if tiles is None:
-            return 0
-        fn = _build_scan(
-            self.mesh, self.col_names, self.tile, 0,
-            config.extent_mode, config.boxes is not None,
-            config.windows is not None, True,
-        )
-        tiles_dev, boxes, windows = self._args(config, tiles)
-        (cnt_all,) = fn(tiles_dev, boxes, windows, *(self.cols[k] for k in self.col_names))
-        return int(np.asarray(cnt_all).sum())
+    def _device_pops(self, blocks: np.ndarray, config: ScanConfig):
+        D = self.n_devices
+        bids2, n_real = self._split_blocks(blocks, pad=-1)
+        boxes, wins = self._params(config)
+        kw = self._kernel_kwargs(config)
+        fn = _dist_pops(self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        pops2 = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args())))
+        pops, gbids = [], []
+        for d in range(D):
+            nr = int(n_real[d])
+            pops.append(pops2[d, :nr].astype(np.int64))
+            gbids.append(bids2[d, :nr].astype(np.int64) * D + d)
+        pops = np.concatenate(pops)
+        gbids = np.concatenate(gbids)
+        order = np.argsort(gbids)
+        return pops[order], gbids[order]
 
-    def density(
-        self, config: ScanConfig, bounds, width: int, height: int
-    ) -> np.ndarray:
-        """psum-merged density grid, equal to the single-device result."""
-        if config.disjoint or self.n == 0:
-            return np.zeros((height, width), dtype=np.float32)
-        tiles = self.candidate_tiles_per_device(config)
-        if tiles is None:
-            return np.zeros((height, width), dtype=np.float32)
-        fn = _build_density(
-            self.mesh, self.col_names, self.tile, width, height,
-            config.extent_mode, config.boxes is not None, config.windows is not None,
+    def _device_density(self, blocks, config, grid_bounds, width, height) -> np.ndarray:
+        bids2, _ = self._split_blocks(blocks, pad=-1)
+        boxes, wins = self._params(config)
+        kw = self._kernel_kwargs(config)
+        fn = _dist_density(
+            self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"],
+            width, height,
         )
-        tiles_dev, boxes, windows = self._args(config, tiles)
-        gb = jax.device_put(
-            jnp.asarray(np.asarray(bounds, dtype=np.float32)), self._rep_spec
-        )
-        grid = fn(tiles_dev, boxes, windows, gb, *(self.cols[k] for k in self.col_names))
-        return np.asarray(grid)
+        grid = fn(bids2, boxes, wins, grid_bounds, *self._cols_args())
+        return np.asarray(jax.device_get(grid))
 
-    @property
-    def nbytes_device(self) -> int:
-        return sum(int(v.nbytes) for v in self.cols.values())
+    def _device_bounds(self, blocks, config):
+        bids2, n_real = self._split_blocks(blocks, pad=-1)
+        boxes, wins = self._params(config)
+        kw = self._kernel_kwargs(config)
+        fn = _dist_bounds(self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        stats = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args())))
+        # fold only real slots from each device
+        parts = [stats[d, : int(n_real[d])] for d in range(self.n_devices)]
+        return aggregations.reduce_bounds(np.concatenate(parts), None)

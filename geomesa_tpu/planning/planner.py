@@ -238,11 +238,9 @@ class QueryPlanner:
         else:
             table = self.store.table(plan.type_name, plan.index)
             with exp.span(f"Device scan [{plan.index}]"):
-                res = table.scan(plan.config)
-            if isinstance(res, tuple):
-                ordinals, certain = res
-            else:  # distributed table: no certainty tier yet
-                ordinals, certain = res, None
+                # single-chip and distributed tables share one engine and
+                # one contract: (ordinals, certainty vector)
+                ordinals, certain = table.scan(plan.config)
             exp(f"Candidates: {len(ordinals)}")
             candidates = fc.take(ordinals)
 

@@ -1,7 +1,9 @@
 """Device scan kernels: the TPU analogue of the reference's server-side
-iterator/filter tier (Accumulo iterators, HBase filters — SURVEY.md §2.4).
+iterator/filter tier (Accumulo iterators, HBase filters — SURVEY.md §2.4):
+block-bitmask scans in ``block_kernels``, density/bounds/count push-downs
+in ``aggregations``.
 """
 
-from geomesa_tpu.scan.kernels import tile_scan, tile_count
+from geomesa_tpu.scan import aggregations, block_kernels
 
-__all__ = ["tile_scan", "tile_count"]
+__all__ = ["aggregations", "block_kernels"]

@@ -1,15 +1,32 @@
-"""Device-side aggregation kernels: density grids and scan statistics.
+"""Aggregation push-down over the block layout: density, bounds, counts.
 
 Reference: the server-side aggregating scans — DensityScan renders matching
 rows onto a pixel grid inside region servers (/root/reference/
 geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/iterators/
 DensityScan.scala:29-100 over utils/geom/RenderingGrid + GridSnap), and
-StatsScan folds stat sketches over rows (iterators/StatsScan.scala). The
-TPU inversion: the membership mask from the tile scan feeds a scatter-add
-onto the grid (one fused XLA program, no per-row iteration), and count /
-spatial-bounds statistics are masked reductions. Partial grids from
-sharded tables merge with `psum` (geomesa_tpu.parallel.dtable), the
-analogue of the client-side reducer merging coprocessor partials.
+StatsScan folds stat sketches over rows (iterators/StatsScan.scala).
+
+Same candidate-block contract as scan.block_kernels.block_scan: the host
+prunes the sorted table to candidate blocks, pads the id list to a static
+M bucket, and the device evaluates the shared wide predicate (``_masks``)
+over whole blocks — no per-row gathers (the round-2 design this replaces
+indexed ``cols[...][base]`` row-by-row, the access pattern measured at
+~1000x below stream bandwidth; see PERF.md).
+
+Two backends per kernel:
+- XLA (CPU tests + portability): one first-axis gather of candidate
+  blocks, then fused mask/reduce; block-granular gathers are contiguous
+  64 KB+ DMAs, not row gathers.
+- Pallas (TPU): scalar-prefetched block DMA; density accumulates the grid
+  in VMEM via an MXU one-hot matmul histogram (no scatter — TPU has no
+  fast vector scatter, but ``A^T @ B`` over one-hot pixel-coordinate
+  planes IS the histogram), bounds reduce per-block on the VPU.
+
+Pad slots are -1 (``pad_bids(..., pad=-1)``): the XLA path masks them out,
+the Pallas index map clamps them to block 0 and the kernel masks them.
+Sharded tables run these same kernels per shard under ``shard_map`` and
+merge with ``psum`` (geomesa_tpu.parallel.dtable), the analogue of the
+client-side reducer merging coprocessor partials.
 """
 
 from __future__ import annotations
@@ -18,82 +35,144 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from geomesa_tpu.scan.kernels import _tile_mask
+from geomesa_tpu.scan import block_kernels as bk
 
-
-def _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode):
-    """Shared prologue: membership mask + representative x/y per row.
-
-    Extent rows are represented by their bbox centroid (the exact
-    geometry-rendering path stays on host, mirroring the reference's
-    point-vs-shape split in DensityScan.getWeight)."""
-    m, base = _tile_mask(cols, tile_ids, boxes, windows, tile, extent_mode)
-    if extent_mode:
-        x = (cols["gxmin"][base] + cols["gxmax"][base]) * 0.5
-        y = (cols["gymin"][base] + cols["gymax"][base]) * 0.5
-    else:
-        x = cols["x"][base]
-        y = cols["y"][base]
-    return m, x, y
+# per-slot bounds stats lane layout: [count, xmin, xmax, ymin, ymax, 0...]
+STAT_LANES = 8
 
 
-@partial(jax.jit, static_argnames=("tile", "width", "height", "extent_mode"))
-def tile_density(
-    cols, tile_ids, boxes, windows, grid_bounds, *, tile, width, height, extent_mode=False
+def _rep_xy(cols: dict, extent: bool):
+    """Representative coordinates per row: the point, or the bbox centroid
+    for extent geometries (the point-vs-shape split of the reference's
+    DensityScan.getWeight; exact shape rendering stays on host)."""
+    if extent:
+        x = (cols["gxmin"] + cols["gxmax"]) * 0.5
+        y = (cols["gymin"] + cols["gymax"]) * 0.5
+        return x, y
+    return cols["x"], cols["y"]
+
+
+# ------------------------------------------------------------------ pops
+
+
+def block_pops(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent):
+    """[M] i32 wide-predicate hit count per candidate block slot (pads
+    included — the host slices [:n_real]). One fused program: the scan
+    kernel's wide plane popcounted and reduced on device, so a count-only
+    query pulls M ints, not M bit planes."""
+    kw = dict(
+        col_names=col_names, has_boxes=has_boxes, has_windows=has_windows, extent=extent
+    )
+    if bk.use_pallas():
+        return _pops_pallas(
+            cols3, bids, boxes, wins,
+            interpret=jax.default_backend() != "tpu", **kw,
+        )
+    return _pops_xla(cols3, bids, boxes, wins, **kw)
+
+
+def _popcount_slots(plane):
+    """[M, PACK, LANES] i32 bit plane -> [M] i32 set-bit counts."""
+    u = lax.bitcast_convert_type(plane, jnp.uint32)
+    return lax.population_count(u).sum(axis=(1, 2)).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "interpret"),
+)
+def _pops_pallas(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent, interpret):
+    wide, _ = bk._pallas_block_scan(
+        cols3, jnp.maximum(bids, 0), boxes, wins,
+        col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
+        extent=extent, interpret=interpret,
+    )
+    return _popcount_slots(wide)
+
+
+@partial(jax.jit, static_argnames=("col_names", "has_boxes", "has_windows", "extent"))
+def _pops_xla(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent):
+    wide, _ = bk._xla_block_scan(
+        cols3, jnp.maximum(bids, 0), boxes, wins,
+        col_names=col_names, has_boxes=has_boxes, has_windows=has_windows, extent=extent,
+    )
+    return _popcount_slots(wide)
+
+
+# --------------------------------------------------------------- density
+
+
+@partial(
+    jax.jit,
+    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "width", "height"),
+)
+def block_density(
+    cols3, bids, boxes, wins, grid_bounds, *,
+    col_names, has_boxes, has_windows, extent, width, height,
 ):
     """[height, width] f32 density grid over ``grid_bounds`` (x0,y0,x1,y1).
 
-    Each matching row inside the grid envelope adds weight 1 to its pixel
-    (reference GridSnap cell assignment). Rows outside the envelope are
-    dropped, not clamped — DensityScan only renders within the bounds.
+    Each wide-predicate hit inside the grid envelope adds weight 1 to its
+    pixel (reference GridSnap cell assignment; rows outside the envelope
+    are dropped, not clamped — DensityScan only renders within bounds).
+    bids: i32 [M], -1 = pad slot.
     """
-    return _density(cols, tile_ids, boxes, windows, grid_bounds, tile, width, height, extent_mode)
-
-
-def _density(cols, tile_ids, boxes, windows, grid_bounds, tile, width, height, extent_mode):
-    m, x, y = _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode)
-    x0, y0, x1, y1 = grid_bounds[0], grid_bounds[1], grid_bounds[2], grid_bounds[3]
-    m = m & (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    gathered = {n: c[jnp.maximum(bids, 0)] for n, c in zip(col_names, cols3)}
+    w, _ = bk._masks(gathered, boxes, wins, has_boxes, has_windows, extent)
+    x, y = _rep_xy(gathered, extent)
+    x0, y0 = grid_bounds[0], grid_bounds[1]
+    x1, y1 = grid_bounds[2], grid_bounds[3]
+    m = (
+        w
+        & (bids >= 0)[:, None, None]
+        & (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    )
     px = jnp.clip(((x - x0) / (x1 - x0) * width).astype(jnp.int32), 0, width - 1)
     py = jnp.clip(((y - y0) / (y1 - y0) * height).astype(jnp.int32), 0, height - 1)
-    flat = py * width + px
-    grid = jnp.zeros(height * width, jnp.float32).at[flat.ravel()].add(
+    flat = (py * width + px).ravel()
+    grid = jnp.zeros(height * width, jnp.float32).at[flat].add(
         m.ravel().astype(jnp.float32)
     )
     return grid.reshape(height, width)
 
 
-@partial(jax.jit, static_argnames=("tile", "width", "height", "extent_mode"))
-def block_density(cols3, tile_ids, boxes, windows, grid_bounds, *, tile, width, height, extent_mode=False):
-    """tile_density over the [n_blocks, SUB, 128] block layout (flattened
-    in-graph; the reshape is free inside XLA)."""
-    cols = {k: v.reshape(-1) for k, v in cols3.items()}
-    return _density(cols, tile_ids, boxes, windows, grid_bounds, tile, width, height, extent_mode)
+# ---------------------------------------------------------------- bounds
 
 
-@partial(jax.jit, static_argnames=("tile", "extent_mode"))
-def block_bounds_stats(cols3, tile_ids, boxes, windows, *, tile, extent_mode=False):
-    """tile_bounds_stats over the block layout."""
-    cols = {k: v.reshape(-1) for k, v in cols3.items()}
-    return _bounds_stats(cols, tile_ids, boxes, windows, tile, extent_mode)
-
-
-@partial(jax.jit, static_argnames=("tile", "extent_mode"))
-def tile_bounds_stats(cols, tile_ids, boxes, windows, *, tile, extent_mode=False):
-    """(count i32, xmin, xmax, ymin, ymax f32) over matching rows — the
-    device fast path for Count() / MinMax(geom) stat queries (reference
-    StatsScan with a Count/MinMax stat). Empty scans return inverted
-    (+inf, -inf) bounds."""
-    return _bounds_stats(cols, tile_ids, boxes, windows, tile, extent_mode)
-
-
-def _bounds_stats(cols, tile_ids, boxes, windows, tile, extent_mode):
-    m, x, y = _mask_xy(cols, tile_ids, boxes, windows, tile, extent_mode)
+@partial(jax.jit, static_argnames=("col_names", "has_boxes", "has_windows", "extent"))
+def block_bounds(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent):
+    """[M, STAT_LANES] f32 per-slot stats: lanes (count, xmin, xmax, ymin,
+    ymax, 0, 0, 0) over wide-predicate hits of each candidate block. The
+    host reduces over real slots — per-slot output needs no cross-step
+    accumulation and pad slots are simply ignored. Counts are exact in f32
+    (a block holds <= 2^24 rows)."""
+    gathered = {n: c[jnp.maximum(bids, 0)] for n, c in zip(col_names, cols3)}
+    w, _ = bk._masks(gathered, boxes, wins, has_boxes, has_windows, extent)
+    x, y = _rep_xy(gathered, extent)
     inf = jnp.float32(jnp.inf)
-    count = m.sum(dtype=jnp.int32)
-    xmin = jnp.where(m, x, inf).min()
-    xmax = jnp.where(m, x, -inf).max()
-    ymin = jnp.where(m, y, inf).min()
-    ymax = jnp.where(m, y, -inf).max()
-    return count, xmin, xmax, ymin, ymax
+    cnt = w.sum(axis=(1, 2), dtype=jnp.float32)
+    xmin = jnp.where(w, x, inf).min(axis=(1, 2))
+    xmax = jnp.where(w, x, -inf).max(axis=(1, 2))
+    ymin = jnp.where(w, y, inf).min(axis=(1, 2))
+    ymax = jnp.where(w, y, -inf).max(axis=(1, 2))
+    zero = jnp.zeros_like(cnt)
+    return jnp.stack([cnt, xmin, xmax, ymin, ymax, zero, zero, zero], axis=1)
+
+
+def reduce_bounds(stats, n_real: int):
+    """Host-side fold of [M, STAT_LANES] per-slot stats (possibly
+    concatenated across shards) -> (count, (xmin, ymin, xmax, ymax) | None)."""
+    import numpy as np
+
+    s = np.asarray(stats)[:n_real] if n_real is not None else np.asarray(stats)
+    if len(s) == 0:
+        return 0, None
+    cnt = int(s[:, 0].sum())
+    if cnt == 0:
+        return 0, None
+    return cnt, (
+        float(s[:, 1].min()), float(s[:, 3].min()),
+        float(s[:, 2].max()), float(s[:, 4].max()),
+    )

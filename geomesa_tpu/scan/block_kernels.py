@@ -388,22 +388,32 @@ def _bids_sorted(bids: np.ndarray, n_real: int) -> bool:
     return bool(np.all(b[1:] > b[:-1])) if len(b) > 1 else True
 
 
-def pad_bids(blocks: np.ndarray, n_blocks_table: int) -> tuple[np.ndarray, int]:
-    """Pad a sorted block-id list to the next static M bucket (pads repeat
-    block 0; decode ignores them). Returns (padded [M] i32, n_real).
-
-    Beyond the largest bucket the caller passes the full block list; the
-    bucket is then the next power of two >= n_blocks_table — still one
-    static shape per table."""
-    n = len(blocks)
+def bucket_of(n: int) -> int:
+    """Static M bucket for an n-block candidate list: the smallest fixed
+    bucket >= n, or the next power of two past the largest bucket (full
+    scans — still one static shape per table)."""
     for m in M_BUCKETS:
         if n <= m:
-            out = np.zeros(m, np.int32)
-            out[:n] = blocks
-            return out, n
-    m = 1
+            return m
+    m = M_BUCKETS[-1]
     while m < n:
         m *= 2
-    out = np.zeros(m, np.int32)
+    return m
+
+
+def pad_bids(
+    blocks: np.ndarray, n_blocks_table: int, pad: int = 0, bucket: int | None = None
+) -> tuple[np.ndarray, int]:
+    """Pad a sorted block-id list to a static M bucket. Returns
+    (padded [M] i32, n_real).
+
+    ``pad=0`` repeats block 0 (scan kernels: the decode ignores pad slots);
+    ``pad=-1`` marks pads explicitly (aggregation kernels: the mask drops
+    them, the Pallas index map clamps them to 0). ``bucket`` forces the
+    bucket — the distributed table pads every device's list to the same M.
+    """
+    n = len(blocks)
+    m = bucket if bucket is not None else bucket_of(n)
+    out = np.full(m, pad, np.int32)
     out[:n] = blocks
     return out, n
