@@ -24,6 +24,7 @@ import numpy as np
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include, PointColumn
 from geomesa_tpu.index import AttributeIndex, S2Index, S3Index, XZ2Index, XZ3Index, Z2Index, Z3Index
+from geomesa_tpu.planning.errors import check_deadline
 from geomesa_tpu.planning.explain import Explainer
 from geomesa_tpu.planning.planner import QueryPlanner
 from geomesa_tpu.sft import FeatureType
@@ -57,12 +58,15 @@ class DataStore:
         audit=None,
         metrics=None,
         auths: Sequence[str] | None = None,
+        query_timeout: float | None = None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh``; when given, index
         tables shard over it and scans run as shard_map collectives
         (geomesa_tpu.parallel). ``guards``/``interceptors`` are
         geomesa_tpu.planning.guards hooks; ``audit`` an AuditWriter;
-        ``metrics`` a MetricsRegistry."""
+        ``metrics`` a MetricsRegistry. ``query_timeout``: default per-query
+        wall-clock budget in seconds (QueryTimeout when exceeded; a
+        QueryHints.timeout overrides it per query)."""
         self._schemas: dict[str, FeatureType] = {}
         # features live as a list of write-batch chunks (LSM memtable
         # pattern): writes append O(batch); the concatenated view is built
@@ -93,6 +97,7 @@ class DataStore:
         # None = security disabled; [] = only public rows (reference
         # AuthorizationsProvider semantics)
         self.auths = auths
+        self.query_timeout = query_timeout
         self.planner = QueryPlanner(self)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
@@ -471,6 +476,14 @@ class DataStore:
             )
 
     # -- aggregation push-down (reference iterators/ + coprocessor tier) --
+    def _agg_deadline(self):
+        """Deadline for a device aggregation call from the store default
+        (aggregation entry points take no hints; the device call itself is
+        uninterruptible, so the check lands at the next stage boundary)."""
+        from geomesa_tpu.planning.errors import deadline_from
+
+        return deadline_from(self.query_timeout)
+
     def density(
         self,
         type_name: str,
@@ -510,8 +523,10 @@ class DataStore:
             if cfg.disjoint:
                 self.record_query(plan, 0, 0.0)
                 return np.zeros((height, width), dtype=np.float32)
+            deadline = self._agg_deadline()
             t0 = time.perf_counter()
             grid = self.table(type_name, plan.index).density(cfg, envelope, width, height)
+            check_deadline(deadline, "density scan")
             self.record_query(plan, int(grid.sum()), time.perf_counter() - t0)
             return grid
         out = self.planner.execute(plan)
@@ -548,12 +563,14 @@ class DataStore:
                     plan.filter, plan.config, self._schemas[type_name]
                 )
             ):
+                deadline = self._agg_deadline()
                 t0 = time.perf_counter()
                 n = (
                     0
                     if plan.config.disjoint
                     else self.table(type_name, plan.index).count(plan.config)
                 )
+                check_deadline(deadline, "count scan")
                 self.record_query(plan, n, time.perf_counter() - t0)
                 out = []
                 for _ in terms:
@@ -592,8 +609,10 @@ class DataStore:
                 self.record_query(plan, 0, 0.0)
                 return None
             if hasattr(table, "bounds_stats"):
+                deadline = self._agg_deadline()
                 t0 = time.perf_counter()
                 cnt, env = table.bounds_stats(plan.config)
+                check_deadline(deadline, "bounds scan")
                 self.record_query(plan, cnt, time.perf_counter() - t0)
                 return env
         out = self.planner.execute(plan)

@@ -69,12 +69,12 @@ def _dist_pops(mesh, names, has_boxes, has_windows, extent):
     axis = mesh.axis_names[0]
 
     def body(bids, boxes, wins, *cols):
-        w, _ = bk.block_scan(
+        pops = aggregations.block_pops(
             tuple(c[0] for c in cols), jax.numpy.maximum(bids[0], 0), boxes, wins,
             col_names=names, has_boxes=has_boxes, has_windows=has_windows,
             extent=extent,
         )
-        return aggregations._popcount_slots(w)[None]
+        return pops[None]
 
     in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
     return jax.jit(

@@ -1,0 +1,30 @@
+"""Planning/execution errors and the deadline check — a leaf module so the
+storage layer can enforce deadlines without importing the planner
+(planner -> storage is the real dependency direction)."""
+
+from __future__ import annotations
+
+import time
+
+
+class QueryGuardError(Exception):
+    """A query guard rejected the plan (reference planning/guard/)."""
+
+
+class QueryTimeout(Exception):
+    """A query exceeded its deadline (reference per-plan timeouts +
+    ThreadManagement.scala: scans are registered with a timeout and killed
+    when overdue; here the single-controller design checks wall-clock at
+    every stage boundary — before/after each device call and around host
+    refinement — and aborts the query)."""
+
+
+def check_deadline(deadline: float | None, stage: str) -> None:
+    """Raise QueryTimeout when a monotonic deadline has passed."""
+    if deadline is not None and time.monotonic() > deadline:
+        raise QueryTimeout(f"query deadline exceeded during {stage}")
+
+
+def deadline_from(timeout: float | None) -> float | None:
+    """Monotonic cutoff for a timeout in seconds, or None."""
+    return None if timeout is None else time.monotonic() + timeout

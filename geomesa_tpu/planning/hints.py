@@ -28,6 +28,10 @@ class QueryHints:
     - ``loose``: accept the widened device mask without exact host
       refinement of spatial/temporal predicates — the reference's
       LOOSE_BBOX fast path. Non-indexed predicates are still applied.
+    - ``timeout``: wall-clock budget in seconds for this query; checked at
+      stage boundaries, raises QueryTimeout when exceeded (reference
+      per-plan timeouts + ThreadManagement scan registration). Overrides
+      the store-level ``query_timeout`` default.
     """
 
     transforms: Optional[Sequence[str]] = None
@@ -35,7 +39,10 @@ class QueryHints:
     sample: Optional[float] = None
     sample_by: Optional[str] = None
     loose: bool = False
+    timeout: Optional[float] = None
 
     def validate(self) -> None:
         if self.sample is not None and not (0.0 < self.sample <= 1.0):
             raise ValueError(f"sample must be in (0, 1], got {self.sample}")
+        if self.timeout is not None and self.timeout <= 0:
+            raise ValueError(f"timeout must be positive, got {self.timeout}")

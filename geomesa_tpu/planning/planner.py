@@ -64,8 +64,11 @@ class QueryPlan:
         return self.index
 
 
-class QueryGuardError(Exception):
-    """A query guard rejected the plan (reference planning/guard/)."""
+# re-exported for existing importers; the definitions live in the leaf
+# module planning.errors so the storage layer can use them too
+from geomesa_tpu.planning.errors import (  # noqa: E402
+    QueryGuardError, QueryTimeout, check_deadline, deadline_from,
+)
 
 
 def _filter_leaf_kinds(
@@ -215,6 +218,13 @@ class QueryPlanner:
         self.store.record_query(plan, len(out), time.perf_counter() - t0)
         return out
 
+    def _deadline(self, hints) -> float | None:
+        """Monotonic cutoff from the hint timeout or the store default."""
+        timeout = getattr(hints, "timeout", None) if hints is not None else None
+        if timeout is None:
+            timeout = getattr(self.store, "query_timeout", None)
+        return deadline_from(timeout)
+
     def _execute(
         self,
         plan: QueryPlan,
@@ -223,14 +233,19 @@ class QueryPlanner:
     ) -> FeatureCollection:
         exp = explain or ExplainNull()
         fc = self.store.features(plan.type_name)
+        if hints is not None:
+            hints.validate()
+        deadline = self._deadline(hints)
 
         certain = None
         if plan.ids is not None:  # id lookup
             ordinals = self.store.id_lookup(plan.type_name, plan.ids)
             candidates = fc.take(ordinals)
         elif plan.index is None:  # full host scan
+            check_deadline(deadline, "full-table scan start")
             with exp.span("Full-table host scan"):
                 mask = plan.filter.evaluate(fc.batch)
+            check_deadline(deadline, "full-table scan")
             return self._post(fc.mask(mask), plan, hints, exp)
         elif plan.index is not None and len(fc) == 0:
             # schema exists but nothing written yet: no index tables
@@ -240,7 +255,7 @@ class QueryPlanner:
             with exp.span(f"Device scan [{plan.index}]"):
                 # single-chip and distributed tables share one engine and
                 # one contract: (ordinals, certainty vector)
-                ordinals, certain = table.scan(plan.config)
+                ordinals, certain = table.scan(plan.config, deadline=deadline)
             exp(f"Candidates: {len(ordinals)}")
             candidates = fc.take(ordinals)
 
@@ -261,15 +276,18 @@ class QueryPlanner:
             unc = np.flatnonzero(~certain)
             exp(f"Refinement: {len(unc)} uncertain of {len(certain)} candidates")
             if len(unc):
+                check_deadline(deadline, "boundary refinement start")
                 with exp.span("Boundary refinement"):
                     sub_mask = plan.filter.evaluate(candidates.take(unc).batch)
                 keep = certain.copy()
                 keep[unc] = sub_mask
                 candidates = candidates.mask(keep)
         elif not isinstance(plan.filter, Include):
+            check_deadline(deadline, "residual refinement start")
             with exp.span("Residual filter refinement"):
                 mask = plan.filter.evaluate(candidates.batch)
             candidates = candidates.mask(mask)
+        check_deadline(deadline, "refinement")
         return self._post(candidates, plan, hints, exp)
 
     def _post(self, out: FeatureCollection, plan, hints, exp):
@@ -288,8 +306,7 @@ class QueryPlanner:
                 out = out.mask(visibility_mask(out.columns[vis_field], auths))
                 exp(f"Visibility filter: {len(out)} visible")
         exp(f"Hits: {len(out)}")
-        if hints is not None:
-            hints.validate()
+        if hints is not None:  # validated at _execute entry
             if hints.sample is not None:
                 out = out.sample(hints.sample, hints.sample_by)
                 exp(f"Sampled: {len(out)}")

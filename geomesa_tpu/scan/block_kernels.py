@@ -227,6 +227,18 @@ def _masks(cols: dict, boxes, wins, has_boxes: bool, has_windows: bool, extent: 
             )
         w_parts.append(wide)
         i_parts.append(inner)
+    if not w_parts:
+        # no predicate at all (INCLUDE-filter aggregations): the mask is
+        # the row-validity test — table pad rows carry sentinels that must
+        # not pollute counts/bounds. No constraint means every valid row is
+        # a certain hit.
+        if "x" in cols:
+            v = jnp.isfinite(cols["x"])
+        elif "gxmin" in cols:
+            v = jnp.isfinite(cols["gxmin"])
+        else:
+            v = cols["tbin"] >= 0
+        return v, v
     w = w_parts[0]
     i = i_parts[0]
     for p, q in zip(w_parts[1:], i_parts[1:]):

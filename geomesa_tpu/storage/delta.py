@@ -106,8 +106,8 @@ class TieredTable:
             return np.zeros(0, np.int64)
         return self.base + np.flatnonzero(delta_wide_mask(config, self.delta))
 
-    def scan(self, config: ScanConfig):
-        ordinals, certain = self.main.scan(config)
+    def scan(self, config: ScanConfig, deadline=None):
+        ordinals, certain = self.main.scan(config, deadline=deadline)
         d = self._delta_hits(config)
         if len(d) == 0:
             return ordinals, certain

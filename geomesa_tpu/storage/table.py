@@ -31,6 +31,7 @@ from __future__ import annotations
 import numpy as np
 
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys
+from geomesa_tpu.planning.errors import check_deadline
 from geomesa_tpu.scan import block_kernels as bk
 
 
@@ -238,9 +239,14 @@ class IndexTable(SortedKeys):
         - ``certain``: per-row True when the row is a guaranteed f64-exact
           hit of the index's spatial/temporal constraint (inner predicate or
           contained range) — the planner refines only the rest.
+
+        ``deadline``: optional ``time.monotonic()`` cutoff; the scan checks
+        it at stage boundaries and raises QueryTimeout when overdue
+        (reference ThreadManagement scan timeouts).
         """
         if config.disjoint or self.n == 0:
             return np.zeros(0, np.int64), np.zeros(0, bool)
+        check_deadline(deadline, "range pruning")
         overlap, contained = self.candidate_spans_split(config)
         cont_rows = _span_rows(contained)
         has_pred = config.boxes is not None or config.windows is not None
@@ -254,7 +260,9 @@ class IndexTable(SortedKeys):
         if len(blocks) == 0:
             return self.perm[cont_rows].astype(np.int64), np.ones(len(cont_rows), bool)
 
+        check_deadline(deadline, "device scan dispatch")
         rows, certain = self._device_scan(blocks, config)
+        check_deadline(deadline, "bitmask decode")
         if config.clip_rows:
             keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
             rows, certain = rows[keep], certain[keep]

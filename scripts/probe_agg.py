@@ -1,0 +1,257 @@
+"""Probe: aggregation-kernel candidates on real TPU.
+
+Measures, per candidate-block count M over a [nb, SUB, 128] point table:
+  scan   - the round-3 bitmask scan kernel (reference point)
+  xd     - XLA block-gather density (gather + scatter-add)
+  xb     - XLA block-gather bounds (gather + masked reduce)
+  pb     - Pallas bounds: block DMA + VPU reduce, per-slot [1,128] out
+  pd_r   - Pallas density: one-hot MXU matmul, chunked via reshape
+           (CH,128)->(1,CH*128)  [tests whether Mosaic takes the reshape]
+  pd_f   - Pallas density: one-hot MXU matmul, fori over sublanes
+
+Run on TPU:  python scripts/probe_agg.py
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from geomesa_tpu.scan import block_kernels as bk
+
+LANES = 128
+SUB = 128
+H = W = 256
+CH = 32  # sublanes per matmul chunk in pd_r
+
+
+def timeit(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+# ---------------------------------------------------------------- pallas
+def _mask_px_py(x_ref, y_ref, boxes_ref, gb_ref, bid_ok):
+    x = x_ref[0]
+    y = y_ref[0]
+    w = jnp.zeros(x.shape, dtype=jnp.bool_)
+    for k in range(8):
+        w |= (
+            (x >= boxes_ref[k, 0]) & (x <= boxes_ref[k, 2])
+            & (y >= boxes_ref[k, 1]) & (y <= boxes_ref[k, 3])
+        )
+    x0, y0, x1, y1 = gb_ref[0, 0], gb_ref[0, 1], gb_ref[0, 2], gb_ref[0, 3]
+    m = w & bid_ok & (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    px = jnp.clip(((x - x0) / (x1 - x0) * W).astype(jnp.int32), 0, W - 1)
+    py = jnp.clip(((y - y0) / (y1 - y0) * H).astype(jnp.int32), 0, H - 1)
+    return m, px, py
+
+
+def _density_kernel_reshape(bids_ref, boxes_ref, gb_ref, x_ref, y_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    m, px, py = _mask_px_py(x_ref, y_ref, boxes_ref, gb_ref, bids_ref[i] >= 0)
+    pix_y = jnp.where(m, py, -1)  # -1 never matches an iota row
+    acc = jnp.zeros((H, W), jnp.float32)
+    for c in range(SUB // CH):
+        yy = pix_y[c * CH : (c + 1) * CH, :].reshape(1, CH * LANES)
+        xx = px[c * CH : (c + 1) * CH, :].reshape(1, CH * LANES)
+        ay = (lax.broadcasted_iota(jnp.int32, (H, CH * LANES), 0) == yy).astype(
+            jnp.bfloat16
+        )
+        ax = (lax.broadcasted_iota(jnp.int32, (W, CH * LANES), 0) == xx).astype(
+            jnp.bfloat16
+        )
+        acc += lax.dot_general(
+            ay, ax, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    out_ref[...] += acc
+
+
+def _density_kernel_fori(bids_ref, boxes_ref, gb_ref, x_ref, y_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    m, px, py = _mask_px_py(x_ref, y_ref, boxes_ref, gb_ref, bids_ref[i] >= 0)
+    pix_y = jnp.where(m, py, -1)
+
+    def body(s, acc):
+        yy = lax.dynamic_slice(pix_y, (s, 0), (1, LANES))
+        xx = lax.dynamic_slice(px, (s, 0), (1, LANES))
+        ay = (lax.broadcasted_iota(jnp.int32, (H, LANES), 0) == yy).astype(jnp.bfloat16)
+        ax = (lax.broadcasted_iota(jnp.int32, (W, LANES), 0) == xx).astype(jnp.bfloat16)
+        return acc + lax.dot_general(
+            ay, ax, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    out_ref[...] += lax.fori_loop(0, SUB, body, jnp.zeros((H, W), jnp.float32))
+
+
+def _bounds_kernel(bids_ref, boxes_ref, gb_ref, x_ref, y_ref, out_ref):
+    x = x_ref[0]
+    y = y_ref[0]
+    w = jnp.zeros(x.shape, dtype=jnp.bool_)
+    for k in range(8):
+        w |= (
+            (x >= boxes_ref[k, 0]) & (x <= boxes_ref[k, 2])
+            & (y >= boxes_ref[k, 1]) & (y <= boxes_ref[k, 3])
+        )
+    inf = jnp.float32(jnp.inf)
+    row = jnp.zeros((1, LANES), jnp.float32)
+    row = row.at[0, 0].set(w.sum(dtype=jnp.float32))
+    row = row.at[0, 1].set(jnp.where(w, x, inf).min())
+    row = row.at[0, 2].set(jnp.where(w, x, -inf).max())
+    row = row.at[0, 3].set(jnp.where(w, y, inf).min())
+    row = row.at[0, 4].set(jnp.where(w, y, -inf).max())
+    out_ref[...] = row
+
+
+def make_pallas(kernel, out_shape, out_block, M):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(M,),
+        in_specs=[
+            pl.BlockSpec((8, LANES), lambda i, bids: (0, 0)),
+            pl.BlockSpec((1, LANES), lambda i, bids: (0, 0)),
+            pl.BlockSpec((1, SUB, LANES), lambda i, bids: (jnp.maximum(bids[i], 0), 0, 0)),
+            pl.BlockSpec((1, SUB, LANES), lambda i, bids: (jnp.maximum(bids[i], 0), 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(out_block[0], out_block[1]),
+    )
+    return jax.jit(
+        lambda bids, boxes, gb, xs, ys: pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape
+        )(bids, boxes, gb, xs, ys)
+    )
+
+
+# ------------------------------------------------------------------ xla
+@jax.jit
+def xla_density(bids, boxes, gb, xs, ys):
+    x = xs[jnp.maximum(bids, 0)]
+    y = ys[jnp.maximum(bids, 0)]
+    w = jnp.zeros(x.shape, dtype=jnp.bool_)
+    for k in range(8):
+        w |= (
+            (x >= boxes[k, 0]) & (x <= boxes[k, 2])
+            & (y >= boxes[k, 1]) & (y <= boxes[k, 3])
+        )
+    x0, y0, x1, y1 = gb[0, 0], gb[0, 1], gb[0, 2], gb[0, 3]
+    m = w & (bids >= 0)[:, None, None] & (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    px = jnp.clip(((x - x0) / (x1 - x0) * W).astype(jnp.int32), 0, W - 1)
+    py = jnp.clip(((y - y0) / (y1 - y0) * H).astype(jnp.int32), 0, H - 1)
+    flat = (py * W + px).ravel()
+    return (
+        jnp.zeros(H * W, jnp.float32).at[flat].add(m.ravel().astype(jnp.float32))
+    ).reshape(H, W)
+
+
+@jax.jit
+def xla_bounds(bids, boxes, gb, xs, ys):
+    x = xs[jnp.maximum(bids, 0)]
+    y = ys[jnp.maximum(bids, 0)]
+    w = jnp.zeros(x.shape, dtype=jnp.bool_)
+    for k in range(8):
+        w |= (
+            (x >= boxes[k, 0]) & (x <= boxes[k, 2])
+            & (y >= boxes[k, 1]) & (y <= boxes[k, 3])
+        )
+    inf = jnp.float32(jnp.inf)
+    return jnp.stack(
+        [
+            w.sum(axis=(1, 2), dtype=jnp.float32),
+            jnp.where(w, x, inf).min(axis=(1, 2)),
+            jnp.where(w, x, -inf).max(axis=(1, 2)),
+            jnp.where(w, y, inf).min(axis=(1, 2)),
+            jnp.where(w, y, -inf).max(axis=(1, 2)),
+        ],
+        axis=1,
+    )
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    nb = 4096  # 67M rows
+    rng = np.random.default_rng(0)
+    xs = jax.device_put(
+        rng.uniform(-180, 180, nb * SUB * LANES).astype(np.float32).reshape(nb, SUB, LANES)
+    )
+    ys = jax.device_put(
+        rng.uniform(-90, 90, nb * SUB * LANES).astype(np.float32).reshape(nb, SUB, LANES)
+    )
+    boxes = bk.pack_boxes(np.array([[-40.0, -30.0, 60.0, 40.0]]), None)
+    gb = np.zeros((1, LANES), np.float32)
+    gb[0, :4] = [-40, -30, 60, 40]
+
+    for M in (256, 1024):
+        bids, _ = bk.pad_bids(
+            np.sort(rng.choice(nb, M, replace=False)), nb, pad=-1, bucket=M
+        )
+        # reference: bitmask scan
+        cols3 = (xs, ys)
+        t_scan = timeit(
+            lambda b: bk.block_scan(
+                cols3, jnp.maximum(jnp.asarray(b), 0), jnp.asarray(boxes),
+                jnp.zeros((8, LANES), jnp.int32),
+                col_names=("x", "y"), has_boxes=True, has_windows=False, extent=False,
+            ),
+            bids,
+        )
+        t_xd = timeit(xla_density, bids, boxes, gb, xs, ys)
+        t_xb = timeit(xla_bounds, bids, boxes, gb, xs, ys)
+        print(f"M={M}: scan={t_scan*1e3:.2f}ms xla_density={t_xd*1e3:.2f}ms xla_bounds={t_xb*1e3:.2f}ms", flush=True)
+
+        pb = make_pallas(
+            _bounds_kernel,
+            jax.ShapeDtypeStruct((M, LANES), jnp.float32),
+            ((1, LANES), lambda i, bids: (i, 0)),
+            M,
+        )
+        try:
+            t_pb = timeit(pb, bids, boxes, gb, xs, ys)
+            ok = np.allclose(np.asarray(pb(bids, boxes, gb, xs, ys))[:, :5],
+                             np.asarray(xla_bounds(bids, boxes, gb, xs, ys)), atol=1e-3)
+            print(f"M={M}: pallas_bounds={t_pb*1e3:.2f}ms match={ok}", flush=True)
+        except Exception as e:
+            print(f"M={M}: pallas_bounds FAILED: {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+        for name, kern in (("pd_reshape", _density_kernel_reshape), ("pd_fori", _density_kernel_fori)):
+            pd = make_pallas(
+                kern,
+                jax.ShapeDtypeStruct((H, W), jnp.float32),
+                ((H, W), lambda i, bids: (0, 0)),
+                M,
+            )
+            try:
+                t_pd = timeit(pd, bids, boxes, gb, xs, ys)
+                ok = np.allclose(np.asarray(pd(bids, boxes, gb, xs, ys)),
+                                 np.asarray(xla_density(bids, boxes, gb, xs, ys)))
+                print(f"M={M}: {name}={t_pd*1e3:.2f}ms match={ok}", flush=True)
+            except Exception as e:
+                print(f"M={M}: {name} FAILED: {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

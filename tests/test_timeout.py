@@ -1,0 +1,61 @@
+"""Scan deadline enforcement (reference ThreadManagement + per-plan
+timeouts): queries carry a wall-clock budget and abort with QueryTimeout
+at the next stage boundary once overdue."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu.planning.hints import QueryHints
+from geomesa_tpu.planning.planner import QueryTimeout
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(4)
+    n = 5000
+    sft = FeatureType.from_spec("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+    store = DataStore()
+    store.create_schema(sft)
+    t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    fc = FeatureCollection.from_columns(
+        sft, [str(i) for i in range(n)],
+        {"name": np.array([f"n{i % 7}" for i in range(n)]),
+         "dtg": t0 + rng.integers(0, 86400_000 * 20, n),
+         "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+    )
+    store.write("t", fc)
+    return store
+
+
+Q = "bbox(geom, -10, -10, 10, 10) AND dtg DURING 2024-01-02T00:00:00Z/2024-01-09T00:00:00Z"
+
+
+class TestQueryTimeout:
+    def test_tiny_deadline_indexed_scan_raises(self, ds):
+        with pytest.raises(QueryTimeout):
+            ds.query("t", Q, hints=QueryHints(timeout=1e-9))
+
+    def test_tiny_deadline_full_scan_raises(self, ds):
+        # LIKE on a non-indexed attribute -> full host scan path
+        with pytest.raises(QueryTimeout):
+            ds.query("t", "name LIKE 'n%'", hints=QueryHints(timeout=1e-9))
+
+    def test_generous_deadline_unaffected(self, ds):
+        out = ds.query("t", Q, hints=QueryHints(timeout=60.0))
+        assert len(out) == len(ds.query("t", Q))
+
+    def test_store_default_timeout(self, ds):
+        ds.query_timeout = 1e-9
+        try:
+            with pytest.raises(QueryTimeout):
+                ds.query("t", Q)
+            # per-query hint overrides the store default
+            out = ds.query("t", Q, hints=QueryHints(timeout=60.0))
+            assert len(out) > 0
+        finally:
+            ds.query_timeout = None
+
+    def test_invalid_timeout_rejected(self, ds):
+        with pytest.raises(ValueError):
+            ds.query("t", Q, hints=QueryHints(timeout=-1))
