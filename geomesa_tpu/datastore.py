@@ -287,9 +287,17 @@ class DataStore:
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted device tables (LSM minor
         compaction; the reference's backends compact SSTables server-side).
-        Also collapses the feature chunks into one collection."""
-        from geomesa_tpu.storage.delta import concat_keys
+        Also collapses the feature chunks into one collection.
 
+        Single-chip tables take the partition-preserving merge path
+        (storage.table.merged_table): only the delta is sorted and only
+        device blocks past the first insertion point re-upload — the
+        TimePartition analogue. Mesh tables rebuild (the round-robin block
+        deal re-homes every block when rows shift)."""
+        from geomesa_tpu.storage.delta import concat_keys
+        from geomesa_tpu.storage.table import merged_table
+
+        main_rows = self._main_rows.get(type_name, 0)
         full = self.features(type_name)
         self._chunks[type_name] = [full] if len(full) else []
         kwargs: dict = {"tile": self.tile} if self.tile else {}
@@ -299,10 +307,20 @@ class DataStore:
                 continue
             keys = concat_keys(parts)
             self._key_chunks[(type_name, idx.name)] = [keys]
+            old = self._tables.get((type_name, idx.name))
+            if old is not None and old.n == len(keys.zs) == main_rows:
+                continue  # empty delta: the resident table is already current
             if self.mesh is not None:
                 from geomesa_tpu.parallel import DistributedIndexTable
 
                 table = DistributedIndexTable(idx, keys, self.mesh, **kwargs)
+            elif (
+                isinstance(old, IndexTable)
+                and old.n == main_rows
+                and 0 < main_rows < len(keys.zs)
+            ):
+                delta = _slice_keys(keys, main_rows)
+                table = merged_table(old, keys, delta, **kwargs)
             else:
                 table = IndexTable(idx, keys, **kwargs)
             self._tables[(type_name, idx.name)] = table

@@ -149,6 +149,7 @@ class DistributedIndexTable(IndexTable):
         return -(-n_blocks // D) * D
 
     def _place_cols(self, cols: dict, device=None) -> None:
+        self.rows_uploaded = self.n_pad  # mesh tables always re-deal
         D = self.n_devices
         nb = self.n_blocks
         self.blocks_local = nb // D

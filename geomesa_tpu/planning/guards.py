@@ -66,7 +66,7 @@ class FullTableScanGuard:
     "everything" query — is allowed, matching the reference."""
 
     def guard(self, plan: QueryPlan, sft) -> None:
-        if plan.index is None and plan.ids is None and not isinstance(plan.filter, Include):
+        if plan.strategy == "full-scan" and not isinstance(plan.filter, Include):
             raise QueryGuardError(
                 f"query on {plan.type_name!r} requires a full-table scan, "
                 "which is disabled"

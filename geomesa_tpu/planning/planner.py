@@ -54,9 +54,14 @@ class QueryPlan:
     ids: Optional[list] = None  # id-lookup plan
     limit: Optional[int] = None
     planning_s: float = 0.0  # wall-clock spent planning (audit/metrics)
+    # multi-index union plan (reference FilterSplitter OR options): each
+    # sub-plan scans one DNF disjunct on its own index; results dedup-union
+    union: Optional[list["QueryPlan"]] = None
 
     @property
     def strategy(self) -> str:
+        if self.union is not None:
+            return "union(" + "+".join(p.strategy for p in self.union) + ")"
         if self.ids is not None:
             return "id-lookup"
         if self.index is None:
@@ -160,6 +165,49 @@ class QueryPlanner:
     def _select(
         self, type_name: str, f: Filter, limit: Optional[int], exp
     ) -> QueryPlan:
+        plan = self._select_single(type_name, f, limit, exp)
+        if plan.index is not None or plan.ids is not None:
+            return plan
+        # no single index serves the whole filter: try a multi-index union
+        # over the DNF disjuncts (reference FilterSplitter.scala:61-147)
+        union = self._select_union(type_name, f, limit, exp)
+        return union if union is not None else plan
+
+    def _select_union(
+        self, type_name: str, f: Filter, limit: Optional[int], exp
+    ) -> Optional[QueryPlan]:
+        from geomesa_tpu.filter.dnf import rewrite_dnf
+
+        disjuncts = rewrite_dnf(f)
+        if disjuncts is None or len(disjuncts) < 2:
+            return None
+        subs: list[QueryPlan] = []
+        for d in disjuncts:
+            sp = self._select_single(type_name, d, None, exp)
+            if sp.config is not None and sp.config.disjoint:
+                exp("Union: disjunct unsatisfiable, dropped")
+                continue  # contributes nothing to the union
+            if sp.index is None and sp.ids is None:
+                exp("Union: a disjunct needs a full scan -> single-scan plan")
+                return None  # one full scan beats full scan + index scans
+            subs.append(sp)
+        if not subs:
+            return QueryPlan(type_name, f, None, ScanConfig.empty("union"), ids=[])
+        if len(subs) == 1:
+            # every other disjunct was unsatisfiable: the live branch IS the
+            # query (its disjunct filter is equivalent to the whole filter)
+            exp(f"Strategy: {subs[0].strategy} (other disjuncts unsatisfiable)")
+            subs[0].limit = limit
+            return subs[0]
+        exp(
+            f"Strategy: union of {len(subs)} index scans ("
+            + ", ".join(s.strategy for s in subs) + ")"
+        )
+        return QueryPlan(type_name, f, None, None, limit=limit, union=subs)
+
+    def _select_single(
+        self, type_name: str, f: Filter, limit: Optional[int], exp
+    ) -> QueryPlan:
         # id filters take absolute priority (reference IdFilterStrategy)
         ids = extract_ids(f)
         if ids.disjoint:
@@ -230,12 +278,16 @@ class QueryPlanner:
         plan: QueryPlan,
         explain: Explainer | None = None,
         hints=None,
+        skip_visibility: bool = False,
     ) -> FeatureCollection:
         exp = explain or ExplainNull()
         fc = self.store.features(plan.type_name)
         if hints is not None:
             hints.validate()
         deadline = self._deadline(hints)
+
+        if plan.union is not None:
+            return self._execute_union(plan, exp, hints, deadline)
 
         certain = None
         if plan.ids is not None:  # id lookup
@@ -246,7 +298,7 @@ class QueryPlanner:
             with exp.span("Full-table host scan"):
                 mask = plan.filter.evaluate(fc.batch)
             check_deadline(deadline, "full-table scan")
-            return self._post(fc.mask(mask), plan, hints, exp)
+            return self._post(fc.mask(mask), plan, hints, exp, skip_visibility)
         elif plan.index is not None and len(fc) == 0:
             # schema exists but nothing written yet: no index tables
             candidates = fc
@@ -288,15 +340,46 @@ class QueryPlanner:
                 mask = plan.filter.evaluate(candidates.batch)
             candidates = candidates.mask(mask)
         check_deadline(deadline, "refinement")
-        return self._post(candidates, plan, hints, exp)
+        return self._post(candidates, plan, hints, exp, skip_visibility)
 
-    def _post(self, out: FeatureCollection, plan, hints, exp):
+    def _execute_union(self, plan: QueryPlan, exp, hints, deadline) -> FeatureCollection:
+        """Run every union branch on its own index and dedup-union by
+        feature id (reference: per-option scans merged client-side with
+        deduplication, FilterSplitter OR semantics). Each branch refines
+        with its own disjunct filter, so the union is exact. The query's
+        ONE deadline bounds all branches: each gets the remaining budget,
+        not a fresh one. Branches skip visibility — it runs once over the
+        union in the final _post."""
+        from geomesa_tpu.planning.hints import QueryHints
+
+        parts = []
+        for sp in plan.union:
+            sub_hints = None
+            if deadline is not None:
+                check_deadline(deadline, f"union branch [{sp.strategy}]")
+                sub_hints = QueryHints(timeout=max(deadline - time.monotonic(), 1e-9))
+            with exp.span(f"Union branch [{sp.strategy}]"):
+                parts.append(
+                    self._execute(sp, explain=exp, hints=sub_hints, skip_visibility=True)
+                )
+        check_deadline(deadline, "union merge")
+        nonempty = [p for p in parts if len(p)]
+        if not nonempty:
+            return self._post(parts[0], plan, hints, exp)
+        out = nonempty[0] if len(nonempty) == 1 else FeatureCollection.concat(nonempty)
+        _, first = np.unique(np.asarray(out.ids), return_index=True)
+        if len(first) != len(out):
+            exp(f"Union dedup: {len(out)} -> {len(first)} rows")
+            out = out.take(np.sort(first))
+        return self._post(out, plan, hints, exp)
+
+    def _post(self, out, plan, hints, exp, skip_visibility: bool = False):
         """Client-side reduce pipeline: visibility -> sample -> sort ->
         limit -> project (reference QueryPlanner.scala:66-102 runs the same
         stages after the scan: reducer, sort, maxFeatures, projection)."""
         # row-level security: mask rows whose visibility label the store's
         # auths cannot satisfy (reference VisibilityEvaluator tier)
-        auths = getattr(self.store, "auths", None)
+        auths = None if skip_visibility else getattr(self.store, "auths", None)
         if auths is not None:
             from geomesa_tpu.security import VIS_FIELD_KEY, visibility_mask
 

@@ -259,3 +259,81 @@ class TestNativeZRanges:
             assert [(r.lower, r.upper, r.contained) for r in got] == [
                 (r.lower, r.upper, r.contained) for r in want
             ]
+
+
+class TestExtentModeKernel:
+    """Direct extent=True kernel cases (XZ tables): bbox-INTERSECTS wide
+    plane, all-false inner plane (bbox intersection can never certify the
+    actual geometry predicate), and never-matching pad sentinels."""
+
+    NAMES = ("gxmax", "gxmin", "gymax", "gymin")
+    SUB = 32
+    NB = 4
+
+    def _cols(self):
+        rng = np.random.default_rng(11)
+        n = self.NB * self.SUB * 128
+        x0 = rng.uniform(-170, 160, n).astype(np.float32)
+        y0 = rng.uniform(-80, 70, n).astype(np.float32)
+        w = rng.uniform(0.1, 10, n).astype(np.float32)
+        h = rng.uniform(0.1, 8, n).astype(np.float32)
+        cols = {"gxmin": x0, "gymin": y0, "gxmax": x0 + w, "gymax": y0 + h}
+        # sentinel-pad the tail exactly like the table does
+        from geomesa_tpu.storage.table import _SENTINELS
+
+        for k in cols:
+            cols[k][-700:] = _SENTINELS[k]
+        import jax.numpy as jnp
+
+        shape = (self.NB, self.SUB, 128)
+        return cols, tuple(jnp.asarray(cols[k].reshape(shape)) for k in self.NAMES)
+
+    def test_wide_intersects_inner_empty(self):
+        host, cols3 = self._cols()
+        boxes = bk.pack_boxes(
+            np.array([[-30.0, -20.0, 40.0, 25.0]]),
+            np.array([[-29.0, -19.0, 39.0, 24.0]]),  # inner MUST be ignored
+        )
+        wins = bk.pack_windows(None, None)
+        bids, n_real = bk.pad_bids(np.arange(self.NB), self.NB)
+        wide, inner = bk._xla_block_scan(
+            cols3, bids, boxes, wins,
+            col_names=self.NAMES, has_boxes=True, has_windows=False, extent=True,
+        )
+        rows, certain = bk.decode_bits_pair(
+            np.asarray(wide), np.asarray(inner), bids, n_real
+        )
+        # inner plane is all-false in extent mode: nothing is certain
+        assert not certain.any()
+        expect = np.flatnonzero(
+            (host["gxmin"] <= 40) & (host["gxmax"] >= -30)
+            & (host["gymin"] <= 25) & (host["gymax"] >= -20)
+        )
+        assert np.array_equal(rows, expect)
+        assert len(rows) > 0
+
+    def test_pad_sentinels_never_match(self):
+        host, cols3 = self._cols()
+        # a box covering the whole world still must not match sentinel rows
+        boxes = bk.pack_boxes(np.array([[-180.0, -90.0, 180.0, 90.0]]), None)
+        wins = bk.pack_windows(None, None)
+        bids, n_real = bk.pad_bids(np.arange(self.NB), self.NB)
+        wide, inner = bk._xla_block_scan(
+            cols3, bids, boxes, wins,
+            col_names=self.NAMES, has_boxes=True, has_windows=False, extent=True,
+        )
+        rows, _ = bk.decode_bits_pair(np.asarray(wide), np.asarray(inner), bids, n_real)
+        n = self.NB * self.SUB * 128
+        assert len(rows) == n - 700
+        assert rows.max() < n - 700
+
+    def test_interpret_parity_extent(self):
+        _, cols3 = self._cols()
+        boxes = bk.pack_boxes(np.array([[-30.0, -20.0, 40.0, 25.0]]), None)
+        wins = bk.pack_windows(None, None)
+        bids, _ = bk.pad_bids(np.array([0, 2]), self.NB)
+        kw = dict(col_names=self.NAMES, has_boxes=True, has_windows=False, extent=True)
+        w_ref, i_ref = bk._xla_block_scan(cols3, bids, boxes, wins, **kw)
+        w_got, i_got = bk._pallas_block_scan(cols3, bids, boxes, wins, interpret=True, **kw)
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_got))
+        assert np.array_equal(np.asarray(i_ref), np.asarray(i_got))

@@ -1,0 +1,112 @@
+"""Partition-preserving merge compaction (reference TimePartition,
+index/conf/partition/TimePartition.scala): folding a delta into the sorted
+table sorts ONLY the delta and re-uploads only device blocks past the
+first insertion point — time partitions are contiguous segments of the
+(bin, z) sort, so recent-time appends touch only the tail."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu.filter import ecql
+
+SPEC = "dtg:Date,*geom:Point:srid=4326"
+T0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+DAY = 86400_000
+
+
+def _fc(sft, ids, day_lo, day_hi, seed):
+    rng = np.random.default_rng(seed)
+    n = len(ids)
+    return FeatureCollection.from_columns(
+        sft, ids,
+        {
+            "dtg": T0 + rng.integers(day_lo * DAY, day_hi * DAY, n),
+            "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n)),
+        },
+    )
+
+
+QUERIES = [
+    "bbox(geom, -20, -15, 25, 20) AND dtg DURING 2024-01-02T00:00:00Z/2024-01-25T00:00:00Z",
+    "bbox(geom, -5, -5, 5, 5)",
+    "bbox(geom, -60, -45, 60, 45) AND dtg DURING 2024-01-20T00:00:00Z/2024-01-23T00:00:00Z",
+]
+
+
+class TestMergeCompaction:
+    def _store(self, tile=4096):
+        sft = FeatureType.from_spec("p", SPEC)
+        sft.user_data["geomesa.indices.enabled"] = "z3"
+        ds = DataStore(tile=tile)
+        ds.create_schema(sft)
+        return ds, sft
+
+    def test_recent_append_sorts_only_delta(self):
+        ds, sft = self._store()
+        n_base, n_delta = 40960, 2000
+        ds.write("p", _fc(sft, [str(i) for i in range(n_base)], 0, 20, 1), check_ids=False)
+        base_table = ds._tables[("p", "z3")]
+        assert base_table.rows_sorted == n_base
+        # recent-time delta (days 19-21): lands in the tail bins
+        ds.write(
+            "p", _fc(sft, [f"d{i}" for i in range(n_delta)], 19, 21, 2), check_ids=False
+        )
+        ds.compact("p")
+        t = ds._tables[("p", "z3")]
+        assert t.n == n_base + n_delta
+        assert t.rows_sorted == n_delta  # only the delta was sorted
+        assert t.rows_uploaded < t.n_pad  # prefix device blocks reused
+
+    def test_merged_equals_fresh_build(self):
+        ds, sft = self._store()
+        base = _fc(sft, [str(i) for i in range(30000)], 0, 25, 3)
+        delta = _fc(sft, [f"d{i}" for i in range(3000)], 10, 26, 4)
+        ds.write("p", base, check_ids=False)
+        ds.write("p", delta, check_ids=False)
+        ds.compact("p")
+
+        fresh, _ = self._store()
+        fresh.write("p", base, check_ids=False)
+        fresh.write("p", delta, check_ids=False)
+        fresh._main_rows["p"] = 0  # force a from-scratch rebuild
+        fresh.compact("p")
+
+        a, b = ds._tables[("p", "z3")], fresh._tables[("p", "z3")]
+        assert np.array_equal(np.asarray(a.perm, np.int64), np.asarray(b.perm, np.int64))
+        assert np.array_equal(a.bins, b.bins)
+        assert np.array_equal(a.zs, b.zs)
+        for k in a.col_names:
+            assert np.array_equal(np.asarray(a.cols3[k]), np.asarray(b.cols3[k]))
+        for q in QUERIES:
+            assert sorted(ds.query("p", q).ids.tolist()) == sorted(
+                fresh.query("p", q).ids.tolist()
+            )
+
+    def test_queries_exact_after_merge(self):
+        ds, sft = self._store()
+        ds.write("p", _fc(sft, [str(i) for i in range(20000)], 0, 15, 5), check_ids=False)
+        ds.write("p", _fc(sft, [f"a{i}" for i in range(1500)], 14, 16, 6), check_ids=False)
+        ds.compact("p")
+        # second merge round on top of a merged table
+        ds.write("p", _fc(sft, [f"b{i}" for i in range(1500)], 15, 17, 7), check_ids=False)
+        ds.compact("p")
+        full = ds.features("p")
+        for q in QUERIES:
+            f = ecql.parse(q)
+            expect = sorted(full.ids[np.asarray(f.evaluate(full.batch))].tolist())
+            assert sorted(ds.query("p", q).ids.tolist()) == expect
+
+    def test_old_time_delta_still_exact(self):
+        ds, sft = self._store()
+        ds.write("p", _fc(sft, [str(i) for i in range(20000)], 10, 25, 8), check_ids=False)
+        # delta BEFORE the base time range: inserts at the head, full upload
+        ds.write("p", _fc(sft, [f"o{i}" for i in range(1000)], 0, 2, 9), check_ids=False)
+        ds.compact("p")
+        t = ds._tables[("p", "z3")]
+        assert t.rows_sorted == 1000
+        full = ds.features("p")
+        for q in QUERIES[:2]:
+            f = ecql.parse(q)
+            expect = sorted(full.ids[np.asarray(f.evaluate(full.batch))].tolist())
+            assert sorted(ds.query("p", q).ids.tolist()) == expect
