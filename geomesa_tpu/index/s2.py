@@ -114,6 +114,8 @@ class S3Index:
             return ScanConfig.empty(self.name)
         if not intervals.values:
             return None
+        # no spatial constraint -> boxes=None: the scan projects x/y away
+        no_geom = not geoms.values
         bounds = geometry_bounds(geoms) if geoms.values else [WHOLE_WORLD]
         ranges = self.sfc.ranges(bounds)
         if not ranges:
@@ -141,7 +143,7 @@ class S3Index:
             range_bins=range_bins,
             range_lo=range_lo,
             range_hi=range_hi,
-            boxes=widen_boxes(bounds),
+            boxes=None if no_geom else widen_boxes(bounds),
             windows=windows,
             geom_precise=geoms.precise and _bounds_only(geoms.values),
             time_precise=intervals.precise,

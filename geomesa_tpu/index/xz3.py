@@ -74,6 +74,8 @@ class XZ3Index:
             return ScanConfig.empty(self.name)
         if not intervals.values:
             return None
+        # no spatial constraint -> boxes=None: the scan projects x/y away
+        no_geom = not geoms.values
         bounds = geometry_bounds(geoms) if geoms.values else [WHOLE_WORLD]
 
         bins_list, lo_list, hi_list = [], [], []
@@ -109,7 +111,7 @@ class XZ3Index:
             range_bins=np.concatenate(range_bins),
             range_lo=np.concatenate(range_lo),
             range_hi=np.concatenate(range_hi),
-            boxes=widen_boxes(bounds),
+            boxes=None if no_geom else widen_boxes(bounds),
             windows=windows.astype(np.int32),
             extent_mode=True,
             geom_precise=False,

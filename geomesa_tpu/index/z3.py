@@ -95,6 +95,9 @@ class Z3Index:
             return ScanConfig.empty(self.name)
         if not intervals.values:
             return None  # unbounded time: z3 cannot serve (z2 should)
+        # no spatial constraint -> no box predicate: the scan variant then
+        # projects away the x/y columns entirely (ColumnGroups analogue)
+        no_geom = not geoms.values
         bounds = geometry_bounds(geoms) if geoms.values else [WHOLE_WORLD]
 
         # per-bin time windows (reference timesByBin, Z3IndexKeySpace:132-158)
@@ -155,7 +158,7 @@ class Z3Index:
             range_bins=np.concatenate(range_bins),
             range_lo=np.concatenate(range_lo),
             range_hi=np.concatenate(range_hi),
-            boxes=widen_boxes(bounds),
+            boxes=None if no_geom else widen_boxes(bounds),
             windows=windows.astype(np.int32),
             geom_precise=geom_precise,
             time_precise=intervals.precise,
@@ -164,7 +167,7 @@ class Z3Index:
             # decided by bbox+interval alone — the planner checks kinds; here
             # we require the geometry values themselves to be plain boxes
             contained_exact=bool(geom_precise and intervals.precise),
-            boxes_inner=shrink_boxes(bounds),
+            boxes_inner=None if no_geom else shrink_boxes(bounds),
             windows_inner=windows_inner.astype(np.int32),
         )
 

@@ -39,7 +39,7 @@ from geomesa_tpu.sft import FeatureType
 # -- expression DSL ------------------------------------------------------
 
 _TOKEN = re.compile(
-    r"\s*(?:(?P<col>\$\d+)|(?P<path>\$(?:\.\w+)+)|(?P<name>\w+)\s*\(|(?P<lit>'[^']*')"
+    r"\s*(?:(?P<col>\$\d+)|(?P<path>\$(?:\.@?\w+)+)|(?P<name>\w+)\s*\(|(?P<lit>'[^']*')"
     r"|(?P<num>-?\d+(?:\.\d+)?)|(?P<close>\))|(?P<comma>,)|(?P<cast>::\w+))"
 )
 
@@ -174,10 +174,14 @@ class Converter:
     sft: FeatureType
     fields: Sequence[FieldSpec]
     id_field: str | None = None  # expression; None = running index
-    fmt: str = "delimited"  # "delimited" | "json"
+    fmt: str = "delimited"  # "delimited" | "json" | "xml"
     delimiter: str = ","
     skip_lines: int = 0  # header rows to drop (delimited)
     drop_errors: bool = True  # skip unparseable records vs raise
+    # xml: tag of the per-feature element (reference geomesa-convert-xml
+    # featurePath); fields address the element tree with $.child.grandchild
+    # paths, attributes as @name segments ($.pos.@lat)
+    xml_feature_tag: str | None = None
 
     def __post_init__(self):
         self._exprs = [(f.name, compile_expression(f.transform)) for f in self.fields]
@@ -220,8 +224,48 @@ class Converter:
             if isinstance(doc, dict):
                 doc = [doc]
             yield from doc
+        elif self.fmt == "xml":
+            import xml.etree.ElementTree as ET
+
+            if self.xml_feature_tag is None:
+                raise ValueError("xml converter requires xml_feature_tag")
+            root = ET.fromstring(data)
+            elems = (
+                [root]
+                if _local(root.tag) == self.xml_feature_tag
+                else [
+                    e for e in root.iter() if _local(e.tag) == self.xml_feature_tag
+                ]
+            )
+            for e in elems:
+                yield _elem_to_dict(e)
         else:
             raise ValueError(f"unknown converter format {self.fmt!r}")
+
+
+# -- xml record shaping --------------------------------------------------
+
+
+def _local(tag: str) -> str:
+    """Element tag without its namespace ({uri}tag -> tag)."""
+    return tag.rsplit("}", 1)[-1]
+
+
+def _elem_to_dict(e) -> dict:
+    """An XML element as a nested dict the $.path expressions can address:
+    attributes under '@name', leaf children under their tag (text), nested
+    children recurse; the first occurrence of a repeated tag wins (the
+    reference's xpath configs select explicitly — this covers the common
+    record-per-element shape)."""
+    out: dict = {f"@{k}": v for k, v in e.attrib.items()}
+    for c in e:
+        tag = _local(c.tag)
+        if tag in out:
+            continue
+        out[tag] = _elem_to_dict(c) if (len(c) or c.attrib) else (c.text or "").strip()
+    if not out and e.text:
+        return e.text.strip()
+    return out
 
 
 # -- type inference ------------------------------------------------------

@@ -20,7 +20,7 @@ from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
 
-FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "arrow")
+FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "arrow", "avro")
 
 
 def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | bytes":
@@ -37,6 +37,10 @@ def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | byte
         payload = _json_rows(fc)
     elif fmt == "arrow":
         payload = _arrow(fc)
+    elif fmt == "avro":
+        from geomesa_tpu.io.avro import write_avro
+
+        payload = write_avro(fc)
     else:
         raise ValueError(f"unknown format {fmt!r}; supported: {FORMATS}")
     if fh is not None:
@@ -164,25 +168,9 @@ def _json_rows(fc: FeatureCollection) -> str:
 
 
 def _arrow(fc: FeatureCollection) -> bytes:
-    """Arrow IPC stream; geometry as WKT strings (the reference's Arrow
-    vectors encode geometries natively — WKT keeps interop without the
-    geomesa-arrow-jts vector spec)."""
-    try:
-        import pyarrow as pa
-        import pyarrow.ipc as ipc
-    except ImportError as e:  # pragma: no cover - depends on image contents
-        raise RuntimeError(
-            "arrow export requires pyarrow, which is not installed"
-        ) from e
-    geom_field = fc.sft.geom_field
-    data = {"id": fc.ids.tolist()}
-    for a in fc.sft.attributes:
-        if a.name == geom_field:
-            data[a.name] = _geom_strings(fc).tolist()
-        else:
-            data[a.name] = np.asarray(fc.columns[a.name]).tolist()
-    table = pa.table(data)
-    sink = pa.BufferOutputStream()
-    with ipc.new_stream(sink, table.schema) as w:
-        w.write_table(table)
-    return sink.getvalue().to_pybytes()
+    """Arrow IPC record-batch stream built from the store's columns, with
+    dictionary-encoded string attributes (geomesa_tpu.io.arrow; reference
+    ArrowScan.scala:31-240)."""
+    from geomesa_tpu.io.arrow import arrow_stream
+
+    return arrow_stream(fc)

@@ -197,8 +197,10 @@ class DistributedIndexTable(IndexTable):
         bids2, n_real = self._split_blocks(blocks)
         boxes, wins = self._params(config)
         kw = self._kernel_kwargs(config)
-        fn = _dist_scan(self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"])
-        wide, inner = fn(bids2, boxes, wins, *self._cols_args())
+        names = kw["col_names"]
+        self._record_scan(names, bids2.size)
+        fn = _dist_scan(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        wide, inner = fn(bids2, boxes, wins, *self._cols_args(names))
         wide_h, inner_h = jax.device_get((wide, inner))
         wide_h, inner_h = np.asarray(wide_h), np.asarray(inner_h)
         parts = []
@@ -215,8 +217,10 @@ class DistributedIndexTable(IndexTable):
         bids2, n_real = self._split_blocks(blocks, pad=-1)
         boxes, wins = self._params(config)
         kw = self._kernel_kwargs(config)
-        fn = _dist_pops(self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"])
-        pops2 = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args())))
+        names = kw["col_names"]
+        self._record_scan(names, bids2.size)
+        fn = _dist_pops(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        pops2 = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args(names))))
         pops, gbids = [], []
         for d in range(D):
             nr = int(n_real[d])
@@ -230,20 +234,24 @@ class DistributedIndexTable(IndexTable):
     def _device_density(self, blocks, config, grid_bounds, width, height) -> np.ndarray:
         bids2, _ = self._split_blocks(blocks, pad=-1)
         boxes, wins = self._params(config)
-        kw = self._kernel_kwargs(config)
+        names = self._agg_cols(config)
+        kw = self._kernel_kwargs(config, names)
+        self._record_scan(names, bids2.size)
         fn = _dist_density(
-            self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"],
+            self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"],
             width, height,
         )
-        grid = fn(bids2, boxes, wins, grid_bounds, *self._cols_args())
+        grid = fn(bids2, boxes, wins, grid_bounds, *self._cols_args(names))
         return np.asarray(jax.device_get(grid))
 
     def _device_bounds(self, blocks, config):
         bids2, n_real = self._split_blocks(blocks, pad=-1)
         boxes, wins = self._params(config)
-        kw = self._kernel_kwargs(config)
-        fn = _dist_bounds(self.mesh, self.col_names, kw["has_boxes"], kw["has_windows"], kw["extent"])
-        stats = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args())))
+        names = self._agg_cols(config)
+        kw = self._kernel_kwargs(config, names)
+        self._record_scan(names, bids2.size)
+        fn = _dist_bounds(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        stats = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args(names))))
         # fold only real slots from each device
         parts = [stats[d, : int(n_real[d])] for d in range(self.n_devices)]
         return aggregations.reduce_bounds(np.concatenate(parts), None)

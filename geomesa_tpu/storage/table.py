@@ -217,6 +217,9 @@ class IndexTable(SortedKeys):
         cols = self.pad_cols(keys, self.n_pad)
         self.col_names = tuple(sorted(cols))
         self.extent = "gxmin" in cols
+        # projection accounting for the most recent kernel call
+        self.last_scan_cols: tuple = ()
+        self.last_scan_bytes = 0
         # ``reuse``: (old table, first changed sorted row) — merge
         # compaction keeps every device block before the first insertion
         # point and uploads only the changed suffix
@@ -326,16 +329,50 @@ class IndexTable(SortedKeys):
             return np.arange(self.n_blocks, dtype=np.int64)
         return blocks
 
-    def _kernel_kwargs(self, config: ScanConfig) -> dict:
+    # -- column projection (reference ColumnGroups, index/conf/
+    # ColumnGroups.scala: scans fetch only the column families the query
+    # needs; here a scan variant's BlockSpecs DMA only the projected
+    # device columns — a time-only query ships no x/y blocks) ------------
+    def _coord_cols(self) -> set:
+        want = {"gxmin", "gymin", "gxmax", "gymax"} if self.extent else {"x", "y"}
+        return want & set(self.col_names)
+
+    def _scan_cols(self, config: ScanConfig) -> tuple:
+        """Device columns this scan's predicate actually reads."""
+        names: set = set()
+        if config.boxes is not None:
+            names |= self._coord_cols()
+        if config.windows is not None:
+            names |= {"tbin", "toff"} & set(self.col_names)
+        if not names:
+            # no predicate: one validity column (sentinel test in _masks)
+            for v in ("x", "gxmin", "tbin"):
+                if v in self.col_names:
+                    names = {v}
+                    break
+        return tuple(sorted(names))
+
+    def _agg_cols(self, config: ScanConfig) -> tuple:
+        """Aggregations additionally read the representative coordinates."""
+        return tuple(sorted(set(self._scan_cols(config)) | self._coord_cols()))
+
+    def _kernel_kwargs(self, config: ScanConfig, names: tuple | None = None) -> dict:
         return dict(
-            col_names=self.col_names,
+            col_names=names if names is not None else self._scan_cols(config),
             has_boxes=config.boxes is not None,
             has_windows=config.windows is not None,
             extent=self.extent,
         )
 
-    def _cols_args(self) -> tuple:
-        return tuple(self.cols3[k] for k in self.col_names)
+    def _cols_args(self, names: tuple) -> tuple:
+        return tuple(self.cols3[k] for k in names)
+
+    def _record_scan(self, names: tuple, n_blocks: int) -> None:
+        """Projection accounting: what the last kernel call DMA'd."""
+        self.last_scan_cols = names
+        self.last_scan_bytes = sum(
+            int(self.cols3[k].dtype.itemsize) for k in names
+        ) * n_blocks * self.block
 
     def _device_scan(self, blocks: np.ndarray, config: ScanConfig):
         """Kernel call over candidate blocks -> (rows, certain)."""
@@ -344,8 +381,11 @@ class IndexTable(SortedKeys):
         blocks = self._full_or(blocks)
         bids, n_real = bk.pad_bids(blocks, self.n_blocks)
         boxes, wins = self._params(config)
+        names = self._scan_cols(config)
+        self._record_scan(names, len(bids))
         wide, inner = bk.block_scan(
-            self._cols_args(), bids, boxes, wins, **self._kernel_kwargs(config)
+            self._cols_args(names), bids, boxes, wins,
+            **self._kernel_kwargs(config, names),
         )
         wide_h, inner_h = jax.device_get((wide, inner))
         return bk.decode_bits_pair(np.asarray(wide_h), np.asarray(inner_h), bids, n_real)
@@ -360,8 +400,11 @@ class IndexTable(SortedKeys):
         blocks = self._full_or(blocks)
         bids, n_real = bk.pad_bids(blocks, self.n_blocks)
         boxes, wins = self._params(config)
+        names = self._scan_cols(config)
+        self._record_scan(names, len(bids))
         pops = aggregations.block_pops(
-            self._cols_args(), bids, boxes, wins, **self._kernel_kwargs(config)
+            self._cols_args(names), bids, boxes, wins,
+            **self._kernel_kwargs(config, names),
         )
         pops = np.asarray(jax.device_get(pops))[:n_real].astype(np.int64)
         return pops, bids[:n_real].astype(np.int64)
@@ -374,9 +417,11 @@ class IndexTable(SortedKeys):
         blocks = self._full_or(blocks)
         bids, _ = bk.pad_bids(blocks, self.n_blocks, pad=-1)
         boxes, wins = self._params(config)
+        names = self._agg_cols(config)
+        self._record_scan(names, len(bids))
         grid = aggregations.block_density(
-            self._cols_args(), bids, boxes, wins, grid_bounds,
-            width=width, height=height, **self._kernel_kwargs(config),
+            self._cols_args(names), bids, boxes, wins, grid_bounds,
+            width=width, height=height, **self._kernel_kwargs(config, names),
         )
         return np.asarray(jax.device_get(grid))
 
@@ -389,8 +434,11 @@ class IndexTable(SortedKeys):
         blocks = self._full_or(blocks)
         bids, n_real = bk.pad_bids(blocks, self.n_blocks, pad=-1)
         boxes, wins = self._params(config)
+        names = self._agg_cols(config)
+        self._record_scan(names, len(bids))
         stats = aggregations.block_bounds(
-            self._cols_args(), bids, boxes, wins, **self._kernel_kwargs(config)
+            self._cols_args(names), bids, boxes, wins,
+            **self._kernel_kwargs(config, names),
         )
         return aggregations.reduce_bounds(jax.device_get(stats), n_real)
 

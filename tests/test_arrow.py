@@ -1,0 +1,108 @@
+"""Arrow columnar output: dictionary encoding, record-batch streaming,
+and the no-Python-rows guarantee (reference ArrowScan + DeltaWriter)."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu.io.arrow import arrow_stream, read_arrow, to_arrow_table
+
+SPEC = "name:String,age:Int,score:Double,dtg:Date,*geom:Point:srid=4326"
+
+
+def make_fc(n, seed=0):
+    rng = np.random.default_rng(seed)
+    sft = FeatureType.from_spec("a", SPEC)
+    t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    return sft, FeatureCollection.from_columns(
+        sft,
+        [str(i) for i in range(n)],
+        {
+            "name": np.array([f"cat{i % 13}" for i in range(n)]),
+            "age": (np.arange(n) % 90).astype(np.int32),
+            "score": rng.uniform(0, 1, n),
+            "dtg": t0 + rng.integers(0, 86400_000 * 10, n),
+            "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n)),
+        },
+    )
+
+
+class TestArrowStream:
+    def test_roundtrip_with_dictionaries(self):
+        _, fc = make_fc(5000)
+        data = arrow_stream(fc)
+        table = read_arrow(data)
+        assert table.num_rows == 5000
+        # string column is dictionary-encoded with 13 unique values
+        field = table.schema.field("name")
+        assert pa.types.is_dictionary(field.type)
+        name_col = table.column("name").combine_chunks()
+        chunk = name_col.chunk(0) if hasattr(name_col, "chunk") else name_col
+        assert len(chunk.dictionary) == 13
+        assert table.column("name").to_pylist() == fc.columns["name"].tolist()
+        # dates come back as timestamp[ms]
+        assert pa.types.is_timestamp(table.schema.field("dtg").type)
+        got_ms = np.asarray(table.column("dtg").cast(pa.int64()))
+        assert np.array_equal(got_ms, np.asarray(fc.columns["dtg"]))
+        # points are FixedSizeList<2 x f64>
+        geom = table.column("geom").combine_chunks()
+        xy = np.asarray(geom.flatten())
+        assert np.allclose(xy[0::2], fc.columns["geom"].x)
+        assert np.allclose(xy[1::2], fc.columns["geom"].y)
+
+    def test_record_batch_streaming(self):
+        _, fc = make_fc(10000)
+        data = arrow_stream(fc, batch_rows=1024)
+        import pyarrow.ipc as ipc
+
+        with ipc.open_stream(pa.py_buffer(data)) as r:
+            batches = list(r)
+        assert len(batches) == 10  # 10000 / 1024 -> 10 batches
+        assert sum(b.num_rows for b in batches) == 10000
+
+    def test_no_python_row_materialization(self, monkeypatch):
+        _, fc = make_fc(100_000)
+
+        def boom(self):  # any row-wise path is a bug
+            raise AssertionError("to_rows called during arrow export")
+
+        monkeypatch.setattr(FeatureCollection, "to_rows", boom)
+        data = arrow_stream(fc)
+        assert read_arrow(data).num_rows == 100_000
+
+    def test_store_query_export(self):
+        sft, fc = make_fc(8000)
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("a", fc)
+        out = ds.query("a", "bbox(geom, -30, -20, 30, 20)")
+        from geomesa_tpu.io.exporters import export
+
+        table = read_arrow(export(out, "arrow"))
+        assert table.num_rows == len(out)
+        assert pa.types.is_dictionary(table.schema.field("name").type)
+
+    def test_extent_geometries_as_wkb(self):
+        sft = FeatureType.from_spec("p", "name:String,*geom:Polygon:srid=4326")
+        rows = [
+            {
+                "__id__": str(i),
+                "name": f"p{i}",
+                "geom": f"POLYGON(({i} 0, {i+1} 0, {i+1} 1, {i} 1, {i} 0))",
+            }
+            for i in range(50)
+        ]
+        fc = FeatureCollection.from_rows(sft, rows)
+        table = read_arrow(arrow_stream(fc))
+        from geomesa_tpu import geometry as geo
+
+        g0 = geo.from_wkb(table.column("geom").to_pylist()[7])
+        assert g0.bounds() == (7.0, 0.0, 8.0, 1.0)
+
+    def test_plain_encoding_without_dictionary(self):
+        _, fc = make_fc(100)
+        table = read_arrow(arrow_stream(fc, dictionary=False))
+        assert pa.types.is_string(table.schema.field("name").type)
+        assert table.column("name").to_pylist() == fc.columns["name"].tolist()
