@@ -1,17 +1,32 @@
-"""Driver benchmark: GDELT-shaped Z3 BBOX+time query mix on one TPU chip.
+"""Driver benchmark: BASELINE.md configs 1-3 on one TPU chip.
 
-BASELINE.md config 1: Z3 point index, BBOX + time-range queries over a
-GDELT-shaped point table. The baseline proxy is a NumPy full-columnar CPU
-scan of the same predicate (the reference's geomesa-fs Parquet/CPU path is
-JVM and cannot run here; a vectorized in-memory CPU scan is a *stronger*
-baseline than a Parquet file scan).
+- config 1 (primary, first JSON line): Z3 point index, BBOX + time-range
+  queries over a GDELT-shaped table (default N=500M — 8 GB of device
+  columns, ~half of v5e HBM).
+- config 2: Z2 point index, BBOX-only queries (OSM-GPS-shaped).
+- config 3: XZ2 polygon index, ST_Intersects queries over building-
+  footprint-shaped rectangles.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
-Env knobs: GEOMESA_BENCH_N (points, default 100M), GEOMESA_BENCH_QUERIES.
+The baseline proxy for every config is a vectorized NumPy full-columnar
+CPU scan of the same predicate (the reference's geomesa-fs Parquet/CPU
+path is JVM and cannot run here; an in-memory columnar scan is a
+*stronger* baseline than a Parquet file scan).
+
+Measured queries are DISJOINT from warmup queries: both draw from the
+same shape/selectivity buckets but with different seeds, so the timed
+set proves no per-query host state is reused (VERDICT r3 weak #4).
+Warmup still compiles every (bucket, flags) kernel variant because
+variants are keyed by shape bucket, not query values.
+
+Prints one JSON line per config, config 1 first. Env knobs:
+GEOMESA_BENCH_N (config-1 points), GEOMESA_BENCH_N2, GEOMESA_BENCH_N3,
+GEOMESA_BENCH_QUERIES, GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"),
+GEOMESA_BENCH_PLATFORM (e.g. "cpu" for off-TPU verification).
 """
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -19,8 +34,11 @@ import time
 
 import numpy as np
 
-N = int(os.environ.get("GEOMESA_BENCH_N", 100_000_000))
+N1 = int(os.environ.get("GEOMESA_BENCH_N", 500_000_000))
+N2 = int(os.environ.get("GEOMESA_BENCH_N2", 200_000_000))
+N3 = int(os.environ.get("GEOMESA_BENCH_N3", 20_000_000))
 N_QUERIES = int(os.environ.get("GEOMESA_BENCH_QUERIES", 40))
+CONFIGS = os.environ.get("GEOMESA_BENCH_CONFIGS", "1,2,3").split(",")
 SEED = 42
 
 
@@ -28,14 +46,9 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_store(n):
-    from geomesa_tpu.datastore import DataStore
-    from geomesa_tpu.features import FeatureCollection
-    from geomesa_tpu.sft import FeatureType
-
-    rng = np.random.default_rng(SEED)
-    # GDELT-shaped: world-wide events clustered around population centers —
-    # approximate with a mixture of uniform background + gaussian clusters
+def gdelt_points(n, rng):
+    """World-wide events clustered around population centers: uniform
+    background + gaussian clusters."""
     n_clustered = n // 2
     n_uniform = n - n_clustered
     cx = rng.uniform(-160, 160, 64)
@@ -53,6 +66,82 @@ def build_store(n):
             np.clip(cy[which] + rng.normal(0, 2.0, n_clustered), -90, 90),
         ]
     )
+    return x, y
+
+
+def box_queries(rng, n_queries):
+    """Selectivity mix: city-scale through continent-scale boxes."""
+    out = []
+    for _ in range(n_queries):
+        w = float(rng.choice([1.0, 2.0, 5.0, 10.0, 20.0, 40.0]))
+        h = w / 2
+        qx = rng.uniform(-175, 175 - w)
+        qy = rng.uniform(-85, 85 - h)
+        out.append((qx, qy, qx + w, qy + h))
+    return out
+
+
+def time_windows(rng, n_queries, t0, span_ms):
+    out = []
+    for _ in range(n_queries):
+        dur_ms = int(rng.choice([6, 24, 72, 168, 24 * 14]) * 3600_000)
+        start = int(t0 + rng.integers(0, span_ms - dur_ms))
+        out.append((start, start + dur_ms))
+    return out
+
+
+def run_queries(ds, type_name, queries, label):
+    """(latencies s, total hits) — warmup pass then a timed pass over a
+    DISJOINT measured set."""
+    warmup, measured = queries
+    t_warm = time.perf_counter()
+    for i, q in enumerate(warmup):
+        s = time.perf_counter()
+        ds.query(type_name, q)
+        if i < 3 or time.perf_counter() - s > 1.0:
+            log(f"[{label}] warmup {i}: {time.perf_counter() - s:.2f}s")
+    log(f"[{label}] warmup done in {time.perf_counter() - t_warm:.1f}s")
+
+    lat, hits = [], 0
+    t_all = time.perf_counter()
+    for q in measured:
+        s = time.perf_counter()
+        out = ds.query(type_name, q)
+        lat.append(time.perf_counter() - s)
+        hits += len(out)
+    return np.array(lat), hits, time.perf_counter() - t_all
+
+
+def result_line(metric, lat, hits, wall, base_mean, extra):
+    lat_ms = lat * 1e3
+    rec = {
+        "metric": metric,
+        "value": round(hits / wall, 1),
+        "unit": "features/s",
+        "vs_baseline": round(base_mean / float(np.mean(lat)), 2),
+        "n_queries": len(lat),
+        "hits_total": hits,
+        "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "latency_mean_ms": round(float(np.mean(lat_ms)), 2),
+        "cpu_baseline_mean_ms": round(base_mean * 1e3, 2),
+    }
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+# ------------------------------------------------------------- config 1
+
+
+def config1_z3():
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+
+    n = N1
+    rng = np.random.default_rng(SEED)
+    log(f"[z3] building {n:,} point store ...")
+    x, y = gdelt_points(n, rng)
     t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
     span_ms = 120 * 86400_000
     t = t0 + rng.integers(0, span_ms, n)
@@ -65,44 +154,184 @@ def build_store(n):
     t_in = time.perf_counter()
     ds.write("gdelt", fc, check_ids=False)
     ingest_s = time.perf_counter() - t_in
-    return ds, (x, y, t, t0, span_ms), ingest_s
+    table = ds.table("gdelt", "z3")
+    log(f"[z3] ingest {ingest_s:.1f}s, device {table.nbytes_device / 1e9:.2f} GB")
 
-
-def make_queries(t0, span_ms):
-    rng = np.random.default_rng(SEED + 1)
-    qs = []
-    for i in range(N_QUERIES):
-        # selectivity mix: small city-scale boxes through continent-scale
-        w = float(rng.choice([1.0, 2.0, 5.0, 10.0, 20.0, 40.0]))
-        h = w / 2
-        qx = rng.uniform(-175, 175 - w)
-        qy = rng.uniform(-85, 85 - h)
-        dur_ms = int(rng.choice([6, 24, 72, 168, 24 * 14]) * 3600_000)
-        start = int(t0 + rng.integers(0, span_ms - dur_ms))
-        lo = np.datetime64(start, "ms")
-        hi = np.datetime64(start + dur_ms, "ms")
-        qs.append(
-            (
-                f"bbox(geom, {qx:.4f}, {qy:.4f}, {qx + w:.4f}, {qy + h:.4f}) "
-                f"AND dtg DURING {lo}Z/{hi}Z",
-                (qx, qy, qx + w, qy + h, start, start + dur_ms),
+    def qset(seed):
+        r = np.random.default_rng(seed)
+        boxes = box_queries(r, N_QUERIES)
+        wins = time_windows(r, N_QUERIES, t0, span_ms)
+        qs = []
+        for (x0, y0, x1, y1), (lo, hi) in zip(boxes, wins):
+            qs.append(
+                (
+                    f"bbox(geom, {x0:.4f}, {y0:.4f}, {x1:.4f}, {y1:.4f}) AND dtg DURING "
+                    f"{np.datetime64(lo, 'ms')}Z/{np.datetime64(hi, 'ms')}Z",
+                    (x0, y0, x1, y1, lo, hi),
+                )
             )
-        )
-    return qs
+        return qs
 
+    warmup = [q for q, _ in qset(SEED + 1)]
+    measured_full = qset(SEED + 2)  # disjoint from warmup, same buckets
+    measured = [q for q, _ in measured_full]
 
-def brute_force_times(data, queries, k=6):
-    """CPU columnar baseline on the first k queries, extrapolated."""
-    x, y, t, _, _ = data
+    lat, hits, wall = run_queries(ds, "gdelt", (warmup, measured), "z3")
+
+    # CPU columnar baseline on a sample of the measured set
     times = []
-    for _, (x0, y0, x1, y1, tlo, thi) in queries[:k]:
+    for _, (x0, y0, x1, y1, lo, hi) in measured_full[:6]:
         s = time.perf_counter()
-        m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1) & (t >= tlo) & (t < thi)
-        n_hits = int(m.sum())
-        idx = np.nonzero(m)[0]
+        m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1) & (t >= lo) & (t < hi)
+        np.nonzero(m)[0]
         times.append(time.perf_counter() - s)
-        del m, idx
-    return float(np.mean(times)), n_hits
+        del m
+    base_mean = float(np.mean(times))
+
+    result_line(
+        "gdelt_z3_bbox_time_features_per_sec_per_chip", lat, hits, wall, base_mean,
+        {
+            "n_points": n,
+            "ingest_rate_per_s": round(n / ingest_s, 1),
+            "device_gb": round(table.nbytes_device / 1e9, 3),
+        },
+    )
+    del ds, fc, table, x, y, t
+    gc.collect()
+
+
+# ------------------------------------------------------------- config 2
+
+
+def config2_z2():
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+
+    n = N2
+    rng = np.random.default_rng(SEED + 10)
+    log(f"[z2] building {n:,} point store ...")
+    x, y = gdelt_points(n, rng)  # OSM-GPS-shaped: clustered + background
+
+    sft = FeatureType.from_spec("osm", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    ds = DataStore()
+    ds.create_schema(sft)
+    fc = FeatureCollection.from_columns(sft, np.arange(n), {"geom": (x, y)})
+    t_in = time.perf_counter()
+    ds.write("osm", fc, check_ids=False)
+    ingest_s = time.perf_counter() - t_in
+    table = ds.table("osm", "z2")
+    log(f"[z2] ingest {ingest_s:.1f}s, device {table.nbytes_device / 1e9:.2f} GB")
+
+    def qset(seed):
+        r = np.random.default_rng(seed)
+        return [
+            (f"bbox(geom, {x0:.4f}, {y0:.4f}, {x1:.4f}, {y1:.4f})", (x0, y0, x1, y1))
+            for x0, y0, x1, y1 in box_queries(r, N_QUERIES)
+        ]
+
+    warmup = [q for q, _ in qset(SEED + 11)]
+    measured_full = qset(SEED + 12)
+    measured = [q for q, _ in measured_full]
+    lat, hits, wall = run_queries(ds, "osm", (warmup, measured), "z2")
+
+    times = []
+    for _, (x0, y0, x1, y1) in measured_full[:6]:
+        s = time.perf_counter()
+        m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+        np.nonzero(m)[0]
+        times.append(time.perf_counter() - s)
+        del m
+    base_mean = float(np.mean(times))
+
+    result_line(
+        "osm_z2_bbox_features_per_sec_per_chip", lat, hits, wall, base_mean,
+        {
+            "n_points": n,
+            "ingest_rate_per_s": round(n / ingest_s, 1),
+            "device_gb": round(table.nbytes_device / 1e9, 3),
+        },
+    )
+    del ds, fc, table, x, y
+    gc.collect()
+
+
+# ------------------------------------------------------------- config 3
+
+
+def config3_xz2():
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+
+    n = N3
+    rng = np.random.default_rng(SEED + 20)
+    log(f"[xz2] building {n:,} polygon store ...")
+    # building-footprint-shaped rectangles clustered in "cities"
+    cx = rng.uniform(-160, 160, 256)
+    cy = rng.uniform(-55, 65, 256)
+    which = rng.integers(0, 256, n)
+    x0 = np.clip(cx[which] + rng.normal(0, 0.5, n), -179.9, 179.8)
+    y0 = np.clip(cy[which] + rng.normal(0, 0.4, n), -89.9, 89.8)
+    w = rng.uniform(0.0002, 0.002, n)  # ~20-200 m
+    h = rng.uniform(0.0002, 0.002, n)
+    col = geo.PackedGeometryColumn.from_boxes(x0, y0, x0 + w, y0 + h)
+
+    sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "xz2"
+    ds = DataStore()
+    ds.create_schema(sft)
+    fc = FeatureCollection.from_columns(sft, np.arange(n), {"geom": col})
+    t_in = time.perf_counter()
+    ds.write("bld", fc, check_ids=False)
+    ingest_s = time.perf_counter() - t_in
+    table = ds.table("bld", "xz2")
+    log(f"[xz2] ingest {ingest_s:.1f}s, device {table.nbytes_device / 1e9:.2f} GB")
+
+    def qset(seed):
+        r = np.random.default_rng(seed)
+        qs = []
+        for _ in range(N_QUERIES):
+            c = r.integers(0, 256)
+            qw = float(r.choice([0.02, 0.05, 0.1, 0.5, 2.0]))
+            qx = cx[c] + r.uniform(-1, 1)
+            qy = cy[c] + r.uniform(-0.8, 0.8)
+            poly = (
+                f"POLYGON(({qx:.4f} {qy:.4f}, {qx + qw:.4f} {qy:.4f}, "
+                f"{qx + qw:.4f} {qy + qw:.4f}, {qx:.4f} {qy + qw:.4f}, "
+                f"{qx:.4f} {qy:.4f}))"
+            )
+            qs.append((f"INTERSECTS(geom, {poly})", (qx, qy, qx + qw, qy + qw)))
+        return qs
+
+    warmup = [q for q, _ in qset(SEED + 21)]
+    measured_full = qset(SEED + 22)
+    measured = [q for q, _ in measured_full]
+    lat, hits, wall = run_queries(ds, "bld", (warmup, measured), "xz2")
+
+    bx0, by0 = col.bboxes[:, 0], col.bboxes[:, 1]
+    bx1, by1 = col.bboxes[:, 2], col.bboxes[:, 3]
+    times = []
+    for _, (qx0, qy0, qx1, qy1) in measured_full[:6]:
+        s = time.perf_counter()
+        m = (bx0 <= qx1) & (bx1 >= qx0) & (by0 <= qy1) & (by1 >= qy0)
+        np.nonzero(m)[0]
+        times.append(time.perf_counter() - s)
+        del m
+    base_mean = float(np.mean(times))
+
+    result_line(
+        "osm_xz2_intersects_features_per_sec_per_chip", lat, hits, wall, base_mean,
+        {
+            "n_polygons": n,
+            "ingest_rate_per_s": round(n / ingest_s, 1),
+            "device_gb": round(table.nbytes_device / 1e9, 3),
+        },
+    )
+    del ds, fc, table, col
+    gc.collect()
 
 
 def main():
@@ -112,56 +341,12 @@ def main():
     if platform:  # e.g. "cpu" for off-TPU verification runs
         jax.config.update("jax_platforms", platform)
     log(f"devices: {jax.devices()}")
-    log(f"building {N:,} point store ...")
-    t_build = time.perf_counter()
-    ds, data, ingest_s = build_store(N)
-    log(f"store built in {time.perf_counter() - t_build:.1f}s (index sort+place {ingest_s:.1f}s)")
-    table = ds.table("gdelt", "z3")
-    log(f"device bytes: {table.nbytes_device / 1e9:.2f} GB")
-
-    queries = make_queries(data[3], data[4])
-
-    # warmup: run the whole mix once untimed so every pad-bucket shape is
-    # compiled (first compile is slow over the tunnel; steady-state is what
-    # the metric measures)
-    t_warm = time.perf_counter()
-    for i, (q, _) in enumerate(queries):
-        s = time.perf_counter()
-        ds.query("gdelt", q)
-        log(f"warmup {i}: {time.perf_counter() - s:.2f}s")
-    log(f"warmup done in {time.perf_counter() - t_warm:.1f}s")
-
-    lat = []
-    hits = 0
-    t_all = time.perf_counter()
-    for q, _ in queries:
-        s = time.perf_counter()
-        out = ds.query("gdelt", q)
-        lat.append(time.perf_counter() - s)
-        hits += len(out)
-    wall = time.perf_counter() - t_all
-    lat_ms = np.array(lat) * 1e3
-
-    base_mean, _ = brute_force_times(data, queries)
-    tpu_mean = float(np.mean(lat))
-    vs_baseline = base_mean / tpu_mean
-
-    result = {
-        "metric": "gdelt_z3_bbox_time_features_per_sec_per_chip",
-        "value": round(hits / wall, 1),
-        "unit": "features/s",
-        "vs_baseline": round(vs_baseline, 2),
-        "n_points": N,
-        "n_queries": N_QUERIES,
-        "hits_total": hits,
-        "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
-        "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
-        "latency_mean_ms": round(tpu_mean * 1e3, 2),
-        "cpu_baseline_mean_ms": round(base_mean * 1e3, 2),
-        "ingest_rate_per_s": round(N / ingest_s, 1),
-        "device_gb": round(table.nbytes_device / 1e9, 3),
-    }
-    print(json.dumps(result))
+    runners = {"1": config1_z3, "2": config2_z2, "3": config3_xz2}
+    for c in CONFIGS:
+        c = c.strip()
+        t0 = time.perf_counter()
+        runners[c]()
+        log(f"[config {c}] total {time.perf_counter() - t0:.1f}s")
 
 
 if __name__ == "__main__":

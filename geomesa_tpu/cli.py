@@ -39,8 +39,11 @@ def cmd_version(args) -> int:
 def cmd_env(args) -> int:
     import jax
 
+    from geomesa_tpu import conf
+
     print(f"devices: {jax.devices()}")
     print(f"backend: {jax.default_backend()}")
+    print(conf.describe())
     return 0
 
 
@@ -112,9 +115,32 @@ def cmd_ingest(args) -> int:
     else:
         ds = DataStore()
 
+    if not args.infer and args.workers and args.workers > 1:
+        # distributed-ingest mode: process-pool converters, single writer
+        from geomesa_tpu.io.ingest import ingest_files
+
+        sft = ds.get_schema(args.feature_name)
+        conv = _converter_from_file(sft, args.converter)
+        res = ingest_files(ds, conv, args.files, workers=args.workers)
+        if res.errors:
+            print(f"{res.errors} records failed to parse", file=sys.stderr)
+        persist.save(ds, args.catalog)
+        print(
+            f"ingested {res.written} features into '{args.feature_name}' "
+            f"({res.splits} splits, {args.workers} workers)"
+        )
+        return 0
+
+    conv0 = None
+    if not args.infer:
+        conv0 = _converter_from_file(
+            ds.get_schema(args.feature_name), args.converter
+        )
     total = 0
     for path in args.files:
-        with open(path) as fh:
+        # binary formats (avro) must not be utf-8 decoded
+        mode = "rb" if conv0 is not None and conv0.fmt == "avro" else "r"
+        with open(path, mode) as fh:
             data = fh.read()
         if args.infer:
             import csv as _csv
@@ -140,8 +166,7 @@ def cmd_ingest(args) -> int:
             if args.header:
                 conv.skip_lines = 1
         else:
-            sft = ds.get_schema(args.feature_name)
-            conv = _converter_from_file(sft, args.converter)
+            conv = conv0
         fc = conv.convert(data)
         if conv._id_expr is None:
             # default running-index ids restart per file; offset by the
@@ -224,6 +249,11 @@ def build_parser() -> argparse.ArgumentParser:
     how.add_argument("--converter", help="converter config (json)")
     how.add_argument("--infer", action="store_true", help="infer schema from csv")
     sp.add_argument("--header", action="store_true", help="first row is a header")
+    sp.add_argument(
+        "--workers", type=int, default=0,
+        help="parallel converter processes (0 = in-process; reference "
+        "distributed MapReduce ingest)",
+    )
     sp.add_argument("files", nargs="+")
 
     sp = add("export", cmd_export, feature=True)

@@ -1,0 +1,95 @@
+"""Typed system properties: the GeoMesaSystemProperties analogue.
+
+Reference: /root/reference/geomesa-utils-parent/geomesa-utils/src/main/
+scala/org/locationtech/geomesa/utils/conf/GeoMesaSystemProperties.scala —
+typed ``SystemProperty`` objects with defaults, resolved from JVM system
+properties (e.g. ``geomesa.scan.ranges.target`` in index/conf/
+QueryProperties.scala, read at Z3IndexKeySpace.scala:170). Here each
+property resolves, in order: programmatic override (``prop.set``) ->
+environment variable -> default. The other two config tiers are per-query
+QueryHints (planning/hints.py) and per-schema SFT user_data (sft.py),
+mirroring the reference's three-tier layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+REGISTRY: dict[str, "SystemProperty"] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class SystemProperty:
+    """One typed, overridable configuration knob."""
+
+    name: str  # dotted name, e.g. "geomesa.scan.ranges.target"
+    default: object
+    parser: Callable = int
+    doc: str = ""
+    _override: Optional[object] = field(default=None, repr=False)
+
+    def __post_init__(self):
+        REGISTRY[self.name] = self
+
+    @property
+    def env_key(self) -> str:
+        return self.name.upper().replace(".", "_")
+
+    def get(self):
+        import os
+
+        if self._override is not None:
+            return self._override
+        raw = os.environ.get(self.env_key)
+        if raw is not None:
+            try:
+                return self.parser(raw)
+            except (TypeError, ValueError):
+                return self.default
+        return self.default
+
+    def set(self, value) -> None:
+        """Programmatic override (takes precedence over the environment);
+        ``clear()`` restores resolution."""
+        self._override = None if value is None else self.parser(value)
+
+    def clear(self) -> None:
+        self._override = None
+
+
+# -- the knobs (reference QueryProperties / index defaults) ---------------
+
+SCAN_RANGES_TARGET = SystemProperty(
+    "geomesa.scan.ranges.target", 2000, int,
+    "max covering z-ranges per query (reference QueryProperties.ScanRangesTarget)",
+)
+COMPACT_MIN_ROWS = SystemProperty(
+    "geomesa.tpu.compact.min.rows", 262_144, int,
+    "delta rows before a minor compaction merges into the device table",
+)
+DENSITY_VMEM_BUDGET = SystemProperty(
+    "geomesa.tpu.density.vmem.budget", 10 << 20, int,
+    "VMEM byte budget for the Pallas density histogram kernel",
+)
+QUERY_TIMEOUT = SystemProperty(
+    "geomesa.query.timeout", None, float,
+    "default per-query wall-clock budget in seconds (None = unbounded)",
+)
+PALLAS_MODE = SystemProperty(
+    "geomesa.tpu.pallas", None, str,
+    "force the kernel backend: '1' = Pallas (interpret off-TPU), '0' = XLA",
+)
+
+
+def describe() -> str:
+    """One line per registered property with its current value (CLI env)."""
+    out = []
+    for name in sorted(REGISTRY):
+        p = REGISTRY[name]
+        out.append(f"{name} = {p.get()!r}  [{p.env_key}] {p.doc}")
+    return "\n".join(out)

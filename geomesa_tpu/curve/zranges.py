@@ -72,7 +72,10 @@ def zranges(
     """
     if not boxes:
         return []
-    max_ranges = DEFAULT_MAX_RANGES if max_ranges is None else max_ranges
+    if max_ranges is None:
+        from geomesa_tpu.conf import SCAN_RANGES_TARGET
+
+        max_ranges = SCAN_RANGES_TARGET.get()
     if max_ranges < 1:
         raise ValueError(f"max_ranges must be >= 1: {max_ranges}")
     max_recurse = DEFAULT_MAX_RECURSE if max_recurse is None else max_recurse

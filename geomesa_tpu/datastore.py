@@ -59,6 +59,8 @@ class DataStore:
         metrics=None,
         auths: Sequence[str] | None = None,
         query_timeout: float | None = None,
+        adapter=None,
+        metadata=None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh``; when given, index
         tables shard over it and scans run as shard_map collectives
@@ -66,7 +68,10 @@ class DataStore:
         geomesa_tpu.planning.guards hooks; ``audit`` an AuditWriter;
         ``metrics`` a MetricsRegistry. ``query_timeout``: default per-query
         wall-clock budget in seconds (QueryTimeout when exceeded; a
-        QueryHints.timeout overrides it per query)."""
+        QueryHints.timeout overrides it per query). ``adapter``: a
+        storage.adapter.IndexAdapter backend (default: the in-process
+        HBM-resident adapter over ``mesh``/``tile``). ``metadata``: a
+        storage.metadata.Metadata catalog backend (default in-memory)."""
         self._schemas: dict[str, FeatureType] = {}
         # features live as a list of write-batch chunks (LSM memtable
         # pattern): writes append O(batch); the concatenated view is built
@@ -97,7 +102,28 @@ class DataStore:
         # None = security disabled; [] = only public rows (reference
         # AuthorizationsProvider semantics)
         self.auths = auths
+        if query_timeout is None:
+            from geomesa_tpu.conf import QUERY_TIMEOUT
+
+            query_timeout = QUERY_TIMEOUT.get()
         self.query_timeout = query_timeout
+        # backend SPI + catalog metadata tier
+        if adapter is None:
+            from geomesa_tpu.storage.adapter import InProcessAdapter
+
+            adapter = InProcessAdapter(mesh=mesh, tile=tile)
+        self.adapter = adapter
+        if metadata is None:
+            from geomesa_tpu.storage.metadata import CachedMetadata, InMemoryMetadata
+
+            metadata = CachedMetadata(InMemoryMetadata())
+        self.metadata = metadata
+        # store mutation lock: writes/compactions are serialized so a
+        # reader thread never observes half-updated chunk/table state
+        # (reference: synchronized metadata + single-writer invariants)
+        import threading
+
+        self._write_lock = threading.RLock()
         self.planner = QueryPlanner(self)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
@@ -117,6 +143,18 @@ class DataStore:
         self._full[sft.name] = None
         self._main_rows[sft.name] = 0
         self._id_sorted[sft.name] = None
+        # catalog entries (reference MetadataBackedDataStore.createSchema
+        # -> metadata.insert of the spec + configs)
+        import json as _json
+
+        self.metadata.insert(f"{sft.name}~schema", sft.to_spec())
+        self.metadata.insert(
+            f"{sft.name}~user_data",
+            _json.dumps({str(k): str(v) for k, v in sft.user_data.items()}),
+        )
+        self.metadata.insert(
+            f"{sft.name}~indices", ",".join(i.name for i in self._indexes[sft.name])
+        )
         return sft
 
     def _choose_indexes(self, sft: FeatureType) -> list:
@@ -157,20 +195,30 @@ class DataStore:
 
     def delete_schema(self, type_name: str) -> None:
         """Drop a schema and all its data (reference removeSchema)."""
-        self._schemas.pop(type_name)
-        self._chunks.pop(type_name, None)
-        self._full.pop(type_name, None)
-        self._main_rows.pop(type_name, None)
-        self._id_sorted.pop(type_name, None)
-        self._stats.pop(type_name, None)
-        for idx in self._indexes.pop(type_name, []):
-            self._tables.pop((type_name, idx.name), None)
-            self._key_chunks.pop((type_name, idx.name), None)
+        with self._write_lock:
+            self._schemas.pop(type_name)
+            self._chunks.pop(type_name, None)
+            self._full.pop(type_name, None)
+            self._main_rows.pop(type_name, None)
+            self._id_sorted.pop(type_name, None)
+            self._stats.pop(type_name, None)
+            for idx in self._indexes.pop(type_name, []):
+                table = self._tables.pop((type_name, idx.name), None)
+                if table is not None:
+                    self.adapter.delete_table(table)
+                self._key_chunks.pop((type_name, idx.name), None)
+            for key in (f"{type_name}~schema", f"{type_name}~user_data", f"{type_name}~indices"):
+                self.metadata.remove(key)
 
     # -- ingest ----------------------------------------------------------
     # delta tier compaction threshold: rebuild the device table when the
-    # host delta exceeds max(MIN, total/8) rows (LSM minor-compaction ratio)
-    COMPACT_MIN_ROWS = 262_144
+    # host delta exceeds max(MIN, total/8) rows (LSM minor-compaction
+    # ratio); MIN from the typed property tier (geomesa_tpu.conf)
+    @property
+    def COMPACT_MIN_ROWS(self) -> int:
+        from geomesa_tpu.conf import COMPACT_MIN_ROWS
+
+        return COMPACT_MIN_ROWS.get()
 
     def write(
         self,
@@ -192,13 +240,14 @@ class DataStore:
             features = FeatureCollection.from_rows(sft, features)
         if len(features) == 0:
             return 0
-        if check_ids:
-            self._check_ids(type_name, features)
 
         # build everything BEFORE mutating store state: a failing encoder
         # (bad dates, unsupported geometry) must leave the store untouched,
-        # not half-written (features visible but index tables stale)
-        stats = self._build_stats(type_name, features)
+        # not half-written (features visible but index tables stale). This
+        # stage is pure per-batch work, so it runs outside the write lock.
+        from geomesa_tpu.stats.store import StatsStore
+
+        batch_stats = StatsStore.build(sft, features)
         new_keys: dict[str, object] = {}
         for idx in self._indexes[type_name]:
             keys = idx.write_keys(features)
@@ -207,27 +256,36 @@ class DataStore:
                 # sketch sees only the delta batch (the store-level sketch
                 # accumulates); cell width is codec-defined (3 x per-dim
                 # precision), NOT data-dependent, so cells stay aligned
-                stats.observe_index_keys(
+                batch_stats.observe_index_keys(
                     idx.name, keys.bins, keys.zs,
                     3 * getattr(idx.sfc, "precision", 21),
                 )
 
-        # commit
-        self._chunks[type_name].append(features)
-        self._full[type_name] = None
-        self._id_sorted[type_name] = None
-        self._stats[type_name] = stats
-        for name, keys in new_keys.items():
-            self._key_chunks.setdefault((type_name, name), []).append(keys)
+        # serialized section: id check, stats merge and commit must be
+        # atomic — two racing writers would otherwise both pass the id
+        # check or both merge onto the same prior sketch (losing one batch)
+        with self._write_lock:
+            if check_ids:
+                self._check_ids(type_name, features)
+            prev = self._stats.get(type_name)
+            stats = prev.merge(batch_stats) if prev is not None else batch_stats
 
-        total = sum(len(c) for c in self._chunks[type_name])
-        delta_rows = total - self._main_rows[type_name]
-        # mesh stores use the same delta tier as single-chip stores (round 3
-        # force-compacted every mesh write; the shared engine removed that)
-        if self._main_rows[type_name] == 0 or delta_rows > max(
-            self.COMPACT_MIN_ROWS, total // 8
-        ):
-            self.compact(type_name)
+            self._chunks[type_name].append(features)
+            self._full[type_name] = None
+            self._id_sorted[type_name] = None
+            self._stats[type_name] = stats
+            for name, keys in new_keys.items():
+                self._key_chunks.setdefault((type_name, name), []).append(keys)
+
+            total = sum(len(c) for c in self._chunks[type_name])
+            delta_rows = total - self._main_rows[type_name]
+            # mesh stores use the same delta tier as single-chip stores
+            # (round 3 force-compacted every mesh write; the shared engine
+            # removed that)
+            if self._main_rows[type_name] == 0 or delta_rows > max(
+                self.COMPACT_MIN_ROWS, total // 8
+            ):
+                self.compact(type_name)
         return len(features)
 
     def delete_features(self, type_name: str, f: "Filter | str") -> int:
@@ -237,6 +295,10 @@ class DataStore:
         Rebuilds the columnar chunks and index tables without the removed
         rows (a major compaction); statistics are re-sketched from the
         survivors since sketches cannot subtract."""
+        with self._write_lock:
+            return self._delete_features_locked(type_name, f)
+
+    def _delete_features_locked(self, type_name: str, f: "Filter | str") -> int:
         out = self.query(type_name, f)
         if len(out) == 0:
             return 0
@@ -289,42 +351,34 @@ class DataStore:
         compaction; the reference's backends compact SSTables server-side).
         Also collapses the feature chunks into one collection.
 
-        Single-chip tables take the partition-preserving merge path
-        (storage.table.merged_table): only the delta is sorted and only
+        Table construction goes through the backend SPI
+        (storage.adapter.IndexAdapter): the built-in in-process adapter
+        mesh-shards when configured and takes the partition-preserving
+        merge path for single-chip updates (only the delta is sorted, only
         device blocks past the first insertion point re-upload — the
-        TimePartition analogue. Mesh tables rebuild (the round-robin block
-        deal re-homes every block when rows shift)."""
+        TimePartition analogue)."""
         from geomesa_tpu.storage.delta import concat_keys
-        from geomesa_tpu.storage.table import merged_table
 
-        main_rows = self._main_rows.get(type_name, 0)
-        full = self.features(type_name)
-        self._chunks[type_name] = [full] if len(full) else []
-        kwargs: dict = {"tile": self.tile} if self.tile else {}
-        for idx in self._indexes[type_name]:
-            parts = self._key_chunks.get((type_name, idx.name))
-            if not parts:
-                continue
-            keys = concat_keys(parts)
-            self._key_chunks[(type_name, idx.name)] = [keys]
-            old = self._tables.get((type_name, idx.name))
-            if old is not None and old.n == len(keys.zs) == main_rows:
-                continue  # empty delta: the resident table is already current
-            if self.mesh is not None:
-                from geomesa_tpu.parallel import DistributedIndexTable
-
-                table = DistributedIndexTable(idx, keys, self.mesh, **kwargs)
-            elif (
-                isinstance(old, IndexTable)
-                and old.n == main_rows
-                and 0 < main_rows < len(keys.zs)
-            ):
-                delta = _slice_keys(keys, main_rows)
-                table = merged_table(old, keys, delta, **kwargs)
-            else:
-                table = IndexTable(idx, keys, **kwargs)
-            self._tables[(type_name, idx.name)] = table
-        self._main_rows[type_name] = len(full)
+        with self._write_lock:
+            main_rows = self._main_rows.get(type_name, 0)
+            full = self.features(type_name)
+            self._chunks[type_name] = [full] if len(full) else []
+            for idx in self._indexes[type_name]:
+                parts = self._key_chunks.get((type_name, idx.name))
+                if not parts:
+                    continue
+                keys = concat_keys(parts)
+                self._key_chunks[(type_name, idx.name)] = [keys]
+                old = self._tables.get((type_name, idx.name))
+                if old is not None and old.n == len(keys.zs) == main_rows:
+                    continue  # empty delta: the resident table is current
+                table = self.adapter.create_table(
+                    idx, keys, old=old, main_rows=main_rows
+                )
+                if old is not None and old is not table:
+                    self.adapter.delete_table(old)
+                self._tables[(type_name, idx.name)] = table
+            self._main_rows[type_name] = len(full)
 
     def _check_ids(self, type_name: str, batch: FeatureCollection) -> None:
         ids = np.asarray(batch.ids)
@@ -351,18 +405,6 @@ class DataStore:
                 cached = (fc.ids[order], order)
             self._id_sorted[type_name] = cached
         return cached
-
-    def _build_stats(self, type_name: str, delta: FeatureCollection):
-        """Incremental: sketch the delta batch, merge into existing stats
-        (the reference's MetadataBackedStats merge-on-write). Pure — the
-        caller commits the result."""
-        from geomesa_tpu.stats.store import StatsStore
-
-        stats = StatsStore.build(self._schemas[type_name], delta)
-        prev = self._stats.get(type_name)
-        if prev is not None:
-            stats = prev.merge(stats)
-        return stats
 
     # -- planner hooks ---------------------------------------------------
     def indexes(self, type_name: str) -> list:

@@ -140,13 +140,29 @@ class BBox(Filter):
             )
         if isinstance(col, geo.PackedGeometryColumn):
             q = np.array(self.bounds)
-            rough = geo.bbox_intersects(col.bboxes.astype(np.float64), q)
-            out = np.zeros(len(col), dtype=bool)
             bx = geo.box(*self.bounds)
-            for i in np.nonzero(rough)[0]:
-                out[i] = geo.intersects(col.geometry(int(i)), bx)
-            return out
+            return _packed_box_intersects(col, q, bx)
         raise TypeError(f"not a geometry column: {type(col)}")
+
+
+def _packed_box_intersects(
+    col: "geo.PackedGeometryColumn", q: np.ndarray, g: "geo.Geometry"
+) -> np.ndarray:
+    """Geometry-intersects-axis-aligned-box over a packed column.
+
+    Rectangle features (geometry == bbox: footprints, tiles, extents)
+    resolve exactly with vectorized f64 bbox algebra; only non-rectangle
+    candidates fall to per-geometry exact tests."""
+    rough = geo.bbox_intersects(col.bboxes.astype(np.float64), q)
+    bmask, bb = col.box_info()
+    out = (
+        bmask
+        & (bb[:, 0] <= q[2]) & (bb[:, 2] >= q[0])
+        & (bb[:, 1] <= q[3]) & (bb[:, 3] >= q[1])
+    )
+    for i in np.nonzero(rough & ~bmask)[0]:
+        out[i] = geo.intersects(col.geometry(int(i)), g)
+    return out
 
 
 @dataclass(frozen=True)
@@ -179,6 +195,8 @@ class Intersects(Filter):
             return out
         if isinstance(col, geo.PackedGeometryColumn):
             q = np.array(g.bounds())
+            if geo.is_rectangle(g):
+                return _packed_box_intersects(col, q, g)
             rough = geo.bbox_intersects(col.bboxes.astype(np.float64), q)
             out = np.zeros(len(col), dtype=bool)
             for i in np.nonzero(rough)[0]:

@@ -445,6 +445,25 @@ def _read_wkb(buf: memoryview, pos: int) -> tuple[Geometry, int]:
     raise ValueError(f"unsupported WKB type {code}")
 
 
+def is_rectangle(g: "Geometry") -> bool:
+    """True when ``g`` is a plain axis-aligned rectangle polygon (its
+    geometry IS its bbox): bbox algebra then answers spatial predicates
+    against it exactly. Every edge must be axis-aligned (a closed 5-point
+    "bowtie" has 2 distinct xs/ys but diagonal edges — not a rectangle)."""
+    if not isinstance(g, Polygon) or g.holes:
+        return False
+    ring = g.shell
+    if len(ring) != 5 or not np.array_equal(ring[0], ring[4]):
+        return False
+    xs = set(ring[:, 0].tolist())
+    ys = set(ring[:, 1].tolist())
+    if len(xs) != 2 or len(ys) != 2:
+        return False
+    dx = ring[1:, 0] != ring[:-1, 0]
+    dy = ring[1:, 1] != ring[:-1, 1]
+    return bool(np.all(dx ^ dy))  # each edge moves in exactly one axis
+
+
 # ---------------------------------------------------------------------------
 # packed columnar geometry pool (the device-facing storage layout)
 # ---------------------------------------------------------------------------
@@ -531,6 +550,81 @@ class PackedGeometryColumn:
             types=np.array(types, dtype=np.int8),
             bboxes=np.concatenate([lo, hi], axis=1).astype(np.float32),
         )
+
+    @staticmethod
+    def from_boxes(xmin, ymin, xmax, ymax) -> "PackedGeometryColumn":
+        """Vectorized bulk constructor for n axis-aligned rectangle
+        polygons (building-footprint-style ingest): 5 CCW vertices each,
+        built with numpy broadcasting — no per-row Geometry objects."""
+        xmin = np.asarray(xmin, dtype=np.float64)
+        ymin = np.asarray(ymin, dtype=np.float64)
+        xmax = np.asarray(xmax, dtype=np.float64)
+        ymax = np.asarray(ymax, dtype=np.float64)
+        n = len(xmin)
+        coords = np.empty((n, 5, 2), dtype=np.float64)
+        coords[:, 0, 0] = xmin; coords[:, 0, 1] = ymin
+        coords[:, 1, 0] = xmax; coords[:, 1, 1] = ymin
+        coords[:, 2, 0] = xmax; coords[:, 2, 1] = ymax
+        coords[:, 3, 0] = xmin; coords[:, 3, 1] = ymax
+        coords[:, 4, 0] = xmin; coords[:, 4, 1] = ymin
+        b = np.stack([xmin, ymin, xmax, ymax], axis=1)
+        lo = np.nextafter(b[:, :2].astype(np.float32), -np.inf)
+        hi = np.nextafter(b[:, 2:].astype(np.float32), np.inf)
+        idx = np.arange(n + 1, dtype=np.int32)
+        return PackedGeometryColumn(
+            coords=coords.reshape(-1, 2),
+            ring_offsets=idx * 5,
+            part_ring_offsets=idx,
+            geom_part_offsets=idx,
+            types=np.full(n, POLYGON, dtype=np.int8),
+            bboxes=np.concatenate([lo, hi], axis=1).astype(np.float32),
+        )
+
+    def box_info(self) -> tuple[np.ndarray, np.ndarray]:
+        """(mask [n] bool, bounds [n, 4] f64): which geometries are plain
+        axis-aligned rectangles (their geometry IS their bbox) and their
+        exact f64 bounds. For those rows, bbox algebra answers spatial
+        predicates exactly — the vectorized fast tier that keeps per-row
+        Python refinement off box-shaped features (footprints, tiles,
+        gridded extents). Computed once per column and cached."""
+        cached = getattr(self, "_box_info", None)
+        if cached is not None:
+            return cached
+        n = len(self)
+        bounds = np.full((n, 4), np.nan)
+        mask = self.types == POLYGON
+        # every geometry owns >= 1 part and every part >= 1 ring, so the
+        # first-part / first-ring lookups below are always in range
+        mask &= np.diff(self.geom_part_offsets) == 1
+        first_part = self.geom_part_offsets[:-1].astype(np.int64)
+        mask &= np.diff(self.part_ring_offsets)[first_part] == 1
+        first_ring = self.part_ring_offsets[first_part].astype(np.int64)
+        mask &= np.diff(self.ring_offsets)[first_ring] == 5
+        idx = np.flatnonzero(mask)
+        if len(idx):
+            starts = self.ring_offsets[first_ring[idx]].astype(np.int64)
+            pts = self.coords[starts[:, None] + np.arange(5)]  # [k, 5, 2]
+            x0 = pts[..., 0].min(axis=1)
+            x1 = pts[..., 0].max(axis=1)
+            y0 = pts[..., 1].min(axis=1)
+            y1 = pts[..., 1].max(axis=1)
+            ok = (pts[:, 0] == pts[:, 4]).all(axis=1)  # closed ring
+            # every vertex on a corner, and all four corners present
+            on_x = (pts[..., 0] == x0[:, None]) | (pts[..., 0] == x1[:, None])
+            on_y = (pts[..., 1] == y0[:, None]) | (pts[..., 1] == y1[:, None])
+            ok &= (on_x & on_y).all(axis=1)
+            for cx, cy in ((x0, y0), (x1, y0), (x1, y1), (x0, y1)):
+                ok &= (
+                    (pts[..., 0] == cx[:, None]) & (pts[..., 1] == cy[:, None])
+                ).any(axis=1)
+            mask[idx[~ok]] = False
+            keep = idx[ok]
+            bounds[keep, 0] = x0[ok]
+            bounds[keep, 1] = y0[ok]
+            bounds[keep, 2] = x1[ok]
+            bounds[keep, 3] = y1[ok]
+        self._box_info = (mask, bounds)
+        return self._box_info
 
     # -- unpacking -------------------------------------------------------
     def _ring(self, r: int) -> np.ndarray:

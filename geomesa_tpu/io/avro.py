@@ -215,15 +215,49 @@ def _decoder_for(avro_type) -> "object":
     """Value decoder for the schema subset write_avro emits."""
     if isinstance(avro_type, dict):
         avro_type = avro_type["type"]
-    return {
-        "string": _Reader.read_str,
-        "bytes": _Reader.read_bytes,
-        "int": _Reader.read_long,
-        "long": _Reader.read_long,
-        "float": lambda r: struct.unpack("<f", r.read(4))[0],
-        "double": lambda r: struct.unpack("<d", r.read(8))[0],
-        "boolean": lambda r: r.read(1) == b"\x01",
-    }[avro_type]
+    try:
+        return {
+            "string": _Reader.read_str,
+            "bytes": _Reader.read_bytes,
+            "int": _Reader.read_long,
+            "long": _Reader.read_long,
+            "float": lambda r: struct.unpack("<f", r.read(4))[0],
+            "double": lambda r: struct.unpack("<d", r.read(8))[0],
+            "boolean": lambda r: r.read(1) == b"\x01",
+        }[avro_type]
+    except KeyError:
+        raise ValueError(f"unsupported avro type {avro_type!r}") from None
+
+
+def _field_decoder(avro_type):
+    """(decode fn(_Reader) -> value | None) for a field type, handling
+    unions in any branch order: the union index picks the branch, null
+    branches decode to None (Avro spec: unions encode a long index then
+    the value)."""
+    if isinstance(avro_type, list):
+        branches = [
+            None if b == "null" else _decoder_for(b) for b in avro_type
+        ]
+
+        def dec(r):
+            i = r.read_long()
+            if not 0 <= i < len(branches):
+                raise ValueError(f"union index {i} out of range")
+            b = branches[i]
+            return None if b is None else b(r)
+
+        return dec
+    return _decoder_for(avro_type)
+
+
+def _union_value_type(t):
+    """The non-null type of a field declaration (union or plain)."""
+    if isinstance(t, list):
+        vals = [b for b in t if b != "null"]
+        if len(vals) != 1:
+            raise ValueError(f"unsupported multi-type union {t!r}")
+        return vals[0]
+    return t
 
 
 def read_avro(data: "bytes | IO", sft: FeatureType | None = None) -> FeatureCollection:
@@ -264,12 +298,7 @@ def read_avro(data: "bytes | IO", sft: FeatureType | None = None) -> FeatureColl
         sft = _sft_from_schema(schema)
     geom_field = sft.geom_field
 
-    decoders = []
-    for f in fields[1:]:
-        t = f["type"]
-        nullable = isinstance(t, list)
-        value_t = t[1] if nullable else t
-        decoders.append((f["name"], nullable, _decoder_for(value_t)))
+    decoders = [(f["name"], _field_decoder(f["type"])) for f in fields[1:]]
 
     ids: list = []
     rows: list = []
@@ -279,18 +308,52 @@ def read_avro(data: "bytes | IO", sft: FeatureType | None = None) -> FeatureColl
         for _ in range(n_rows):
             ids.append(r.read_str())
             row = {}
-            for name, nullable, dec in decoders:
-                if nullable and r.read_long() == 0:
-                    row[name] = None
-                    continue
+            for name, dec in decoders:
                 v = dec(r)
-                if name == geom_field:
+                if v is not None and name == geom_field:
                     v = geo.from_wkb(v)
                 row[name] = v
             rows.append(row)
         if r.read(16) != sync:
             raise ValueError("sync marker mismatch: corrupt avro block")
     return FeatureCollection.from_rows(sft, rows, ids=ids)
+
+
+def read_records(data: "bytes | IO"):
+    """(schema dict, list of plain-dict records) from a container file —
+    the generic record view for the Avro ingest converter (reference
+    geomesa-convert-avro): geometry/bytes values stay raw ``bytes``, the
+    feature id is under ``__fid__``."""
+    if hasattr(data, "read"):
+        data = data.read()
+    r = _Reader(bytes(data))
+    if r.read(4) != MAGIC:
+        raise ValueError("not an avro object container file")
+    meta: dict = {}
+    while True:
+        count = r.read_long()
+        if count == 0:
+            break
+        if count < 0:
+            r.read_long()
+            count = -count
+        for _ in range(count):
+            key = r.read_str()
+            meta[key] = r.read_bytes()
+    if meta.get("avro.codec", b"null") not in (b"null", b""):
+        raise ValueError("unsupported avro codec")
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    sync = r.read(16)
+    decoders = [(f["name"], _field_decoder(f["type"])) for f in schema["fields"]]
+    records = []
+    while r.pos < len(r.b):
+        n_rows = r.read_long()
+        r.read_long()
+        for _ in range(n_rows):
+            records.append({name: dec(r) for name, dec in decoders})
+        if r.read(16) != sync:
+            raise ValueError("sync marker mismatch: corrupt avro block")
+    return schema, records
 
 
 def _sft_from_schema(schema: dict) -> FeatureType:
@@ -300,7 +363,7 @@ def _sft_from_schema(schema: dict) -> FeatureType:
     bytes_fields = [
         f["name"]
         for f in schema["fields"][1:]
-        if (f["type"][1] if isinstance(f["type"], list) else f["type"]) == "bytes"
+        if _union_value_type(f["type"]) == "bytes"
     ]
     if geom_name is None and len(bytes_fields) == 1:
         geom_name = bytes_fields[0]  # unambiguous: the geomesa layout uses
@@ -312,8 +375,7 @@ def _sft_from_schema(schema: dict) -> FeatureType:
         )
     parts = []
     for f in schema["fields"][1:]:
-        t = f["type"]
-        t = t[1] if isinstance(t, list) else t
+        t = _union_value_type(f["type"])
         if f["name"] == geom_name:
             parts.append(f"*{f['name']}:Geometry:srid=4326")
         elif isinstance(t, dict) and t.get("logicalType") == "timestamp-millis":

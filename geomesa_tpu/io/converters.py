@@ -78,6 +78,8 @@ def _compile_fns(name: str, args: list):
         return lambda rec: geo.Point(float(args[0](rec)), float(args[1](rec)))
     if name in ("geomfromwkt", "geometry"):
         return lambda rec: geo.from_wkt(str(args[0](rec)))
+    if name == "geomfromwkb":
+        return lambda rec: geo.from_wkb(args[0](rec))
     if name in ("datetime", "date", "isodate"):
         from geomesa_tpu.filter.ecql import parse_dt_millis
 
@@ -189,12 +191,16 @@ class Converter:
         self.errors = 0
 
     def convert(self, data: "str | bytes | io.IOBase") -> FeatureCollection:
-        if isinstance(data, bytes):
-            data = data.decode("utf-8")
-        if not isinstance(data, str):
-            data = data.read()
+        if self.fmt == "avro":  # binary format: never decode
+            if hasattr(data, "read"):
+                data = data.read()
+        else:
             if isinstance(data, bytes):
                 data = data.decode("utf-8")
+            if not isinstance(data, str):
+                data = data.read()
+                if isinstance(data, bytes):
+                    data = data.decode("utf-8")
         records = self._parse(data)
         rows = []
         ids = []
@@ -224,6 +230,11 @@ class Converter:
             if isinstance(doc, dict):
                 doc = [doc]
             yield from doc
+        elif self.fmt == "avro":
+            from geomesa_tpu.io.avro import read_records
+
+            _, records = read_records(data)
+            yield from records
         elif self.fmt == "xml":
             import xml.etree.ElementTree as ET
 

@@ -163,19 +163,22 @@ def _xla_density(
 # per dot. 32 sublanes * 128 lanes = 4096-deep contractions keep the MXU
 # busy (one dot per chunk instead of one per sublane).
 _DENSITY_CHUNK = 32
-_DENSITY_VMEM_BUDGET = 10 << 20  # leave headroom under the ~16 MB VMEM
+
 
 
 def _density_chunk(width, height, sub, n_cols) -> int | None:
     """Largest sublane chunk whose working set fits VMEM, or None when no
     chunk does (very large grids) — the caller then takes the XLA scatter
     path instead of failing Mosaic compilation."""
+    from geomesa_tpu.conf import DENSITY_VMEM_BUDGET
+
+    budget = DENSITY_VMEM_BUDGET.get()  # headroom under the ~16 MB VMEM
     hp = -(-height // 8) * 8
     wp = -(-width // bk.LANES) * bk.LANES
     fixed = 2 * hp * wp * 4 + n_cols * sub * bk.LANES * 4 + (1 << 20)  # acc+out, cols, slack
     ch = min(_DENSITY_CHUNK, sub)
     while ch >= 8:
-        if fixed + (hp + wp) * ch * bk.LANES * 2 <= _DENSITY_VMEM_BUDGET:
+        if fixed + (hp + wp) * ch * bk.LANES * 2 <= budget:
             return ch
         ch //= 2
     return None

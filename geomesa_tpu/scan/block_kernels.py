@@ -51,11 +51,15 @@ EXTENT_TIME_COLS = EXTENT_COLS + ("tbin", "toff")
 
 
 def use_pallas() -> bool:
-    """Pallas path: real TPU, or interpret mode under GEOMESA_TPU_PALLAS=1."""
-    env = os.environ.get("GEOMESA_TPU_PALLAS")
-    if env == "0":
+    """Pallas path: real TPU, or interpret mode when the
+    geomesa.tpu.pallas property (env GEOMESA_TPU_PALLAS) is '1';
+    '0' forces the XLA fallback."""
+    from geomesa_tpu.conf import PALLAS_MODE
+
+    mode = PALLAS_MODE.get()
+    if mode == "0":
         return False
-    return jax.default_backend() == "tpu" or env == "1"
+    return jax.default_backend() == "tpu" or mode == "1"
 
 
 # --------------------------------------------------------------- params

@@ -1,0 +1,73 @@
+"""IndexAdapter SPI: the pluggable backend seam.
+
+Reference: IndexAdapter (/root/reference/geomesa-index-api/src/main/scala/
+org/locationtech/geomesa/index/api/IndexAdapter.scala:27-86) — every
+backend (Accumulo/HBase/Cassandra/Redis/fs/...) implements one interface
+(createTable / deleteTables / createWriter / createQueryPlan) and the
+DataStore is backend-agnostic. Here the contract is columnar: an adapter
+turns (keyspace, sorted write keys) into a *scan surface* — any object
+with the IndexTable interface (scan/count/density/bounds_stats/
+candidate_spans/nbytes_device) — and owns its lifecycle. The built-in
+adapter is the in-process HBM-resident table (single-chip or mesh-
+sharded); alternative adapters can host tables elsewhere (e.g. a
+host-memory XLA-CPU tier, or a remote pool) without touching the
+DataStore or planner."""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, runtime_checkable
+
+from geomesa_tpu.index.api import IndexKeySpace, WriteKeys
+
+
+@runtime_checkable
+class IndexAdapter(Protocol):
+    """Backend SPI (reference IndexAdapter.createTable/deleteTables)."""
+
+    def create_table(
+        self,
+        keyspace: IndexKeySpace,
+        keys: WriteKeys,
+        old=None,
+        main_rows: int = 0,
+    ):
+        """Build (or incrementally update from ``old``) the scan surface
+        for one index. ``old`` is this adapter's previous table for the
+        index (or None); ``main_rows`` is the row count ``old`` was built
+        from — rows past it in ``keys`` are the freshly-compacted delta."""
+        ...
+
+    def delete_table(self, table) -> None:
+        """Release a table's resources (reference deleteTables)."""
+        ...
+
+
+class InProcessAdapter:
+    """The built-in backend: HBM-resident sorted columnar tables, mesh-
+    sharded when a mesh is configured. Single-chip updates take the
+    partition-preserving merge path (storage.table.merged_table)."""
+
+    def __init__(self, mesh=None, tile: Optional[int] = None):
+        self.mesh = mesh
+        self.tile = tile
+
+    def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
+        from geomesa_tpu.storage.table import IndexTable, merged_table
+
+        kwargs: dict = {"tile": self.tile} if self.tile else {}
+        if self.mesh is not None:
+            from geomesa_tpu.parallel import DistributedIndexTable
+
+            return DistributedIndexTable(keyspace, keys, self.mesh, **kwargs)
+        if (
+            isinstance(old, IndexTable)
+            and old.n == main_rows
+            and 0 < main_rows < len(keys.zs)
+        ):
+            from geomesa_tpu.datastore import _slice_keys
+
+            return merged_table(old, keys, _slice_keys(keys, main_rows), **kwargs)
+        return IndexTable(keyspace, keys, **kwargs)
+
+    def delete_table(self, table) -> None:
+        pass  # device arrays free with the last reference

@@ -109,6 +109,33 @@ class TestAvro:
         assert len(ds.query("av", "bbox(geom, -180, -90, 180, 90)")) == 300
 
 
+class TestAvroConverter:
+    def test_avro_ingest_via_converter(self):
+        """fmt='avro' converter: records from a container file through the
+        field-expression pipeline (reference geomesa-convert-avro)."""
+        sft, fc = make_fc(120)
+        data = write_avro(fc)
+        target = FeatureType.from_spec(
+            "mapped", "label:String,when:Date,*geom:Point:srid=4326"
+        )
+        conv = Converter(
+            sft=target,
+            fmt="avro",
+            id_field="$.__fid__",
+            fields=[
+                FieldSpec("label", "concat($.name, '-', $.age)"),
+                FieldSpec("when", "$.dtg::long"),
+                FieldSpec("geom", "geomFromWkb($.geom)"),
+            ],
+        )
+        out = conv.convert(data)
+        assert len(out) == 120
+        assert out.ids.tolist() == fc.ids.tolist()
+        assert out.columns["label"][0] == f"{fc.columns['name'][0]}-{fc.columns['age'][0]}"
+        assert np.array_equal(out.columns["when"], fc.columns["dtg"])
+        assert np.allclose(out.columns["geom"].x, fc.columns["geom"].x)
+
+
 XML_DOC = """<?xml version="1.0"?>
 <gml:featureCollection xmlns:gml="http://example.com/fake-gml">
   <gml:member>

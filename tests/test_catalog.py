@@ -1,0 +1,151 @@
+"""Catalog tiers added in round 4: typed system properties (conf),
+metadata KV backends, and the IndexAdapter SPI seam."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu.conf import COMPACT_MIN_ROWS, SCAN_RANGES_TARGET
+from geomesa_tpu.storage.metadata import CachedMetadata, FileMetadata, InMemoryMetadata
+
+
+class TestSystemProperties:
+    def test_default_and_override(self):
+        assert SCAN_RANGES_TARGET.get() == 2000
+        SCAN_RANGES_TARGET.set(500)
+        try:
+            assert SCAN_RANGES_TARGET.get() == 500
+        finally:
+            SCAN_RANGES_TARGET.clear()
+        assert SCAN_RANGES_TARGET.get() == 2000
+
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.setenv(COMPACT_MIN_ROWS.env_key, "1024")
+        assert COMPACT_MIN_ROWS.get() == 1024
+
+    def test_bad_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv(COMPACT_MIN_ROWS.env_key, "not-a-number")
+        assert COMPACT_MIN_ROWS.get() == COMPACT_MIN_ROWS.default
+
+    def test_ranges_budget_applies(self):
+        from geomesa_tpu.curve.z2sfc import Z2SFC
+
+        wide = [(-170.0, -80.0, 170.0, 80.0)]
+        many = Z2SFC().ranges(wide)
+        SCAN_RANGES_TARGET.set(16)
+        try:
+            few = Z2SFC().ranges(wide)
+        finally:
+            SCAN_RANGES_TARGET.clear()
+        assert len(few) <= 16 < len(many) + 1
+
+
+class TestMetadata:
+    def _exercise(self, md):
+        assert md.get("t~schema") is None
+        md.insert("t~schema", "a:Int,*geom:Point:srid=4326")
+        md.insert("t~user_data", "{}")
+        md.insert("u~schema", "other")
+        assert md.get("t~schema").startswith("a:Int")
+        assert dict(md.scan("t~")) == {
+            "t~schema": "a:Int,*geom:Point:srid=4326", "t~user_data": "{}",
+        }
+        md.remove("t~schema")
+        assert md.get("t~schema") is None
+        assert md.get("u~schema") == "other"
+
+    def test_in_memory(self):
+        self._exercise(InMemoryMetadata())
+
+    def test_file_backed(self, tmp_path):
+        self._exercise(FileMetadata(str(tmp_path / "md")))
+
+    def test_file_rejects_traversal(self, tmp_path):
+        md = FileMetadata(str(tmp_path / "md"))
+        with pytest.raises(ValueError):
+            md.insert("../evil", "x")
+
+    def test_cached_invalidation(self, tmp_path):
+        backend = FileMetadata(str(tmp_path / "md"))
+        md = CachedMetadata(backend)
+        md.insert("k", "v1")
+        backend.insert("k", "v2")  # external change: cache is stale
+        assert md.get("k") == "v1"
+        md.invalidate()
+        assert md.get("k") == "v2"
+
+
+def _store(**kw):
+    sft = FeatureType.from_spec("c", "name:String,dtg:Date,*geom:Point:srid=4326")
+    ds = DataStore(**kw)
+    ds.create_schema(sft)
+    rng = np.random.default_rng(2)
+    n = 1500
+    t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    fc = FeatureCollection.from_columns(
+        sft, [str(i) for i in range(n)],
+        {"name": np.array([f"n{i % 5}" for i in range(n)]),
+         "dtg": t0 + rng.integers(0, 86400_000 * 10, n),
+         "geom": (rng.uniform(-40, 40, n), rng.uniform(-30, 30, n))},
+    )
+    ds.write("c", fc)
+    return ds
+
+
+class TestAdapterSeam:
+    def test_store_catalog_entries(self):
+        ds = _store()
+        assert "geom:Point" in ds.metadata.get("c~schema")
+        assert "z3" in ds.metadata.get("c~indices")
+        ds.delete_schema("c")
+        assert ds.metadata.get("c~schema") is None
+
+    def test_custom_adapter_is_used(self):
+        from geomesa_tpu.storage.adapter import InProcessAdapter
+
+        calls = {"create": 0, "delete": 0}
+
+        class CountingAdapter(InProcessAdapter):
+            def create_table(self, keyspace, keys, old=None, main_rows=0):
+                calls["create"] += 1
+                return super().create_table(keyspace, keys, old=old, main_rows=main_rows)
+
+            def delete_table(self, table):
+                calls["delete"] += 1
+
+        ds = _store(adapter=CountingAdapter())
+        assert calls["create"] >= 1
+        n_before = calls["create"]
+        out = ds.query("c", "bbox(geom, -10, -10, 10, 10)")
+        assert len(out) > 0
+        ds.delete_schema("c")
+        assert calls["delete"] >= n_before  # every table released
+
+    def test_concurrent_writes_serialized(self):
+        import threading
+
+        sft = FeatureType.from_spec("w", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(0)
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+
+        def batch(tag):
+            n = 400
+            return FeatureCollection.from_columns(
+                sft, [f"{tag}{i}" for i in range(n)],
+                {"dtg": t0 + rng.integers(0, 86400_000, n),
+                 "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))},
+            )
+
+        batches = [batch(t) for t in "abcdefgh"]
+        threads = [
+            threading.Thread(target=ds.write, args=("w", b)) for b in batches
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ds.count("w") == 8 * 400
+        ds.compact("w")
+        assert ds.count("w") == 8 * 400

@@ -1,0 +1,137 @@
+"""Parallel converter ingest (MapReduce-ingest analogue): line-boundary
+splits, process-pool conversion, single-writer commit."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureType
+from geomesa_tpu.io.converters import Converter, FieldSpec
+from geomesa_tpu.io.ingest import ingest_files, plan_splits
+
+SPEC = "name:String,val:Double,dtg:Date,*geom:Point:srid=4326"
+
+
+def _write_csv(path, n, seed, header=True):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as fh:
+        if header:
+            fh.write("name,val,lon,lat,when\n")
+        for i in range(n):
+            fh.write(
+                f"r{seed}_{i},{rng.uniform():.4f},{rng.uniform(-60, 60):.4f},"
+                f"{rng.uniform(-45, 45):.4f},2024-02-0{1 + i % 9}T00:00:00Z\n"
+            )
+    return str(path)
+
+
+def _converter():
+    sft = FeatureType.from_spec("ing", SPEC)
+    return Converter(
+        sft=sft,
+        fmt="delimited",
+        skip_lines=1,
+        id_field="$1",
+        fields=[
+            FieldSpec("name", "$1"),
+            FieldSpec("val", "$2::double"),
+            FieldSpec("geom", "point($3, $4)"),
+            FieldSpec("dtg", "datetime($5)"),
+        ],
+    )
+
+
+class TestSplits:
+    def test_line_boundary_splits(self, tmp_path):
+        p = _write_csv(tmp_path / "big.csv", 5000, 1)
+        splits = plan_splits([p], "delimited", split_bytes=64 << 10)
+        assert len(splits) > 3
+        assert splits[0].skip_header and not splits[1].skip_header
+        # splits tile the file exactly
+        assert splits[0].start == 0
+        for a, b in zip(splits, splits[1:]):
+            assert a.end == b.start
+        import os
+
+        assert splits[-1].end == os.path.getsize(p)
+        # every split starts at a line boundary
+        with open(p, "rb") as fh:
+            for s in splits[1:]:
+                fh.seek(s.start - 1)
+                assert fh.read(1) == b"\n"
+
+    def test_non_delimited_never_splits(self, tmp_path):
+        p = tmp_path / "doc.json"
+        p.write_text("[]" * 100000)
+        assert len(plan_splits([str(p)], "json", split_bytes=1024)) == 1
+
+
+class TestParallelIngest:
+    def _expected(self, paths):
+        total = 0
+        for p in paths:
+            with open(p) as fh:
+                total += sum(1 for _ in fh) - 1
+        return total
+
+    def test_multi_file_pool(self, tmp_path):
+        paths = [_write_csv(tmp_path / f"f{i}.csv", 800, i) for i in range(4)]
+        conv = _converter()
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        res = ingest_files(ds, conv, paths, workers=2)
+        assert res.written == self._expected(paths) == ds.count("ing")
+        assert res.errors == 0
+
+    def test_single_big_file_splits_match_serial(self, tmp_path):
+        from geomesa_tpu.io import ingest as ing
+
+        p = _write_csv(tmp_path / "big.csv", 4000, 9)
+        old = ing.SPLIT_BYTES
+        ing.SPLIT_BYTES = 32 << 10  # force many splits
+        try:
+            conv = _converter()
+            ds = DataStore()
+            ds.create_schema(conv.sft)
+            res = ingest_files(ds, conv, [p], workers=2)
+        finally:
+            ing.SPLIT_BYTES = old
+        assert res.splits > 1
+        assert res.written == 4000 == ds.count("ing")
+        # same rows as a serial single-split ingest
+        serial = DataStore()
+        serial.create_schema(_converter().sft)
+        ingest_files(serial, _converter(), [p], workers=0)
+        assert sorted(ds.features("ing").ids.tolist()) == sorted(
+            serial.features("ing").ids.tolist()
+        )
+
+    def test_bad_rows_counted(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text(
+            "name,val,lon,lat,when\n"
+            "a,1.0,10,10,2024-02-01T00:00:00Z\n"
+            "b,not-a-number,10,10,2024-02-01T00:00:00Z\n"
+            "c,2.0,20,20,2024-02-02T00:00:00Z\n"
+        )
+        conv = _converter()
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        res = ingest_files(ds, conv, [str(p)], workers=0)
+        assert res.written == 2 and res.errors == 1
+
+    def test_running_index_ids_namespaced(self, tmp_path):
+        from geomesa_tpu.io import ingest as ing
+
+        p = _write_csv(tmp_path / "noid.csv", 2000, 3)
+        conv = _converter()
+        conv.id_field = None
+        conv.__post_init__()
+        old = ing.SPLIT_BYTES
+        ing.SPLIT_BYTES = 32 << 10
+        try:
+            ds = DataStore()
+            ds.create_schema(conv.sft)
+            res = ingest_files(ds, conv, [p], workers=2)
+        finally:
+            ing.SPLIT_BYTES = old
+        assert res.written == 2000 == ds.count("ing")  # no id collisions
