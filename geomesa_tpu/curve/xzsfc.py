@@ -29,7 +29,6 @@ import numpy as np
 
 from geomesa_tpu.curve.zranges import IndexRange, merge_ranges
 
-DEFAULT_MAX_RANGES = 2000
 
 
 @dataclass(frozen=True)
@@ -130,7 +129,10 @@ class XZSFC:
         """
         if not queries:
             return []
-        max_ranges = DEFAULT_MAX_RANGES if max_ranges is None else max_ranges
+        if max_ranges is None:
+            from geomesa_tpu.conf import SCAN_RANGES_TARGET
+
+            max_ranges = SCAN_RANGES_TARGET.get()
         if max_ranges < 1:
             raise ValueError(f"max_ranges must be >= 1: {max_ranges}")
         qlo = np.array([q.lo for q in queries])  # [nq, dims]

@@ -30,7 +30,6 @@ import numpy as np
 
 from geomesa_tpu.curve.zorder import _ZN, longest_common_prefix, zdiv  # noqa: F401
 
-DEFAULT_MAX_RANGES = 2000
 DEFAULT_MAX_RECURSE = 7
 
 

@@ -613,6 +613,11 @@ class PackedGeometryColumn:
             on_x = (pts[..., 0] == x0[:, None]) | (pts[..., 0] == x1[:, None])
             on_y = (pts[..., 1] == y0[:, None]) | (pts[..., 1] == y1[:, None])
             ok &= (on_x & on_y).all(axis=1)
+            # every edge axis-aligned (excludes corner-ordered "bowties",
+            # whose diagonal edges make the interior smaller than the bbox)
+            dx = pts[:, 1:, 0] != pts[:, :-1, 0]
+            dy = pts[:, 1:, 1] != pts[:, :-1, 1]
+            ok &= (dx ^ dy).all(axis=1)
             for cx, cy in ((x0, y0), (x1, y0), (x1, y1), (x0, y1)):
                 ok &= (
                     (pts[..., 0] == cx[:, None]) & (pts[..., 1] == cy[:, None])

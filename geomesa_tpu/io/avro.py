@@ -180,6 +180,16 @@ def write_avro(fc: FeatureCollection, fh: IO | None = None, block_rows: int = 40
 # ----------------------------------------------------------------- decode
 
 
+def _block_count(r: "_Reader") -> int:
+    """Data-block row count; a negative count (spec: skippable blocks)
+    carries abs(count) rows preceded by a byte size."""
+    n = r.read_long()
+    if n < 0:
+        r.read_long()  # block byte size
+        return -n
+    r.read_long()  # serialized size
+    return n
+
 class _Reader:
     def __init__(self, data: bytes):
         self.b = data
@@ -303,8 +313,7 @@ def read_avro(data: "bytes | IO", sft: FeatureType | None = None) -> FeatureColl
     ids: list = []
     rows: list = []
     while r.pos < len(r.b):
-        n_rows = r.read_long()
-        r.read_long()  # serialized size
+        n_rows = _block_count(r)
         for _ in range(n_rows):
             ids.append(r.read_str())
             row = {}
@@ -347,8 +356,7 @@ def read_records(data: "bytes | IO"):
     decoders = [(f["name"], _field_decoder(f["type"])) for f in schema["fields"]]
     records = []
     while r.pos < len(r.b):
-        n_rows = r.read_long()
-        r.read_long()
+        n_rows = _block_count(r)
         for _ in range(n_rows):
             records.append({name: dec(r) for name, dec in decoders})
         if r.read(16) != sync:

@@ -152,23 +152,32 @@ def ingest_files(
     if workers is None:
         workers = min(len(splits), os.cpu_count() or 1)
 
+    # running-index rebase: seed from the store ONCE (a features() call
+    # concatenates all chunks — doing it per split would be quadratic),
+    # then track the count locally; this writer is the only one
+    base = (
+        len(store.features(type_name))
+        if id_prefix_splits and converter.id_field is None
+        else 0
+    )
+
     def commit(fc, errors):
+        nonlocal base
         result.errors += errors
         if len(fc) == 0:
             return
         if id_prefix_splits and converter.id_field is None:
             # running-index ids restart per split AND per run: rebase onto
-            # the store's current row count (same semantics as the
-            # sequential CLI path), so repeat ingests and multi-split
-            # inputs never collide
+            # the store's row count (same semantics as the sequential CLI
+            # path), so repeat ingests and multi-split inputs never collide
             import numpy as np
 
-            base = len(store.features(type_name))
             fc = FeatureCollection(
                 fc.sft,
                 np.arange(base, base + len(fc)).astype(str),
                 fc.columns,
             )
+            base += len(fc)
         result.written += store.write(type_name, fc)
 
     if workers <= 1 or len(splits) <= 1:

@@ -1,18 +1,27 @@
 """Store persistence: save/load a DataStore's schemas and data to disk.
 
 Reference: the filesystem datastore (geomesa-fs, SURVEY.md §2.4) — a
-directory layout of metadata + columnar data files
+partition-scheme directory layout of metadata + columnar data files
 (/root/reference/geomesa-fs/geomesa-fs-storage/geomesa-fs-storage-common/
-src/main/scala/org/locationtech/geomesa/fs/storage/common/metadata/
-FileBasedMetadata.scala, parquet/ParquetFileSystemStorage.scala). The TPU
-redesign persists each feature type as one .npz of its columns (the
-Parquet-file analogue: columnar, compressed) plus a JSON metadata document
-(schema spec + user data), and rebuilds index tables on load — indexes are
-derived state, exactly as the reference rebuilds query state from
-metadata + files.
+src/main/scala/org/locationtech/geomesa/fs/storage/common/partitions/
+DateTimeScheme et al., metadata/FileBasedMetadata.scala,
+parquet/ParquetFileSystemStorage.scala). Each feature type persists as
+.npz column files (the Parquet-file analogue: columnar, compressed):
 
-Layout:  <root>/metadata.json
-         <root>/<type_name>.npz
+- atemporal types: one file, ``<type>.npz``;
+- types with a time attribute: one file per coarse time partition
+  (``<type>/p<NNNN>.npz``, partition = dtg // PARTITION_MS — the
+  DateTimeScheme analogue). Saves are INCREMENTAL: a partition whose
+  content signature matches the manifest is skipped, so steady-state
+  appends rewrite only the partitions they touched (the reference's
+  per-partition file writes).
+
+Index tables are rebuilt on load — indexes are derived state, exactly as
+the reference rebuilds query state from metadata + files.
+
+Layout:  <root>/metadata.json      (schema specs + partition manifest)
+         <root>/<type>.npz         (atemporal)
+         <root>/<type>/p<NNNN>.npz (time-partitioned)
 """
 
 from __future__ import annotations
@@ -27,17 +36,55 @@ from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
 from geomesa_tpu.sft import FeatureType
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+PARTITION_MS = 28 * 86_400_000  # ~monthly time partitions (DateTimeScheme)
 
 
+import hashlib
 import re
 
 _SAFE_NAME = re.compile(r"^[A-Za-z0-9_.-]+$")
 
 
+def _signature(fc: FeatureCollection, idx: np.ndarray) -> str:
+    """Cheap content signature of a partition's rows: ids + count. Rows
+    are append-only between saves, so (count, id digest) detects any
+    membership change; blake2b streams at memory bandwidth. Ids hash in a
+    width-independent encoding — the numpy unicode itemsize grows with the
+    longest id ANYWHERE in the type, and padding bytes must not change
+    untouched partitions' signatures."""
+    h = hashlib.blake2b(digest_size=16)
+    ids = np.asarray(fc.ids)[idx]
+    h.update(str(len(idx)).encode())
+    if ids.dtype.kind in ("U", "S", "O"):
+        h.update(b"\n".join(str(v).encode("utf-8") for v in ids))
+    else:
+        h.update(np.ascontiguousarray(ids).tobytes())
+    return h.hexdigest()
+
+
+def _partition_ids(fc: FeatureCollection, dtg_field: str | None) -> np.ndarray:
+    if dtg_field is None or len(fc) == 0:
+        return np.zeros(len(fc), dtype=np.int64)
+    return np.asarray(fc.columns[dtg_field], dtype=np.int64) // PARTITION_MS
+
+
 def save(store, root: str) -> None:
-    """Persist every schema + feature batch under ``root``."""
+    """Persist every schema + feature batch under ``root``. Incremental:
+    time partitions whose content signature matches the existing manifest
+    are not rewritten."""
     os.makedirs(root, exist_ok=True)
+    old_manifest: dict = {}
+    meta_path = os.path.join(root, "metadata.json")
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as fh:
+                old = json.load(fh)
+            if old.get("version") == FORMAT_VERSION:
+                for t, info in old.get("types", {}).items():
+                    old_manifest[t] = info.get("partitions", {})
+        except (ValueError, OSError):
+            pass
     meta: dict = {"version": FORMAT_VERSION, "types": {}}
     for name in store.type_names():
         if not _SAFE_NAME.match(name):
@@ -46,27 +93,54 @@ def save(store, root: str) -> None:
                 "([A-Za-z0-9_.-] only) — cannot persist"
             )
         sft = store.get_schema(name)
-        meta["types"][name] = {
+        info = {
             "spec": sft.to_spec(),
             "user_data": {str(k): str(v) for k, v in sft.user_data.items()},
         }
         fc = store.features(name)
-        np.savez_compressed(
-            os.path.join(root, f"{name}.npz"), **_pack_columns(sft, fc)
-        )
-    tmp = os.path.join(root, "metadata.json.tmp")
+        if sft.dtg_field is None:
+            np.savez_compressed(
+                os.path.join(root, f"{name}.npz"), **_pack_columns(sft, fc)
+            )
+        else:
+            parts = _partition_ids(fc, sft.dtg_field)
+            tdir = os.path.join(root, name)
+            os.makedirs(tdir, exist_ok=True)
+            manifest: dict = {}
+            prev = old_manifest.get(name, {})
+            kept: set = set()
+            for p in np.unique(parts):
+                idx = np.flatnonzero(parts == p)
+                sig = _signature(fc, idx)
+                fname = f"p{int(p)}.npz"
+                kept.add(fname)
+                manifest[fname] = sig
+                if prev.get(fname) == sig and os.path.exists(
+                    os.path.join(tdir, fname)
+                ):
+                    continue  # unchanged partition: skip the rewrite
+                np.savez_compressed(
+                    os.path.join(tdir, fname), **_pack_columns(sft, fc.take(idx))
+                )
+            for stale in set(os.listdir(tdir)) - kept:  # removed partitions
+                if stale.endswith(".npz"):
+                    os.remove(os.path.join(tdir, stale))
+            info["partitions"] = manifest
+        meta["types"][name] = info
+    tmp = meta_path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(meta, fh, indent=2)
-    os.replace(tmp, os.path.join(root, "metadata.json"))
+    os.replace(tmp, meta_path)
 
 
 def load(root: str, **store_kwargs):
-    """Rebuild a DataStore (indexes re-derived) from a saved directory."""
+    """Rebuild a DataStore (indexes re-derived) from a saved directory.
+    Reads both the v2 partitioned layout and the v1 single-file layout."""
     from geomesa_tpu.datastore import DataStore
 
     with open(os.path.join(root, "metadata.json")) as fh:
         meta = json.load(fh)
-    if meta.get("version") != FORMAT_VERSION:
+    if meta.get("version") not in (1, FORMAT_VERSION):
         raise ValueError(f"unsupported store format {meta.get('version')!r}")
     store = DataStore(**store_kwargs)
     for name, info in meta["types"].items():
@@ -75,9 +149,19 @@ def load(root: str, **store_kwargs):
         sft = FeatureType.from_spec(name, info["spec"])
         sft.user_data.update(info.get("user_data", {}))
         store.create_schema(sft)
-        with np.load(os.path.join(root, f"{name}.npz"), allow_pickle=False) as z:
-            fc = _unpack_columns(sft, z)
-        if len(fc):
+        pieces: list[FeatureCollection] = []
+        if "partitions" in info:
+            for fname in sorted(info["partitions"]):
+                if not _SAFE_NAME.match(fname):
+                    raise ValueError(f"unsafe partition file name: {fname!r}")
+                with np.load(os.path.join(root, name, fname), allow_pickle=False) as z:
+                    pieces.append(_unpack_columns(sft, z))
+        else:
+            with np.load(os.path.join(root, f"{name}.npz"), allow_pickle=False) as z:
+                pieces.append(_unpack_columns(sft, z))
+        pieces = [p for p in pieces if len(p)]
+        if pieces:
+            fc = pieces[0] if len(pieces) == 1 else FeatureCollection.concat(pieces)
             store.write(name, fc, check_ids=False)
     return store
 

@@ -221,3 +221,72 @@ class TestCli:
         assert cli.main(["delete-schema", "-c", cat, "-f", "s"]) == 0
         assert cli.main(["get-type-names", "-c", cat]) == 0
         assert "created schema" in capsys.readouterr().out
+
+
+class TestPartitionedPersistence:
+    """v2 partitioned layout (DateTimeScheme analogue): one npz per coarse
+    time partition, incremental re-saves skip unchanged partitions."""
+
+    def _store(self, tmp, n=4000, extra=0):
+        from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+
+        sft = FeatureType.from_spec("pp", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(1)
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        fc = FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"dtg": t0 + rng.integers(0, 120 * 86400_000, n),
+             "geom": (rng.uniform(-50, 50, n), rng.uniform(-40, 40, n))},
+        )
+        ds.write("pp", fc, check_ids=False)
+        if extra:
+            fc2 = FeatureCollection.from_columns(
+                sft, [f"x{i}" for i in range(extra)],
+                {"dtg": t0 + 119 * 86400_000 + rng.integers(0, 86400_000, extra),
+                 "geom": (rng.uniform(-50, 50, extra), rng.uniform(-40, 40, extra))},
+            )
+            ds.write("pp", fc2, check_ids=False)
+        return ds
+
+    def test_roundtrip_partitioned(self, tmp_path):
+        import os
+
+        from geomesa_tpu.storage import persist
+
+        ds = self._store(tmp_path)
+        root = str(tmp_path / "cat")
+        persist.save(ds, root)
+        files = os.listdir(os.path.join(root, "pp"))
+        assert len(files) >= 4  # 120 days / ~28-day partitions
+        back = persist.load(root)
+        assert back.count("pp") == ds.count("pp")
+        q = "bbox(geom, -10, -10, 10, 10)"
+        assert sorted(back.query("pp", q).ids.tolist()) == sorted(
+            ds.query("pp", q).ids.tolist()
+        )
+
+    def test_incremental_save_skips_unchanged(self, tmp_path):
+        import os
+
+        from geomesa_tpu.storage import persist
+
+        ds = self._store(tmp_path)
+        root = str(tmp_path / "cat")
+        persist.save(ds, root)
+        tdir = os.path.join(root, "pp")
+        mtimes = {f: os.path.getmtime(os.path.join(tdir, f)) for f in os.listdir(tdir)}
+        # append rows only to the LAST partition, then re-save
+        ds2 = self._store(tmp_path, extra=300)
+        import time as _time
+
+        _time.sleep(0.02)
+        persist.save(ds2, root)
+        changed = [
+            f for f in mtimes
+            if os.path.getmtime(os.path.join(tdir, f)) != mtimes[f]
+        ]
+        assert len(changed) == 1  # only the touched partition rewrote
+        back = persist.load(root)
+        assert back.count("pp") == ds2.count("pp")
