@@ -93,6 +93,17 @@ def _load():
             u64p, u64p, u8p, ctypes.c_int64,
         ]
         lib.zranges_cpp.restype = ctypes.c_int64
+        lib.bitmask_count.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64]
+        lib.bitmask_count.restype = ctypes.c_int64
+        lib.bitmask_decode_pair.argtypes = [
+            i32p, i32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i64p, u8p,
+        ]
+        lib.bitmask_decode_pair.restype = ctypes.c_int64
+        lib.merge_rows_spans.argtypes = [
+            i64p, i64p, ctypes.c_int64, i64p, u8p, ctypes.c_int64, i64p, u8p,
+        ]
+        lib.merge_rows_spans.restype = ctypes.c_int64
         _lib = lib
         return lib
 
@@ -219,6 +230,42 @@ def take(src: np.ndarray, idx: np.ndarray) -> "np.ndarray | None":
     out = np.empty(len(idx), dtype=src.dtype)
     getattr(lib, name)(src, idx, len(idx), out)
     return out
+
+
+def bitmask_decode_pair(wide, inner, bids, n_real: int, block: int):
+    """(rows i64, certain bool) from wide/inner bit planes — the scan
+    decode hot path (see geomesa_native.cpp), or None when native is
+    unavailable. ~25x the numpy unpackbits route on large pulls."""
+    lib = _load()
+    if lib is None or n_real == 0:
+        return None
+    wide = np.ascontiguousarray(wide[:n_real], dtype=np.int32)
+    inner = np.ascontiguousarray(inner[:n_real], dtype=np.int32)
+    bids = np.ascontiguousarray(bids[:n_real], dtype=np.int64)
+    pack = wide.shape[1]
+    count = lib.bitmask_count(wide, n_real, pack)
+    rows = np.empty(count, dtype=np.int64)
+    cert = np.empty(count, dtype=np.uint8)
+    k = lib.bitmask_decode_pair(wide, inner, bids, n_real, pack, block, rows, cert)
+    assert k == count
+    return rows, cert.astype(bool)
+
+
+def merge_rows_spans(spans, rows, cert):
+    """(rows, certain) union of contained spans (certain) and ascending
+    kernel rows, deduplicated — one C++ two-pointer pass, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    lo = np.ascontiguousarray([s[0] for s in spans], dtype=np.int64)
+    hi = np.ascontiguousarray([s[1] for s in spans], dtype=np.int64)
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    cert8 = np.ascontiguousarray(cert, dtype=np.uint8)
+    cap = int((hi - lo).sum()) + len(rows)
+    out_rows = np.empty(cap, dtype=np.int64)
+    out_cert = np.empty(cap, dtype=np.uint8)
+    k = lib.merge_rows_spans(lo, hi, len(lo), rows, cert8, len(rows), out_rows, out_cert)
+    return out_rows[:k], out_cert[:k].astype(bool)
 
 
 def zranges(dims, bits_per_dim, mins, maxes, inner_mins, inner_maxes,

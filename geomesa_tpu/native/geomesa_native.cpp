@@ -488,3 +488,90 @@ extern "C" int64_t zranges_cpp(int32_t dims, int32_t bits_per_dim, int64_t nbox,
   }
   return (int64_t)out.size();
 }
+
+// ---------------------------------------------------------------------------
+// bitmask decode: the scan pull's host decode hot path
+// (geomesa_tpu/scan/block_kernels.py decode_bits_pair; bit b of word
+// [blk, j, lane] = local row (j*32 + b)*128 + lane). The numpy route
+// (unpackbits + transpose + nonzero + fancy index) costs ~25x this.
+// ---------------------------------------------------------------------------
+
+extern "C" int64_t bitmask_count(const int32_t* wide, int64_t n_real,
+                                 int64_t pack) {
+  const uint32_t* w = (const uint32_t*)wide;
+  int64_t words = n_real * pack * 128;
+  int64_t total = 0;
+  for (int64_t i = 0; i < words; ++i) total += __builtin_popcount(w[i]);
+  return total;
+}
+
+extern "C" int64_t bitmask_decode_pair(const int32_t* wide,
+                                       const int32_t* inner,
+                                       const int64_t* bids, int64_t n_real,
+                                       int64_t pack, int64_t block,
+                                       int64_t* rows_out, uint8_t* cert_out) {
+  const uint32_t* w = (const uint32_t*)wide;
+  const uint32_t* in = (const uint32_t*)inner;
+  int64_t k = 0;
+  for (int64_t blk = 0; blk < n_real; ++blk) {
+    int64_t base = bids[blk] * block;
+    for (int64_t j = 0; j < pack; ++j) {
+      const uint32_t* wrow = w + (blk * pack + j) * 128;
+      const uint32_t* irow = in + (blk * pack + j) * 128;
+      uint32_t any = 0;
+      for (int lane = 0; lane < 128; ++lane) any |= wrow[lane];
+      if (!any) continue;  // sparse planes: skip empty sub-blocks cheaply
+      for (int b = 0; b < 32; ++b) {
+        if (!(any & (1u << b))) continue;
+        const uint32_t bit = 1u << b;
+        const int64_t rbase = base + (j * 32 + b) * 128;
+        for (int lane = 0; lane < 128; ++lane) {
+          if (wrow[lane] & bit) {
+            rows_out[k] = rbase + lane;
+            cert_out[k] = (irow[lane] & bit) ? 1 : 0;
+            ++k;
+          }
+        }
+      }
+    }
+  }
+  return k;
+}
+
+// ---------------------------------------------------------------------------
+// contained-span merge: emit the union of contained-span rows (all certain)
+// and kernel rows (with their certainty), ascending, deduplicating kernel
+// rows that fall inside a span — one two-pointer pass replacing the
+// span_rows + rows_in_spans + positional-merge numpy pipeline.
+// ---------------------------------------------------------------------------
+
+extern "C" int64_t merge_rows_spans(const int64_t* span_lo,
+                                    const int64_t* span_hi, int64_t n_spans,
+                                    const int64_t* rows, const uint8_t* cert,
+                                    int64_t n_rows, int64_t* out_rows,
+                                    uint8_t* out_cert) {
+  int64_t k = 0, r = 0;
+  for (int64_t s = 0; s < n_spans; ++s) {
+    const int64_t lo = span_lo[s], hi = span_hi[s];  // [lo, hi)
+    // kernel rows strictly before this span
+    while (r < n_rows && rows[r] < lo) {
+      out_rows[k] = rows[r];
+      out_cert[k] = cert[r];
+      ++k; ++r;
+    }
+    // the span itself (all rows certain)
+    for (int64_t v = lo; v < hi; ++v) {
+      out_rows[k] = v;
+      out_cert[k] = 1;
+      ++k;
+    }
+    // skip kernel duplicates inside the span
+    while (r < n_rows && rows[r] < hi) ++r;
+  }
+  while (r < n_rows) {
+    out_rows[k] = rows[r];
+    out_cert[k] = cert[r];
+    ++k; ++r;
+  }
+  return k;
+}

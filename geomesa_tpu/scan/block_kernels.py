@@ -384,10 +384,25 @@ def decode_bits(plane: np.ndarray, bids: np.ndarray, n_real: int) -> np.ndarray:
 
 def decode_bits_pair(wide_plane, inner_plane, bids, n_real):
     """(rows, certain) — rows ascending, certain[i] True when row i is in
-    the inner plane (no host refinement needed)."""
+    the inner plane (no host refinement needed). Native C++ decode when
+    available (~25x the numpy route on large pulls); exact numpy
+    fallback."""
     if n_real == 0:
         return np.zeros(0, np.int64), np.zeros(0, bool)
     block = wide_plane.shape[1] * 32 * LANES
+
+    from geomesa_tpu import native
+
+    nat = native.bitmask_decode_pair(
+        wide_plane, inner_plane, np.asarray(bids, np.int64), n_real, block
+    )
+    if nat is not None:
+        rows, certain = nat
+        if not _bids_sorted(bids, n_real):
+            order = np.argsort(rows, kind="stable")
+            rows, certain = rows[order], certain[order]
+        return rows, certain
+
     wb = _unpack_plane(wide_plane, n_real)
     ib = _unpack_plane(inner_plane, n_real)
     blk, local = np.nonzero(wb)

@@ -159,6 +159,30 @@ def _span_rows(spans: list[tuple[int, int]]) -> np.ndarray:
     return np.concatenate([np.arange(a, z, dtype=np.int64) for a, z in spans])
 
 
+def _merge_sorted_rows(cont_rows: np.ndarray, kr: np.ndarray, kc: np.ndarray):
+    """Merge two ascending row runs — contained rows (all certain) and
+    kernel rows with their certainty — in O(n) via the positional two-run
+    merge (an argsort over the concatenation costs n log n and dominated
+    large-query latency; see PERF.md)."""
+    nm, nd = len(cont_rows), len(kr)
+    if nd == 0:
+        return cont_rows, np.ones(nm, bool)
+    if nm == 0:
+        return kr, kc
+    pos = np.searchsorted(cont_rows, kr)
+    kr_dest = pos + np.arange(nd, dtype=np.int64)
+    cont_dest = np.arange(nm, dtype=np.int64) + np.searchsorted(
+        pos, np.arange(nm, dtype=np.int64), side="right"
+    )
+    rows = np.empty(nm + nd, np.int64)
+    certain = np.empty(nm + nd, bool)
+    rows[cont_dest] = cont_rows
+    certain[cont_dest] = True
+    rows[kr_dest] = kr
+    certain[kr_dest] = kc
+    return rows, certain
+
+
 def _spans_intersect(rng: tuple[int, int], spans: list[tuple[int, int]]) -> bool:
     """True when [rng.lo, rng.hi) intersects any [lo, hi) span."""
     lo, hi = rng
@@ -285,16 +309,17 @@ class IndexTable(SortedKeys):
             return np.zeros(0, np.int64), np.zeros(0, bool)
         check_deadline(deadline, "range pruning")
         overlap, contained = self.candidate_spans_split(config)
-        cont_rows = _span_rows(contained)
         has_pred = config.boxes is not None or config.windows is not None
 
         if not has_pred:
             # pure range scan (attribute index primary): spans are row-exact
+            cont_rows = _span_rows(contained)
             rows = np.union1d(_span_rows(overlap), cont_rows) if overlap else cont_rows
             return self.perm[rows].astype(np.int64), np.ones(len(rows), bool)
 
         blocks = self.candidate_blocks(overlap)
         if len(blocks) == 0:
+            cont_rows = _span_rows(contained)
             return self.perm[cont_rows].astype(np.int64), np.ones(len(cont_rows), bool)
 
         check_deadline(deadline, "device scan dispatch")
@@ -303,13 +328,20 @@ class IndexTable(SortedKeys):
         if config.clip_rows:
             keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
             rows, certain = rows[keep], certain[keep]
-        if len(cont_rows):
-            # kernel rows inside contained spans are duplicates of cont_rows
-            dup = _rows_in_spans(rows, contained)
-            rows = np.concatenate([rows[~dup], cont_rows])
-            certain = np.concatenate([certain[~dup], np.ones(len(cont_rows), bool)])
-            order = np.argsort(rows, kind="stable")
-            rows, certain = rows[order], certain[order]
+        if contained:
+            # union with contained-span rows (all certain), deduplicating
+            # kernel rows that fall inside a span — one native two-pointer
+            # pass when available, numpy fallback otherwise
+            from geomesa_tpu import native
+
+            merged = native.merge_rows_spans(contained, rows, certain)
+            if merged is not None:
+                rows, certain = merged
+            else:
+                dup = _rows_in_spans(rows, contained)
+                rows, certain = _merge_sorted_rows(
+                    _span_rows(contained), rows[~dup], certain[~dup]
+                )
         return self.perm[rows].astype(np.int64), certain
 
     # -- device hooks ----------------------------------------------------

@@ -119,3 +119,96 @@ def test_store_query_identical_with_and_without_native(monkeypatch):
     monkeypatch.setattr(native, "_lib", False)
     without = sorted(build().query("n", q).ids.tolist())
     assert with_native == without and len(with_native) > 0
+
+
+class TestBitmaskDecode:
+    """Native bitmask decode + span merge vs the numpy reference paths."""
+
+    def _planes(self, seed, n_real=6, pack=4):
+        rng = np.random.default_rng(seed)
+        # full u32 range: bit 31 (the int32 sign bit) must be exercised —
+        # a signed shift/compare regression in the C++ would only show there
+        wide = (
+            rng.integers(0, 1 << 32, (n_real, pack, 128), dtype=np.uint64)
+            .astype(np.uint32)
+            .view(np.int32)
+        )
+        wide[rng.uniform(size=wide.shape) < 0.7] = 0  # sparse-ish
+        inner = wide & (
+            rng.integers(0, 1 << 32, wide.shape, dtype=np.uint64)
+            .astype(np.uint32)
+            .view(np.int32)
+        )
+        bids = np.sort(rng.choice(50, n_real, replace=False)).astype(np.int64)
+        return wide, inner, bids
+
+    def test_decode_matches_numpy(self):
+        from geomesa_tpu import native
+        from geomesa_tpu.scan import block_kernels as bk
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("native unavailable")
+        for seed in range(5):
+            wide, inner, bids = self._planes(seed)
+            block = wide.shape[1] * 32 * 128
+            got = native.bitmask_decode_pair(wide, inner, bids, len(bids), block)
+            assert got is not None
+            assert (np.asarray(wide) < 0).any()  # sign bit really exercised
+            # numpy reference
+            wb = bk._unpack_plane(wide, len(bids))
+            blk, local = np.nonzero(wb)
+            rows = bids[blk] * block + local
+            cert = bk._unpack_plane(inner, len(bids))[blk, local].astype(bool)
+            assert np.array_equal(got[0], rows)
+            assert np.array_equal(got[1], cert)
+
+    def test_decode_unsorted_bids_resorted(self):
+        from geomesa_tpu import native
+        from geomesa_tpu.scan import block_kernels as bk
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("native unavailable")
+        wide, inner, _ = self._planes(11, n_real=4)
+        bids = np.array([9, 2, 30, 5], dtype=np.int64)  # deliberately unsorted
+        rows, cert = bk.decode_bits_pair(wide, inner, bids, 4)
+        assert np.all(rows[1:] > rows[:-1])  # globally ascending after resort
+        # membership matches the numpy reference
+        wb = bk._unpack_plane(wide, 4)
+        blk, local = np.nonzero(wb)
+        block = wide.shape[1] * 32 * 128
+        want = np.sort(bids[blk] * block + local)
+        assert np.array_equal(rows, want)
+
+    def test_merge_rows_spans_matches_numpy(self):
+        from geomesa_tpu import native
+        from geomesa_tpu.storage.table import (
+            _merge_sorted_rows, _rows_in_spans, _span_rows,
+        )
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("native unavailable")
+        rng = np.random.default_rng(3)
+        for _ in range(10):
+            spans = []
+            pos = 0
+            for _ in range(rng.integers(1, 6)):
+                pos += int(rng.integers(5, 40))
+                end = pos + int(rng.integers(1, 30))
+                spans.append((pos, end))
+                pos = end
+            rows = np.unique(rng.integers(0, pos + 50, 60)).astype(np.int64)
+            cert = rng.uniform(size=len(rows)) < 0.5
+            got = native.merge_rows_spans(spans, rows, cert)
+            assert got is not None
+            dup = _rows_in_spans(rows, spans)
+            want_rows, want_cert = _merge_sorted_rows(
+                _span_rows(spans), rows[~dup], cert[~dup]
+            )
+            assert np.array_equal(got[0], want_rows)
+            assert np.array_equal(got[1], want_cert)
