@@ -3,12 +3,13 @@
 
 Each process composes planner queries with vectorized post-compute:
 k-nearest-neighbour search, proximity search, tube (spatio-temporal
-corridor) select, unique-value enumeration, sampling, and density
-(the heatmap process wraps DataStore.density directly)."""
+corridor) select, unique-value enumeration, attribute joins, sampling,
+and density (the heatmap process wraps DataStore.density directly)."""
 
+from geomesa_tpu.process.join import join_search
 from geomesa_tpu.process.knn import knn_search
 from geomesa_tpu.process.proximity import proximity_search
 from geomesa_tpu.process.tube import tube_select
 from geomesa_tpu.process.unique import unique_values
 
-__all__ = ["knn_search", "proximity_search", "tube_select", "unique_values"]
+__all__ = ["join_search", "knn_search", "proximity_search", "tube_select", "unique_values"]

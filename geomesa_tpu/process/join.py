@@ -1,0 +1,77 @@
+"""Join process: correlate two feature types by attribute value.
+
+Reference: JoinProcess (/root/reference/geomesa-process/src/main/scala/
+org/locationtech/geomesa/process/query/JoinProcess.scala) — queries a
+primary type, collects the join-attribute values of the hits, and returns
+the features of a secondary type whose join attribute matches (each
+distinct value queried through the secondary store's attribute index when
+present). The columnar inversion: one vectorized membership test via
+np.isin over the secondary candidates instead of per-value queries."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import And, Filter, In, Include
+
+
+def join_search(
+    store,
+    primary_type: str,
+    secondary_type: str,
+    join_attribute: str,
+    primary_filter: "Filter | str" = Include(),
+    secondary_filter: "Filter | str | None" = None,
+    max_values: int = 10_000,
+) -> FeatureCollection:
+    """Features of ``secondary_type`` whose ``join_attribute`` value occurs
+    among the ``primary_filter`` hits of ``primary_type``.
+
+    ``max_values`` caps the number of distinct join values pushed into the
+    secondary query's IN predicate (the planner routes it through the
+    attribute index when one exists); past the cap the secondary side runs
+    ``secondary_filter`` alone and membership applies as one vectorized
+    host mask.
+    """
+    kinds = []
+    for t, name in ((primary_type, "primary"), (secondary_type, "secondary")):
+        sft = store.get_schema(t)
+        attr = next((a for a in sft.attributes if a.name == join_attribute), None)
+        if attr is None:
+            raise ValueError(
+                f"{name} type {t!r} has no attribute {join_attribute!r}"
+            )
+        if attr.is_geometry:
+            raise ValueError(
+                f"cannot join on geometry attribute {join_attribute!r}; "
+                "use the spatial join (geomesa_tpu.sql.join)"
+            )
+        kinds.append(attr.type)
+    if kinds[0] != kinds[1]:
+        raise ValueError(
+            f"join attribute {join_attribute!r} has mismatched types: "
+            f"{kinds[0]} (primary) vs {kinds[1]} (secondary)"
+        )
+    hits = store.query(primary_type, primary_filter)
+    if len(hits) == 0:
+        # empty result in the SECONDARY type's shape
+        return FeatureCollection.from_rows(store.get_schema(secondary_type), [])
+    values = np.unique(np.asarray(hits.columns[join_attribute]))
+
+    if len(values) <= max_values:
+        pred: Filter = In(join_attribute, tuple(values.tolist()))
+        if secondary_filter is not None and not isinstance(secondary_filter, Include):
+            from geomesa_tpu.filter import ecql
+
+            sec = (
+                ecql.parse(secondary_filter)
+                if isinstance(secondary_filter, str)
+                else secondary_filter
+            )
+            pred = And((pred, sec))
+        return store.query(secondary_type, pred)
+
+    out = store.query(secondary_type, secondary_filter or Include())
+    mask = np.isin(np.asarray(out.columns[join_attribute]), values)
+    return out.mask(mask)

@@ -228,3 +228,58 @@ def test_extent_geometries_distributed():
         out[mesh is None] = sorted(ds.query("polys", q).ids.tolist())
     assert out[True] == out[False]
     assert len(out[True]) > 0
+
+
+def test_union_plans_on_mesh():
+    """Cross-kind OR union plans execute per-branch mesh scans."""
+    sft = FeatureType.from_spec(
+        "um", "name:String:index=true,dtg:Date,*geom:Point:srid=4326"
+    )
+    rng = np.random.default_rng(12)
+    n = 3000
+    t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    fc = FeatureCollection.from_columns(
+        sft, [str(i) for i in range(n)],
+        {"name": np.array([f"n{i % 11}" for i in range(n)]),
+         "dtg": t0 + rng.integers(0, 30 * 86400_000, n),
+         "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n))},
+    )
+    q = "bbox(geom, -20, -15, 10, 10) OR name = 'n4'"
+    out = {}
+    for mesh in (None, make_mesh(8)):
+        ds = DataStore(mesh=mesh)
+        ds.create_schema(sft)
+        ds.write("um", fc)
+        plan = ds.planner.plan("um", q)
+        assert plan.union is not None
+        out[mesh is None] = sorted(ds.query("um", q).ids.tolist())
+    assert out[True] == out[False] and len(out[True]) > 0
+
+
+def test_timeout_on_mesh():
+    from geomesa_tpu.planning.errors import QueryTimeout
+    from geomesa_tpu.planning.hints import QueryHints
+
+    ds = _store(make_mesh(4), n=2000)
+    q = QUERIES[0]
+    with pytest.raises(QueryTimeout):
+        ds.query("pts", q, hints=QueryHints(timeout=1e-9))
+    assert len(ds.query("pts", q, hints=QueryHints(timeout=60.0))) > 0
+
+
+def test_mesh_store_persist_roundtrip(tmp_path):
+    """Mesh stores persist and reload (tables rebuilt sharded)."""
+    from geomesa_tpu.storage import persist
+
+    mesh = make_mesh(4)
+    ds = _store(mesh, n=2500)
+    root = str(tmp_path / "cat")
+    persist.save(ds, root)
+    back = persist.load(root, mesh=mesh)
+    from geomesa_tpu.parallel import DistributedIndexTable
+
+    assert isinstance(back._tables[("pts", "z3")], DistributedIndexTable)
+    for q in QUERIES[:3]:
+        assert sorted(back.query("pts", q).ids.tolist()) == sorted(
+            ds.query("pts", q).ids.tolist()
+        )

@@ -111,3 +111,85 @@ class TestUnique:
         vals, cnts = np.unique(np.asarray(fc.columns["kind"]), return_counts=True)
         assert dict(pairs) == dict(zip(vals.tolist(), cnts.tolist()))
         assert pairs[0][1] == max(cnts)
+
+
+class TestJoinProcess:
+    """JoinProcess analogue: correlate two types by attribute value."""
+
+    def _stores(self):
+        rng = np.random.default_rng(9)
+        ds = DataStore()
+        tracks = FeatureType.from_spec(
+            "tracks", "vessel:String:index=true,dtg:Date,*geom:Point:srid=4326"
+        )
+        info = FeatureType.from_spec(
+            "vessels", "vessel:String:index=true,flag:String,*geom:Point:srid=4326"
+        )
+        ds.create_schema(tracks)
+        ds.create_schema(info)
+        n = 2000
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        ds.write("tracks", FeatureCollection.from_columns(
+            tracks, [str(i) for i in range(n)],
+            {"vessel": np.array([f"v{i % 40}" for i in range(n)]),
+             "dtg": t0 + rng.integers(0, 86400_000, n),
+             "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n))},
+        ))
+        ds.write("vessels", FeatureCollection.from_columns(
+            info, [f"m{i}" for i in range(60)],
+            {"vessel": np.array([f"v{i}" for i in range(60)]),
+             "flag": np.array([f"f{i % 5}" for i in range(60)]),
+             "geom": (rng.uniform(-60, 60, 60), rng.uniform(-45, 45, 60))},
+        ))
+        return ds
+
+    def test_join_by_attribute(self):
+        from geomesa_tpu.process import join_search
+
+        ds = self._stores()
+        out = join_search(
+            ds, "tracks", "vessels", "vessel",
+            primary_filter="bbox(geom, -20, -15, 20, 15)",
+        )
+        # expected: vessels whose id appears among the primary hits
+        hits = ds.query("tracks", "bbox(geom, -20, -15, 20, 15)")
+        want = sorted(set(hits.columns["vessel"].tolist()))
+        assert sorted(out.columns["vessel"].tolist()) == want
+        assert len(out) > 0
+
+    def test_join_with_secondary_filter(self):
+        from geomesa_tpu.process import join_search
+
+        ds = self._stores()
+        out = join_search(
+            ds, "tracks", "vessels", "vessel",
+            primary_filter="bbox(geom, -60, -45, 60, 45)",
+            secondary_filter="flag = 'f2'",
+        )
+        assert len(out) > 0
+        assert set(out.columns["flag"].tolist()) == {"f2"}
+
+    def test_join_value_cap_falls_back_to_mask(self):
+        from geomesa_tpu.process import join_search
+
+        ds = self._stores()
+        small = join_search(ds, "tracks", "vessels", "vessel", max_values=3)
+        full = join_search(ds, "tracks", "vessels", "vessel")
+        assert sorted(small.ids.tolist()) == sorted(full.ids.tolist())
+
+    def test_empty_primary(self):
+        from geomesa_tpu.process import join_search
+
+        ds = self._stores()
+        out = join_search(
+            ds, "tracks", "vessels", "vessel",
+            primary_filter="vessel = 'nope'",
+        )
+        assert len(out) == 0 and out.sft.name == "vessels"
+
+    def test_unknown_attribute_rejected(self):
+        from geomesa_tpu.process import join_search
+
+        ds = self._stores()
+        with pytest.raises(ValueError):
+            join_search(ds, "tracks", "vessels", "missing")
