@@ -38,7 +38,7 @@ N1 = int(os.environ.get("GEOMESA_BENCH_N", 500_000_000))
 N2 = int(os.environ.get("GEOMESA_BENCH_N2", 200_000_000))
 N3 = int(os.environ.get("GEOMESA_BENCH_N3", 20_000_000))
 N_QUERIES = int(os.environ.get("GEOMESA_BENCH_QUERIES", 40))
-CONFIGS = os.environ.get("GEOMESA_BENCH_CONFIGS", "1,2,3").split(",")
+CONFIGS = os.environ.get("GEOMESA_BENCH_CONFIGS", "1,2,3,4,5").split(",")
 SEED = 42
 
 
@@ -334,6 +334,108 @@ def config3_xz2():
     gc.collect()
 
 
+# ------------------------------------------------------------- config 4
+
+
+def config4_join():
+    """Spatial join: GDELT-shaped points x admin-polygon-shaped rectangles
+    (BASELINE config 4; the geomesa-spark grid-partitioned join). Baseline:
+    the ungridded per-polygon scan (bbox mask over ALL points + exact
+    point-in-polygon) — what a naive executor does without the grid."""
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.sql.join import spatial_join
+
+    n_pts = int(os.environ.get("GEOMESA_BENCH_N4", 2_000_000))
+    n_poly = 256
+    rng = np.random.default_rng(SEED + 30)
+    x, y = gdelt_points(n_pts, rng)
+    px0 = rng.uniform(-170, 150, n_poly)
+    py0 = rng.uniform(-80, 60, n_poly)
+    pw = rng.uniform(1, 12, n_poly)
+    ph = rng.uniform(1, 8, n_poly)
+    polys = geo.PackedGeometryColumn.from_boxes(px0, py0, px0 + pw, py0 + ph)
+
+    psft = FeatureType.from_spec("pts", "*geom:Point:srid=4326")
+    gsft = FeatureType.from_spec("adm", "*geom:Polygon:srid=4326")
+    pts_fc = FeatureCollection.from_columns(psft, np.arange(n_pts), {"geom": (x, y)})
+    poly_fc = FeatureCollection.from_columns(gsft, np.arange(n_poly), {"geom": polys})
+
+    spatial_join(poly_fc.take(np.arange(8)), pts_fc.take(np.arange(1000)), "contains")
+    t0 = time.perf_counter()
+    li, ri = spatial_join(poly_fc, pts_fc, "contains")
+    t_join = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    total = 0
+    for p in range(min(n_poly, 16)):  # baseline sampled, extrapolated
+        bx0, by0, bx1, by1 = px0[p], py0[p], px0[p] + pw[p], py0[p] + ph[p]
+        m = (x >= bx0) & (x <= bx1) & (y >= by0) & (y <= by1)
+        total += int(m.sum())
+    base = (time.perf_counter() - t0) * (n_poly / 16)
+
+    result_line(
+        "gdelt_join_pairs_per_sec", np.array([t_join]), len(li), t_join, base,
+        {"n_points": n_pts, "n_polygons": n_poly, "pairs": len(li)},
+    )
+
+
+# ------------------------------------------------------------- config 5
+
+
+def config5_knn():
+    """kNN process on AIS-trajectory-shaped points (BASELINE config 5).
+    Baseline: full haversine + argpartition over every point per query."""
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.process import knn_search
+    from geomesa_tpu.process.knn import haversine_m
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_N5", 5_000_000))
+    rng = np.random.default_rng(SEED + 40)
+    # trajectory-shaped: random walks from seed ports
+    n_tracks = 2000
+    per = n // n_tracks
+    sx = rng.uniform(-170, 170, n_tracks)
+    sy = rng.uniform(-75, 75, n_tracks)
+    x = np.clip(
+        (sx[:, None] + np.cumsum(rng.normal(0, 0.02, (n_tracks, per)), axis=1)).ravel(),
+        -180, 180,
+    )
+    y = np.clip(
+        (sy[:, None] + np.cumsum(rng.normal(0, 0.015, (n_tracks, per)), axis=1)).ravel(),
+        -90, 90,
+    )
+    sft = FeatureType.from_spec("ais", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    ds = DataStore()
+    ds.create_schema(sft)
+    ds.write("ais", FeatureCollection.from_columns(sft, np.arange(len(x)), {"geom": (x, y)}), check_ids=False)
+
+    qs = [(float(rng.uniform(-150, 150)), float(rng.uniform(-60, 60))) for _ in range(20)]
+    knn_search(ds, "ais", *qs[0], k=10)  # warmup compiles
+    lat = []
+    t_all = time.perf_counter()
+    for qx, qy in qs:
+        s = time.perf_counter()
+        out = knn_search(ds, "ais", qx, qy, k=10)
+        lat.append(time.perf_counter() - s)
+    wall = time.perf_counter() - t_all
+
+    t0 = time.perf_counter()
+    for qx, qy in qs[:4]:  # baseline sampled
+        d = haversine_m(x, y, qx, qy)
+        np.argpartition(d, 10)[:10]
+    base = (time.perf_counter() - t0) / 4
+
+    result_line(
+        "ais_knn_queries", np.array(lat), 10 * len(qs), wall, base,
+        {"n_points": len(x), "k": 10},
+    )
+
+
 def main():
     import jax
 
@@ -341,7 +443,10 @@ def main():
     if platform:  # e.g. "cpu" for off-TPU verification runs
         jax.config.update("jax_platforms", platform)
     log(f"devices: {jax.devices()}")
-    runners = {"1": config1_z3, "2": config2_z2, "3": config3_xz2}
+    runners = {
+        "1": config1_z3, "2": config2_z2, "3": config3_xz2,
+        "4": config4_join, "5": config5_knn,
+    }
     for c in CONFIGS:
         c = c.strip()
         t0 = time.perf_counter()

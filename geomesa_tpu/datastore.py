@@ -270,6 +270,21 @@ class DataStore:
             prev = self._stats.get(type_name)
             stats = prev.merge(batch_stats) if prev is not None else batch_stats
 
+            # widen each index's known time-bin range (open-ended temporal
+            # predicates clamp to it; see index.z3.clamp_bins) — a
+            # read-modify-write, so it lives under the lock: a lost widen
+            # would make committed rows invisible to clamped queries.
+            # Attribute indexes key by value bucket; the time bins come
+            # from the tbin device column, not the sort bins.
+            for idx in self._indexes[type_name]:
+                tb = new_keys[idx.name].device_cols.get("tbin")
+                if tb is not None and len(tb):
+                    lo, hi = int(tb.min()), int(tb.max())
+                    p = idx.bin_range
+                    idx.bin_range = (
+                        (lo, hi) if p is None else (min(p[0], lo), max(p[1], hi))
+                    )
+
             self._chunks[type_name].append(features)
             self._full[type_name] = None
             self._id_sorted[type_name] = None
@@ -298,8 +313,26 @@ class DataStore:
         with self._write_lock:
             return self._delete_features_locked(type_name, f)
 
+    def age_off(self, type_name: str, ttl_ms: int, now_ms: int | None = None) -> int:
+        """Physically remove features older than ``ttl_ms`` (reference
+        AgeOffIterator compaction semantics; pair with AgeOffInterceptor
+        for query-time hiding between sweeps). Returns rows removed."""
+        import time as _time
+
+        sft = self._schemas[type_name]
+        if sft.dtg_field is None:
+            raise ValueError(f"{type_name!r} has no time attribute to age off")
+        now = now_ms if now_ms is not None else int(_time.time() * 1000)
+        from geomesa_tpu.filter.predicates import Cmp
+
+        return self.delete_features(type_name, Cmp(sft.dtg_field, "<", now - ttl_ms))
+
     def _delete_features_locked(self, type_name: str, f: "Filter | str") -> int:
-        out = self.query(type_name, f)
+        # maintenance scan: the RAW filter decides what is removed — an
+        # interceptor (age-off TTL, say) must not rewrite a deletion of
+        # expired rows into a contradiction
+        plan = self.planner.plan(type_name, f, intercept=False)
+        out = self.planner.execute(plan)
         if len(out) == 0:
             return 0
         ordinals = self.id_lookup(type_name, out.ids)
@@ -475,8 +508,15 @@ class DataStore:
 
     def apply_interceptors(self, type_name: str, f: Filter) -> Filter:
         """Run filter-rewriting interceptors in order (reference
-        QueryInterceptor SPI, hooked at QueryPlanner.scala:155)."""
+        QueryInterceptor SPI, hooked at QueryPlanner.scala:155). An
+        interceptor may define ``applies_to(sft) -> bool`` to scope itself
+        to matching schemas (e.g. AgeOffInterceptor skips types without
+        its time attribute)."""
+        sft = self._schemas.get(type_name)
         for ic in self.interceptors:
+            applies = getattr(ic, "applies_to", None)
+            if applies is not None and sft is not None and not applies(sft):
+                continue
             f = ic.rewrite(type_name, f)
         return f
 
@@ -705,7 +745,11 @@ class DataStore:
 
     def count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
         """Exact hit count (scan + refine)."""
-        if isinstance(f, Include) and not self._vis_active(type_name):
+        if (
+            isinstance(f, Include)
+            and not self._vis_active(type_name)
+            and not self.interceptors  # an interceptor may hide rows
+        ):
             return len(self.features(type_name))
         return len(self.query(type_name, f))
 
@@ -720,6 +764,9 @@ class DataStore:
             f = ecql.parse(f)
         if self._vis_active(type_name):
             return self.count(type_name, f)  # sketches can't see visibility
+        # interceptor rewrites (TTL hiding etc.) apply to estimates too —
+        # the sketch path below never reaches the planner's rewrite hook
+        f = self.apply_interceptors(type_name, f)
         if isinstance(f, Include):
             return len(self.features(type_name))
         stats = self.stats_for(type_name)
@@ -735,7 +782,11 @@ class DataStore:
                 est = stats.estimate_scan(idx.name, cfg)
                 if est is not None:
                     return int(round(est))
-        return self.count(type_name, f)
+        # exact fallback on the ALREADY-rewritten filter: plan without the
+        # interceptor hook (the rewrite would apply twice) but WITH guards
+        # — this is still a user-facing query
+        plan = self.planner.plan(type_name, f, intercept=False, guard=True)
+        return len(self.planner.execute(plan))
 
     def explain(self, type_name: str, f: "Filter | str" = INCLUDE) -> str:
         """Render the query plan trace without running the scan

@@ -941,6 +941,26 @@ def _geom_covers_point(g: Geometry, x: float, y: float) -> bool:
     raise ValueError(type(g))
 
 
+def points_on_boundary(px, py, g: Geometry) -> np.ndarray:
+    """Vectorized-over-points sibling of ``_point_on_rings``: which (px,
+    py) lie exactly on a ring edge of ``g`` (same _orient collinearity +
+    edge-bbox test, looped over the few edges instead of the many
+    points)."""
+    px = np.asarray(px, dtype=np.float64)
+    py = np.asarray(py, dtype=np.float64)
+    out = np.zeros(len(px), dtype=bool)
+    for ring in _rings_of(g):
+        p1, p2 = _ring_edges(ring)
+        for (x1, y1), (x2, y2) in zip(p1.tolist(), p2.tolist()):
+            d = _orient(x1, y1, x2, y2, px, py)
+            out |= (
+                (d == 0)
+                & (min(x1, x2) <= px) & (px <= max(x1, x2))
+                & (min(y1, y2) <= py) & (py <= max(y1, y2))
+            )
+    return out
+
+
 def _point_on_rings(g: Geometry, x: float, y: float) -> bool:
     for ring in _rings_of(g):
         p1, p2 = _ring_edges(ring)

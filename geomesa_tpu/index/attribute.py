@@ -20,6 +20,7 @@ from typing import Optional
 import numpy as np
 
 from geomesa_tpu import geometry as geo
+from geomesa_tpu.index.z3 import clamp_bins
 from geomesa_tpu.curve.binnedtime import BinnedTime, TimePeriod
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import (
@@ -47,6 +48,7 @@ class AttributeIndex:
         self.binner = (
             BinnedTime(TimePeriod.parse(sft.z3_interval)) if self.dtg else None
         )
+        self.bin_range = None  # (min, max) time bins present; see clamp_bins
 
     def supports(self, sft: FeatureType) -> bool:
         return sft.has(self.attr) and not sft.attr(self.attr).is_geometry
@@ -117,7 +119,13 @@ class AttributeIndex:
                 parts = []
                 for iv in intervals.values:
                     b, lo, hi = self.binner.bins_for_interval(iv.lo, iv.hi - 1)
+                    b, (lo, hi) = clamp_bins(self.bin_range, b, lo, hi)
+                    if len(b) == 0:
+                        continue
                     parts.append(np.stack([b, lo, hi], axis=1))
+                if not parts:
+                    # every queried time bin is absent from the store
+                    return ScanConfig.empty(self.name)
                 windows = np.concatenate(parts).astype(np.int32)
                 time_precise = intervals.precise
 

@@ -20,7 +20,7 @@ from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter, PointColumn
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
-from geomesa_tpu.index.z3 import WHOLE_WORLD, _bounds_only
+from geomesa_tpu.index.z3 import WHOLE_WORLD, _bounds_only, clamp_bins
 
 
 class S2Index:
@@ -83,6 +83,7 @@ class S3Index:
         self.period = TimePeriod.parse(sft.z3_interval)
         self.sfc = S2SFC(**s2_kwargs)
         self.binner = BinnedTime(self.period)
+        self.bin_range = None  # (min, max) time bins present; see clamp_bins
 
     def supports(self, sft) -> bool:
         return sft.is_points and sft.dtg_field is not None
@@ -126,9 +127,14 @@ class S3Index:
         bins_list, lo_list, hi_list = [], [], []
         for iv in intervals.values:
             b, lo, hi = self.binner.bins_for_interval(iv.lo, iv.hi - 1)
+            b, (lo, hi) = clamp_bins(self.bin_range, b, lo, hi)
+            if len(b) == 0:
+                continue
             bins_list.append(b)
             lo_list.append(lo)
             hi_list.append(hi)
+        if not bins_list:
+            return ScanConfig.empty(self.name)
         bins = np.concatenate(bins_list)
         windows = np.stack(
             [bins, np.concatenate(lo_list), np.concatenate(hi_list)], axis=1

@@ -20,7 +20,7 @@ from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter
 from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
-from geomesa_tpu.index.z3 import WHOLE_WORLD
+from geomesa_tpu.index.z3 import WHOLE_WORLD, clamp_bins
 from geomesa_tpu.sft import FeatureType
 
 
@@ -35,6 +35,7 @@ class XZ3Index:
         self.period = TimePeriod.parse(sft.z3_interval)
         self.sfc = XZ3SFC.for_period(self.period, sft.xz_precision)
         self.binner = BinnedTime(self.period)
+        self.bin_range = None  # (min, max) time bins present; see clamp_bins
 
     def supports(self, sft: FeatureType) -> bool:
         return (
@@ -81,9 +82,14 @@ class XZ3Index:
         bins_list, lo_list, hi_list = [], [], []
         for iv in intervals.values:
             b, lo, hi = self.binner.bins_for_interval(iv.lo, iv.hi - 1)
+            b, (lo, hi) = clamp_bins(self.bin_range, b, lo, hi)
+            if len(b) == 0:
+                continue
             bins_list.append(b)
             lo_list.append(lo)
             hi_list.append(hi)
+        if not bins_list:
+            return ScanConfig.empty(self.name)
         bins = np.concatenate(bins_list)
         los = np.concatenate(lo_list)
         his = np.concatenate(hi_list)

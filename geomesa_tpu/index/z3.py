@@ -49,6 +49,12 @@ class Z3Index:
         self.period = TimePeriod.parse(sft.z3_interval)
         self.sfc = Z3SFC.for_period(self.period)
         self.binner = BinnedTime(self.period)
+        # (min_bin, max_bin) actually present in the store, maintained by
+        # DataStore on write: open-ended time predicates (dtg >= x) clamp
+        # to it, so they cost the data's bins, not every representable bin
+        # (an unclamped `dtg >= x` materializes tens of millions of
+        # range rows — see clamp_bins)
+        self.bin_range: "tuple[int, int] | None" = None
 
     def supports(self, sft: FeatureType) -> bool:
         return sft.is_points and sft.dtg_field is not None
@@ -114,11 +120,16 @@ class Z3Index:
                 ilo[0] += 1
             if int(iv.hi) % unit != 0:
                 ihi[-1] -= 1
+            b, (lo, hi, ilo, ihi) = clamp_bins(self.bin_range, b, lo, hi, ilo, ihi)
+            if len(b) == 0:
+                continue
             bins_list.append(b)
             lo_list.append(lo)
             hi_list.append(hi)
             ilo_list.append(ilo)
             ihi_list.append(ihi)
+        if not bins_list:
+            return ScanConfig.empty(self.name)
         bins = np.concatenate(bins_list)
         los = np.concatenate(lo_list)
         his = np.concatenate(hi_list)
@@ -170,6 +181,19 @@ class Z3Index:
             boxes_inner=None if no_geom else shrink_boxes(bounds),
             windows_inner=windows_inner.astype(np.int32),
         )
+
+
+def clamp_bins(bin_range, b, *cols):
+    """Drop per-bin window rows outside the store's known (min, max) bin
+    range — exact for scanning (rows in absent bins do not exist), and the
+    guard against open-ended time predicates materializing every
+    representable bin."""
+    if bin_range is None:
+        return b, cols
+    keep = (b >= bin_range[0]) & (b <= bin_range[1])
+    if keep.all():
+        return b, cols
+    return b[keep], tuple(c[keep] for c in cols)
 
 
 def _bounds_only(geom_values) -> bool:

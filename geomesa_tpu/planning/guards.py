@@ -45,6 +45,22 @@ def _union_area(boxes) -> float:
     return float((covered * wx * wy).sum())
 
 
+def _practical_span(intervals) -> int:
+    """Total queried milliseconds, with intervals open above (e.g. the
+    `dtg >= now-ttl` an AgeOffInterceptor appends) clamped to the wall
+    clock: the guard bounds *scannable history*, and no history exists
+    past now — an astronomically-open upper endpoint must not reject a
+    bounded-below recency query."""
+    import time
+
+    now = int(time.time() * 1000)
+    total = 0
+    for iv in intervals:
+        hi = min(iv.hi, max(now, iv.lo))
+        total += max(hi - iv.lo, 0)
+    return total
+
+
 @runtime_checkable
 class QueryInterceptor(Protocol):
     """Rewrites a filter before planning (reference QueryInterceptor SPI).
@@ -74,6 +90,42 @@ class FullTableScanGuard:
 
 
 @dataclass
+class AgeOffInterceptor:
+    """Hide features older than ``ttl_ms`` from every query (reference
+    AgeOffFilter/AgeOffIterator, geomesa-accumulo/.../iterators/
+    AgeOffIterator.scala: rows past their TTL stop being visible before
+    compaction physically removes them). Queries rewrite with an extra
+    dtg >= now-ttl conjunct — the planner's z3 window then prunes the
+    expired rows at scan time; DataStore.age_off() is the physical
+    removal.
+
+    Scope: only schemas whose time attribute is named ``dtg_field``
+    (``applies_to``, consulted by DataStore.apply_interceptors) — a
+    store hosting an atemporal or differently-named type must not have
+    its queries rewritten against a missing column. ``type_name``
+    restricts the TTL to one feature type."""
+
+    ttl_ms: int
+    dtg_field: str = "dtg"
+    type_name: "str | None" = None
+    now_ms: "int | None" = None  # fixed clock for tests; None = wall clock
+
+    def applies_to(self, sft) -> bool:
+        if self.type_name is not None and sft.name != self.type_name:
+            return False
+        return sft.dtg_field == self.dtg_field
+
+    def rewrite(self, type_name: str, f: Filter) -> Filter:
+        import time
+
+        from geomesa_tpu.filter.predicates import And, Cmp
+
+        now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)
+        cutoff = Cmp(self.dtg_field, ">=", now - self.ttl_ms)
+        return cutoff if isinstance(f, Include) else And((f, cutoff))
+
+
+@dataclass
 class TemporalQueryGuard:
     """Require a bounded temporal constraint no longer than ``max_ms``
     (reference TemporalQueryGuard: `geomesa.guard.temporal.max.duration`).
@@ -92,7 +144,7 @@ class TemporalQueryGuard:
                 f"query on {plan.type_name!r} requires a temporal filter on "
                 f"{sft.dtg_field!r}"
             )
-        span = sum(iv.hi - iv.lo for iv in intervals.values)
+        span = _practical_span(intervals.values)
         if span > self.max_ms:
             raise QueryGuardError(
                 f"temporal filter spans {span}ms, over the {self.max_ms}ms limit"
@@ -142,7 +194,7 @@ class GraduatedQueryGuard:
             raise QueryGuardError(
                 f"queries over {area:.1f} deg^2 require a temporal filter"
             )
-        span = sum(iv.hi - iv.lo for iv in intervals.values)
+        span = _practical_span(intervals.values)
         if span > limit:
             raise QueryGuardError(
                 f"queries over {area:.1f} deg^2 may span at most {limit}ms "

@@ -149,16 +149,27 @@ class QueryPlanner:
         f: "Filter | str",
         limit: Optional[int] = None,
         explain: Explainer | None = None,
+        intercept: bool = True,
+        guard: "bool | None" = None,
     ) -> QueryPlan:
+        """``intercept=False`` skips the interceptor rewrite — for internal
+        maintenance scans (age-off sweeps, delete_features, which guards
+        must not reject either: ``guard`` defaults to ``intercept``) and
+        for callers that already applied the rewrite themselves (pass
+        ``guard=True`` to keep guarding those)."""
+        if guard is None:
+            guard = intercept
         t0 = time.perf_counter()
         exp = explain or ExplainNull()
         if isinstance(f, str):
             f = ecql.parse(f)
-        f = self.store.apply_interceptors(type_name, f)
+        if intercept:
+            f = self.store.apply_interceptors(type_name, f)
         exp(f"Planning query on '{type_name}': {type(f).__name__}")
 
         plan = self._select(type_name, f, limit, exp)
-        self.store.apply_guards(plan)
+        if guard:
+            self.store.apply_guards(plan)
         plan.planning_s = time.perf_counter() - t0
         return plan
 

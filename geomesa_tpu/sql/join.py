@@ -78,10 +78,25 @@ def spatial_join(
     inv_cx = nx / max(x1 - x0, 1e-12)
     inv_cy = ny / max(y1 - y0, 1e-12)
 
-    # assign features to covered cells (extents span multiple)
     in_l = (lb[:, 2] >= x0) & (lb[:, 0] <= x1) & (lb[:, 3] >= y0) & (lb[:, 1] <= y1)
-    in_r = (rb[:, 2] >= x0) & (rb[:, 0] <= x1) & (rb[:, 3] >= y0) & (rb[:, 1] <= y1)
     li = np.nonzero(in_l)[0]
+
+    # right-side points + containment-style predicate: the whole pipeline
+    # vectorizes — points sort by grid cell once, each left feature's
+    # covered cell rows slice out candidates with searchsorted, the bbox
+    # test and geo.points_in_polygon run per-left over arrays. No Python
+    # per-pair loop and no per-point cell materialization (both were the
+    # join's bottleneck), no dedup needed (a point owns exactly one cell).
+    if isinstance(right.geom_column, PointColumn) and predicate in (
+        "contains", "intersects"
+    ):
+        return _join_points_right(
+            left, right, lb, pred, predicate,
+            x0, y0, inv_cx, inv_cy, nx, ny, li,
+        )
+
+    # assign features to covered cells (extents span multiple)
+    in_r = (rb[:, 2] >= x0) & (rb[:, 0] <= x1) & (rb[:, 3] >= y0) & (rb[:, 1] <= y1)
     ri = np.nonzero(in_r)[0]
     l_cells = _cells_for(lb[li], x0, y0, inv_cx, inv_cy, nx, ny)
     r_cells = _cells_for(rb[ri], x0, y0, inv_cx, inv_cy, nx, ny)
@@ -108,12 +123,15 @@ def spatial_join(
             & (rb[cand_arr, 1] <= lb[k, 3])
             & (rb[cand_arr, 3] >= lb[k, 1])
         )
-        for j in cand_arr[ov].tolist():
+        hits = cand_arr[ov]
+        if len(hits) == 0:
+            continue
+        ga = lgeoms.get(k)
+        if ga is None:
+            ga = lgeoms[k] = _geom(left, k)
+        for j in hits.tolist():
             if (k, j) in pairs:
                 continue
-            ga = lgeoms.get(k)
-            if ga is None:
-                ga = lgeoms[k] = _geom(left, k)
             gb = rgeoms.get(j)
             if gb is None:
                 gb = rgeoms[j] = _geom(right, j)
@@ -123,6 +141,69 @@ def spatial_join(
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
     out = np.array(sorted(pairs), dtype=np.int64)
     return out[:, 0], out[:, 1]
+
+
+def _join_points_right(left, right, lb, pred, predicate, x0, y0, inv_cx, inv_cy, nx, ny, li):
+    col = right.geom_column
+    px, py = col.x, col.y
+    cx = np.clip(((px - x0) * inv_cx).astype(np.int64), 0, nx - 1)
+    cy = np.clip(((py - y0) * inv_cy).astype(np.int64), 0, ny - 1)
+    cell = cy * nx + cx
+    order = np.argsort(cell, kind="stable")
+    cell_s = cell[order]
+    px_s, py_s = px[order], py[order]
+
+    L: list[np.ndarray] = []
+    R: list[np.ndarray] = []
+    for k in li:
+        bx0, by0, bx1, by1 = lb[k]
+        cx0 = max(int((bx0 - x0) * inv_cx), 0)
+        cx1 = min(int((bx1 - x0) * inv_cx), nx - 1)
+        cy0 = max(int((by0 - y0) * inv_cy), 0)
+        cy1 = min(int((by1 - y0) * inv_cy), ny - 1)
+        if cx1 < cx0 or cy1 < cy0:
+            continue
+        chunks = [
+            np.arange(
+                np.searchsorted(cell_s, row * nx + cx0),
+                np.searchsorted(cell_s, row * nx + cx1 + 1),
+            )
+            for row in range(cy0, cy1 + 1)
+        ]
+        sel = np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+        if len(sel) == 0:
+            continue
+        xs, ys = px_s[sel], py_s[sel]
+        m = (xs >= bx0) & (xs <= bx1) & (ys >= by0) & (ys <= by1)
+        sel, xs, ys = sel[m], xs[m], ys[m]
+        if len(sel) == 0:
+            continue
+        ga = _geom(left, int(k))
+        if isinstance(ga, (geo.Polygon, geo.MultiPolygon)):
+            inside = geo.points_in_polygon(xs, ys, ga)
+            if predicate != "contains":  # intersects counts boundary points
+                out_idx = np.flatnonzero(~inside)
+                if len(out_idx):
+                    onb = geo.points_on_boundary(xs[out_idx], ys[out_idx], ga)
+                    inside[out_idx[onb]] = True
+            hit = sel[inside]
+            if len(hit):
+                L.append(np.full(len(hit), k, dtype=np.int64))
+                R.append(order[hit])
+        else:  # non-polygonal left (point/line): per-candidate exact
+            keep = [
+                s for s in sel.tolist()
+                if pred(ga, geo.Point(float(px_s[s]), float(py_s[s])))
+            ]
+            if keep:
+                L.append(np.full(len(keep), k, dtype=np.int64))
+                R.append(order[np.array(keep)])
+    if not L:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    lo = np.concatenate(L)
+    ro = np.concatenate(R).astype(np.int64)
+    srt = np.lexsort((ro, lo))
+    return lo[srt], ro[srt]
 
 
 def _geom(fc: FeatureCollection, i: int) -> geo.Geometry:

@@ -124,3 +124,55 @@ class TestAuditMetrics:
         with reg.time("op"):
             pass
         assert reg.timers["op"].count == 1
+
+
+class TestAgeOff:
+    """AgeOff: query-time hiding via interceptor + physical removal."""
+
+    T0 = int(np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64))
+
+    def _store(self, interceptor=None):
+        from geomesa_tpu import FeatureCollection
+
+        sft = FeatureType.from_spec("ev", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore(interceptors=[interceptor] if interceptor else None)
+        ds.create_schema(sft)
+        rng = np.random.default_rng(5)
+        n = 1000
+        # half old (day 0), half recent (day 20)
+        t = np.where(np.arange(n) < n // 2, self.T0, self.T0 + 20 * 86400_000)
+        ds.write("ev", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"dtg": t, "geom": (rng.uniform(-50, 50, n), rng.uniform(-40, 40, n))},
+        ), check_ids=False)
+        return ds
+
+    def test_interceptor_hides_expired(self):
+        from geomesa_tpu.planning.guards import AgeOffInterceptor
+
+        now = self.T0 + 21 * 86400_000
+        ic = AgeOffInterceptor(ttl_ms=5 * 86400_000, now_ms=now)
+        ds = self._store(ic)
+        out = ds.query("ev")
+        assert len(out) == 500  # the old half is hidden
+        assert all(int(i) >= 500 for i in out.ids)
+        # conjunct composes with user filters
+        out2 = ds.query("ev", "bbox(geom, -50, -40, 50, 40)")
+        assert len(out2) == 500
+
+    def test_physical_age_off(self):
+        ds = self._store()
+        now = self.T0 + 21 * 86400_000
+        removed = ds.age_off("ev", ttl_ms=5 * 86400_000, now_ms=now)
+        assert removed == 500
+        assert ds.count("ev") == 500
+        assert all(int(i) >= 500 for i in ds.query("ev").ids)
+
+    def test_age_off_requires_dtg(self):
+        from geomesa_tpu import FeatureCollection
+
+        sft = FeatureType.from_spec("nt", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        with pytest.raises(ValueError):
+            ds.age_off("nt", ttl_ms=1000)
