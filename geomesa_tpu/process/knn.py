@@ -28,11 +28,22 @@ def haversine_m(lon1, lat1, lon2, lat2) -> np.ndarray:
     return 2 * EARTH_RADIUS_M * np.arcsin(np.minimum(1.0, np.sqrt(a)))
 
 
+METERS_PER_DEGREE = 111_320.0  # one degree of latitude (~also longitude at equator)
+
+
 def _meters_to_degrees(m: float, lat: float) -> float:
     """Conservative (over-wide) degree radius for a meter distance."""
-    lat_deg = m / 111_320.0
+    lat_deg = m / METERS_PER_DEGREE
     lon_deg = lat_deg / max(0.01, np.cos(np.radians(min(abs(lat), 89.0))))
     return float(max(lat_deg, lon_deg))
+
+
+def _degrees_to_meters(deg: float, lat: float) -> float:
+    """Meters spanned by a longitude extent of ``deg`` at ``lat`` (the
+    inverse direction of _meters_to_degrees, same constants)."""
+    return float(
+        deg * METERS_PER_DEGREE * max(0.01, np.cos(np.radians(min(abs(lat), 89.0))))
+    )
 
 
 def knn_search(
@@ -41,7 +52,7 @@ def knn_search(
     x: float,
     y: float,
     k: int,
-    estimated_distance_m: float = 10_000.0,
+    estimated_distance_m: "float | None" = None,
     max_distance_m: float = 1_000_000.0,
     filter: Filter = Include(),
 ) -> FeatureCollection:
@@ -49,10 +60,15 @@ def knn_search(
 
     Expands the query window from ``estimated_distance_m`` by doubling
     until k in-radius hits exist or ``max_distance_m`` is reached
-    (reference's KNNQuery window protocol).
-    """
+    (reference's KNNQuery window protocol). With ``estimated_distance_m``
+    None, the start radius comes from the store's statistics — mean point
+    density over the data envelope sized so the first window expects ~4k
+    points (the reference process likewise estimates its initial window;
+    every extra expansion round costs a full store query)."""
     sft = store.get_schema(type_name)
     geom = sft.geom_field
+    if estimated_distance_m is None:
+        estimated_distance_m = _estimate_radius_m(store, type_name, k)
     # clamp to a positive start: radius 0 would never grow (min(0*2, max))
     radius = min(max(float(estimated_distance_m), 1.0), float(max_distance_m))
     while True:
@@ -71,3 +87,35 @@ def knn_search(
         elif radius >= max_distance_m:
             return out
         radius = min(radius * 2.0, max_distance_m)
+
+
+def _estimate_radius_m(store, type_name: str, k: int, fallback: float = 10_000.0) -> float:
+    """Start radius from mean point density: r such that a circle holds
+    ~4k points under uniform density over the stats envelope. Clustered
+    data departs from uniform, hence the 4x cushion; the doubling loop
+    still corrects underestimates."""
+    import math
+
+    stats = store.stats_for(type_name)
+    if stats is None:
+        return fallback
+    geom = store.get_schema(type_name).geom_field
+    bx = stats.attribute_bounds(f"{geom}.x")
+    by = stats.attribute_bounds(f"{geom}.y")
+    n = stats.total_count()
+    if not n or bx is None or by is None:
+        return fallback
+    x0, x1 = float(bx[0]), float(bx[1])
+    y0, y1 = float(by[0]), float(by[1])
+    mid_lat = (y0 + y1) / 2.0
+    area_m2 = _degrees_to_meters(max(x1 - x0, 1e-9), mid_lat) * (
+        max(y1 - y0, 1e-9) * METERS_PER_DEGREE
+    )
+    density = n / area_m2  # points per m^2
+    if density <= 0:
+        return fallback
+    r = math.sqrt(4.0 * k / (math.pi * density))
+    # floor: a tight cluster yields a microscopic r, and a query point
+    # outside the cluster would then pay many doubling rounds (each a full
+    # store query) — never start below a tenth of the old fixed default
+    return max(r, fallback / 10.0)

@@ -35,7 +35,6 @@ class StatsStore:
         self.frequencies: dict[str, Frequency] = {}
         self.topk: dict[str, TopK] = {}
         self.z3: Z3Histogram | None = None
-        self.bounds_geom: MinMax | None = None  # packed (x, y) bounds
 
     # -- build -----------------------------------------------------------
     @staticmethod

@@ -193,3 +193,42 @@ class TestJoinProcess:
         ds = self._stores()
         with pytest.raises(ValueError):
             join_search(ds, "tracks", "vessels", "missing")
+
+
+class TestKnnRadiusEstimate:
+    def test_auto_radius_reduces_expansions(self):
+        """Stats-based start radius: the first window should usually hold k
+        neighbours, so the expansion loop runs once for uniform data."""
+        rng = np.random.default_rng(14)
+        n = 20000
+        sft = FeatureType.from_spec("p", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("p", FeatureCollection.from_columns(
+            sft, np.arange(n), {"geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))}
+        ), check_ids=False)
+        from geomesa_tpu.process.knn import _estimate_radius_m, knn_search
+
+        r = _estimate_radius_m(ds, "p", 10)
+        # ~50 pts per sq-degree here: a sane estimate sits well under 100km
+        assert 1000 < r < 200_000
+        queries = 0
+        orig = ds.query
+
+        def counting(*a, **k):
+            nonlocal queries
+            queries += 1
+            return orig(*a, **k)
+
+        ds.query = counting
+        out = knn_search(ds, "p", 0.0, 0.0, k=10)
+        assert len(out) == 10
+        assert queries <= 2  # estimate good enough to avoid radius doubling
+
+    def test_fallback_without_stats(self):
+        from geomesa_tpu.process.knn import _estimate_radius_m
+
+        sft = FeatureType.from_spec("e", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        assert _estimate_radius_m(ds, "e", 10) == 10_000.0
