@@ -454,17 +454,17 @@ def main():
         "1": config1_z3, "2": config2_z2, "3": config3_xz2,
         "4": config4_join, "5": config5_knn,
     }
-    results = []
+    results: dict[str, dict] = {}
     for c in CONFIGS:
         c = c.strip()
         t0 = time.perf_counter()
-        results.append(runners[c]())
+        results[c] = runners[c]()
         log(f"[config {c}] total {time.perf_counter() - t0:.1f}s")
-    if len(results) > 1 and results[0] is not None:
+    if len(results) > 1 and results.get("1") is not None:
         # repeat the headline (config 1) as the LAST line too: a driver
         # parsing either the first or the final JSON line gets the
         # north-star metric, not whichever config happened to run last
-        print(json.dumps(results[0]), flush=True)
+        print(json.dumps(results["1"]), flush=True)
 
 
 if __name__ == "__main__":
