@@ -110,9 +110,19 @@ class XZSFC:
         return cs
 
     def index(self, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
-        """Element boxes [n, dims] -> XZ codes [n]. Reference XZ2SFC.index:54."""
+        """Element boxes [n, dims] -> XZ codes [n]. Reference XZ2SFC.index:54.
+
+        Native C++ scalar pass when available (the extent ingest hot loop;
+        ~2*g numpy full-array passes otherwise), exact numpy fallback —
+        parity asserted in tests/test_native.py."""
         lo = np.atleast_2d(np.asarray(lo, dtype=np.float64))
         hi = np.atleast_2d(np.asarray(hi, dtype=np.float64))
+
+        from geomesa_tpu import native
+
+        out = native.xz_index(lo, hi, self.dims, self.g, self.subtree_size)
+        if out is not None:
+            return out
         length = self.length_at(lo, hi)
         return self.sequence_code(lo, length)
 

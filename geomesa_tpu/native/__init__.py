@@ -104,6 +104,17 @@ def _load():
             i64p, i64p, ctypes.c_int64, i64p, u8p, ctypes.c_int64, i64p, u8p,
         ]
         lib.merge_rows_spans.restype = ctypes.c_int64
+        lib.counting_argsort.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int64, u32p,
+        ]
+        lib.bitmask_decode.argtypes = [
+            i32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i64p,
+        ]
+        lib.bitmask_decode.restype = ctypes.c_int64
+        lib.xz_index.argtypes = [
+            f64p, f64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            i64p, i64p,
+        ]
         _lib = lib
         return lib
 
@@ -251,6 +262,38 @@ def bitmask_decode_pair(wide, inner, bids, n_real: int, block: int):
     return rows, cert.astype(bool)
 
 
+def xz_index(lo, hi, dims: int, g: int, subtree) -> "np.ndarray | None":
+    """Element boxes ([n, dims] normalized lo/hi) -> XZ sequence codes, or
+    None. ``subtree`` is XZSFC.subtree_size (len g+2) so native and Python
+    agree on the preorder arithmetic. The extent-table ingest hot loop."""
+    lib = _load()
+    if lib is None or dims > 4:  # C++ cell buffers are fixed at 4 dims
+        return None
+    lo = np.ascontiguousarray(lo, dtype=np.float64)
+    hi = np.ascontiguousarray(hi, dtype=np.float64)
+    sub = np.ascontiguousarray(subtree, dtype=np.int64)
+    n = lo.shape[0]
+    out = np.empty(n, dtype=np.int64)
+    lib.xz_index(lo.reshape(-1), hi.reshape(-1), n, int(dims), int(g), sub, out)
+    return out
+
+
+def bitmask_decode(wide, bids, n_real: int, block: int):
+    """Ascending rows from a wide bit plane (no certainty — extent scans
+    skip the inner plane), or None when native is unavailable."""
+    lib = _load()
+    if lib is None or n_real == 0:
+        return None
+    wide = np.ascontiguousarray(wide[:n_real], dtype=np.int32)
+    bids = np.ascontiguousarray(bids[:n_real], dtype=np.int64)
+    pack = wide.shape[1]
+    count = lib.bitmask_count(wide, n_real, pack)
+    rows = np.empty(count, dtype=np.int64)
+    k = lib.bitmask_decode(wide, bids, n_real, pack, block, rows)
+    assert k == count
+    return rows
+
+
 def merge_rows_spans(spans, rows, cert):
     """(rows, certain) union of contained spans (certain) and ascending
     kernel rows, deduplicated — one C++ two-pointer pass, or None."""
@@ -266,6 +309,23 @@ def merge_rows_spans(spans, rows, cert):
     out_cert = np.empty(cap, dtype=np.uint8)
     k = lib.merge_rows_spans(lo, hi, len(lo), rows, cert8, len(rows), out_rows, out_cert)
     return out_rows[:k], out_cert[:k].astype(bool)
+
+
+def counting_argsort(keys, n_buckets: int) -> "np.ndarray | None":
+    """Stable O(n) argsort of int keys in [0, n_buckets) — the spatial
+    join's cell-id sort (np.argsort stable is n log n). Returns uint32
+    perm, or None when native is unavailable, n >= 2^32, or any key is
+    out of range (the C++ indexes its offsets vector by key unchecked)."""
+    lib = _load()
+    if lib is None or len(keys) >= (1 << 32) or n_buckets > (1 << 31) - 2:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    if len(keys) and (keys.min() < 0 or keys.max() >= n_buckets):
+        return None
+    keys = keys.astype(np.int32)
+    perm = np.empty(len(keys), dtype=np.uint32)
+    lib.counting_argsort(keys, len(keys), int(n_buckets), perm)
+    return perm
 
 
 def zranges(dims, bits_per_dim, mins, maxes, inner_mins, inner_maxes,

@@ -575,3 +575,92 @@ extern "C" int64_t merge_rows_spans(const int64_t* span_lo,
   }
   return k;
 }
+
+// ---------------------------------------------------------------------------
+// counting argsort: stable O(n) argsort of small-integer keys (grid cell
+// ids in the spatial join; np.argsort's n log n dominated join setup).
+// ---------------------------------------------------------------------------
+
+extern "C" void counting_argsort(const int32_t* keys, int64_t n,
+                                 int64_t n_buckets, uint32_t* perm) {
+  std::vector<int64_t> offsets(static_cast<size_t>(n_buckets) + 1, 0);
+  for (int64_t i = 0; i < n; ++i) ++offsets[keys[i] + 1];
+  for (int64_t b = 0; b < n_buckets; ++b) offsets[b + 1] += offsets[b];
+  for (int64_t i = 0; i < n; ++i)
+    perm[offsets[keys[i]]++] = static_cast<uint32_t>(i);
+}
+
+// wide-only decode (extent scans skip the inner plane entirely)
+extern "C" int64_t bitmask_decode(const int32_t* wide, const int64_t* bids,
+                                  int64_t n_real, int64_t pack, int64_t block,
+                                  int64_t* rows_out) {
+  const uint32_t* w = (const uint32_t*)wide;
+  int64_t k = 0;
+  for (int64_t blk = 0; blk < n_real; ++blk) {
+    int64_t base = bids[blk] * block;
+    for (int64_t j = 0; j < pack; ++j) {
+      const uint32_t* wrow = w + (blk * pack + j) * 128;
+      uint32_t any = 0;
+      for (int lane = 0; lane < 128; ++lane) any |= wrow[lane];
+      if (!any) continue;
+      for (int b = 0; b < 32; ++b) {
+        if (!(any & (1u << b))) continue;
+        const uint32_t bit = 1u << b;
+        const int64_t rbase = base + (j * 32 + b) * 128;
+        for (int lane = 0; lane < 128; ++lane) {
+          if (wrow[lane] & bit) rows_out[k++] = rbase + lane;
+        }
+      }
+    }
+  }
+  return k;
+}
+
+// ---------------------------------------------------------------------------
+// XZ index write path: element boxes -> XZ sequence codes (the extent-table
+// analogue of z3_write_keys). Same construction as curve/xzsfc.py
+// XZSFC.length_at + sequence_code (Boehm et al. XZ-ordering, re-derived;
+// reference XZ2SFC.index:54-77): deepest level whose enlarged cell still
+// contains the element, then the preorder code of the cell holding the
+// element's low corner at that level. One scalar pass per element replaces
+// ~2*g full-array numpy passes.
+// ---------------------------------------------------------------------------
+
+extern "C" void xz_index(const double* lo, const double* hi, int64_t n,
+                         int32_t dims, int32_t g, const int64_t* subtree,
+                         int64_t* out) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t e = 0; e < n; ++e) {
+    const double* el = lo + e * dims;
+    const double* eh = hi + e * dims;
+    double extent = 0.0;
+    for (int32_t d = 0; d < dims; ++d)
+      extent = std::max(extent, eh[d] - el[d]);
+    int64_t l1 = (int64_t)std::floor(std::log(std::max(extent, 1e-300)) /
+                                     std::log(0.5));
+    if (l1 > g) l1 = g;
+    const int64_t lp = std::min<int64_t>(l1 + 1, g);
+    const double w2 = std::ldexp(1.0, (int)-lp);  // 0.5^lp, exact
+    bool fits = true;
+    for (int32_t d = 0; d < dims; ++d) {
+      const double anchor = std::floor(el[d] / w2) * w2;
+      if (eh[d] > anchor + 2.0 * w2) { fits = false; break; }
+    }
+    int64_t length = fits ? lp : std::max<int64_t>(l1, 0);
+    if (length > g) length = g;
+    int64_t cs = 0;
+    double clo[4] = {0, 0, 0, 0}, chi[4] = {1, 1, 1, 1};
+    for (int64_t i = 0; i < length; ++i) {
+      int64_t q = 0;
+      for (int32_t d = 0; d < dims; ++d) {
+        const double c = (clo[d] + chi[d]) * 0.5;
+        if (el[d] >= c) { q |= (int64_t)1 << d; clo[d] = c; }
+        else chi[d] = c;
+      }
+      cs += 1 + q * subtree[i + 1];
+    }
+    out[e] = cs;
+  }
+}

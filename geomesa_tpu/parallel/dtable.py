@@ -45,18 +45,21 @@ def _dist_scan(mesh, names, has_boxes, has_windows, extent):
     device_get is the only cross-host movement."""
     axis = mesh.axis_names[0]
 
+    skip = bk.skip_inner_plane(has_boxes, extent)
+
     def body(bids, boxes, wins, *cols):
         w, i = bk.block_scan(
             tuple(c[0] for c in cols), bids[0], boxes, wins,
             col_names=names, has_boxes=has_boxes, has_windows=has_windows,
             extent=extent,
         )
-        return w[None], i[None]
+        return w[None] if skip else (w[None], i[None])
 
     in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
     return jax.jit(
         jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=(P(axis), P(axis)),
+            body, mesh=mesh, in_specs=in_specs,
+            out_specs=P(axis) if skip else (P(axis), P(axis)),
             check_vma=False,
         )
     )
@@ -200,16 +203,24 @@ class DistributedIndexTable(IndexTable):
         names = kw["col_names"]
         self._record_scan(names, bids2.size)
         fn = _dist_scan(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
-        wide, inner = fn(bids2, boxes, wins, *self._cols_args(names))
-        wide_h, inner_h = jax.device_get((wide, inner))
-        wide_h, inner_h = np.asarray(wide_h), np.asarray(inner_h)
+        if bk.skip_inner_plane(kw["has_boxes"], kw["extent"]):
+            wide_h = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args(names))))
+            inner_h = None
+        else:
+            wide, inner = fn(bids2, boxes, wins, *self._cols_args(names))
+            wide_h, inner_h = jax.device_get((wide, inner))
+            wide_h, inner_h = np.asarray(wide_h), np.asarray(inner_h)
         parts = []
         for d in range(D):
             nr = int(n_real[d])
             if nr == 0:
                 continue
             gb = bids2[d].astype(np.int64) * D + d  # local slot -> global block
-            parts.append(bk.decode_bits_pair(wide_h[d], inner_h[d], gb, nr))
+            parts.append(
+                bk.decode_bits_pair(
+                    wide_h[d], None if inner_h is None else inner_h[d], gb, nr
+                )
+            )
         return self._merge_device_rows(parts)
 
     def _device_pops(self, blocks: np.ndarray, config: ScanConfig):

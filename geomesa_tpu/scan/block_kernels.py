@@ -263,15 +263,25 @@ def _pack_bits(m, pack):
     return (u << shifts).sum(axis=1, dtype=jnp.int32)
 
 
+def skip_inner_plane(has_boxes: bool, extent: bool) -> bool:
+    """Extent-mode box scans have an identically-false inner plane (bbox
+    intersection can never certify the true geometry predicate — see
+    _masks), so kernels skip emitting it and the host skips pulling it:
+    at the measured ~30 MB/s pull bandwidth (PERF.md §1) the dead plane
+    was ~half the per-query device time on XZ tables."""
+    return extent and has_boxes
+
+
 def _make_pallas_kernel(col_names, has_boxes, has_windows, extent, pack):
     n = len(col_names)
+    skip = skip_inner_plane(has_boxes, extent)
 
     def kernel(bids_ref, boxes_ref, wins_ref, *refs):
         cols = {name: refs[k][0] for k, name in enumerate(col_names)}
-        outw_ref, outi_ref = refs[n], refs[n + 1]
         w, i = _masks(cols, boxes_ref, wins_ref, has_boxes, has_windows, extent)
-        outw_ref[0] = _pack_bits(w, pack)
-        outi_ref[0] = _pack_bits(i, pack)
+        refs[n][0] = _pack_bits(w, pack)
+        if not skip:
+            refs[n + 1][0] = _pack_bits(i, pack)
 
     return kernel
 
@@ -292,6 +302,7 @@ def _pallas_block_scan(
     M = bids.shape[0]
     SUB = cols3[0].shape[1]
     PACK = SUB // 32
+    n_out = 1 if skip_inner_plane(has_boxes, extent) else 2
     kernel = _make_pallas_kernel(col_names, has_boxes, has_windows, extent, PACK)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -305,19 +316,16 @@ def _pallas_block_scan(
             for _ in col_names
         ],
         out_specs=[
-            pl.BlockSpec((1, PACK, LANES), lambda i, bids: (i, 0, 0)),
-            pl.BlockSpec((1, PACK, LANES), lambda i, bids: (i, 0, 0)),
-        ],
+            pl.BlockSpec((1, PACK, LANES), lambda i, bids: (i, 0, 0))
+        ] * n_out,
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32),
-            jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32),
-        ],
+        out_shape=[jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32)] * n_out,
         interpret=interpret,
     )(bids, boxes, wins, *cols3)
+    return (out[0], None) if n_out == 1 else (out[0], out[1])
 
 
 @partial(
@@ -337,12 +345,16 @@ def _xla_block_scan(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windo
         u = m.astype(jnp.int32).reshape(M, PACK, 32, LANES)
         return (u << shifts).sum(axis=2, dtype=jnp.int32)
 
+    if skip_inner_plane(has_boxes, extent):
+        return pack(w), None
     return pack(w), pack(i)
 
 
 def block_scan(cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent):
     """Dispatch to Pallas (TPU) / interpret / XLA by backend. All shapes
-    static: (len(bids), col_names, flags) determine the compiled variant."""
+    static: (len(bids), col_names, flags) determine the compiled variant.
+    Returns (wide, inner) planes; inner is None when skip_inner_plane()
+    (extent box scans — the plane would be identically false)."""
     if use_pallas():
         interpret = jax.default_backend() != "tpu"
         return _pallas_block_scan(
@@ -376,20 +388,29 @@ def decode_bits(plane: np.ndarray, bids: np.ndarray, n_real: int) -> np.ndarray:
     if n_real == 0:
         return np.zeros(0, np.int64)
     block = plane.shape[1] * 32 * LANES
-    flat = _unpack_plane(plane, n_real)
-    blk, local = np.nonzero(flat)
-    rows = bids[:n_real][blk].astype(np.int64) * block + local
+
+    from geomesa_tpu import native
+
+    rows = native.bitmask_decode(plane, np.asarray(bids, np.int64), n_real, block)
+    if rows is None:
+        flat = _unpack_plane(plane, n_real)
+        blk, local = np.nonzero(flat)
+        rows = bids[:n_real][blk].astype(np.int64) * block + local
     return np.sort(rows) if not _bids_sorted(bids, n_real) else rows
 
 
 def decode_bits_pair(wide_plane, inner_plane, bids, n_real):
     """(rows, certain) — rows ascending, certain[i] True when row i is in
-    the inner plane (no host refinement needed). Native C++ decode when
-    available (~25x the numpy route on large pulls); exact numpy
-    fallback."""
+    the inner plane (no host refinement needed). ``inner_plane=None``
+    (extent scans, skip_inner_plane) decodes wide only with certain all
+    False. Native C++ decode when available (~25x the numpy route on large
+    pulls); exact numpy fallback."""
     if n_real == 0:
         return np.zeros(0, np.int64), np.zeros(0, bool)
     block = wide_plane.shape[1] * 32 * LANES
+    if inner_plane is None:
+        rows = decode_bits(wide_plane, bids, n_real)
+        return rows, np.zeros(len(rows), bool)
 
     from geomesa_tpu import native
 

@@ -419,8 +419,11 @@ class IndexTable(SortedKeys):
             self._cols_args(names), bids, boxes, wins,
             **self._kernel_kwargs(config, names),
         )
+        # inner is None on extent box scans (skip_inner_plane): pull and
+        # decode the wide plane only — half the per-query pull bytes
         wide_h, inner_h = jax.device_get((wide, inner))
-        return bk.decode_bits_pair(np.asarray(wide_h), np.asarray(inner_h), bids, n_real)
+        inner_h = None if inner_h is None else np.asarray(inner_h)
+        return bk.decode_bits_pair(np.asarray(wide_h), inner_h, bids, n_real)
 
     def _device_pops(self, blocks: np.ndarray, config: ScanConfig):
         """Per-candidate-block wide-hit counts -> (pops [n] i64, global

@@ -212,3 +212,48 @@ class TestBitmaskDecode:
             )
             assert np.array_equal(got[0], want_rows)
             assert np.array_equal(got[1], want_cert)
+
+
+def test_counting_argsort_matches_stable_argsort():
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 1024, 100_000).astype(np.int64)
+    perm = native.counting_argsort(keys, 1024)
+    np.testing.assert_array_equal(
+        np.asarray(perm, dtype=np.int64), np.argsort(keys, kind="stable")
+    )
+
+
+def test_xz_index_parity():
+    from geomesa_tpu.curve.xzsfc import XZSFC
+
+    rng = np.random.default_rng(8)
+    for dims in (2, 3):
+        sfc = XZSFC(12 if dims == 2 else 10, dims)
+        lo = rng.uniform(0, 0.98, (20_000, dims))
+        hi = lo + rng.uniform(0, 0.02, (20_000, dims)) ** 2
+        # include degenerate (point-like) and full-extent elements
+        lo[:5] = 0.0
+        hi[:5] = 1.0
+        hi[5:10] = lo[5:10]
+        got = native.xz_index(lo, hi, dims, sfc.g, sfc.subtree_size)
+        want = sfc.sequence_code(lo, sfc.length_at(lo, hi))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_bitmask_decode_wide_only():
+    from geomesa_tpu.scan import block_kernels as bk
+
+    rng = np.random.default_rng(9)
+    wide = (
+        rng.integers(0, 1 << 32, (5, 4, 128), dtype=np.uint64)
+        .astype(np.uint32)
+        .view(np.int32)
+    )
+    wide[rng.uniform(size=wide.shape) < 0.6] = 0
+    bids = np.sort(rng.choice(40, 5, replace=False)).astype(np.int64)
+    block = 4 * 32 * 128
+    got = native.bitmask_decode(wide, bids, 5, block)
+    flat = bk._unpack_plane(wide, 5)
+    blk, local = np.nonzero(flat)
+    want = bids[blk].astype(np.int64) * block + local
+    np.testing.assert_array_equal(got, want)
