@@ -249,16 +249,22 @@ class DataStore:
 
         batch_stats = StatsStore.build(sft, features)
         new_keys: dict[str, object] = {}
+        index_names = {i.name for i in self._indexes[type_name]}
+        # the selectivity sketch observes ONE z index per store: z3 when
+        # present, else z2 (a z2-only store previously never fed the
+        # sketch, leaving estimate_count and the kNN radius tier blind)
+        sketch_index = "z3" if "z3" in index_names else "z2"
         for idx in self._indexes[type_name]:
             keys = idx.write_keys(features)
             new_keys[idx.name] = keys
-            if idx.name == "z3" and len(keys.zs):
+            if idx.name == sketch_index and len(keys.zs):
                 # sketch sees only the delta batch (the store-level sketch
-                # accumulates); cell width is codec-defined (3 x per-dim
+                # accumulates); cell width is codec-defined (dims x per-dim
                 # precision), NOT data-dependent, so cells stay aligned
+                dims = 3 if idx.name == "z3" else 2
                 batch_stats.observe_index_keys(
                     idx.name, keys.bins, keys.zs,
-                    3 * getattr(idx.sfc, "precision", 21),
+                    dims * getattr(idx.sfc, "precision", 21),
                 )
 
         # serialized section: id check, stats merge and commit must be
@@ -771,17 +777,27 @@ class DataStore:
             return len(self.features(type_name))
         stats = self.stats_for(type_name)
         if stats is not None:
-            for idx in self._indexes[type_name]:
-                if idx.name != "z3":
-                    continue
+            # tier 1: marginal-histogram selectivity product (spatial x
+            # temporal). Finer-grained than the z-prefix sketch, whose
+            # coarse joint cells underestimated clustered data ~17x;
+            # independence can overestimate, the safer failure mode
+            est = stats.estimate_filter(self._schemas[type_name], f)
+            if est is not None:
+                return int(round(est))
+            # tier 2: the z-prefix sketch over the index that feeds it
+            # (z2 ranges against a z3-keyed sketch would estimate ~0)
+            idx = next(
+                (i for i in self._indexes[type_name] if i.name == stats.z_index),
+                None,
+            )
+            if idx is not None:
                 cfg = idx.scan_config(f)
-                if cfg is None:
-                    continue
-                if cfg.disjoint:
-                    return 0
-                est = stats.estimate_scan(idx.name, cfg)
-                if est is not None:
-                    return int(round(est))
+                if cfg is not None:
+                    if cfg.disjoint:
+                        return 0
+                    est = stats.estimate_scan(idx.name, cfg)
+                    if est is not None:
+                        return int(round(est))
         # exact fallback on the ALREADY-rewritten filter: plan without the
         # interceptor hook (the rewrite would apply twice) but WITH guards
         # — this is still a user-facing query

@@ -90,15 +90,36 @@ class FeatureCollection:
 
     def take(self, idx) -> "FeatureCollection":
         idx = np.asarray(idx)
+        # the threaded native gather beats numpy's serial fancy indexing on
+        # large pulls (the multi-million-row result gather was the last
+        # host-bound stage of big queries, PERF.md §4b); u32-indexable
+        # columns route through it, everything else falls back
+        idx_u32 = None
+        if idx.dtype.kind in "iu" and len(idx) and len(self.ids) < (1 << 32):
+            lo, hi = int(idx.min()), int(idx.max())
+            # negative (python-style) or out-of-range indices fall back to
+            # numpy, which raises IndexError — the C++ gather is unchecked
+            if lo >= 0 and hi < len(self.ids):
+                idx_u32 = idx.astype(np.uint32, copy=False)
+
+        def g(col):
+            if idx_u32 is not None:
+                from geomesa_tpu import native
+
+                out = native.take(np.asarray(col), idx_u32)
+                if out is not None:
+                    return out
+            return np.asarray(col)[idx]
+
         cols = {}
         for name, col in self.columns.items():
             if isinstance(col, PointColumn):
-                cols[name] = PointColumn(col.x[idx], col.y[idx])
+                cols[name] = PointColumn(g(col.x), g(col.y))
             elif isinstance(col, geo.PackedGeometryColumn):
                 cols[name] = col.take(idx)
             else:
-                cols[name] = np.asarray(col)[idx]
-        return FeatureCollection(self.sft, self.ids[idx], cols)
+                cols[name] = g(col)
+        return FeatureCollection(self.sft, g(self.ids), cols)
 
     def mask(self, m: np.ndarray) -> "FeatureCollection":
         return self.take(np.nonzero(np.asarray(m))[0])

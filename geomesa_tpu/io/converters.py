@@ -176,14 +176,18 @@ class Converter:
     sft: FeatureType
     fields: Sequence[FieldSpec]
     id_field: str | None = None  # expression; None = running index
-    fmt: str = "delimited"  # "delimited" | "json" | "xml"
+    fmt: str = "delimited"  # "delimited" | "json" | "xml" | "fixed-width"
     delimiter: str = ","
-    skip_lines: int = 0  # header rows to drop (delimited)
+    skip_lines: int = 0  # header rows to drop (delimited / fixed-width)
     drop_errors: bool = True  # skip unparseable records vs raise
     # xml: tag of the per-feature element (reference geomesa-convert-xml
     # featurePath); fields address the element tree with $.child.grandchild
     # paths, attributes as @name segments ($.pos.@lat)
     xml_feature_tag: str | None = None
+    # fixed-width: (start, width) character slices per column (reference
+    # geomesa-convert-fixedwidth FixedWidthConverter); $N addresses the
+    # N-th slice, stripped
+    fixed_widths: Sequence[tuple[int, int]] | None = None
 
     def __post_init__(self):
         self._exprs = [(f.name, compile_expression(f.transform)) for f in self.fields]
@@ -201,7 +205,13 @@ class Converter:
                 data = data.read()
                 if isinstance(data, bytes):
                     data = data.decode("utf-8")
-        records = self._parse(data)
+        return self.convert_records(self._parse(data))
+
+    def convert_records(self, records) -> FeatureCollection:
+        """Convert an iterable of already-parsed records (lists for $N
+        expressions, dicts for $.path expressions). The entry point for
+        externally-sourced records — e.g. DB-API rows via
+        :func:`dbapi_records` (the geomesa-convert-jdbc analogue)."""
         rows = []
         ids = []
         self.errors = 0
@@ -225,6 +235,14 @@ class Converter:
                 if i < self.skip_lines or not rec:
                     continue
                 yield rec
+        elif self.fmt == "fixed-width":
+            if not self.fixed_widths:
+                raise ValueError("fixed-width converter requires fixed_widths")
+            for i, line in enumerate(io.StringIO(data)):
+                line = line.rstrip("\n")
+                if i < self.skip_lines or not line.strip():
+                    continue
+                yield [line[s : s + w].strip() for s, w in self.fixed_widths]
         elif self.fmt == "json":
             doc = json.loads(data)
             if isinstance(doc, dict):
@@ -366,3 +384,29 @@ def _infer_kind(vals: Sequence[str]) -> str:
     if all(_DATE_RE.match(str(v)) for v in vals):
         return "Date"
     return "String"
+
+
+# -- database records (geomesa-convert-jdbc analogue) --------------------
+
+def dbapi_records(conn, sql: str, params=()):
+    """Rows of a DB-API 2.0 query as converter records: each row yields
+    ``[rowvals...]`` addressable as $1..$N ($0 is the whole row), matching
+    the reference's JDBC converter column addressing
+    (geomesa-convert-jdbc/.../JdbcConverter.scala: statement.executeQuery,
+    fields reference columns by index). Works with any DB-API driver
+    (sqlite3 in the standard library).
+
+        conv = Converter(sft, fields=[FieldSpec("name", "$1"), ...])
+        fc = conv.convert_records(dbapi_records(conn, "SELECT ..."))
+    """
+    cur = conn.cursor()
+    try:
+        cur.execute(sql, params)
+        while True:
+            batch = cur.fetchmany(10_000)
+            if not batch:
+                break
+            for row in batch:
+                yield list(row)  # $1 = first column, $0 = whole row
+    finally:
+        cur.close()

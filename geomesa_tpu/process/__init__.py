@@ -9,7 +9,28 @@ and density (the heatmap process wraps DataStore.density directly)."""
 from geomesa_tpu.process.join import join_search
 from geomesa_tpu.process.knn import knn_search
 from geomesa_tpu.process.proximity import proximity_search
+from geomesa_tpu.process.route import heading_diff, route_search
+from geomesa_tpu.process.transforms import (
+    arrow_conversion,
+    bin_conversion,
+    date_offset,
+    point2point,
+    track_label,
+)
 from geomesa_tpu.process.tube import tube_select
 from geomesa_tpu.process.unique import unique_values
 
-__all__ = ["join_search", "knn_search", "proximity_search", "tube_select", "unique_values"]
+__all__ = [
+    "arrow_conversion",
+    "bin_conversion",
+    "date_offset",
+    "heading_diff",
+    "join_search",
+    "knn_search",
+    "point2point",
+    "proximity_search",
+    "route_search",
+    "track_label",
+    "tube_select",
+    "unique_values",
+]

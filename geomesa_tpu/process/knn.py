@@ -68,7 +68,9 @@ def knn_search(
     sft = store.get_schema(type_name)
     geom = sft.geom_field
     if estimated_distance_m is None:
-        estimated_distance_m = _estimate_radius_m(store, type_name, k)
+        estimated_distance_m = _estimate_radius_m(
+            store, type_name, k, x, y, max_distance_m
+        )
     # clamp to a positive start: radius 0 would never grow (min(0*2, max))
     radius = min(max(float(estimated_distance_m), 1.0), float(max_distance_m))
     while True:
@@ -89,11 +91,26 @@ def knn_search(
         radius = min(radius * 2.0, max_distance_m)
 
 
-def _estimate_radius_m(store, type_name: str, k: int, fallback: float = 10_000.0) -> float:
-    """Start radius from mean point density: r such that a circle holds
-    ~4k points under uniform density over the stats envelope. Clustered
-    data departs from uniform, hence the 4x cushion; the doubling loop
-    still corrects underestimates."""
+def _estimate_radius_m(
+    store,
+    type_name: str,
+    k: int,
+    x: float,
+    y: float,
+    max_m: float,
+    fallback: float = 10_000.0,
+) -> float:
+    """Start radius for the expanding-window search.
+
+    Two tiers (each device-free):
+    1. global mean density over the stats envelope — r such that a circle
+       holds ~4k points under uniform density (4x cushion for clustering);
+    2. *local* refinement against the Z-histogram sketch (the same
+       StatsBasedEstimator tier the planner's cost model uses): grow the
+       window host-side until the sketch predicts >= 4k hits near THIS
+       query point. Every avoided doubling round saves a full store query
+       (one device round-trip), which dominates kNN latency on sparse
+       regions — global density badly underestimates the radius there."""
     import math
 
     stats = store.stats_for(type_name)
@@ -118,4 +135,23 @@ def _estimate_radius_m(store, type_name: str, k: int, fallback: float = 10_000.0
     # floor: a tight cluster yields a microscopic r, and a query point
     # outside the cluster would then pay many doubling rounds (each a full
     # store query) — never start below a tenth of the old fixed default
-    return max(r, fallback / 10.0)
+    r = max(r, fallback / 10.0)
+    return _refine_radius_local(stats, geom, k, x, y, r, max_m)
+
+
+def _refine_radius_local(
+    stats, geom: str, k: int, x: float, y: float, r: float, max_m: float
+) -> float:
+    """Grow ``r`` until the marginal-histogram estimator predicts ~4k
+    hits in the window around (x, y). Sketch-only: no device work, no
+    range decomposition — each probe is two histogram range sums."""
+    target = max(4 * k, 64)
+    while r < max_m:
+        deg = _meters_to_degrees(r, y)
+        est = stats.estimate_bbox(
+            geom, x - deg, max(y - deg, -90.0), x + deg, min(y + deg, 90.0)
+        )
+        if est is None or est >= target:
+            break
+        r = min(r * 2.0, max_m)
+    return r

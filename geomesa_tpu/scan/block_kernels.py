@@ -41,7 +41,13 @@ import numpy as np
 
 LANES = 128
 BLOCK = 16384  # default rows per scan block (4096 minimum: SUB % 32 == 0)
-M_BUCKETS = (32, 256, 1024, 4096)  # candidate-block list sizes (static)
+# candidate-block list sizes (static). The ladder is geometric with ratio
+# 2 (round 4; rounds 2-3 used (32, 256, 1024, 4096)): plane pull bytes
+# scale with the padded M, and at the measured ~30 MB/s pull bandwidth
+# (PERF.md §1) the 8x jump from 32 to 256 made mid-size queries pull up
+# to 8x the bytes their candidates needed. Each extra bucket costs one
+# warmup compile per (table, col-set, flags) variant — untimed, amortized.
+M_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 # column-set signatures -> ordered device column names
 POINT_COLS = ("x", "y")

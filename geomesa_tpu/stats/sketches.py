@@ -114,16 +114,15 @@ class Histogram:
         return self
 
     def estimate_range(self, lo: float, hi: float) -> float:
-        """Estimated count within [lo, hi] assuming uniform intra-bin mass."""
+        """Estimated count within [lo, hi] assuming uniform intra-bin mass.
+        Vectorized: hot-path callers (estimate_bbox, the kNN radius
+        refinement) probe this several times per query."""
         w = (self.hi - self.lo) / self.n_bins
-        est = 0.0
-        for b in range(self.n_bins):
-            b_lo = self.lo + b * w
-            b_hi = b_lo + w
-            overlap = max(0.0, min(hi, b_hi) - max(lo, b_lo))
-            if overlap > 0:
-                est += self.counts[b] * overlap / w
-        return est
+        edges = self.lo + np.arange(self.n_bins + 1) * w
+        overlap = np.clip(
+            np.minimum(hi, edges[1:]) - np.maximum(lo, edges[:-1]), 0.0, w
+        )
+        return float((self.counts * (overlap / w)).sum())
 
     def to_json(self):
         return {
@@ -225,10 +224,15 @@ class Z3Histogram:
     ``prefix_bits`` of the z value per time bin; estimates sum matching
     cells for a set of z ranges."""
 
-    def __init__(self, total_bits: int, prefix_bits: int = 12):
+    def __init__(self, total_bits: int, prefix_bits: int = 16):
+        # prefix 16 (round 4; was 12): 12-bit cells were ~6x off on
+        # clustered data — too coarse for the kNN local-radius tier. Cell
+        # count is bounded by cells actually touched, and the sorted view
+        # is cached, so finer cells cost memory ~ data spread, not 2^16.
         self.total_bits = total_bits
         self.shift = np.uint64(max(0, total_bits - prefix_bits))
         self.cells: dict = {}  # (bin, z_prefix) -> count
+        self._sorted: "tuple | None" = None  # cached (keys, counts) arrays
 
     def observe(self, bins: np.ndarray, zs: np.ndarray) -> None:
         key = bins.astype(np.int64) * (1 << 32) + (
@@ -237,19 +241,29 @@ class Z3Histogram:
         vals, cnts = np.unique(key, return_counts=True)
         for v, c in zip(vals.tolist(), cnts.tolist()):
             self.cells[v] = self.cells.get(v, 0) + c
+        self._sorted = None
 
     def __iadd__(self, other: "Z3Histogram") -> "Z3Histogram":
         for v, c in other.cells.items():
             self.cells[v] = self.cells.get(v, 0) + c
+        self._sorted = None
         return self
+
+    def _sorted_cells(self):
+        if self._sorted is None:
+            keys = np.array(sorted(self.cells), dtype=np.int64)
+            cnts = np.array(
+                [self.cells[k] for k in keys.tolist()], dtype=np.float64
+            )
+            self._sorted = (keys, cnts)
+        return self._sorted
 
     def estimate(self, range_bins, range_lo, range_hi) -> float:
         """Estimated rows covered by inclusive z ranges, assuming uniform
         intra-cell mass."""
         if not self.cells:
             return 0.0
-        keys = np.array(sorted(self.cells), dtype=np.int64)
-        cnts = np.array([self.cells[k] for k in keys.tolist()], dtype=np.float64)
+        keys, cnts = self._sorted_cells()
         cell = np.uint64(1) << self.shift
         est = 0.0
         for b, lo, hi in zip(

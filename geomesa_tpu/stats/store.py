@@ -35,6 +35,10 @@ class StatsStore:
         self.frequencies: dict[str, Frequency] = {}
         self.topk: dict[str, TopK] = {}
         self.z3: Z3Histogram | None = None
+        # which index's keys feed the z sketch ("z3" or "z2"): estimates
+        # are only valid for ranges in THAT index's key space — z2 ranges
+        # against a z3-keyed sketch silently estimate ~0
+        self.z_index: "str | None" = None
 
     # -- build -----------------------------------------------------------
     @staticmethod
@@ -59,6 +63,17 @@ class StatsStore:
                 mm_y.observe(ys)
                 st.minmax[attr.name + ".x"] = mm_x
                 st.minmax[attr.name + ".y"] = mm_y
+                # marginal coordinate histograms: the bbox selectivity
+                # estimator (independence product) — much finer spatial
+                # resolution than the z-prefix sketch for bbox-only
+                # probes on z3-keyed stores
+                for suffix, vals, mm in ((".x", xs, mm_x), (".y", ys, mm_y)):
+                    if mm.bounds is not None:
+                        h = Histogram(
+                            HISTOGRAM_BINS, float(mm.min), float(mm.max) + 1e-9
+                        )
+                        h.observe(np.asarray(vals, dtype=np.float64))
+                        st.histograms[attr.name + suffix] = h
                 continue
             col = np.asarray(col)
             if col.dtype.kind in "iuf" or attr.type == "Date":
@@ -85,6 +100,7 @@ class StatsStore:
         if index_name in ("z3", "z2"):
             if self.z3 is None:
                 self.z3 = Z3Histogram(total_bits)
+                self.z_index = index_name
             self.z3.observe(np.asarray(bins), np.asarray(zs))
 
     def merge(self, other: "StatsStore") -> "StatsStore":
@@ -101,6 +117,7 @@ class StatsStore:
         if other.z3 is not None:
             if self.z3 is None:
                 self.z3 = other.z3
+                self.z_index = other.z_index
             else:
                 self.z3 += other.z3
         return self
@@ -111,7 +128,7 @@ class StatsStore:
 
     def estimate_scan(self, index_name: str, cfg) -> float | None:
         """Estimated rows a scan config touches (cost-model input)."""
-        if self.z3 is not None and index_name in ("z3", "z2"):
+        if self.z3 is not None and index_name == self.z_index:
             return self.z3.estimate(cfg.range_bins, cfg.range_lo, cfg.range_hi)
         return None
 
@@ -122,6 +139,62 @@ class StatsStore:
     def estimate_range(self, attr: str, lo: float, hi: float) -> float | None:
         h = self.histograms.get(attr)
         return h.estimate_range(lo, hi) if h is not None else None
+
+    def estimate_bbox(self, geom: str, x0, y0, x1, y1) -> float | None:
+        """Estimated rows intersecting a bbox from the marginal coordinate
+        histograms under independence (reference StatsBasedEstimator's
+        attribute-selectivity composition). Correlated multi-cluster data
+        can overestimate; callers treat this as a selectivity hint."""
+        hx = self.histograms.get(geom + ".x")
+        hy = self.histograms.get(geom + ".y")
+        n = self.total_count()
+        if hx is None or hy is None or not n:
+            return None
+        tx = float(hx.counts.sum())
+        ty = float(hy.counts.sum())
+        if tx <= 0 or ty <= 0:
+            return None
+        fx = hx.estimate_range(float(x0), float(x1)) / tx
+        fy = hy.estimate_range(float(y0), float(y1)) / ty
+        return n * fx * fy
+
+    def estimate_filter(self, sft, f) -> float | None:
+        """Selectivity-product estimate for a filter's spatial and temporal
+        parts: bbox marginals x date-histogram fraction. None when neither
+        axis is constrained or sketches are missing."""
+        from geomesa_tpu.filter.extract import (
+            extract_geometries, extract_intervals, geometry_bounds,
+        )
+
+        n = self.total_count()
+        if not n or sft.geom_field is None:
+            return None
+        geoms = extract_geometries(f, sft.geom_field)
+        if geoms.disjoint:
+            return 0.0
+        est = None
+        if geoms.values:
+            parts = [
+                self.estimate_bbox(sft.geom_field, *b)
+                for b in geometry_bounds(geoms)
+            ]
+            if any(p is None for p in parts):
+                return None
+            est = min(float(np.sum(parts)), float(n))
+        if sft.dtg_field is not None:
+            intervals = extract_intervals(f, sft.dtg_field)
+            if intervals.disjoint:
+                return 0.0
+            if intervals.values:
+                h = self.histograms.get(sft.dtg_field)
+                if h is not None and h.counts.sum() > 0:
+                    frac = sum(
+                        h.estimate_range(float(iv.lo), float(iv.hi))
+                        for iv in intervals.values
+                    ) / float(h.counts.sum())
+                    frac = min(frac, 1.0)
+                    est = n * frac if est is None else est * frac
+        return est
 
     def attribute_bounds(self, attr: str):
         mm = self.minmax.get(attr)

@@ -290,3 +290,79 @@ class TestPartitionedPersistence:
         assert len(changed) == 1  # only the touched partition rewrote
         back = persist.load(root)
         assert back.count("pp") == ds2.count("pp")
+
+
+class TestFixedWidthConverter:
+    """fixed-width format (reference geomesa-convert-fixedwidth)."""
+
+    def test_fixed_width(self):
+        from geomesa_tpu.io.converters import Converter, FieldSpec
+
+        sft = FeatureType.from_spec("fw", "name:String,*geom:Point:srid=4326")
+        conv = Converter(
+            sft,
+            fields=[
+                FieldSpec("name", "$1"),
+                FieldSpec("geom", "point($2, $3)"),
+            ],
+            fmt="fixed-width",
+            fixed_widths=[(0, 6), (6, 8), (14, 8)],
+            skip_lines=1,
+        )
+        data = (
+            "NAME  LON     LAT     \n"
+            "alpha   10.50   20.25\n"
+            "beta   -33.10   51.00\n"
+            "\n"
+        )
+        fc = conv.convert(data)
+        assert len(fc) == 2
+        assert list(fc.columns["name"]) == ["alpha", "beta"]
+        x, y = fc.representative_xy()
+        np.testing.assert_allclose(x, [10.5, -33.1])
+        np.testing.assert_allclose(y, [20.25, 51.0])
+
+    def test_missing_widths_raises(self):
+        from geomesa_tpu.io.converters import Converter, FieldSpec
+
+        sft = FeatureType.from_spec("fw", "name:String,*geom:Point:srid=4326")
+        conv = Converter(
+            sft, fields=[FieldSpec("name", "$1")], fmt="fixed-width"
+        )
+        with pytest.raises(ValueError, match="fixed_widths"):
+            list(conv.convert("abc\n"))
+
+
+class TestDbapiConverter:
+    """DB-API rows as converter records (geomesa-convert-jdbc analogue,
+    driven through the standard library's sqlite3)."""
+
+    def test_sqlite_roundtrip(self):
+        import sqlite3
+
+        from geomesa_tpu.io.converters import Converter, FieldSpec, dbapi_records
+
+        conn = sqlite3.connect(":memory:")
+        conn.execute("CREATE TABLE pts (name TEXT, lon REAL, lat REAL)")
+        conn.executemany(
+            "INSERT INTO pts VALUES (?, ?, ?)",
+            [("a", 1.0, 2.0), ("b", -3.0, 4.5), ("c", 100.0, -45.0)],
+        )
+        sft = FeatureType.from_spec("db", "name:String,*geom:Point:srid=4326")
+        conv = Converter(
+            sft,
+            fields=[
+                FieldSpec("name", "$1"),
+                FieldSpec("geom", "point($2, $3)"),
+            ],
+            id_field="$1",
+        )
+        fc = conv.convert_records(
+            dbapi_records(conn, "SELECT name, lon, lat FROM pts ORDER BY name")
+        )
+        assert len(fc) == 3
+        assert list(fc.ids) == ["a", "b", "c"]
+        x, y = fc.representative_xy()
+        np.testing.assert_allclose(x, [1.0, -3.0, 100.0])
+        np.testing.assert_allclose(y, [2.0, 4.5, -45.0])
+        conn.close()

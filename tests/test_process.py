@@ -209,7 +209,7 @@ class TestKnnRadiusEstimate:
         ), check_ids=False)
         from geomesa_tpu.process.knn import _estimate_radius_m, knn_search
 
-        r = _estimate_radius_m(ds, "p", 10)
+        r = _estimate_radius_m(ds, "p", 10, 0.0, 0.0, 1_000_000.0)
         # ~50 pts per sq-degree here: a sane estimate sits well under 100km
         assert 1000 < r < 200_000
         queries = 0
@@ -231,4 +231,273 @@ class TestKnnRadiusEstimate:
         sft = FeatureType.from_spec("e", "*geom:Point:srid=4326")
         ds = DataStore()
         ds.create_schema(sft)
-        assert _estimate_radius_m(ds, "e", 10) == 10_000.0
+        assert _estimate_radius_m(ds, "e", 10, 0.0, 0.0, 1_000_000.0) == 10_000.0
+
+
+class TestRouteSearch:
+    """route_search vs a brute-force numpy re-implementation (reference
+    RouteSearchProcess: dwithin buffer + closest-segment heading match)."""
+
+    @pytest.fixture(scope="class")
+    def route_ds(self):
+        from geomesa_tpu.process.knn import METERS_PER_DEGREE
+
+        sft = FeatureType.from_spec(
+            "trk", "heading:Double,*geom:Point:srid=4326"
+        )
+        store = DataStore(tile=64)
+        store.create_schema(sft)
+        rng = np.random.default_rng(11)
+        n = 3000
+        x = rng.uniform(-1, 3, n)
+        y = rng.uniform(-1, 3, n)
+        heading = rng.uniform(0, 360, n)
+        fc = FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"heading": heading, "geom": (x, y)},
+        )
+        store.write("trk", fc)
+        return store, (x, y, heading)
+
+    # an L-shaped route: east along y=0 then north along x=2
+    ROUTE = np.array([[0.0, 0.0], [2.0, 0.0], [2.0, 2.0]])
+
+    def _brute(self, x, y, heading, buffer_m, thr, bidirectional):
+        from geomesa_tpu.process.knn import METERS_PER_DEGREE
+        from geomesa_tpu.process.route import (
+            _point_segment_distances, heading_diff,
+        )
+
+        d, b = _point_segment_distances(
+            x, y, self.ROUTE[:-1], self.ROUTE[1:]
+        )
+        k = np.argmin(d, axis=1)
+        rng = np.arange(len(k))
+        dist = d[rng, k]
+        diff = heading_diff(b[rng, k], heading)
+        m = diff <= thr
+        if bidirectional:
+            m |= np.abs(diff - 180.0) <= thr
+        return (dist <= buffer_m) & m
+
+    def test_matches_brute_force(self, route_ds):
+        from geomesa_tpu.process import route_search
+
+        store, (x, y, heading) = route_ds
+        out = route_search(
+            store, "trk", self.ROUTE, buffer_m=30_000,
+            heading_threshold_deg=25.0, heading_field="heading",
+        )
+        want = np.flatnonzero(self._brute(x, y, heading, 30_000, 25.0, False))
+        got = np.sort(np.asarray(out.ids, dtype=np.int64).astype(np.int64))
+        np.testing.assert_array_equal(got, want)
+        assert len(want) > 0
+
+    def test_bidirectional_superset(self, route_ds):
+        from geomesa_tpu.process import route_search
+
+        store, (x, y, heading) = route_ds
+        uni = route_search(
+            store, "trk", self.ROUTE, 30_000, 25.0,
+            heading_field="heading",
+        )
+        bi = route_search(
+            store, "trk", self.ROUTE, 30_000, 25.0,
+            heading_field="heading", bidirectional=True,
+        )
+        want = np.flatnonzero(self._brute(x, y, heading, 30_000, 25.0, True))
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(bi.ids, dtype=np.int64)), want
+        )
+        assert len(bi) > len(uni)
+
+    def test_heading_required_for_points(self, route_ds):
+        from geomesa_tpu.process import route_search
+
+        store, _ = route_ds
+        with pytest.raises(ValueError, match="heading_field"):
+            route_search(store, "trk", self.ROUTE, 1000, 10.0)
+
+    def test_wkt_route_and_filter(self, route_ds):
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.process import route_search
+
+        store, (x, y, heading) = route_ds
+        out = route_search(
+            store, "trk", "LINESTRING(0 0, 2 0, 2 2)", 30_000, 25.0,
+            heading_field="heading",
+            filter=ecql.parse("bbox(geom, -1, -1, 1, 1)"),
+        )
+        brute = self._brute(x, y, heading, 30_000, 25.0, False)
+        brute &= (x >= -1) & (x <= 1) & (y >= -1) & (y <= 1)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(out.ids, dtype=np.int64)),
+            np.flatnonzero(brute),
+        )
+
+
+class TestTransformProcesses:
+    """point2point / track_label / date_offset / bin+arrow conversion
+    (reference geomesa-process transform tier)."""
+
+    @pytest.fixture(scope="class")
+    def tracks(self):
+        sft = FeatureType.from_spec(
+            "trk2", "track:String,dtg:Date,*geom:Point:srid=4326"
+        )
+        t0 = np.datetime64("2024-03-01T00:00:00", "ms").astype(np.int64)
+        HOUR = 3600_000
+        rows = [
+            # track a: 3 points, crosses a day boundary between p1 and p2
+            ("a", t0 + 22 * HOUR, 0.0, 0.0),
+            ("a", t0 + 23 * HOUR, 1.0, 0.0),
+            ("a", t0 + 25 * HOUR, 2.0, 0.0),
+            # track b: 2 points, second is a duplicate position
+            ("b", t0 + 1 * HOUR, 5.0, 5.0),
+            ("b", t0 + 2 * HOUR, 5.0, 5.0),
+            # track c: single point
+            ("c", t0 + 3 * HOUR, 9.0, 9.0),
+        ]
+        fc = FeatureCollection.from_columns(
+            sft,
+            [str(i) for i in range(len(rows))],
+            {
+                "track": np.array([r[0] for r in rows]),
+                "dtg": np.array([r[1] for r in rows], dtype=np.int64),
+                "geom": (
+                    np.array([r[2] for r in rows]),
+                    np.array([r[3] for r in rows]),
+                ),
+            },
+        )
+        return fc, t0
+
+    def test_point2point_segments(self, tracks):
+        from geomesa_tpu.process import point2point
+
+        fc, t0 = tracks
+        out = point2point(fc, "track", "dtg", min_points=1)
+        # a: 2 segments; b: its only segment is singular (dropped); c: too small
+        assert len(out) == 2
+        assert list(out.columns["track"]) == ["a", "a"]
+        assert list(out.ids) == ["a-0", "a-1"]
+        g0 = out.geom_column.geometry(0)
+        assert [tuple(c) for c in g0.coords] == [(0.0, 0.0), (1.0, 0.0)]
+        HOUR = 3600_000
+        np.testing.assert_array_equal(
+            out.columns["dtg_start"], [t0 + 22 * HOUR, t0 + 23 * HOUR]
+        )
+        np.testing.assert_array_equal(
+            out.columns["dtg_end"], [t0 + 23 * HOUR, t0 + 25 * HOUR]
+        )
+
+    def test_point2point_break_on_day(self, tracks):
+        from geomesa_tpu.process import point2point
+
+        fc, _ = tracks
+        out = point2point(fc, "track", "dtg", min_points=1, break_on_day=True)
+        assert len(out) == 1  # a's day-crossing segment dropped
+        assert list(out.ids) == ["a-0"]
+
+    def test_point2point_keep_singular(self, tracks):
+        from geomesa_tpu.process import point2point
+
+        fc, _ = tracks
+        out = point2point(
+            fc, "track", "dtg", min_points=1, filter_singular=False
+        )
+        assert len(out) == 3  # b's zero-length segment kept
+
+    def test_track_label(self, tracks):
+        from geomesa_tpu.process import track_label
+
+        fc, t0 = tracks
+        out = track_label(fc, "track", "dtg")
+        assert len(out) == 3
+        got = dict(zip(out.columns["track"].tolist(), out.columns["dtg"].tolist()))
+        HOUR = 3600_000
+        assert got == {
+            "a": t0 + 25 * HOUR, "b": t0 + 2 * HOUR, "c": t0 + 3 * HOUR
+        }
+
+    def test_date_offset(self, tracks):
+        from geomesa_tpu.process import date_offset
+
+        fc, _ = tracks
+        out = date_offset(fc, "dtg", 60_000)
+        np.testing.assert_array_equal(
+            np.asarray(out.columns["dtg"]),
+            np.asarray(fc.columns["dtg"]) + 60_000,
+        )
+        # input unchanged
+        assert out.columns["dtg"] is not fc.columns["dtg"]
+
+    def test_bin_conversion_roundtrip(self, tracks):
+        from geomesa_tpu.process import bin_conversion
+        from geomesa_tpu.utils import bin_format
+
+        fc, _ = tracks
+        data = bin_conversion(fc, "track", "dtg")
+        dec = bin_format.decode(data)
+        assert len(dec["lat"]) == len(fc)
+        np.testing.assert_allclose(dec["lon"], fc.representative_xy()[0])
+
+    def test_arrow_conversion(self, tracks):
+        pytest.importorskip("pyarrow")
+        from geomesa_tpu.io.arrow import read_arrow
+        from geomesa_tpu.process import arrow_conversion
+
+        fc, _ = tracks
+        table = read_arrow(arrow_conversion(fc))
+        assert table.num_rows == len(fc)
+
+
+class TestKnnLocalRadius:
+    """Sketch-refined start radius (z2 store): sparse query regions grow
+    the window host-side instead of paying device-query doubling rounds."""
+
+    @pytest.fixture(scope="class")
+    def clustered(self):
+        rng = np.random.default_rng(21)
+        sft = FeatureType.from_spec("c", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        # dense cluster at (0, 0), nothing within ~10 degrees of (40, 40)
+        n = 30000
+        x = rng.normal(0, 0.5, n)
+        y = rng.normal(0, 0.5, n)
+        ds.write("c", FeatureCollection.from_columns(
+            sft, np.arange(n), {"geom": (x, y)}
+        ), check_ids=False)
+        return ds
+
+    def test_z2_sketch_feeds_estimate_count(self, clustered):
+        ds = clustered
+        est = ds.estimate_count("c", "bbox(geom, -1, -1, 1, 1)")
+        # sketch-based (not exact): right order of magnitude is enough
+        true = ds.count("c", "bbox(geom, -1, -1, 1, 1)")
+        assert true > 0
+        assert 0.2 * true < est < 5 * true
+
+    def test_sparse_region_grows_radius_without_queries(self, clustered):
+        from geomesa_tpu.process.knn import _estimate_radius_m, knn_search
+
+        ds = clustered
+        r_dense = _estimate_radius_m(ds, "c", 10, 0.0, 0.0, 5e6)
+        r_sparse = _estimate_radius_m(ds, "c", 10, 40.0, 40.0, 5e6)
+        assert r_sparse > 10 * r_dense  # local sketch sees the emptiness
+        queries = 0
+        orig = ds.query
+
+        def counting(*a, **k):
+            nonlocal queries
+            queries += 1
+            return orig(*a, **k)
+
+        ds.query = counting
+        try:
+            out = knn_search(ds, "c", 40.0, 40.0, k=5, max_distance_m=2e7)
+        finally:
+            ds.query = orig
+        assert len(out) == 5
+        assert queries <= 3

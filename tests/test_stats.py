@@ -1,6 +1,7 @@
 """Stats sketches + cost-based strategy selection."""
 
 import numpy as np
+import pytest
 
 from geomesa_tpu.datastore import DataStore
 from geomesa_tpu.features import FeatureCollection
@@ -187,3 +188,81 @@ def test_cost_changes_with_distribution():
     c_dense = ds.planner.cost("d", "z2", idx.scan_config(dense), None)
     c_empty = ds.planner.cost("d", "z2", idx.scan_config(empty), None)
     assert c_dense > 100 * c_empty
+
+
+class TestMarginalEstimator:
+    """Marginal-histogram selectivity (estimate_bbox / estimate_filter):
+    the bbox-only and spatio-temporal estimate paths on a z3-keyed store
+    (the z-prefix sketch alone underestimated clustered data ~17x)."""
+
+    @pytest.fixture(scope="class")
+    def st_store(self):
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+
+        rng = np.random.default_rng(31)
+        sft = FeatureType.from_spec("st", "dtg:Date,*geom:Point:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "z3,z2"
+        ds = DataStore()
+        ds.create_schema(sft)
+        n = 30000
+        x = rng.normal(0, 0.5, n)
+        y = rng.normal(0, 0.5, n)
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        t = t0 + rng.integers(0, 30 * 86400_000, n)
+        ds.write(
+            "st",
+            FeatureCollection.from_columns(
+                sft, np.arange(n), {"dtg": t, "geom": (x, y)}
+            ),
+            check_ids=False,
+        )
+        return ds, (x, y, t)
+
+    def test_bbox_only_on_z3_store(self, st_store):
+        ds, (x, y, t) = st_store
+        est = ds.estimate_count("st", "bbox(geom, -1, -1, 1, 1)")
+        true = int(((x >= -1) & (x <= 1) & (y >= -1) & (y <= 1)).sum())
+        assert 0.3 * true < est < 3 * true
+
+    def test_spatiotemporal_product(self, st_store):
+        ds, (x, y, t) = st_store
+        lo = np.datetime64("2024-01-05", "ms").astype(np.int64)
+        hi = np.datetime64("2024-01-20", "ms").astype(np.int64)
+        est = ds.estimate_count(
+            "st",
+            "bbox(geom, -1, -1, 1, 1) AND dtg DURING "
+            "2024-01-05T00:00:00Z/2024-01-20T00:00:00Z",
+        )
+        m = (x >= -1) & (x <= 1) & (y >= -1) & (y <= 1) & (t >= lo) & (t < hi)
+        true = int(m.sum())
+        assert 0.3 * true < est < 3 * true
+
+    def test_disjoint_estimates_zero(self, st_store):
+        ds, _ = st_store
+        assert ds.estimate_count(
+            "st", "bbox(geom, 0, 0, 1, 1) AND bbox(geom, 5, 5, 6, 6)"
+        ) == 0
+
+    def test_sparse_region_radius_grows(self, st_store):
+        from geomesa_tpu.process.knn import _estimate_radius_m
+
+        ds, _ = st_store
+        r_dense = _estimate_radius_m(ds, "st", 10, 0.0, 0.0, 5e6)
+        r_sparse = _estimate_radius_m(ds, "st", 10, 40.0, 40.0, 5e6)
+        assert r_sparse > 10 * r_dense
+
+
+class TestTakeBoundsGuard:
+    def test_out_of_range_raises_and_negative_works(self):
+        from geomesa_tpu.features import FeatureCollection
+
+        sft = FeatureType.from_spec("t", "v:Integer,*geom:Point:srid=4326")
+        n = 100
+        fc = FeatureCollection.from_columns(
+            sft, np.arange(n),
+            {"v": np.arange(n), "geom": (np.zeros(n), np.zeros(n))},
+        )
+        with pytest.raises(IndexError):
+            fc.take(np.array([n]))
+        assert int(np.asarray(fc.take(np.array([-1])).columns["v"])[0]) == n - 1
