@@ -221,6 +221,45 @@ def cmd_count(args) -> int:
     return 0
 
 
+def cmd_playback(args) -> int:
+    """Replay a store's features in time order into a streaming cache at a
+    rate multiplier (reference geomesa-tools `playback` command, which
+    replays dtg-ordered features to simulate a live stream). ``--rate 0``
+    replays as fast as possible; each batch prints one summary line."""
+    import time as _time
+
+    from geomesa_tpu.streaming import StreamingFeatureCache
+
+    ds = _load(args)
+    sft = ds.get_schema(args.feature_name)
+    if sft.dtg_field is None:
+        print("playback requires a schema with a date attribute", file=sys.stderr)
+        return 1
+    fc = ds.query(args.feature_name, args.cql or "INCLUDE")
+    if len(fc) == 0:
+        print("nothing to play back")
+        return 0
+    order = np.argsort(np.asarray(fc.columns[sft.dtg_field]), kind="stable")
+    fc = fc.take(order)
+    t = np.asarray(fc.columns[sft.dtg_field], dtype=np.int64)
+    cache = StreamingFeatureCache(sft)
+    batch = max(1, args.batch_size)
+    played = 0
+    t_wall = _time.perf_counter()
+    for s in range(0, len(fc), batch):
+        part = fc.take(np.arange(s, min(s + batch, len(fc))))
+        if args.rate > 0 and s > 0:
+            # sleep for the data time since the PREVIOUS batch's start so
+            # the gaps telescope to the full data span at 1/rate speed
+            gap_s = (int(t[s]) - int(t[s - batch])) / 1000.0 / args.rate
+            _time.sleep(min(max(gap_s, 0.0), 5.0))
+        cache.upsert(part.to_rows())
+        played += len(part)
+        print(f"played {played}/{len(fc)} (cache size {len(cache)})")
+    print(f"playback done in {_time.perf_counter() - t_wall:.1f}s")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -271,6 +310,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("count", cmd_count, feature=True)
     sp.add_argument("-q", "--cql")
+
+    sp = add("playback", cmd_playback, feature=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument(
+        "--rate", type=float, default=0.0,
+        help="data-time speedup factor (0 = as fast as possible)",
+    )
+    sp.add_argument("--batch-size", type=int, default=1000)
 
     return p
 

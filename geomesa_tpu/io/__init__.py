@@ -1,7 +1,8 @@
-"""IO tier: exporters and ingest converters (the geomesa-features
-exporters + geomesa-convert analogue, SURVEY.md §2.3/§2.5)."""
+"""IO tier: exporters, ingest converters, and storage formats (the
+geomesa-features exporters + geomesa-convert + geomesa-fs Parquet
+analogue, SURVEY.md §2.3/§2.4/§2.5)."""
 
+from geomesa_tpu.io.converters import Converter, dbapi_records, infer_schema
 from geomesa_tpu.io.exporters import export
-from geomesa_tpu.io.converters import Converter, infer_schema
 
-__all__ = ["export", "Converter", "infer_schema"]
+__all__ = ["export", "Converter", "dbapi_records", "infer_schema"]

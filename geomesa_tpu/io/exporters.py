@@ -20,7 +20,7 @@ from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
 
-FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "arrow", "avro")
+FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "arrow", "avro", "parquet")
 
 
 def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | bytes":
@@ -41,6 +41,14 @@ def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | byte
         from geomesa_tpu.io.avro import write_avro
 
         payload = write_avro(fc)
+    elif fmt == "parquet":
+        import io as _io
+
+        from geomesa_tpu.io.parquet import write_parquet
+
+        buf = _io.BytesIO()
+        write_parquet(fc, buf)
+        payload = buf.getvalue()
     else:
         raise ValueError(f"unknown format {fmt!r}; supported: {FORMATS}")
     if fh is not None:

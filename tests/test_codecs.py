@@ -1,0 +1,170 @@
+"""TWKB codec, geohash, Parquet IO, CLI playback (round-4 parity adds)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+
+
+class TestTwkb:
+    def _rt(self, g, prec=7):
+        from geomesa_tpu.io.twkb import from_twkb, to_twkb
+
+        return from_twkb(to_twkb(g, prec))
+
+    def test_point_precision(self):
+        p = self._rt(geo.Point(10.123456789, -45.987654321))
+        assert abs(p.x - 10.1234568) < 1e-7
+        assert abs(p.y + 45.9876543) < 1e-7
+
+    def test_linestring_delta_compression(self):
+        from geomesa_tpu.io.twkb import to_twkb
+
+        rng = np.random.default_rng(0)
+        track = np.cumsum(rng.normal(0, 0.001, (500, 2)), axis=0) + [10, 20]
+        line = geo.LineString(track)
+        got = self._rt(line, 6)
+        np.testing.assert_allclose(got.coords, np.round(track * 1e6) / 1e6, atol=1e-9)
+        # delta varints beat WKB's fixed doubles by ~4x on smooth tracks
+        assert len(to_twkb(line, 6)) * 3 < len(geo.to_wkb(line))
+
+    def test_polygon_with_hole(self):
+        shell = np.array([[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]], float)
+        hole = np.array([[2, 2], [4, 2], [4, 4], [2, 4], [2, 2]], float)
+        pg = self._rt(geo.Polygon(shell, [hole]))
+        np.testing.assert_allclose(pg.shell, shell)
+        np.testing.assert_allclose(pg.holes[0], hole)
+
+    def test_multis_and_empty(self):
+        shell = np.array([[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]], float)
+        mp = self._rt(geo.MultiPolygon([geo.Polygon(shell), geo.Polygon(shell + 5)]))
+        assert len(mp.parts) == 2
+        np.testing.assert_allclose(mp.parts[1].shell, shell + 5)
+        assert len(self._rt(geo.MultiPoint([])).parts) == 0
+
+    def test_negative_precision(self):
+        p = self._rt(geo.Point(12345.0, -6789.0), prec=-2)
+        assert p.x == 12300.0 and p.y == -6800.0
+
+    def test_bad_inputs(self):
+        from geomesa_tpu.io.twkb import from_twkb, to_twkb
+
+        with pytest.raises(ValueError, match="precision"):
+            to_twkb(geo.Point(0, 0), precision=9)
+        with pytest.raises(ValueError, match="metadata"):
+            from_twkb(bytes([0x01, 0x02, 0, 0]))  # size flag unsupported
+
+
+class TestGeohash:
+    def test_known_vectors(self):
+        from geomesa_tpu.utils import geohash as gh
+
+        assert str(gh.encode(-5.603, 42.605, 5)) == "ezs42"
+        assert str(gh.encode(10.40744, 57.64911, 11)) == "u4pruydqqvj"
+
+    def test_roundtrip_all_precisions(self):
+        from geomesa_tpu.utils import geohash as gh
+
+        rng = np.random.default_rng(0)
+        lon = rng.uniform(-180, 180, 200)
+        lat = rng.uniform(-90, 90, 200)
+        for p in (1, 5, 6, 12):
+            hs = gh.encode(lon, lat, p)
+            for h, lo, la in zip(hs.tolist()[:30], lon, lat):
+                x0, y0, x1, y1 = gh.bbox(h)
+                assert x0 <= lo <= x1 and y0 <= la <= y1
+                cx, cy = gh.decode(h)
+                assert str(gh.encode(cx, cy, p)) == h
+
+    def test_neighbors(self):
+        from geomesa_tpu.utils import geohash as gh
+
+        n = gh.neighbors("ezs42")
+        assert len(n) == 8 and len(set(n)) == 8
+        for h in n:  # all adjacent cells touch the center cell's bbox
+            x0, y0, x1, y1 = gh.bbox(h)
+            cx0, cy0, cx1, cy1 = gh.bbox("ezs42")
+            assert x0 <= cx1 + 1e-9 and x1 >= cx0 - 1e-9
+            assert y0 <= cy1 + 1e-9 and y1 >= cy0 - 1e-9
+
+
+class TestParquet:
+    def test_point_roundtrip_and_pushdown(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        from geomesa_tpu.io.parquet import read_parquet, write_parquet
+
+        rng = np.random.default_rng(3)
+        n = 5000
+        sft = FeatureType.from_spec(
+            "ev", "name:String,v:Integer,dtg:Date,*geom:Point:srid=4326"
+        )
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        t = np.datetime64("2024-01-01", "ms").astype(np.int64) + rng.integers(
+            0, 10**9, n
+        )
+        fc = FeatureCollection.from_columns(
+            sft, np.arange(n),
+            {
+                "name": np.array(["a", "b", "c"])[rng.integers(0, 3, n)].astype(object),
+                "v": rng.integers(0, 100, n).astype(np.int32),
+                "dtg": t,
+                "geom": (x, y),
+            },
+        )
+        p = tmp_path / "f.parquet"
+        write_parquet(fc, p)
+        back = read_parquet(p)  # schema from file metadata
+        assert len(back) == n
+        np.testing.assert_array_equal(np.asarray(back.columns["dtg"]), t)
+        np.testing.assert_allclose(back.geom_column.x, x)
+        sub = read_parquet(p, bbox=(-10, -10, 10, 10))
+        m = (x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)
+        assert len(sub) == int(m.sum())
+
+    def test_extent_roundtrip(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        from geomesa_tpu.io.parquet import read_parquet, write_parquet
+
+        sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+        col = geo.PackedGeometryColumn.from_boxes(
+            np.array([0.0, 5.0]), np.array([0.0, 5.0]),
+            np.array([1.0, 6.0]), np.array([1.0, 6.0]),
+        )
+        fc = FeatureCollection.from_columns(sft, np.arange(2), {"geom": col})
+        p = tmp_path / "g.parquet"
+        write_parquet(fc, p)
+        back = read_parquet(p)
+        assert len(back) == 2
+        np.testing.assert_allclose(back.geom_column.bboxes, col.bboxes, atol=1e-5)
+
+
+class TestPlayback:
+    def test_playback_command(self, tmp_path, capsys):
+        from geomesa_tpu.cli import main
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.storage import persist
+
+        sft = FeatureType.from_spec("ev", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(4)
+        n = 250
+        t = np.datetime64("2024-01-01", "ms").astype(np.int64) + rng.integers(
+            0, 10**8, n
+        )
+        ds.write("ev", FeatureCollection.from_columns(
+            sft, np.arange(n),
+            {"dtg": t, "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))},
+        ))
+        persist.save(ds, tmp_path / "store")
+        rc = main([
+            "playback", "-c", str(tmp_path / "store"), "-f", "ev",
+            "--batch-size", "100",
+        ])
+        assert rc == 0
+        outp = capsys.readouterr().out
+        assert f"played {n}/{n} (cache size {n})" in outp
+        assert "playback done" in outp
