@@ -74,20 +74,14 @@ def schema_dict(sft: FeatureType) -> dict:
 # ----------------------------------------------------------------- encode
 
 
-def _zigzag(n: int) -> int:
-    return (n << 1) ^ (n >> 63)
+from geomesa_tpu.io.varint import append_uvarint as _append_uvarint
+from geomesa_tpu.io.varint import zigzag as _zigzag
 
 
 def _write_long(out: io.BytesIO, n: int) -> None:
-    z = _zigzag(int(n)) & ((1 << 64) - 1)
-    while True:
-        b = z & 0x7F
-        z >>= 7
-        if z:
-            out.write(bytes([b | 0x80]))
-        else:
-            out.write(bytes([b]))
-            return
+    buf = bytearray()
+    _append_uvarint(buf, _zigzag(int(n)))
+    out.write(bytes(buf))
 
 
 def _write_bytes(out: io.BytesIO, b: bytes) -> None:
@@ -203,16 +197,10 @@ class _Reader:
         return out
 
     def read_long(self) -> int:
-        shift = 0
-        acc = 0
-        while True:
-            byte = self.b[self.pos]
-            self.pos += 1
-            acc |= (byte & 0x7F) << shift
-            if not byte & 0x80:
-                break
-            shift += 7
-        return (acc >> 1) ^ -(acc & 1)
+        from geomesa_tpu.io.varint import read_uvarint, unzigzag
+
+        acc, self.pos = read_uvarint(self.b, self.pos)
+        return unzigzag(acc)
 
     def read_bytes(self) -> bytes:
         return self.read(self.read_long())

@@ -70,8 +70,8 @@ def read_parquet(
 
     from geomesa_tpu import geometry as geo
 
-    pf = pq.ParquetFile(path)
-    meta = pf.schema_arrow.metadata or {}
+    schema = pq.read_schema(path)  # footer only; the data reads once below
+    meta = schema.metadata or {}
     if sft is None:
         spec = meta.get(_SFT_KEY)
         if spec is None:
@@ -84,7 +84,7 @@ def read_parquet(
     geom = sft.geom_field
     filters = None
     if bbox is not None:
-        if f"{geom}_x" not in pf.schema_arrow.names:
+        if f"{geom}_x" not in schema.names:
             raise ValueError("bbox push-down requires a point schema")
         x0, y0, x1, y1 = bbox
         filters = [

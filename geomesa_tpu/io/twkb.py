@@ -31,36 +31,12 @@ _TYPE_CODES = {
 }
 
 
-def _zigzag(v: int) -> int:
-    return (v << 1) ^ (v >> 63)
-
-
-def _unzigzag(v: int) -> int:
-    return (v >> 1) ^ -(v & 1)
-
-
-def _write_varint(out: bytearray, v: int) -> None:
-    v &= (1 << 64) - 1
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return
-
-
-def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
-    shift = 0
-    v = 0
-    while True:
-        b = data[pos]
-        pos += 1
-        v |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return v, pos
-        shift += 7
+from geomesa_tpu.io.varint import (
+    append_uvarint as _write_varint,
+    read_uvarint as _read_varint,
+    unzigzag as _unzigzag,
+    zigzag as _zigzag,
+)
 
 
 class _CoordWriter:
@@ -148,6 +124,10 @@ def from_twkb(data: bytes) -> geo.Geometry:
     meta = data[1]
     if meta & ~_EMPTY:
         raise ValueError(f"unsupported twkb metadata flags: {meta:#x}")
+    if meta & _EMPTY and code not in (4, 5, 6):
+        # the geometry model has no empty scalar geometries (LineString
+        # requires >= 2 points etc.) — reject e.g. POINT EMPTY cleanly
+        raise ValueError(f"empty twkb geometry (type {code}) not supported")
     pos = 2
     scale = 10.0 ** precision
     r = _CoordReader(scale)

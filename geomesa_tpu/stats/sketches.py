@@ -91,7 +91,8 @@ class Histogram:
             return
         idx = ((col - self.lo) / (self.hi - self.lo) * self.n_bins).astype(np.int64)
         idx = np.clip(idx, 0, self.n_bins - 1)
-        np.add.at(self.counts, idx, 1)
+        # bincount is ~20x np.add.at — this runs per ingest batch
+        self.counts += np.bincount(idx, minlength=self.n_bins)
 
     def __iadd__(self, other: "Histogram") -> "Histogram":
         if (other.lo, other.hi, other.n_bins) == (self.lo, self.hi, self.n_bins):
@@ -226,44 +227,60 @@ class Z3Histogram:
 
     def __init__(self, total_bits: int, prefix_bits: int = 16):
         # prefix 16 (round 4; was 12): 12-bit cells were ~6x off on
-        # clustered data — too coarse for the kNN local-radius tier. Cell
-        # count is bounded by cells actually touched, and the sorted view
-        # is cached, so finer cells cost memory ~ data spread, not 2^16.
+        # clustered data — too coarse for the kNN local-radius tier. Cells
+        # live as parallel SORTED arrays (keys, counts) merged wholesale
+        # per batch — a per-cell python dict loop dominated large ingests.
         self.total_bits = total_bits
         self.shift = np.uint64(max(0, total_bits - prefix_bits))
-        self.cells: dict = {}  # (bin, z_prefix) -> count
-        self._sorted: "tuple | None" = None  # cached (keys, counts) arrays
+        self._keys = np.zeros(0, dtype=np.int64)
+        self._counts = np.zeros(0, dtype=np.int64)
+
+    # rows per observe() pass: larger batches stride-sample down to this
+    # (a selectivity sketch needs distribution shape, not exact mass; the
+    # full-array unique dominated large ingest batches)
+    SAMPLE_CAP = 4_000_000
+
+    @property
+    def cells(self) -> dict:
+        """(bin, z_prefix) -> count view (tests/inspection)."""
+        return dict(zip(self._keys.tolist(), self._counts.tolist()))
+
+    def _merge(self, vals: np.ndarray, cnts: np.ndarray) -> None:
+        if len(self._keys) == 0:
+            self._keys, self._counts = vals, cnts
+            return
+        uk, inv = np.unique(
+            np.concatenate([self._keys, vals]), return_inverse=True
+        )
+        uc = np.bincount(
+            inv, weights=np.concatenate([self._counts, cnts]), minlength=len(uk)
+        ).astype(np.int64)
+        self._keys, self._counts = uk, uc
 
     def observe(self, bins: np.ndarray, zs: np.ndarray) -> None:
+        n = len(zs)
+        weight = 1
+        if n > self.SAMPLE_CAP:
+            stride = -(-n // self.SAMPLE_CAP)
+            bins = np.ascontiguousarray(bins[::stride])
+            zs = np.ascontiguousarray(zs[::stride])
+            weight = stride
         key = bins.astype(np.int64) * (1 << 32) + (
             zs.astype(np.uint64) >> self.shift
         ).astype(np.int64)
         vals, cnts = np.unique(key, return_counts=True)
-        for v, c in zip(vals.tolist(), cnts.tolist()):
-            self.cells[v] = self.cells.get(v, 0) + c
-        self._sorted = None
+        self._merge(vals, cnts.astype(np.int64) * weight)
 
     def __iadd__(self, other: "Z3Histogram") -> "Z3Histogram":
-        for v, c in other.cells.items():
-            self.cells[v] = self.cells.get(v, 0) + c
-        self._sorted = None
+        self._merge(other._keys, other._counts)
         return self
-
-    def _sorted_cells(self):
-        if self._sorted is None:
-            keys = np.array(sorted(self.cells), dtype=np.int64)
-            cnts = np.array(
-                [self.cells[k] for k in keys.tolist()], dtype=np.float64
-            )
-            self._sorted = (keys, cnts)
-        return self._sorted
 
     def estimate(self, range_bins, range_lo, range_hi) -> float:
         """Estimated rows covered by inclusive z ranges, assuming uniform
         intra-cell mass."""
-        if not self.cells:
+        if len(self._keys) == 0:
             return 0.0
-        keys, cnts = self._sorted_cells()
+        keys, cnts = self._keys, self._counts
         cell = np.uint64(1) << self.shift
         est = 0.0
         for b, lo, hi in zip(
@@ -292,4 +309,4 @@ class Z3Histogram:
         return max(est, 0.0)
 
     def to_json(self):
-        return {"cells": len(self.cells), "shift": int(self.shift)}
+        return {"cells": len(self._keys), "shift": int(self.shift)}
