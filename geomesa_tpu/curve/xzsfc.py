@@ -148,6 +148,18 @@ class XZSFC:
         qlo = np.array([q.lo for q in queries])  # [nq, dims]
         qhi = np.array([q.hi for q in queries])
 
+        from geomesa_tpu import native
+
+        nat = native.xz_ranges(
+            self.dims, self.g, self.subtree_size, qlo, qhi, max_ranges
+        )
+        if nat is not None:
+            lo, hi, cont = nat
+            return [
+                IndexRange(int(a), int(b), bool(c))
+                for a, b, c in zip(lo.tolist(), hi.tolist(), cont.tolist())
+            ]
+
         ranges: list[IndexRange] = []
         # queue entries: (cell lo tuple, level, cs)
         level_cells: list[tuple[tuple[float, ...], int, int]] = [((0.0,) * self.dims, 0, 0)]

@@ -28,6 +28,10 @@ import numpy as np
 from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
+from geomesa_tpu.io.varint import append_uvarint as _append_uvarint
+from geomesa_tpu.io.varint import read_uvarint as _read_uvarint
+from geomesa_tpu.io.varint import unzigzag as _unzigzag
+from geomesa_tpu.io.varint import zigzag as _zigzag
 from geomesa_tpu.sft import FeatureType
 
 MAGIC = b"Obj\x01"
@@ -72,10 +76,6 @@ def schema_dict(sft: FeatureType) -> dict:
 
 
 # ----------------------------------------------------------------- encode
-
-
-from geomesa_tpu.io.varint import append_uvarint as _append_uvarint
-from geomesa_tpu.io.varint import zigzag as _zigzag
 
 
 def _write_long(out: io.BytesIO, n: int) -> None:
@@ -197,10 +197,8 @@ class _Reader:
         return out
 
     def read_long(self) -> int:
-        from geomesa_tpu.io.varint import read_uvarint, unzigzag
-
-        acc, self.pos = read_uvarint(self.b, self.pos)
-        return unzigzag(acc)
+        acc, self.pos = _read_uvarint(self.b, self.pos)
+        return _unzigzag(acc)
 
     def read_bytes(self) -> bytes:
         return self.read(self.read_long())

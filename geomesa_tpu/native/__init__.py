@@ -115,6 +115,11 @@ def _load():
             f64p, f64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             i64p, i64p,
         ]
+        lib.xz_ranges.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i64p, f64p, f64p,
+            ctypes.c_int64, ctypes.c_int64, u64p, u64p, u8p, ctypes.c_int64,
+        ]
+        lib.xz_ranges.restype = ctypes.c_int64
         _lib = lib
         return lib
 
@@ -276,6 +281,30 @@ def xz_index(lo, hi, dims: int, g: int, subtree) -> "np.ndarray | None":
     out = np.empty(n, dtype=np.int64)
     lib.xz_index(lo.reshape(-1), hi.reshape(-1), n, int(dims), int(g), sub, out)
     return out
+
+
+def xz_ranges(dims: int, g: int, subtree, qlo, qhi, max_ranges: int):
+    """Covering XZ sequence-code ranges of normalized query boxes (C++
+    BFS + merge, ~100x the python pass at g=12). Returns (lo u64[k],
+    hi u64[k], contained bool[k]) or None when native is unavailable."""
+    lib = _load()
+    if lib is None or dims > 4:
+        return None
+    qlo = np.ascontiguousarray(qlo, dtype=np.float64)
+    qhi = np.ascontiguousarray(qhi, dtype=np.float64)
+    sub = np.ascontiguousarray(subtree, dtype=np.int64)
+    nq = qlo.shape[0] if qlo.ndim == 2 else len(qlo) // dims
+    cap = max(int(max_ranges) * 2 + 64, 256)
+    lo = np.empty(cap, dtype=np.uint64)
+    hi = np.empty(cap, dtype=np.uint64)
+    cont = np.empty(cap, dtype=np.uint8)
+    n = lib.xz_ranges(
+        dims, g, sub, qlo.reshape(-1), qhi.reshape(-1), nq,
+        int(max_ranges), lo, hi, cont, cap,
+    )
+    if n < 0:
+        return None
+    return lo[:n].copy(), hi[:n].copy(), cont[:n].astype(bool)
 
 
 def bitmask_decode(wide, bids, n_real: int, block: int):

@@ -664,3 +664,161 @@ extern "C" void xz_index(const double* lo, const double* hi, int64_t n,
     out[e] = cs;
   }
 }
+
+// ---------------------------------------------------------------------------
+// XZ range decomposition: covering sequence-code ranges of query boxes.
+// Same BFS + budget + merge semantics as curve/xzsfc.py XZSFC.ranges
+// (re-derived XZ-ordering construction; reference XZ2SFC.ranges:146-252):
+// a cell whose ENLARGED extent is contained in a query covers its whole
+// subtree (contained=true, no row filter); an overlapping cell emits its
+// own code and recurses. Per-level budget of 2*max_ranges, then a
+// sort+merge that only glues same-kind neighbors and closes the smallest
+// gaps to reach max_ranges. Python's per-cell numpy ops cost 3-116 ms per
+// query at g=12; this pass is ~100x cheaper.
+// ---------------------------------------------------------------------------
+
+namespace {
+struct XzCell {
+  double lo[4];
+  int32_t level;
+  int64_t cs;
+};
+struct XzRange {
+  uint64_t lo, hi;
+  uint8_t contained;
+};
+}  // namespace
+
+extern "C" int64_t xz_ranges(int32_t dims, int32_t g, const int64_t* subtree,
+                             const double* qlo, const double* qhi, int64_t nq,
+                             int64_t max_ranges, uint64_t* out_lo,
+                             uint64_t* out_hi, uint8_t* out_cont,
+                             int64_t cap) {
+  if (dims > 4) return -1;
+  const int32_t children = 1 << dims;
+  std::vector<XzCell> level_cells, nxt;
+  XzCell root{};
+  for (int32_t d = 0; d < dims; ++d) root.lo[d] = 0.0;
+  root.level = 0;
+  root.cs = 0;
+  level_cells.push_back(root);
+  std::vector<XzRange> ranges;
+
+  while (!level_cells.empty()) {
+    nxt.clear();
+    const int64_t budget_left = max_ranges * 2 - (int64_t)ranges.size();
+    if (budget_left <= 0) break;
+    for (const XzCell& c : level_cells) {
+      const double w = std::ldexp(1.0, -c.level);
+      bool contained = false, overlaps = false;
+      for (int64_t q = 0; q < nq && !contained; ++q) {
+        bool cont = true;
+        for (int32_t d = 0; d < dims; ++d) {
+          if (!(qlo[q * dims + d] <= c.lo[d] &&
+                qhi[q * dims + d] >= c.lo[d] + 2.0 * w)) {
+            cont = false;
+            break;
+          }
+        }
+        contained |= cont;
+      }
+      if (contained) {
+        ranges.push_back({(uint64_t)c.cs,
+                          (uint64_t)(c.cs + subtree[c.level] - 1), 1});
+        continue;
+      }
+      for (int64_t q = 0; q < nq && !overlaps; ++q) {
+        bool ov = true;
+        for (int32_t d = 0; d < dims; ++d) {
+          if (!(qlo[q * dims + d] <= c.lo[d] + 2.0 * w &&
+                qhi[q * dims + d] >= c.lo[d])) {
+            ov = false;
+            break;
+          }
+        }
+        overlaps |= ov;
+      }
+      if (!overlaps) continue;
+      ranges.push_back({(uint64_t)c.cs, (uint64_t)c.cs, 0});
+      if (c.level < g) {
+        const int64_t sub = subtree[c.level + 1];
+        const double half = w * 0.5;
+        for (int32_t q = 0; q < children; ++q) {
+          XzCell ch{};
+          for (int32_t d = 0; d < dims; ++d)
+            ch.lo[d] = c.lo[d] + (((q >> d) & 1) ? half : 0.0);
+          ch.level = c.level + 1;
+          ch.cs = c.cs + 1 + q * sub;
+          nxt.push_back(ch);
+        }
+      }
+    }
+    level_cells.swap(nxt);
+  }
+  // budget exhausted: whole subtrees for unprocessed overlapping cells
+  for (const XzCell& c : level_cells) {
+    const double w = std::ldexp(1.0, -c.level);
+    bool overlaps = false;
+    for (int64_t q = 0; q < nq && !overlaps; ++q) {
+      bool ov = true;
+      for (int32_t d = 0; d < dims; ++d) {
+        if (!(qlo[q * dims + d] <= c.lo[d] + 2.0 * w &&
+              qhi[q * dims + d] >= c.lo[d])) {
+          ov = false;
+          break;
+        }
+      }
+      overlaps |= ov;
+    }
+    if (overlaps)
+      ranges.push_back({(uint64_t)c.cs,
+                        (uint64_t)(c.cs + subtree[c.level] - 1), 0});
+  }
+
+  if (ranges.empty()) return 0;
+  // sort + merge same-kind neighbors (curve/zranges.py merge_ranges)
+  std::sort(ranges.begin(), ranges.end(), [](const XzRange& a, const XzRange& b) {
+    return a.lo != b.lo ? a.lo < b.lo : a.hi < b.hi;
+  });
+  std::vector<XzRange> merged;
+  merged.push_back(ranges[0]);
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    XzRange& last = merged.back();
+    const XzRange& r = ranges[i];
+    if (r.lo <= last.hi + 1 && r.contained == last.contained) {
+      last.hi = std::max(last.hi, r.hi);
+    } else {
+      merged.push_back(r);
+    }
+  }
+  if (max_ranges > 0 && (int64_t)merged.size() > max_ranges) {
+    const int64_t k = (int64_t)merged.size() - max_ranges;
+    std::vector<int64_t> gap_idx(merged.size() - 1);
+    for (size_t i = 0; i + 1 < merged.size(); ++i) gap_idx[i] = (int64_t)i;
+    std::nth_element(
+        gap_idx.begin(), gap_idx.begin() + (k - 1), gap_idx.end(),
+        [&](int64_t a, int64_t b) {
+          return merged[a + 1].lo - merged[a].hi < merged[b + 1].lo - merged[b].hi;
+        });
+    std::vector<uint8_t> close(merged.size() - 1, 0);
+    for (int64_t i = 0; i < k; ++i) close[gap_idx[i]] = 1;
+    std::vector<XzRange> out;
+    out.push_back(merged[0]);
+    for (size_t i = 1; i < merged.size(); ++i) {
+      if (close[i - 1]) {
+        out.back().hi = std::max(out.back().hi, merged[i].hi);
+        out.back().contained = 0;
+      } else {
+        out.push_back(merged[i]);
+      }
+    }
+    merged.swap(out);
+  }
+  if ((int64_t)merged.size() > cap) return -1;
+  for (size_t i = 0; i < merged.size(); ++i) {
+    out_lo[i] = merged[i].lo;
+    out_hi[i] = merged[i].hi;
+    out_cont[i] = merged[i].contained;
+  }
+  return (int64_t)merged.size();
+}

@@ -257,3 +257,50 @@ def test_bitmask_decode_wide_only():
     blk, local = np.nonzero(flat)
     want = bids[blk].astype(np.int64) * block + local
     np.testing.assert_array_equal(got, want)
+
+
+def test_xz_ranges_parity():
+    """Native XZ BFS vs the python pass: exact match uncapped; covering
+    superset when the range budget caps (gap-close tie-breaks differ)."""
+    from geomesa_tpu.curve.xzsfc import XElement, XZSFC
+
+    rng = np.random.default_rng(12)
+    for dims in (2, 3):
+        sfc = XZSFC(12 if dims == 2 else 10, dims)
+        for trial in range(12):
+            k = rng.integers(1, 3)
+            qs = []
+            for _ in range(k):
+                lo = rng.uniform(0, 0.9, dims)
+                hi = lo + rng.uniform(0.001, 0.1, dims) ** (1 + trial % 2)
+                qs.append(XElement(tuple(lo), tuple(np.minimum(hi, 1.0))))
+            got = sfc.ranges(qs, max_ranges=200_000)  # large: no capping
+            native._lib, saved = False, native._lib
+            try:
+                want = sfc.ranges(qs, max_ranges=200_000)
+            finally:
+                native._lib = saved
+            assert [(r.lower, r.upper, r.contained) for r in got] == [
+                (r.lower, r.upper, r.contained) for r in want
+            ]
+
+    # capped: both produce <= max_ranges ranges covering the uncapped set
+    sfc = XZSFC(12, 2)
+    qs = [XElement((0.1, 0.1), (0.6, 0.55))]
+    full = sfc.ranges(qs, max_ranges=100_000)
+    capped = sfc.ranges(qs, max_ranges=50)
+    assert len(capped) <= 50
+    # coverage: the union of capped intervals contains every full range
+    # (merge kind-insensitively first: containment flags may differ)
+    ivals = sorted((r.lower, r.upper) for r in capped)
+    merged = [list(ivals[0])]
+    for lo, hi in ivals[1:]:
+        if lo <= merged[-1][1] + 1:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    lows = np.array([m[0] for m in merged])
+    highs = np.array([m[1] for m in merged])
+    for r in full:
+        i = np.searchsorted(lows, r.lower, side="right") - 1
+        assert i >= 0 and highs[i] >= r.upper  # covered
