@@ -449,12 +449,30 @@ def config5_knn():
 
 
 def main():
+    import threading
+
     import jax
 
     platform = os.environ.get("GEOMESA_BENCH_PLATFORM")
     if platform:  # e.g. "cpu" for off-TPU verification runs
         jax.config.update("jax_platforms", platform)
+
+    # device-claim watchdog: a wedged TPU lease makes jax.devices() block
+    # forever inside PJRT init; fail loudly instead of hanging the driver
+    init_timeout = float(os.environ.get("GEOMESA_BENCH_INIT_TIMEOUT", 600))
+    ready = threading.Event()
+
+    def watchdog():
+        if not ready.wait(init_timeout):
+            log(
+                f"FATAL: device init did not complete within {init_timeout:.0f}s "
+                "(TPU claim wedged?); aborting bench"
+            )
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
     log(f"devices: {jax.devices()}")
+    ready.set()
     runners = {
         "1": config1_z3, "2": config2_z2, "3": config3_xz2,
         "4": config4_join, "5": config5_knn,

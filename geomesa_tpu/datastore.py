@@ -556,6 +556,22 @@ class DataStore:
         plan = self.planner.plan(type_name, f, limit=limit, explain=explain)
         return self.planner.execute(plan, explain=explain, hints=hints)
 
+    def query_many(
+        self,
+        type_name: str,
+        filters: "Sequence[Filter | str]",
+        limit: Optional[int] = None,
+        hints=None,
+    ) -> list[FeatureCollection]:
+        """Run several queries with pipelined device work: all scans
+        dispatch before any result is pulled, so the per-query device
+        round-trip overlaps across the batch (throughput-oriented; the
+        per-query results are identical to sequential ``query`` calls)."""
+        plans = [
+            self.planner.plan(type_name, f, limit=limit) for f in filters
+        ]
+        return self.planner.execute_many(plans, hints=hints)
+
     def record_query(self, plan, hits: int, scan_s: float) -> None:
         """Audit + metrics sink for every executed plan — the planner calls
         this from execute(), and the aggregation fast paths call it

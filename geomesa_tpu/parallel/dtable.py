@@ -195,7 +195,7 @@ class DistributedIndexTable(IndexTable):
         return rows[order], cert[order]
 
     # -- device hooks ----------------------------------------------------
-    def _device_scan(self, blocks: np.ndarray, config: ScanConfig):
+    def _device_scan_submit(self, blocks: np.ndarray, config: ScanConfig):
         D = self.n_devices
         bids2, n_real = self._split_blocks(blocks)
         boxes, wins = self._params(config)
@@ -203,25 +203,29 @@ class DistributedIndexTable(IndexTable):
         names = kw["col_names"]
         self._record_scan(names, bids2.size)
         fn = _dist_scan(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
-        if bk.skip_inner_plane(kw["has_boxes"], kw["extent"]):
-            wide_h = np.asarray(jax.device_get(fn(bids2, boxes, wins, *self._cols_args(names))))
-            inner_h = None
-        else:
-            wide, inner = fn(bids2, boxes, wins, *self._cols_args(names))
-            wide_h, inner_h = jax.device_get((wide, inner))
-            wide_h, inner_h = np.asarray(wide_h), np.asarray(inner_h)
-        parts = []
-        for d in range(D):
-            nr = int(n_real[d])
-            if nr == 0:
-                continue
-            gb = bids2[d].astype(np.int64) * D + d  # local slot -> global block
-            parts.append(
-                bk.decode_bits_pair(
-                    wide_h[d], None if inner_h is None else inner_h[d], gb, nr
+        skip = bk.skip_inner_plane(kw["has_boxes"], kw["extent"])
+        out = fn(bids2, boxes, wins, *self._cols_args(names))  # dispatched now
+
+        def finish():
+            if skip:
+                wide_h, inner_h = np.asarray(jax.device_get(out)), None
+            else:
+                wide_h, inner_h = jax.device_get(out)
+                wide_h, inner_h = np.asarray(wide_h), np.asarray(inner_h)
+            parts = []
+            for d in range(D):
+                nr = int(n_real[d])
+                if nr == 0:
+                    continue
+                gb = bids2[d].astype(np.int64) * D + d  # local slot -> global
+                parts.append(
+                    bk.decode_bits_pair(
+                        wide_h[d], None if inner_h is None else inner_h[d], gb, nr
+                    )
                 )
-            )
-        return self._merge_device_rows(parts)
+            return self._merge_device_rows(parts)
+
+        return finish
 
     def _device_pops(self, blocks: np.ndarray, config: ScanConfig):
         D = self.n_devices

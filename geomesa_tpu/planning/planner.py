@@ -314,21 +314,49 @@ class QueryPlanner:
             # schema exists but nothing written yet: no index tables
             candidates = fc
         else:
-            table = self.store.table(plan.type_name, plan.index)
+            # simple index scan: the shared dispatch/finish implementation
+            # (finish runs immediately here; query_many defers it)
+            return self._submit_simple(plan, fc, exp, hints, skip_visibility)()
+
+        return self._refine_and_post(
+            plan, candidates, certain, hints, exp, deadline, skip_visibility
+        )
+
+    def _submit_simple(self, plan, fc, exp, hints, skip_visibility=False):
+        """Dispatch a simple index-scan plan's device work now; return
+        ``finish()`` -> FeatureCollection. ONE implementation serves both
+        the synchronous path (_execute calls finish immediately) and the
+        pipelined path (execute_many defers it). The deadline clock starts
+        when finish() runs — matching sequential semantics, so a late
+        pull in a long batch doesn't spuriously time out."""
+        table = self.store.table(plan.type_name, plan.index)
+        finish_scan = table.scan_submit(plan.config, deadline=None)
+
+        def finish() -> FeatureCollection:
+            deadline = self._deadline(hints)
             with exp.span(f"Device scan [{plan.index}]"):
                 # single-chip and distributed tables share one engine and
                 # one contract: (ordinals, certainty vector)
-                ordinals, certain = table.scan(plan.config, deadline=deadline)
+                ordinals, certain = finish_scan()
+            check_deadline(deadline, "scan result pull")
             exp(f"Candidates: {len(ordinals)}")
             candidates = fc.take(ordinals)
+            return self._refine_and_post(
+                plan, candidates, certain, hints, exp, deadline, skip_visibility
+            )
 
-        # Refinement tiers (reference Z3IndexKeySpace.useFullFilter,
-        # Z3IndexKeySpace.scala:240-254, automatic since round 3):
-        # - the device mask decides the filter: only *uncertain* boundary
-        #   rows (wide & ~inner; f32/offset rounding) re-check on host;
-        # - `loose` hint: accept the widened mask outright (reference
-        #   LOOSE_BBOX semantics);
-        # - otherwise: exact full-filter refinement over all candidates.
+        return finish
+
+    def _refine_and_post(
+        self, plan, candidates, certain, hints, exp, deadline, skip_visibility=False
+    ):
+        """Refinement tiers (reference Z3IndexKeySpace.useFullFilter,
+        Z3IndexKeySpace.scala:240-254, automatic since round 3):
+        - the device mask decides the filter: only *uncertain* boundary
+          rows (wide & ~inner; f32/offset rounding) re-check on host;
+        - `loose` hint: accept the widened mask outright (reference
+          LOOSE_BBOX semantics);
+        - otherwise: exact full-filter refinement over all candidates."""
         decided = mask_decides_filter(
             plan.filter, plan.config, self.store.get_schema(plan.type_name)
         )
@@ -352,6 +380,43 @@ class QueryPlanner:
             candidates = candidates.mask(mask)
         check_deadline(deadline, "refinement")
         return self._post(candidates, plan, hints, exp, skip_visibility)
+
+    # -- pipelined multi-query execution ---------------------------------
+    def submit(self, plan: QueryPlan, explain: Explainer | None = None, hints=None):
+        """Stage one query: dispatch its device scan NOW, return a zero-arg
+        ``finish()`` producing the FeatureCollection. Plans without a
+        simple index scan (unions, id lookups, full scans) fall back to
+        synchronous execution inside finish()."""
+        exp = explain or ExplainNull()
+        simple = (
+            plan.union is None
+            and plan.ids is None
+            and plan.index is not None
+            and plan.config is not None
+        )
+        if not simple or len(self.store.features(plan.type_name)) == 0:
+            return lambda: self.execute(plan, explain=exp, hints=hints)
+        fc = self.store.features(plan.type_name)
+        if hints is not None:
+            hints.validate()
+        inner = self._submit_simple(plan, fc, exp, hints)
+
+        def finish() -> FeatureCollection:
+            t0 = time.perf_counter()
+            out = inner()
+            self.store.record_query(plan, len(out), time.perf_counter() - t0)
+            return out
+
+        return finish
+
+    def execute_many(self, plans, hints=None) -> list:
+        """Execute several plans with overlapped device work: every scan
+        dispatches before any result is pulled, so per-query round-trip
+        latency pipelines instead of serializing (a throughput API — the
+        reference gets the same effect from server-side thread pools,
+        utils/AbstractBatchScan; here jax async dispatch provides it)."""
+        finishes = [self.submit(p, hints=hints) for p in plans]
+        return [f() for f in finishes]
 
     def _execute_union(self, plan: QueryPlan, exp, hints, deadline) -> FeatureCollection:
         """Run every union branch on its own index and dedup-union by

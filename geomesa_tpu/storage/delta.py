@@ -107,14 +107,24 @@ class TieredTable:
         return self.base + np.flatnonzero(delta_wide_mask(config, self.delta))
 
     def scan(self, config: ScanConfig, deadline=None):
-        ordinals, certain = self.main.scan(config, deadline=deadline)
-        d = self._delta_hits(config)
-        if len(d) == 0:
-            return ordinals, certain
-        return (
-            np.concatenate([ordinals, d]),
-            np.concatenate([certain, np.zeros(len(d), bool)]),
-        )
+        return self.scan_submit(config, deadline=deadline)()
+
+    def scan_submit(self, config: ScanConfig, deadline=None):
+        """Pipelined scan (see IndexTable.scan_submit): the device main-
+        table scan dispatches now; the host delta scan runs at finish."""
+        finish_main = self.main.scan_submit(config, deadline=deadline)
+
+        def finish():
+            ordinals, certain = finish_main()
+            d = self._delta_hits(config)
+            if len(d) == 0:
+                return ordinals, certain
+            return (
+                np.concatenate([ordinals, d]),
+                np.concatenate([certain, np.zeros(len(d), bool)]),
+            )
+
+        return finish
 
     def count(self, config: ScanConfig) -> int:
         return self.main.count(config) + len(self._delta_hits(config))

@@ -305,8 +305,18 @@ class IndexTable(SortedKeys):
         it at stage boundaries and raises QueryTimeout when overdue
         (reference ThreadManagement scan timeouts).
         """
+        return self.scan_submit(config, deadline=deadline)()
+
+    def scan_submit(self, config: ScanConfig, deadline=None):
+        """Pipelined form of :meth:`scan`: dispatch the device work NOW,
+        return a zero-arg ``finish()`` producing (ordinals, certain).
+
+        jax dispatch is asynchronous — submitting several queries' kernels
+        before pulling any result overlaps their device work and hides the
+        per-pull link latency behind computation (DataStore.query_many).
+        """
         if config.disjoint or self.n == 0:
-            return np.zeros(0, np.int64), np.zeros(0, bool)
+            return lambda: (np.zeros(0, np.int64), np.zeros(0, bool))
         check_deadline(deadline, "range pruning")
         overlap, contained = self.candidate_spans_split(config)
         has_pred = config.boxes is not None or config.windows is not None
@@ -315,34 +325,41 @@ class IndexTable(SortedKeys):
             # pure range scan (attribute index primary): spans are row-exact
             cont_rows = _span_rows(contained)
             rows = np.union1d(_span_rows(overlap), cont_rows) if overlap else cont_rows
-            return self.perm[rows].astype(np.int64), np.ones(len(rows), bool)
+            out = (self.perm[rows].astype(np.int64), np.ones(len(rows), bool))
+            return lambda: out
 
         blocks = self.candidate_blocks(overlap)
         if len(blocks) == 0:
             cont_rows = _span_rows(contained)
-            return self.perm[cont_rows].astype(np.int64), np.ones(len(cont_rows), bool)
+            out = (self.perm[cont_rows].astype(np.int64), np.ones(len(cont_rows), bool))
+            return lambda: out
 
         check_deadline(deadline, "device scan dispatch")
-        rows, certain = self._device_scan(blocks, config)
-        check_deadline(deadline, "bitmask decode")
-        if config.clip_rows:
-            keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
-            rows, certain = rows[keep], certain[keep]
-        if contained:
-            # union with contained-span rows (all certain), deduplicating
-            # kernel rows that fall inside a span — one native two-pointer
-            # pass when available, numpy fallback otherwise
-            from geomesa_tpu import native
+        finish_device = self._device_scan_submit(blocks, config)
 
-            merged = native.merge_rows_spans(contained, rows, certain)
-            if merged is not None:
-                rows, certain = merged
-            else:
-                dup = _rows_in_spans(rows, contained)
-                rows, certain = _merge_sorted_rows(
-                    _span_rows(contained), rows[~dup], certain[~dup]
-                )
-        return self.perm[rows].astype(np.int64), certain
+        def finish() -> tuple[np.ndarray, np.ndarray]:
+            rows, certain = finish_device()
+            check_deadline(deadline, "bitmask decode")
+            if config.clip_rows:
+                keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
+                rows, certain = rows[keep], certain[keep]
+            if contained:
+                # union with contained-span rows (all certain), dedup
+                # kernel rows inside a span — one native two-pointer pass
+                # when available, numpy fallback otherwise
+                from geomesa_tpu import native
+
+                merged = native.merge_rows_spans(contained, rows, certain)
+                if merged is not None:
+                    rows, certain = merged
+                else:
+                    dup = _rows_in_spans(rows, contained)
+                    rows, certain = _merge_sorted_rows(
+                        _span_rows(contained), rows[~dup], certain[~dup]
+                    )
+            return self.perm[rows].astype(np.int64), certain
+
+        return finish
 
     # -- device hooks ----------------------------------------------------
     def _params(self, config: ScanConfig):
@@ -408,6 +425,11 @@ class IndexTable(SortedKeys):
 
     def _device_scan(self, blocks: np.ndarray, config: ScanConfig):
         """Kernel call over candidate blocks -> (rows, certain)."""
+        return self._device_scan_submit(blocks, config)()
+
+    def _device_scan_submit(self, blocks: np.ndarray, config: ScanConfig):
+        """Dispatch the scan kernel now; return finish() -> (rows, certain).
+        The device-hook seam the distributed table overrides."""
         import jax
 
         blocks = self._full_or(blocks)
@@ -419,11 +441,15 @@ class IndexTable(SortedKeys):
             self._cols_args(names), bids, boxes, wins,
             **self._kernel_kwargs(config, names),
         )
-        # inner is None on extent box scans (skip_inner_plane): pull and
-        # decode the wide plane only — half the per-query pull bytes
-        wide_h, inner_h = jax.device_get((wide, inner))
-        inner_h = None if inner_h is None else np.asarray(inner_h)
-        return bk.decode_bits_pair(np.asarray(wide_h), inner_h, bids, n_real)
+
+        def finish():
+            # inner is None on extent box scans (skip_inner_plane): pull
+            # and decode the wide plane only — half the per-query bytes
+            wide_h, inner_h = jax.device_get((wide, inner))
+            inner_h = None if inner_h is None else np.asarray(inner_h)
+            return bk.decode_bits_pair(np.asarray(wide_h), inner_h, bids, n_real)
+
+        return finish
 
     def _device_pops(self, blocks: np.ndarray, config: ScanConfig):
         """Per-candidate-block wide-hit counts -> (pops [n] i64, global
