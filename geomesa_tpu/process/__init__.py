@@ -14,7 +14,10 @@ from geomesa_tpu.process.transforms import (
     arrow_conversion,
     bin_conversion,
     date_offset,
+    minmax_process,
     point2point,
+    query_process,
+    sampling_process,
     track_label,
 )
 from geomesa_tpu.process.tube import tube_select
@@ -27,9 +30,12 @@ __all__ = [
     "heading_diff",
     "join_search",
     "knn_search",
+    "minmax_process",
     "point2point",
     "proximity_search",
+    "query_process",
     "route_search",
+    "sampling_process",
     "track_label",
     "tube_select",
     "unique_values",

@@ -180,3 +180,46 @@ def arrow_conversion(fc: FeatureCollection, dictionary: bool = True) -> bytes:
     from geomesa_tpu.io.arrow import arrow_stream
 
     return arrow_stream(fc, dictionary=dictionary)
+
+
+def query_process(store, type_name: str, f, limit=None) -> FeatureCollection:
+    """Thin QueryProcess analogue (reference query/QueryProcess.scala):
+    evaluate a filter against a store through the planner."""
+    return store.query(type_name, f, limit=limit)
+
+
+def sampling_process(
+    fc: FeatureCollection, fraction: float, threading_field: "str | None" = None
+) -> FeatureCollection:
+    """SamplingProcess analogue (reference analytic/SamplingProcess.scala):
+    per-group deterministic thinning via FeatureCollection.sample."""
+    return fc.sample(fraction, threading_field)
+
+
+def minmax_process(store, type_name: str, attribute: str, cql="INCLUDE"):
+    """MinMaxProcess analogue (reference analytic/MinMaxProcess.scala):
+    (min, max) of an attribute under a filter. Served from the stats
+    sketches only when the filter is INCLUDE AND no visibility or
+    interceptor could hide rows (sketches see every row — the same gate
+    every aggregate fast path in the store applies); exact via the
+    planner otherwise."""
+    from geomesa_tpu.filter import ecql
+    from geomesa_tpu.filter.predicates import Include
+
+    f = ecql.parse(cql) if isinstance(cql, str) else cql
+    sketch_ok = (
+        isinstance(f, Include)
+        and not store._vis_active(type_name)
+        and not store.interceptors
+    )
+    if sketch_ok:
+        stats = store.stats_for(type_name)
+        if stats is not None:
+            b = stats.attribute_bounds(attribute)
+            if b is not None:
+                return b
+    out = store.query(type_name, f)
+    col = np.asarray(out.columns[attribute])
+    if len(col) == 0:
+        return None
+    return col.min(), col.max()

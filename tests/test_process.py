@@ -501,3 +501,21 @@ class TestKnnLocalRadius:
             ds.query = orig
         assert len(out) == 5
         assert queries <= 3
+
+
+class TestThinProcesses:
+    def test_query_sampling_minmax(self, ds):
+        from geomesa_tpu.process import (
+            minmax_process, query_process, sampling_process,
+        )
+
+        store, fc, (x, y, t, t0) = ds
+        out = query_process(store, "p", "bbox(geom, -5, -5, 5, 5)")
+        want = np.flatnonzero((x >= -5) & (x <= 5) & (y >= -5) & (y <= 5))
+        assert np.array_equal(np.sort(np.asarray(out.ids, np.int64)), want)
+        s = sampling_process(fc, 0.25)
+        assert 0 < len(s) < len(fc)
+        mm = minmax_process(store, "p", "dtg")
+        assert int(mm[0]) == int(t.min()) and int(mm[1]) == int(t.max())
+        mm2 = minmax_process(store, "p", "dtg", "bbox(geom, -5, -5, 5, 5)")
+        assert int(mm2[0]) == int(t[want].min())
