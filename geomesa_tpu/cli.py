@@ -221,6 +221,17 @@ def cmd_count(args) -> int:
     return 0
 
 
+def cmd_stats_analyze(args) -> int:
+    """Recompute statistics from the stored data and re-persist them
+    (reference geomesa-tools stats-analyze)."""
+    ds = _load(args)
+    stats = ds.analyze_stats(args.feature_name)
+    n = stats.total_count() if stats is not None else 0
+    print(f"re-analyzed {args.feature_name}: {n} features sketched")
+    persist.save(ds, args.catalog)
+    return 0
+
+
 def cmd_playback(args) -> int:
     """Replay a store's features in time order into a streaming cache at a
     rate multiplier (reference geomesa-tools `playback` command, which
@@ -310,6 +321,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("count", cmd_count, feature=True)
     sp.add_argument("-q", "--cql")
+
+    add("stats-analyze", cmd_stats_analyze, feature=True)
 
     sp = add("playback", cmd_playback, feature=True)
     sp.add_argument("-q", "--cql")

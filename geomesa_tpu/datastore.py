@@ -376,13 +376,27 @@ class DataStore:
         from geomesa_tpu.stats.store import StatsStore
 
         stats = StatsStore.build(self._schemas[type_name], fc)
+        index_names = {i.name for i in self._indexes[type_name]}
+        sketch_index = "z3" if "z3" in index_names else "z2"
         for idx in self._indexes[type_name]:
-            if idx.name == "z3" and len(fc):
+            if idx.name == sketch_index and len(fc):
                 keys = idx.write_keys(fc)
+                dims = 3 if idx.name == "z3" else 2
                 stats.observe_index_keys(
                     idx.name, keys.bins, keys.zs,
-                    3 * getattr(idx.sfc, "precision", 21),
+                    dims * getattr(idx.sfc, "precision", 21),
                 )
+        return stats
+
+    def analyze_stats(self, type_name: str):
+        """Recompute this type's statistics from the stored data
+        (reference geomesa-tools ``stats-analyze``: sketches accumulated
+        across writes drift after deletes/updates; a full re-sketch
+        restores exactness). Returns the fresh StatsStore."""
+        with self._write_lock:
+            fc = self.features(type_name)
+            stats = self._build_stats_fresh(type_name, fc) if len(fc) else None
+            self._stats[type_name] = stats
         return stats
 
     def compact(self, type_name: str) -> None:

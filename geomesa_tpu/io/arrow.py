@@ -153,8 +153,10 @@ class ArrowDeltaWriter:
         self._pa = _pa()
         self._sink = self._pa.BufferOutputStream()
         self._writer = None
-        # per string column: accumulated values list + value -> code
+        # per string column: accumulated values list + value -> code,
+        # plus the cached pyarrow dictionary array (appended, not rebuilt)
         self._dicts: dict[str, tuple[list, dict]] = {}
+        self._dict_arrays: dict = {}
         self._string_cols = [
             a.name for a in sft.attributes
             if a.type in ("String", "UUID") and not a.is_geometry
@@ -174,17 +176,27 @@ class ArrowDeltaWriter:
 
     def _delta_dictionary(self, name: str, fc: FeatureCollection):
         """Encode one string column against the accumulated dictionary.
-        Nulls (None in object arrays) stay null slots, never dictionary
-        values — matching _string_array's null handling."""
+        Nulls (None/NaN in object arrays) stay null slots, never
+        dictionary values — matching _string_array's null handling. The
+        pyarrow dictionary array is cached and only the new tail is
+        appended per batch (rebuilding it from the python list made total
+        work quadratic over a long stream)."""
         pa = self._pa
         values, codes_of = self._dicts.setdefault(name, ([], {}))
         raw = np.asarray(fc.columns[name])
         null = (
-            np.array([v is None for v in raw], dtype=bool)
+            np.array(
+                [
+                    v is None or (isinstance(v, float) and np.isnan(v))
+                    for v in raw
+                ],
+                dtype=bool,
+            )
             if raw.dtype.kind == "O" else np.zeros(len(raw), dtype=bool)
         )
         codes = np.zeros(len(raw), dtype=np.int32)
         present = raw[~null]
+        n_before = len(values)
         if len(present):
             u, inv = np.unique(present.astype(str), return_inverse=True)
             code_of_u = np.empty(len(u), dtype=np.int32)
@@ -195,9 +207,12 @@ class ArrowDeltaWriter:
                     values.append(v)
                 code_of_u[j] = c
             codes[~null] = code_of_u[inv]
-        return pa.DictionaryArray.from_arrays(
-            pa.array(codes, mask=null), pa.array(values, pa.string())
-        )
+        cached = self._dict_arrays.get(name)
+        if cached is None or len(values) != len(cached):
+            tail = pa.array(values[n_before:], pa.string())
+            cached = tail if cached is None else pa.concat_arrays([cached, tail])
+            self._dict_arrays[name] = cached
+        return pa.DictionaryArray.from_arrays(pa.array(codes, mask=null), cached)
 
     def write(self, fc: FeatureCollection) -> None:
         pa = self._pa

@@ -179,18 +179,24 @@ void z2_write_keys(const double* x, const double* y, int64_t n, uint64_t* out_z,
 // small bin counts make most of the 10 nominal passes no-ops).
 
 
-static int radix_pass_u64(const uint64_t* key, const uint32_t* idx, int64_t n,
-                          int shift, uint64_t* key_out, uint32_t* idx_out) {
-  int64_t hist[256] = {0};
-  for (int64_t i = 0; i < n; ++i) hist[(key[i] >> shift) & 0xFF]++;
-  int nonzero = 0;
-  for (int b = 0; b < 256; ++b) nonzero += hist[b] != 0;
-  if (nonzero <= 1) return 0;  // all keys share this byte: skip
-  int64_t offs[256];
+static int radix_pass_u64_w(const uint64_t* key, const uint32_t* idx, int64_t n,
+                            int shift, int bits, uint64_t* key_out,
+                            uint32_t* idx_out, int64_t* hist) {
+  const uint64_t mask = ((uint64_t)1 << bits) - 1;
+  const int64_t buckets = (int64_t)1 << bits;
+  std::fill(hist, hist + buckets, 0);
+  for (int64_t i = 0; i < n; ++i) hist[(key[i] >> shift) & mask]++;
+  int64_t nonzero = 0;
+  for (int64_t b = 0; b < buckets; ++b) nonzero += hist[b] != 0;
+  if (nonzero <= 1) return 0;  // all keys share this digit: skip
   int64_t acc = 0;
-  for (int b = 0; b < 256; ++b) { offs[b] = acc; acc += hist[b]; }
+  for (int64_t b = 0; b < buckets; ++b) {
+    const int64_t c = hist[b];
+    hist[b] = acc;
+    acc += c;
+  }
   for (int64_t i = 0; i < n; ++i) {
-    int64_t& o = offs[(key[i] >> shift) & 0xFF];
+    int64_t& o = hist[(key[i] >> shift) & mask];
     key_out[o] = key[i];
     idx_out[o] = idx[i];
     ++o;
@@ -199,23 +205,27 @@ static int radix_pass_u64(const uint64_t* key, const uint32_t* idx, int64_t n,
 }
 
 // argsort by (bins asc, zs asc), stable; out_perm must hold n uint32.
+// 16-bit digits (4 z passes + 1 bin pass vs 8+4 at 8 bits) for large n,
+// 8-bit digits below 1M rows where the 512 KB histogram dominates.
 extern "C" void sort_bins_z(const int32_t* bins, const uint64_t* zs, int64_t n,
                  uint32_t* out_perm) {
+  const int bits = n >= (1 << 20) ? 16 : 8;
+  std::vector<int64_t> hist((size_t)1 << bits);
   std::vector<uint64_t> ka(n), kb(n);
   std::vector<uint32_t> ia(n), ib(n);
   for (int64_t i = 0; i < n; ++i) { ka[i] = zs[i]; ia[i] = (uint32_t)i; }
   uint64_t* k0 = ka.data(); uint64_t* k1 = kb.data();
   uint32_t* i0 = ia.data(); uint32_t* i1 = ib.data();
-  for (int shift = 0; shift < 64; shift += 8) {
-    if (radix_pass_u64(k0, i0, n, shift, k1, i1)) {
+  for (int shift = 0; shift < 64; shift += bits) {
+    if (radix_pass_u64_w(k0, i0, n, shift, bits, k1, i1, hist.data())) {
       std::swap(k0, k1);
       std::swap(i0, i1);
     }
   }
   // bin passes: rebuild key as bin (u16 range) of the current order
   for (int64_t i = 0; i < n; ++i) k0[i] = (uint64_t)(uint32_t)bins[i0[i]];
-  for (int shift = 0; shift < 32; shift += 8) {
-    if (radix_pass_u64(k0, i0, n, shift, k1, i1)) {
+  for (int shift = 0; shift < 32; shift += bits) {
+    if (radix_pass_u64_w(k0, i0, n, shift, bits, k1, i1, hist.data())) {
       std::swap(k0, k1);
       std::swap(i0, i1);
     }

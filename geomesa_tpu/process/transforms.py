@@ -218,8 +218,8 @@ def minmax_process(store, type_name: str, attribute: str, cql="INCLUDE"):
             b = stats.attribute_bounds(attribute)
             if b is not None:
                 return b
-    out = store.query(type_name, f)
-    col = np.asarray(out.columns[attribute])
-    if len(col) == 0:
-        return None
-    return col.min(), col.max()
+    # exact path through the Stat DSL (handles geometry/point columns and
+    # null-bearing string columns — a bare np.min would not)
+    results = store.stats_query(type_name, f"MinMax({attribute})", f)
+    mm = results[0]
+    return mm.bounds if mm.bounds is not None else None

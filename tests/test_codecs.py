@@ -168,3 +168,29 @@ class TestPlayback:
         outp = capsys.readouterr().out
         assert f"played {n}/{n} (cache size {n})" in outp
         assert "playback done" in outp
+
+
+class TestStatsAnalyze:
+    def test_reanalyze_after_delete(self, tmp_path, capsys):
+        from geomesa_tpu.cli import main
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.storage import persist
+
+        sft = FeatureType.from_spec("ev", "v:Integer,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(6)
+        n = 2000
+        ds.write("ev", FeatureCollection.from_columns(
+            sft, np.arange(n),
+            {"v": np.arange(n), "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))},
+        ))
+        ds.delete_features("ev", "v < 500")
+        # delete already re-sketches; clobber stats to simulate drift
+        ds._stats["ev"].count.count = 999_999
+        persist.save(ds, tmp_path / "s")
+        rc = main(["stats-analyze", "-c", str(tmp_path / "s"), "-f", "ev"])
+        assert rc == 0
+        assert f"{n - 500} features sketched" in capsys.readouterr().out
+        ds2 = persist.load(tmp_path / "s")
+        assert ds2.stats_for("ev").total_count() == n - 500
