@@ -222,8 +222,11 @@ def cmd_count(args) -> int:
 
 
 def cmd_stats_analyze(args) -> int:
-    """Recompute statistics from the stored data and re-persist them
-    (reference geomesa-tools stats-analyze)."""
+    """Recompute statistics from the stored data (reference geomesa-tools
+    stats-analyze). In a long-lived store, per-batch histograms rebin on
+    merge as bounds widen; a full re-sketch rebuilds them at the final
+    bounds. (A freshly loaded store already has exact stats — load
+    re-ingests through the write path.)"""
     ds = _load(args)
     stats = ds.analyze_stats(args.feature_name)
     n = stats.total_count() if stats is not None else 0

@@ -249,11 +249,7 @@ class DataStore:
 
         batch_stats = StatsStore.build(sft, features)
         new_keys: dict[str, object] = {}
-        index_names = {i.name for i in self._indexes[type_name]}
-        # the selectivity sketch observes ONE z index per store: z3 when
-        # present, else z2 (a z2-only store previously never fed the
-        # sketch, leaving estimate_count and the kNN radius tier blind)
-        sketch_index = "z3" if "z3" in index_names else "z2"
+        sketch_index = _sketch_index(self._indexes[type_name])
         for idx in self._indexes[type_name]:
             keys = idx.write_keys(features)
             new_keys[idx.name] = keys
@@ -261,11 +257,7 @@ class DataStore:
                 # sketch sees only the delta batch (the store-level sketch
                 # accumulates); cell width is codec-defined (dims x per-dim
                 # precision), NOT data-dependent, so cells stay aligned
-                dims = 3 if idx.name == "z3" else 2
-                batch_stats.observe_index_keys(
-                    idx.name, keys.bins, keys.zs,
-                    dims * getattr(idx.sfc, "precision", 21),
-                )
+                _observe_sketch(batch_stats, idx, keys)
 
         # serialized section: id check, stats merge and commit must be
         # atomic — two racing writers would otherwise both pass the id
@@ -376,16 +368,10 @@ class DataStore:
         from geomesa_tpu.stats.store import StatsStore
 
         stats = StatsStore.build(self._schemas[type_name], fc)
-        index_names = {i.name for i in self._indexes[type_name]}
-        sketch_index = "z3" if "z3" in index_names else "z2"
+        sketch_index = _sketch_index(self._indexes[type_name])
         for idx in self._indexes[type_name]:
             if idx.name == sketch_index and len(fc):
-                keys = idx.write_keys(fc)
-                dims = 3 if idx.name == "z3" else 2
-                stats.observe_index_keys(
-                    idx.name, keys.bins, keys.zs,
-                    dims * getattr(idx.sfc, "precision", 21),
-                )
+                _observe_sketch(stats, idx, idx.write_keys(fc))
         return stats
 
     def analyze_stats(self, type_name: str):
@@ -843,6 +829,25 @@ class DataStore:
         if plan.config is not None and not plan.config.disjoint:
             exp(f"Ranges: {plan.config.n_ranges}")
         return exp.render()
+
+
+def _sketch_index(indexes) -> str:
+    """Which index's keys feed the selectivity sketch: z3 when present,
+    else z2 (ONE sketch per store; its key space must match the ranges
+    estimated against it — StatsStore.z_index)."""
+    names = {i.name for i in indexes}
+    return "z3" if "z3" in names else "z2"
+
+
+def _observe_sketch(stats, idx, keys) -> None:
+    """Feed one index's write keys into the z sketch; cell width is
+    codec-defined (dims x per-dim precision) so cells stay aligned across
+    batches. Shared by the write path and the full re-sketch."""
+    dims = 3 if idx.name == "z3" else 2
+    stats.observe_index_keys(
+        idx.name, keys.bins, keys.zs,
+        dims * getattr(idx.sfc, "precision", 21),
+    )
 
 
 def _exact_bounds(fc: FeatureCollection) -> Optional[tuple]:

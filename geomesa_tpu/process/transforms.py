@@ -218,8 +218,7 @@ def minmax_process(store, type_name: str, attribute: str, cql="INCLUDE"):
             b = stats.attribute_bounds(attribute)
             if b is not None:
                 return b
-    # exact path through the Stat DSL (handles geometry/point columns and
-    # null-bearing string columns — a bare np.min would not)
+    # exact path through the Stat DSL (handles geometry/point columns —
+    # a bare np.min over a PointColumn would raise)
     results = store.stats_query(type_name, f"MinMax({attribute})", f)
-    mm = results[0]
-    return mm.bounds if mm.bounds is not None else None
+    return results[0].bounds
