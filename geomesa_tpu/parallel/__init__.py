@@ -6,6 +6,6 @@ under ``shard_map``.
 """
 
 from geomesa_tpu.parallel.dtable import DistributedIndexTable
-from geomesa_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from geomesa_tpu.parallel.mesh import SHARD_AXIS, make_mesh, make_multihost_mesh
 
-__all__ = ["DistributedIndexTable", "make_mesh", "SHARD_AXIS"]
+__all__ = ["DistributedIndexTable", "make_mesh", "make_multihost_mesh", "SHARD_AXIS"]

@@ -29,6 +29,68 @@ def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def make_multihost_mesh(
+    hosts: int | None = None,
+    devices_per_host: int | None = None,
+    axis: str = SHARD_AXIS,
+) -> Mesh:
+    """A 1-D scan mesh over a multi-host slice, devices ordered HOST-MAJOR.
+
+    Multi-host layout guidance (SURVEY §2.6 distributed comm backend):
+    the scan path needs only a 1-D axis — each device scans its own
+    HBM-resident blocks, and the only cross-device traffic is the
+    collective merge (psum for aggregations) plus the host pull of each
+    device's packed planes. Host-major ordering keeps every contiguous
+    ``devices_per_host`` run of the axis inside one host, so the XLA
+    collective schedule does its ring/tree phase over ICI within hosts
+    and crosses DCN once per host group — the same hierarchy the
+    reference gets from per-regionserver aggregation + client-side merge
+    (GeoMesaCoprocessor), with DCN in place of the client RPC fan-in.
+
+    Under ``jax.distributed`` each process contributes its local devices
+    (jax.devices() is already globally host-major); single-process runs
+    (tests, the virtual CPU mesh) reshape the local devices the same way
+    so the layout is testable without a pod.
+    """
+    devs = jax.devices()
+    if hosts is None:
+        hosts = max(getattr(jax, "process_count", lambda: 1)(), 1)
+    if devices_per_host is None:
+        if len(devs) % hosts:
+            raise ValueError(
+                f"{len(devs)} devices do not divide over {hosts} hosts"
+            )
+        devices_per_host = len(devs) // hosts
+    return Mesh(
+        np.array(_host_major(devs, hosts, devices_per_host)), (axis,)
+    )
+
+
+def _host_major(devs, hosts: int, devices_per_host: int) -> list:
+    """Order devices host-major by ``process_index``: the first
+    ``devices_per_host`` devices of each of the first ``hosts`` processes,
+    concatenated. Single-process runs (tests, the virtual CPU mesh) have
+    one process_index — they slice its devices into synthetic host
+    groups, which preserves the layout semantics without a pod."""
+    by_host: dict = {}
+    for d in devs:
+        by_host.setdefault(getattr(d, "process_index", 0), []).append(d)
+    if len(by_host) >= hosts > 1:
+        out = []
+        for h in sorted(by_host)[:hosts]:
+            hd = by_host[h]
+            if len(hd) < devices_per_host:
+                raise ValueError(
+                    f"host {h} has {len(hd)} devices, need {devices_per_host}"
+                )
+            out.extend(hd[:devices_per_host])
+        return out
+    n = hosts * devices_per_host
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    return list(devs[:n])
+
+
 def shard_spec(mesh: Mesh) -> NamedSharding:
     """Sharding for [D, ...] arrays split along the mesh axis."""
     return NamedSharding(mesh, P(mesh.axis_names[0]))

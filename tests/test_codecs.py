@@ -216,3 +216,6 @@ class TestStatsAnalyze:
         rc = main(["stats-analyze", "-c", str(tmp_path / "s"), "-f", "ev"])
         assert rc == 0
         assert f"{n} features sketched" in capsys.readouterr().out
+        # the command re-persists the store (reload still sees exact stats)
+        ds2 = persist.load(tmp_path / "s")
+        assert ds2.stats_for("ev").total_count() == n

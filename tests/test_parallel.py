@@ -283,3 +283,50 @@ def test_mesh_store_persist_roundtrip(tmp_path):
         assert sorted(back.query("pts", q).ids.tolist()) == sorted(
             ds.query("pts", q).ids.tolist()
         )
+
+
+def test_multihost_mesh_layout_and_equality():
+    """make_multihost_mesh: host-major 1-D ordering; a store sharded over
+    the 2x4 'multi-host' mesh answers identically to single-device."""
+    from geomesa_tpu.parallel import make_multihost_mesh
+
+    mesh = make_multihost_mesh(hosts=2, devices_per_host=4)
+    assert mesh.devices.shape == (8,)
+    import jax
+    assert list(mesh.devices) == jax.devices()[:8]  # one process: sliced
+
+    # the grouping logic itself, against stub multi-process devices
+    from collections import namedtuple
+
+    from geomesa_tpu.parallel.mesh import _host_major
+
+    D = namedtuple("D", "name process_index")
+    stub = [D(f"d{h}_{i}", h) for i in (0, 1, 2, 3) for h in (1, 0)]
+    got = _host_major(stub, hosts=2, devices_per_host=3)
+    assert [d.name for d in got] == [
+        "d0_0", "d0_1", "d0_2", "d1_0", "d1_1", "d1_2"
+    ]
+    with pytest.raises(ValueError, match="has 4 devices, need 5"):
+        _host_major(stub, hosts=2, devices_per_host=5)
+
+    sft = FeatureType.from_spec("mh", "dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(8)
+    n = 4000
+    t0 = int(np.datetime64("2024-02-01", "ms").astype(np.int64))
+    fc_cols = {
+        "dtg": t0 + rng.integers(0, 86400_000 * 10, n),
+        "geom": (rng.uniform(-90, 90, n), rng.uniform(-45, 45, n)),
+    }
+    q = ("bbox(geom, -20, -20, 20, 20) AND dtg DURING "
+         "2024-02-02T00:00:00Z/2024-02-06T00:00:00Z")
+    out = {}
+    for mesh_ in (None, mesh):
+        ds = DataStore(tile=32, mesh=mesh_)
+        ds.create_schema(sft)
+        ds.write("mh", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)], dict(fc_cols)))
+        out[mesh_ is None] = sorted(ds.query("mh", q).ids.tolist())
+    assert out[True] == out[False] and len(out[True]) > 0
+
+    with pytest.raises(ValueError):
+        make_multihost_mesh(hosts=3)  # 8 devices don't divide over 3
