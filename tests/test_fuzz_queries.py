@@ -101,3 +101,62 @@ def test_random_filter_matches_brute_force(world, seed):
         expr, len(got), len(want),
         np.setdiff1d(got, want)[:5], np.setdiff1d(want, got)[:5],
     )
+
+
+class TestExtentFuzz:
+    """Same differential sweep over an XZ2 extent store: random rectangle
+    footprints, random INTERSECTS/bbox/NOT combinations vs brute-force
+    bbox-overlap truth (rect geometries' intersects IS bbox overlap)."""
+
+    N = 3000
+
+    @pytest.fixture(scope="class")
+    def bld(self):
+        from geomesa_tpu import geometry as geo
+
+        rng = np.random.default_rng(7)
+        sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "xz2"
+        ds = DataStore(tile=64)
+        ds.create_schema(sft)
+        x0 = rng.uniform(-170, 168, self.N)
+        y0 = rng.uniform(-80, 78, self.N)
+        w = rng.uniform(0.001, 1.5, self.N)
+        h = rng.uniform(0.001, 1.2, self.N)
+        col = geo.PackedGeometryColumn.from_boxes(x0, y0, x0 + w, y0 + h)
+        ds.write("bld", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(self.N)], {"geom": col}
+        ))
+        return ds, (x0, y0, x0 + w, y0 + h)
+
+    @pytest.mark.parametrize("seed", range(30))
+    def test_random_extent_filters(self, bld, seed):
+        ds, (bx0, by0, bx1, by1) = bld
+        rng = np.random.default_rng(400 + seed)
+
+        def leaf():
+            qw = float(rng.choice([0.05, 1.0, 15.0]))
+            qx = float(f"{rng.uniform(-175, 175 - qw):.3f}")
+            qy = float(f"{rng.uniform(-85, 85 - qw):.3f}")
+            x1 = float(f"{qx + qw:.3f}")
+            y1 = float(f"{qy + qw:.3f}")
+            if rng.uniform() < 0.5:
+                expr = f"bbox(geom, {qx}, {qy}, {x1}, {y1})"
+            else:
+                expr = (
+                    f"INTERSECTS(geom, POLYGON(({qx} {qy}, {x1} {qy}, "
+                    f"{x1} {y1}, {qx} {y1}, {qx} {qy})))"
+                )
+            m = (bx0 <= x1) & (bx1 >= qx) & (by0 <= y1) & (by1 >= qy)
+            return expr, m
+
+        (e1, m1), (e2, m2) = leaf(), leaf()
+        op = str(rng.choice(["AND", "OR"]))
+        expr = f"({e1}) {op} ({e2})"
+        mask = (m1 & m2) if op == "AND" else (m1 | m2)
+        if rng.uniform() < 0.3:
+            expr = f"NOT ({expr})"
+            mask = ~mask
+        out = ds.query("bld", expr)
+        got = np.sort(np.asarray(out.ids, dtype=np.int64))
+        np.testing.assert_array_equal(got, np.flatnonzero(mask))
