@@ -1,0 +1,50 @@
+"""Streaming hot tier + hot/cold lambda store (Kafka/Lambda analogue).
+
+Run: JAX_PLATFORMS=cpu python examples/streaming_hot_tier.py
+"""
+
+import numpy as np
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.streaming import LambdaStore, StreamingFeatureCache
+
+
+def main():
+    sft = FeatureType.from_spec("ships", "mmsi:String,*geom:Point:srid=4326")
+
+    # live keyed state: latest message per id wins, spatial queries served
+    # from a bucket grid index
+    cache = StreamingFeatureCache(sft)
+    events = []
+    cache.listeners.append(lambda ev, fid, row: events.append((ev, fid)))
+    cache.upsert(
+        [{"mmsi": "a", "geom": geo.Point(1.0, 1.0)},
+         {"mmsi": "b", "geom": geo.Point(50.0, 10.0)}],
+        ids=["a", "b"],
+    )
+    cache.upsert([{"mmsi": "a", "geom": geo.Point(2.0, 1.5)}], ids=["a"])
+    live = cache.query("bbox(geom, 0, 0, 10, 10)")
+    print(f"live hits: {len(live)}; events: {events}")
+
+    # hot/cold: recent rows in the cache, history in the columnar store
+    cold = DataStore()
+    cold.create_schema(sft)
+    store = LambdaStore(cold, "ships")
+    rng = np.random.default_rng(2)
+    n = 50_000
+    store.write(FeatureCollection.from_columns(
+        sft, np.arange(n),
+        {
+            "mmsi": np.array([f"m{i % 500}" for i in range(n)]),
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        },
+    ).to_rows())
+    store.persist_hot()  # flush hot -> cold
+    out = store.query("bbox(geom, -10, -10, 10, 10)")
+    print(f"lambda-store hits: {len(out)}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""The examples/ scripts must run end-to-end (CPU) and return results."""
+
+import runpy
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.parametrize(
+    "script", ["quickstart", "distributed_mesh", "streaming_hot_tier"]
+)
+def test_example_runs(script, monkeypatch):
+    monkeypatch.syspath_prepend(str(ROOT))  # import geomesa_tpu from any cwd
+    mod = runpy.run_path(str(ROOT / "examples" / f"{script}.py"))
+    out = mod["main"]()
+    assert out is not None and len(out) > 0

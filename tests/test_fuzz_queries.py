@@ -160,3 +160,56 @@ class TestExtentFuzz:
         out = ds.query("bld", expr)
         got = np.sort(np.asarray(out.ids, dtype=np.int64))
         np.testing.assert_array_equal(got, np.flatnonzero(mask))
+
+
+class TestAggregationFuzz:
+    """Random density/count/bounds configs: mesh == single-device == numpy
+    truth (loose f32 tolerance where the device path is widened)."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        from geomesa_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(17)
+        sft = FeatureType.from_spec("ev", "dtg:Date,*geom:Point:srid=4326")
+        n = 5000
+        t0 = int(np.datetime64("2024-05-01", "ms").astype(np.int64))
+        cols = {
+            "dtg": t0 + rng.integers(0, 86400_000 * 15, n),
+            "geom": (rng.uniform(-90, 90, n), rng.uniform(-45, 45, n)),
+        }
+        stores = []
+        for mesh in (None, make_mesh(4)):
+            ds = DataStore(tile=32, mesh=mesh)
+            ds.create_schema(sft)
+            ds.write("ev", FeatureCollection.from_columns(
+                sft, [str(i) for i in range(n)], dict(cols)))
+            stores.append(ds)
+        return stores, cols
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_aggregations(self, pair, seed):
+        (single, mesh), cols = pair
+        rng = np.random.default_rng(800 + seed)
+        w = float(rng.choice([5.0, 30.0, 100.0]))
+        qx = float(f"{rng.uniform(-90, 90 - w):.2f}")
+        qy = float(f"{rng.uniform(-45, 45 - min(w, 40)):.2f}")
+        x1, y1 = qx + w, min(qy + w, 45.0)
+        q = f"bbox(geom, {qx}, {qy}, {x1}, {y1})"
+        x, y = cols["geom"]
+        m = (x >= qx) & (x <= x1) & (y >= qy) & (y <= y1)
+
+        assert single.count("ev", q) == mesh.count("ev", q) == int(m.sum())
+        gw, gh = int(rng.choice([32, 64])), int(rng.choice([32, 64]))
+        d1 = single.density("ev", q, width=gw, height=gh)
+        d2 = mesh.density("ev", q, width=gw, height=gh)
+        np.testing.assert_allclose(d1, d2, atol=1e-4)
+        assert abs(float(d1.sum()) - int(m.sum())) <= max(2, 0.02 * m.sum())
+        b1 = single.bounds("ev", q, estimate=True)
+        b2 = mesh.bounds("ev", q, estimate=True)
+        if b1 is None or b2 is None:
+            assert b1 == b2
+        else:
+            np.testing.assert_allclose(
+                np.array(b1, float), np.array(b2, float), atol=1e-3
+            )
