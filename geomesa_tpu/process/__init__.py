@@ -7,7 +7,7 @@ corridor) select, unique-value enumeration, attribute joins, sampling,
 and density (the heatmap process wraps DataStore.density directly)."""
 
 from geomesa_tpu.process.join import join_search
-from geomesa_tpu.process.knn import knn_search
+from geomesa_tpu.process.knn import knn_many, knn_search
 from geomesa_tpu.process.proximity import proximity_search
 from geomesa_tpu.process.route import heading_diff, route_search
 from geomesa_tpu.process.transforms import (
@@ -29,6 +29,7 @@ __all__ = [
     "date_offset",
     "heading_diff",
     "join_search",
+    "knn_many",
     "knn_search",
     "minmax_process",
     "point2point",

@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from geomesa_tpu.features import FeatureCollection
-from geomesa_tpu.filter.predicates import And, BBox, Filter, Include
+from geomesa_tpu.filter.predicates import And, BBox, Filter, Include, Or
 
 EARTH_RADIUS_M = 6_371_000.0
 
@@ -46,6 +46,33 @@ def _degrees_to_meters(deg: float, lat: float) -> float:
     )
 
 
+def wrap_box_filter(
+    geom: str, x0: float, y0: float, x1: float, y1: float
+) -> Filter:
+    """A lon/lat box as a filter, WRAPPING across the antimeridian: a box
+    past +/-180 becomes two boxes, so near-seam windows see features on
+    the other side (a single clamped box would miss them). Shared by the
+    kNN/proximity/route window builders."""
+    y0, y1 = max(y0, -90.0), min(y1, 90.0)
+    if x1 - x0 >= 360.0:
+        return BBox(geom, -180.0, y0, 180.0, y1)
+    if x0 < -180.0:
+        return Or((
+            BBox(geom, -180.0, y0, x1, y1),
+            BBox(geom, x0 + 360.0, y0, 180.0, y1),
+        ))
+    if x1 > 180.0:
+        return Or((
+            BBox(geom, x0, y0, 180.0, y1),
+            BBox(geom, -180.0, y0, x1 - 360.0, y1),
+        ))
+    return BBox(geom, x0, y0, x1, y1)
+
+
+def _window_filter(geom: str, x: float, y: float, deg: float) -> Filter:
+    return wrap_box_filter(geom, x - deg, y - deg, x + deg, y + deg)
+
+
 def knn_search(
     store,
     type_name: str,
@@ -62,33 +89,75 @@ def knn_search(
     until k in-radius hits exist or ``max_distance_m`` is reached
     (reference's KNNQuery window protocol). With ``estimated_distance_m``
     None, the start radius comes from the store's statistics — mean point
-    density over the data envelope sized so the first window expects ~4k
-    points (the reference process likewise estimates its initial window;
-    every extra expansion round costs a full store query)."""
+    density refined by the local histogram probe (every extra expansion
+    round costs a full store query). One implementation serves the
+    single-point and batched forms: this is ``knn_many`` with one point."""
+    return knn_many(
+        store, type_name, [(x, y)], k,
+        estimated_distance_m=estimated_distance_m,
+        max_distance_m=max_distance_m, filter=filter,
+    )[0]
+
+
+def knn_many(
+    store,
+    type_name: str,
+    points,
+    k: int,
+    estimated_distance_m: "float | None" = None,
+    max_distance_m: float = 1_000_000.0,
+    filter: Filter = Include(),
+) -> list[FeatureCollection]:
+    """k nearest neighbours for MANY query points with pipelined rounds.
+
+    Each round plans every still-unsatisfied query's window, submits all
+    device scans before pulling any result (planner.submit), then doubles
+    the radius only for queries short of k — so a batch of Q queries pays
+    ~max_rounds pipelined sweeps instead of Q x rounds sequential device
+    round-trips. Results are identical to per-point :func:`knn_search`."""
+    pts = np.asarray(points, dtype=np.float64).reshape(-1, 2)
     sft = store.get_schema(type_name)
     geom = sft.geom_field
-    if estimated_distance_m is None:
-        estimated_distance_m = _estimate_radius_m(
-            store, type_name, k, x, y, max_distance_m
+    out: list = [None] * len(pts)
+    radii = np.empty(len(pts))
+    for i, (x, y) in enumerate(pts):
+        r = (
+            _estimate_radius_m(store, type_name, k, float(x), float(y), max_distance_m)
+            if estimated_distance_m is None
+            else float(estimated_distance_m)
         )
-    # clamp to a positive start: radius 0 would never grow (min(0*2, max))
-    radius = min(max(float(estimated_distance_m), 1.0), float(max_distance_m))
-    while True:
-        deg = _meters_to_degrees(radius, y)
-        box = BBox(geom, x - deg, max(y - deg, -90.0), x + deg, min(y + deg, 90.0))
-        f = box if isinstance(filter, Include) else And((box, filter))
-        out = store.query(type_name, f)
-        if len(out):
-            cx, cy = out.representative_xy()
-            d = haversine_m(x, y, cx, cy)
-            in_radius = d <= radius
-            if in_radius.sum() >= k or radius >= max_distance_m:
-                keep = np.nonzero(in_radius)[0]
-                order = keep[np.argsort(d[keep], kind="stable")][:k]
-                return out.take(order)
-        elif radius >= max_distance_m:
-            return out
-        radius = min(radius * 2.0, max_distance_m)
+        radii[i] = min(max(r, 1.0), float(max_distance_m))
+    pending = list(range(len(pts)))
+    while pending:
+        finishes = []
+        for i in pending:
+            x, y = pts[i]
+            deg = _meters_to_degrees(float(radii[i]), float(y))
+            box = _window_filter(geom, float(x), float(y), deg)
+            f = box if isinstance(filter, Include) else And((box, filter))
+            plan = store.planner.plan(type_name, f)
+            finishes.append((i, store.planner.submit(plan)))
+        nxt = []
+        for i, finish in finishes:
+            res = finish()
+            x, y = pts[i]
+            r = float(radii[i])
+            if len(res):
+                cx, cy = res.representative_xy()
+                d = haversine_m(x, y, cx, cy)
+                in_radius = d <= r
+                if in_radius.sum() >= k or r >= max_distance_m:
+                    keep = np.nonzero(in_radius)[0]
+                    order = keep[np.argsort(d[keep], kind="stable")][:k]
+                    out[i] = res.take(order)
+                    continue
+            elif r >= max_distance_m:
+                out[i] = res
+                continue
+            radii[i] = min(r * 2.0, max_distance_m)
+            nxt.append(i)
+        pending = nxt
+    return out
 
 
 def _estimate_radius_m(

@@ -12,8 +12,8 @@ from __future__ import annotations
 import numpy as np
 
 from geomesa_tpu.features import FeatureCollection
-from geomesa_tpu.filter.predicates import And, BBox, Filter, Include, Or
-from geomesa_tpu.process.knn import _meters_to_degrees, haversine_m
+from geomesa_tpu.filter.predicates import And, Filter, Include, Or
+from geomesa_tpu.process.knn import _meters_to_degrees, haversine_m, wrap_box_filter
 
 
 def proximity_search(
@@ -29,12 +29,14 @@ def proximity_search(
         return _empty(store, type_name)
     sft = store.get_schema(type_name)
     geom = sft.geom_field
-    boxes = []
-    for x, y in pts:
-        deg = _meters_to_degrees(distance_m, y)
-        boxes.append(
-            BBox(geom, x - deg, max(y - deg, -90.0), x + deg, min(y + deg, 90.0))
+    boxes = [
+        wrap_box_filter(
+            geom,
+            x - (deg := _meters_to_degrees(distance_m, y)), y - deg,
+            x + deg, y + deg,
         )
+        for x, y in pts
+    ]
     spatial: Filter = boxes[0] if len(boxes) == 1 else Or(tuple(boxes))
     f = spatial if isinstance(filter, Include) else And((spatial, filter))
     out = store.query(type_name, f)

@@ -21,8 +21,10 @@ import numpy as np
 
 from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
-from geomesa_tpu.filter.predicates import And, BBox, Filter, Include, Or
-from geomesa_tpu.process.knn import METERS_PER_DEGREE, _meters_to_degrees
+from geomesa_tpu.filter.predicates import And, Filter, Include, Or
+from geomesa_tpu.process.knn import (
+    METERS_PER_DEGREE, _meters_to_degrees, wrap_box_filter,
+)
 
 _CHUNK = 4_000_000  # max candidate x segment pairs per vectorized block
 _MAX_ENVELOPES = 128  # cap on buffered query boxes (segments chunk up)
@@ -107,9 +109,9 @@ def route_search(
         for a, b in zip(clo[:, 1], chi[:, 1])
     ])
     boxes = [
-        BBox(
-            geom, clo[i, 0] - degs[i], max(clo[i, 1] - degs[i], -90.0),
-            chi[i, 0] + degs[i], min(chi[i, 1] + degs[i], 90.0),
+        wrap_box_filter(
+            geom, clo[i, 0] - degs[i], clo[i, 1] - degs[i],
+            chi[i, 0] + degs[i], chi[i, 1] + degs[i],
         )
         for i in range(len(clo))
     ]

@@ -519,3 +519,54 @@ class TestThinProcesses:
         assert int(mm[0]) == int(t.min()) and int(mm[1]) == int(t.max())
         mm2 = minmax_process(store, "p", "dtg", "bbox(geom, -5, -5, 5, 5)")
         assert int(mm2[0]) == int(t[want].min())
+
+
+class TestKnnMany:
+    def test_matches_per_point_search(self, ds):
+        from geomesa_tpu.process import knn_many, knn_search
+
+        store, fc, (x, y, t, t0) = ds
+        rng = np.random.default_rng(33)
+        pts = [(float(rng.uniform(-9, 9)), float(rng.uniform(-9, 9)))
+               for _ in range(8)]
+        # one far-away point forces the expansion rounds
+        pts.append((60.0, 60.0))
+        batched = knn_many(store, "p", pts, k=6, max_distance_m=2e7)
+        for (qx, qy), got in zip(pts, batched):
+            want = knn_search(store, "p", qx, qy, k=6, max_distance_m=2e7)
+            assert got.ids.tolist() == want.ids.tolist(), (qx, qy)
+        assert all(len(b) == 6 for b in batched)
+
+    def test_with_filter(self, ds):
+        from geomesa_tpu.filter import ecql
+        from geomesa_tpu.process import knn_many, knn_search
+
+        store, fc, _ = ds
+        f = ecql.parse("kind = 'b'")
+        got = knn_many(store, "p", [(0.0, 0.0)], k=5, filter=f)[0]
+        want = knn_search(store, "p", 0.0, 0.0, k=5, filter=f)
+        assert got.ids.tolist() == want.ids.tolist()
+        assert set(got.columns["kind"]) == {"b"}
+
+
+class TestKnnAntimeridian:
+    def test_wraps_across_seam(self):
+        """Neighbours across +/-180 must win over farther same-side points
+        (the window becomes two boxes at the seam)."""
+        from geomesa_tpu.process import knn_many, knn_search
+        from geomesa_tpu.process.knn import haversine_m
+
+        sft = FeatureType.from_spec("s", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        x = np.array([-179.9, -179.5, 178.0, 170.0, 0.0])
+        y = np.zeros(5)
+        ds.write("s", FeatureCollection.from_columns(
+            sft, np.arange(5), {"geom": (x, y)}
+        ))
+        got = knn_search(ds, "s", 179.8, 0.0, k=2, estimated_distance_m=30_000)
+        d = haversine_m(x, y, 179.8, 0.0)
+        want = np.argsort(d)[:2]
+        assert set(np.asarray(got.ids, np.int64).tolist()) == set(want.tolist())
+        many = knn_many(ds, "s", [(179.8, 0.0)], k=2, estimated_distance_m=30_000)
+        assert many[0].ids.tolist() == got.ids.tolist()
