@@ -463,3 +463,50 @@ class Not(Filter):
 
     def evaluate(self, batch):
         return ~self.filter.evaluate(batch)
+
+
+def wrap_box(prop: str, x0: float, y0: float, x1: float, y1: float) -> Filter:
+    """A lon/lat box as a filter, WRAPPING across the antimeridian
+    (GeoTools BBOX semantics: a box past +/-180 crosses the seam and
+    becomes two boxes). Latitude clamps to [-90, 90]."""
+    y0, y1 = max(y0, -90.0), min(y1, 90.0)
+    if x1 - x0 >= 360.0:
+        return BBox(prop, -180.0, y0, 180.0, y1)
+    # a box lying ENTIRELY beyond the seam shifts into range first — the
+    # splits below would otherwise emit an inverted (xmin > xmax) arm
+    while x0 > 180.0:
+        x0 -= 360.0
+        x1 -= 360.0
+    while x1 < -180.0:
+        x0 += 360.0
+        x1 += 360.0
+    if x0 < -180.0:
+        return Or((
+            BBox(prop, -180.0, y0, x1, y1),
+            BBox(prop, x0 + 360.0, y0, 180.0, y1),
+        ))
+    if x1 > 180.0:
+        return Or((
+            BBox(prop, x0, y0, 180.0, y1),
+            BBox(prop, -180.0, y0, x1 - 360.0, y1),
+        ))
+    return BBox(prop, x0, y0, x1, y1)
+
+
+def normalize_antimeridian(f: Filter) -> Filter:
+    """Rewrite out-of-range BBOXes anywhere in a filter tree into their
+    wrapped two-box form (reference FilterHelper splits seam-crossing
+    boxes the same way; without this the planner's world-clamping would
+    silently drop the wrapped part). Returns ``f`` itself when nothing
+    in the tree needed rewriting (the common case on every plan())."""
+    if isinstance(f, BBox) and (f.xmin < -180.0 or f.xmax > 180.0):
+        return wrap_box(f.prop, f.xmin, f.ymin, f.xmax, f.ymax)
+    if isinstance(f, (And, Or)):
+        kids = tuple(normalize_antimeridian(c) for c in f.filters)
+        if all(k is c for k, c in zip(kids, f.filters)):
+            return f
+        return And(kids) if isinstance(f, And) else Or(kids)
+    if isinstance(f, Not):
+        inner = normalize_antimeridian(f.filter)
+        return f if inner is f.filter else Not(inner)
+    return f

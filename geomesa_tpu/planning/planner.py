@@ -163,6 +163,9 @@ class QueryPlanner:
         exp = explain or ExplainNull()
         if isinstance(f, str):
             f = ecql.parse(f)
+        from geomesa_tpu.filter.predicates import normalize_antimeridian
+
+        f = normalize_antimeridian(f)
         if intercept:
             f = self.store.apply_interceptors(type_name, f)
         exp(f"Planning query on '{type_name}': {type(f).__name__}")

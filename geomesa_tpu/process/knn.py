@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from geomesa_tpu.features import FeatureCollection
-from geomesa_tpu.filter.predicates import And, BBox, Filter, Include, Or
+from geomesa_tpu.filter.predicates import And, Filter, Include
 
 EARTH_RADIUS_M = 6_371_000.0
 
@@ -46,27 +46,10 @@ def _degrees_to_meters(deg: float, lat: float) -> float:
     )
 
 
-def wrap_box_filter(
-    geom: str, x0: float, y0: float, x1: float, y1: float
-) -> Filter:
-    """A lon/lat box as a filter, WRAPPING across the antimeridian: a box
-    past +/-180 becomes two boxes, so near-seam windows see features on
-    the other side (a single clamped box would miss them). Shared by the
-    kNN/proximity/route window builders."""
-    y0, y1 = max(y0, -90.0), min(y1, 90.0)
-    if x1 - x0 >= 360.0:
-        return BBox(geom, -180.0, y0, 180.0, y1)
-    if x0 < -180.0:
-        return Or((
-            BBox(geom, -180.0, y0, x1, y1),
-            BBox(geom, x0 + 360.0, y0, 180.0, y1),
-        ))
-    if x1 > 180.0:
-        return Or((
-            BBox(geom, x0, y0, 180.0, y1),
-            BBox(geom, -180.0, y0, x1 - 360.0, y1),
-        ))
-    return BBox(geom, x0, y0, x1, y1)
+from geomesa_tpu.filter.predicates import wrap_box as wrap_box_filter  # noqa: E402
+# (one wrapping implementation — filter.predicates.wrap_box — shared by
+# the kNN/proximity/route window builders and the planner's
+# normalize_antimeridian rewrite)
 
 
 def _window_filter(geom: str, x: float, y: float, deg: float) -> Filter:

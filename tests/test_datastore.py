@@ -80,7 +80,12 @@ class TestZ3QueryPath:
             exp = Explainer()
             got = ids(ds.query("gdelt", f, explain=exp))
             assert "z3" in exp.render()
-            assert got == brute(fc, f)
+            # boxes generated past +/-180 wrap across the antimeridian
+            # (GeoTools BBOX semantics) — apply the same normalization
+            # to the brute-force truth
+            from geomesa_tpu.filter.predicates import normalize_antimeridian
+
+            assert got == brute(fc, normalize_antimeridian(f))
 
     def test_tiny_and_empty_boxes(self, point_store):
         ds, fc = point_store
@@ -121,7 +126,11 @@ class TestZ2QueryPath:
             cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
             w, h = rng.uniform(0.1, 40, 2)
             f = BBox("geom", cx - w, cy - h, cx + w, cy + h)
-            assert ids(ds.query("gdelt", f)) == brute(fc, f)
+            from geomesa_tpu.filter.predicates import normalize_antimeridian
+
+            assert ids(ds.query("gdelt", f)) == brute(
+                fc, normalize_antimeridian(f)
+            )
 
     def test_polygon_intersects(self, point_store):
         ds, fc = point_store
@@ -234,3 +243,42 @@ class TestSchemaLifecycle:
         rows = [{"dtg": T0, "geom": "POINT (0 0)", "__id__": "x"}] * 2
         with pytest.raises(ValueError):
             ds.write("t", rows)
+
+
+class TestAntimeridianBBox:
+    def test_seam_crossing_bbox_wraps(self):
+        from geomesa_tpu.filter.predicates import Not, BBox
+
+        sft = FeatureType.from_spec("s", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        x = np.array([-179.0, 175.0, 0.0, 179.5])
+        y = np.array([0.0, 5.0, 0.0, -5.0])
+        ds.write("s", FeatureCollection.from_columns(
+            sft, np.arange(4), {"geom": (x, y)}
+        ))
+        out = ds.query("s", "bbox(geom, 170, -10, 190, 10)")
+        assert set(np.asarray(out.ids, np.int64).tolist()) == {0, 1, 3}
+        out2 = ds.query("s", "NOT (bbox(geom, 170, -10, 190, 10))")
+        assert set(np.asarray(out2.ids, np.int64).tolist()) == {2}
+        assert ds.count("s", "bbox(geom, 170, -10, 190, 10)") == 3
+        # western crossing: wraps to [-180, -170] + [170, 180]
+        out3 = ds.query("s", "bbox(geom, -190, -10, -170, 10)")
+        assert set(np.asarray(out3.ids, np.int64).tolist()) == {0, 1, 3}
+
+    def test_fully_out_of_range_boxes_shift(self):
+        """Boxes lying ENTIRELY beyond +/-180 shift into range (an
+        inverted two-box split returned wrong rows before)."""
+        sft = FeatureType.from_spec("s2", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        x = np.array([-179.0, 175.0, 0.0, 179.5])
+        y = np.array([0.0, 5.0, 0.0, -5.0])
+        ds.write("s2", FeatureCollection.from_columns(
+            sft, np.arange(4), {"geom": (x, y)}
+        ))
+        assert len(ds.query("s2", "bbox(geom, 185, -10, 190, 10)")) == 0
+        out = ds.query("s2", "bbox(geom, -190, -10, -185, 10)")
+        assert set(np.asarray(out.ids, np.int64).tolist()) == {1}
+        out = ds.query("s2", "bbox(geom, 181, -10, 182, 10)")
+        assert set(np.asarray(out.ids, np.int64).tolist()) == {0}

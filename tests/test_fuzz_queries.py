@@ -51,10 +51,15 @@ def _random_leaf(rng, cols):
         x1 = float(f"{qx + w:.3f}")
         y1 = float(f"{qy + w / 2:.3f}")
         expr = f"bbox(geom, {qx}, {qy}, {x1}, {y1})"
-        mask = (
-            (cols["x"] >= qx) & (cols["x"] <= x1)
-            & (cols["y"] >= qy) & (cols["y"] <= y1)
-        )
+        # wrap-aware truth (GeoTools BBOX semantics, matching the
+        # planner's normalize_antimeridian rewrite)
+        if x1 - qx >= 360.0:
+            lon_m = np.ones(len(cols["x"]), dtype=bool)
+        elif x1 > 180.0:
+            lon_m = (cols["x"] >= qx) | (cols["x"] <= x1 - 360.0)
+        else:
+            lon_m = (cols["x"] >= qx) & (cols["x"] <= x1)
+        mask = lon_m & (cols["y"] >= qy) & (cols["y"] <= y1)
         return expr, mask
     if k == 1:  # time window (occasionally empty or outside data range)
         lo = int(t0 + rng.integers(-5, 40) * DAY)
