@@ -469,7 +469,13 @@ def wrap_box(prop: str, x0: float, y0: float, x1: float, y1: float) -> Filter:
     """A lon/lat box as a filter, WRAPPING across the antimeridian
     (GeoTools BBOX semantics: a box past +/-180 crosses the seam and
     becomes two boxes). Latitude clamps to [-90, 90]."""
+    import math
+
     y0, y1 = max(y0, -90.0), min(y1, 90.0)
+    if not (math.isfinite(x0) and math.isfinite(x1)):
+        # non-finite lons (e.g. an overflowed literal): keep the raw box —
+        # the shift loops below would never terminate on inf
+        return BBox(prop, x0, y0, x1, y1)
     if x1 - x0 >= 360.0:
         return BBox(prop, -180.0, y0, 180.0, y1)
     # a box lying ENTIRELY beyond the seam shifts into range first — the

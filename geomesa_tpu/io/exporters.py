@@ -20,7 +20,7 @@ from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
 
-FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "arrow", "avro", "parquet")
+FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "gml", "arrow", "avro", "parquet")
 
 
 def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | bytes":
@@ -35,6 +35,8 @@ def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | byte
         payload = _wkt_lines(fc)
     elif fmt == "json":
         payload = _json_rows(fc)
+    elif fmt == "gml":
+        payload = _gml(fc)
     elif fmt == "arrow":
         payload = _arrow(fc)
     elif fmt == "avro":
@@ -78,6 +80,12 @@ def _date_strings(col) -> np.ndarray:
     return np.datetime_as_string(
         np.asarray(col, dtype=np.int64).astype("datetime64[ms]"), unit="ms"
     )
+
+
+def date_str(v) -> str:
+    """ISO-8601 'Z' rendering of one epoch-millis value — the single
+    definition shared by the GML and DBF writers."""
+    return f"{np.datetime64(int(v), 'ms')}Z"
 
 
 def _delimited(fc: FeatureCollection, sep: str) -> str:
@@ -182,3 +190,76 @@ def _arrow(fc: FeatureCollection) -> bytes:
     from geomesa_tpu.io.arrow import arrow_stream
 
     return arrow_stream(fc)
+
+
+def _gml_coords(coords) -> str:
+    return " ".join(f"{x:.10g} {y:.10g}" for x, y in np.asarray(coords))
+
+
+def _gml_geom(g: "geo.Geometry") -> str:
+    """GML 3.1 geometry element (srsName EPSG:4326, lon/lat order kept)."""
+    if isinstance(g, geo.Point):
+        return (
+            f'<gml:Point srsName="EPSG:4326"><gml:pos>{g.x:.10g} {g.y:.10g}'
+            "</gml:pos></gml:Point>"
+        )
+    if isinstance(g, geo.LineString):
+        return (
+            '<gml:LineString srsName="EPSG:4326"><gml:posList>'
+            f"{_gml_coords(g.coords)}</gml:posList></gml:LineString>"
+        )
+    if isinstance(g, geo.Polygon):
+        rings = [
+            "<gml:exterior><gml:LinearRing><gml:posList>"
+            f"{_gml_coords(g.shell)}</gml:posList></gml:LinearRing></gml:exterior>"
+        ]
+        for h in g.holes:
+            rings.append(
+                "<gml:interior><gml:LinearRing><gml:posList>"
+                f"{_gml_coords(h)}</gml:posList></gml:LinearRing></gml:interior>"
+            )
+        return (
+            f'<gml:Polygon srsName="EPSG:4326">{"".join(rings)}</gml:Polygon>'
+        )
+    if isinstance(g, (geo.MultiPoint, geo.MultiLineString, geo.MultiPolygon)):
+        tag = {
+            geo.MultiPoint: ("gml:MultiPoint", "gml:pointMember"),
+            geo.MultiLineString: ("gml:MultiCurve", "gml:curveMember"),
+            geo.MultiPolygon: ("gml:MultiSurface", "gml:surfaceMember"),
+        }[type(g)]
+        inner = "".join(f"<{tag[1]}>{_gml_geom(p)}</{tag[1]}>" for p in g.parts)
+        return f'<{tag[0]} srsName="EPSG:4326">{inner}</{tag[0]}>'
+    raise ValueError(f"cannot GML-encode {type(g).__name__}")
+
+
+def _gml(fc: FeatureCollection) -> str:
+    """GML 3.1 FeatureCollection (reference GmlExporter,
+    geomesa-feature-exporters)."""
+    from xml.sax.saxutils import escape, quoteattr
+
+    sft = fc.sft
+    name = escape(sft.name or "features")
+    geoms = fc.geometries()
+    parts = [
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<gml:FeatureCollection xmlns:gml="http://www.opengis.net/gml" '
+        'xmlns:geomesa="http://geomesa.org">\n'
+    ]
+    for i in range(len(fc)):
+        parts.append(
+            f"<gml:featureMember><geomesa:{name} "
+            f"gml:id={quoteattr(str(fc.ids[i]))}>"
+        )
+        for a in sft.attributes:
+            if a.is_geometry:
+                parts.append(
+                    f"<geomesa:{a.name}>{_gml_geom(geoms[i])}</geomesa:{a.name}>"
+                )
+                continue
+            v = fc.columns[a.name][i]
+            if a.type == "Date":
+                v = date_str(v)
+            parts.append(f"<geomesa:{a.name}>{escape(str(v))}</geomesa:{a.name}>")
+        parts.append(f"</geomesa:{name}></gml:featureMember>\n")
+    parts.append("</gml:FeatureCollection>\n")
+    return "".join(parts)

@@ -216,3 +216,156 @@ def read_shapefile(
             row.update(dict(zip(names, records[i])))
         rows.append(row)
     return FeatureCollection.from_rows(sft, rows, ids=[str(i) for i in keep])
+
+
+# ------------------------------------------------------------------ write
+
+_TYPE_CODE = {"Point": 1, "LineString": 3, "Polygon": 5, "MultiLineString": 3,
+              "MultiPolygon": 5, "MultiPoint": 8}
+
+
+def _shape_record(g) -> bytes:
+    """One record's content (shape type + body), little-endian."""
+    if isinstance(g, geo.Point):
+        return struct.pack("<i2d", 1, g.x, g.y)
+    if isinstance(g, geo.MultiPoint):
+        pts = np.array([[p.x, p.y] for p in g.parts], dtype="<f8")
+        x0, y0, x1, y1 = g.bounds()
+        return (
+            struct.pack("<i4di", 8, x0, y0, x1, y1, len(pts)) + pts.tobytes()
+        )
+    if isinstance(g, (geo.LineString, geo.MultiLineString)):
+        parts = [g.coords] if isinstance(g, geo.LineString) else [
+            p.coords for p in g.parts
+        ]
+        code = 3
+    elif isinstance(g, (geo.Polygon, geo.MultiPolygon)):
+        polys = [g] if isinstance(g, geo.Polygon) else list(g.parts)
+        parts = []
+        for p in polys:
+            shell = np.asarray(p.shell, dtype=np.float64)
+            if not _ring_is_cw(shell):  # shapefile outer rings are CW
+                shell = shell[::-1]
+            parts.append(shell)
+            for h in p.holes:
+                hole = np.asarray(h, dtype=np.float64)
+                if _ring_is_cw(hole):  # holes are CCW
+                    hole = hole[::-1]
+                parts.append(hole)
+        code = 5
+    else:
+        raise ValueError(f"cannot write {type(g).__name__} to a shapefile")
+    pts = np.concatenate([np.asarray(p, dtype="<f8") for p in parts])
+    offsets = np.cumsum([0] + [len(p) for p in parts[:-1]]).astype("<i4")
+    x0, y0, x1, y1 = g.bounds()
+    return (
+        struct.pack("<i4d2i", code, x0, y0, x1, y1, len(parts), len(pts))
+        + offsets.tobytes()
+        + pts.tobytes()
+    )
+
+
+def _dbf_fields(sft: FeatureType, fc: FeatureCollection):
+    """(name, dbf type, width, decimals, formatter) per attribute."""
+    out = []
+    seen: set = set()
+    for a in sft.attributes:
+        if a.is_geometry:
+            continue
+        name = a.name[:10]
+        k = 0
+        while name in seen:  # 10-char truncation can collide
+            k += 1
+            name = f"{a.name[:10 - len(str(k))]}{k}"
+        seen.add(name)
+        col = fc.columns[a.name]
+        if a.type in ("Integer", "Int", "Long"):
+            # width 20 holds any int64 including the sign
+            out.append((a.name, name, "N", 20, 0, lambda v: f"{int(v):>20d}"))
+        elif a.type in ("Float", "Double"):
+            # general format: any double fits in 25 chars at 16 sig digits
+            out.append(
+                (a.name, name, "F", 25, 8, lambda v: f"{float(v):>25.16g}")
+            )
+        elif a.type == "Boolean":
+            out.append(
+                (a.name, name, "L", 1, 0, lambda v: "T" if v else "F")
+            )
+        elif a.type == "Date":
+            from geomesa_tpu.io.exporters import date_str
+
+            out.append((
+                a.name, name, "C", 24, 0,
+                lambda v: date_str(v)[:24].ljust(24),
+            ))
+        else:
+            width = 1
+            if len(col):
+                width = min(
+                    254, max(1, max(len(str(v)) for v in np.asarray(col)))
+                )
+            out.append((
+                a.name, name, "C", width, 0,
+                lambda v, w=width: str(v)[:w].ljust(w),
+            ))
+    return out
+
+
+def write_shapefile(fc: FeatureCollection, base: str) -> None:
+    """Write ``base``.shp/.shx/.dbf (reference ShapefileExporter,
+    geomesa-feature-exporters). Geometries must share one shapefile type
+    family (points, lines, or polygons); attributes go to the .dbf."""
+    sft = fc.sft
+    geoms = fc.geometries()
+    if not geoms:
+        raise ValueError("nothing to write")
+    codes = {_TYPE_CODE[type(g).__name__] for g in geoms}
+    if len(codes) > 1:
+        raise ValueError("shapefile requires a single geometry type family")
+    code = codes.pop()
+
+    records = [_shape_record(g) for g in geoms]
+    xs = np.array([g.bounds() for g in geoms])
+    bbox = (xs[:, 0].min(), xs[:, 1].min(), xs[:, 2].max(), xs[:, 3].max())
+
+    def header(file_words: int) -> bytes:
+        return (
+            struct.pack(">7i", SHP_MAGIC, 0, 0, 0, 0, 0, file_words)
+            + struct.pack("<2i", 1000, code)
+            + struct.pack("<8d", *bbox, 0.0, 0.0, 0.0, 0.0)
+        )
+
+    shp = bytearray()
+    shx = bytearray()
+    offset_words = 50  # header = 100 bytes
+    for i, rec in enumerate(records):
+        words = len(rec) // 2
+        shx += struct.pack(">2i", offset_words, words)
+        shp += struct.pack(">2i", i + 1, words) + rec
+        offset_words += 4 + words
+    with open(base + ".shp", "wb") as fh:
+        fh.write(header(offset_words) + bytes(shp))
+    with open(base + ".shx", "wb") as fh:
+        fh.write(header(50 + 4 * len(records)) + bytes(shx))
+
+    fields = _dbf_fields(sft, fc)
+    rec_size = 1 + sum(f[3] for f in fields)
+    hdr = bytearray(struct.pack(
+        "<4BiHH20x", 3, 24, 1, 1, len(fc), 33 + 32 * len(fields), rec_size
+    ))
+    for _, name, ftype, width, dec, _fmt in fields:
+        hdr += name.encode("ascii", "replace")[:10].ljust(11, b"\x00")
+        hdr += ftype.encode() + b"\x00" * 4 + bytes([width, dec]) + b"\x00" * 14
+    hdr += b"\x0d"
+    body = bytearray()
+    for i in range(len(fc)):
+        body += b" "
+        for attr, _name, _ftype, width, _dec, fmt in fields:
+            cell = fmt(fc.columns[attr][i]).encode("latin-1", "replace")
+            if len(cell) > width:
+                raise ValueError(
+                    f"value for {attr!r} exceeds its DBF width {width}"
+                )
+            body += cell.ljust(width)
+    with open(base + ".dbf", "wb") as fh:
+        fh.write(bytes(hdr) + bytes(body) + b"\x1a")

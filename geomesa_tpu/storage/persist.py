@@ -166,8 +166,18 @@ def load(root: str, **store_kwargs):
     return store
 
 
+def _plain_array(col) -> np.ndarray:
+    """npz-safe array: object columns (python strings, possibly None)
+    become fixed-width unicode — loading is allow_pickle=False, so an
+    object array would fail the round-trip."""
+    a = np.asarray(col)
+    if a.dtype.kind == "O":
+        a = np.array(["" if v is None else str(v) for v in a])
+    return a
+
+
 def _pack_columns(sft: FeatureType, fc: FeatureCollection) -> dict:
-    out: dict = {"__ids__": fc.ids}
+    out: dict = {"__ids__": _plain_array(fc.ids)}
     for name, col in fc.columns.items():
         if isinstance(col, PointColumn):
             out[f"pt:{name}:x"] = col.x
@@ -180,7 +190,7 @@ def _pack_columns(sft: FeatureType, fc: FeatureCollection) -> dict:
             out[f"pg:{name}:types"] = col.types
             out[f"pg:{name}:bboxes"] = col.bboxes
         else:
-            out[f"col:{name}"] = np.asarray(col)
+            out[f"col:{name}"] = _plain_array(col)
     return out
 
 

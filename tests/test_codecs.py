@@ -219,3 +219,99 @@ class TestStatsAnalyze:
         # the command re-persists the store (reload still sees exact stats)
         ds2 = persist.load(tmp_path / "s")
         assert ds2.stats_for("ev").total_count() == n
+
+
+class TestShapefileWriter:
+    def test_point_roundtrip_with_attributes(self, tmp_path):
+        from geomesa_tpu.io.shapefile import read_shapefile, write_shapefile
+
+        rng = np.random.default_rng(0)
+        n = 150
+        sft = FeatureType.from_spec(
+            "p", "name:String,v:Integer,s:Double,*geom:Point:srid=4326"
+        )
+        fc = FeatureCollection.from_columns(sft, np.arange(n), {
+            "name": np.array([f"nm{i % 9}" for i in range(n)], dtype=object),
+            "v": rng.integers(-50, 50, n).astype(np.int64),
+            "s": rng.uniform(0, 10, n),
+            "geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)),
+        })
+        base = str(tmp_path / "pts")
+        write_shapefile(fc, base)
+        back = read_shapefile(base + ".shp")
+        assert len(back) == n
+        np.testing.assert_array_equal(
+            np.asarray(back.columns["v"]), np.asarray(fc.columns["v"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(back.columns["s"]), np.asarray(fc.columns["s"]), atol=1e-7
+        )
+        np.testing.assert_allclose(back.geom_column.x, fc.geom_column.x)
+        assert list(back.columns["name"][:3]) == ["nm0", "nm1", "nm2"]
+
+    def test_polygon_with_hole_roundtrip(self, tmp_path):
+        from geomesa_tpu.io.shapefile import read_shapefile, write_shapefile
+
+        shell = np.array([[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]], float)
+        hole = np.array([[2, 2], [2, 4], [4, 4], [4, 2], [2, 2]], float)
+        sft = FeatureType.from_spec("pg", "*geom:Polygon:srid=4326")
+        fc = FeatureCollection.from_rows(sft, [
+            {"geom": geo.Polygon(shell, [hole])},
+            {"geom": geo.Polygon(shell + 20)},
+        ])
+        base = str(tmp_path / "pg")
+        write_shapefile(fc, base)
+        back = read_shapefile(base + ".shp")
+        assert len(back) == 2
+        g0 = back.geom_column.geometry(0)
+        assert isinstance(g0, geo.Polygon) and len(g0.holes) == 1
+        assert abs(g0.area - (100 - 4)) < 1e-9
+
+    def test_mixed_types_rejected(self, tmp_path):
+        from geomesa_tpu.io.shapefile import write_shapefile
+
+        sft = FeatureType.from_spec("m", "*geom:Geometry:srid=4326")
+        fc = FeatureCollection.from_rows(sft, [
+            {"geom": geo.Point(0, 0)},
+            {"geom": geo.Polygon(np.array(
+                [[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]], float))},
+        ])
+        with pytest.raises(ValueError, match="single geometry type"):
+            write_shapefile(fc, str(tmp_path / "m"))
+
+
+class TestGmlExport:
+    def test_well_formed_with_escaping(self):
+        import xml.etree.ElementTree as ET
+
+        from geomesa_tpu.io import export
+
+        sft = FeatureType.from_spec(
+            "ev", "name:String,dtg:Date,*geom:Point:srid=4326"
+        )
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        fc = FeatureCollection.from_columns(sft, ["a", "b"], {
+            "name": np.array(["x<y&z", "ok"], dtype=object),
+            "dtg": np.array([t0, t0 + 1000]),
+            "geom": (np.array([1.5, -2.0]), np.array([3.0, 4.0])),
+        })
+        g = export(fc, "gml")
+        root = ET.fromstring(g)
+        assert len(root) == 2
+        assert "x&lt;y&amp;z" in g
+        assert "<gml:pos>1.5 3</gml:pos>" in g
+
+    def test_gml_polygon_and_multi(self):
+        import xml.etree.ElementTree as ET
+
+        from geomesa_tpu.io import export
+
+        shell = np.array([[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]], float)
+        sft = FeatureType.from_spec("pg", "*geom:Geometry:srid=4326")
+        fc = FeatureCollection.from_rows(sft, [
+            {"geom": geo.Polygon(shell, [shell * 0.2 + 0.3])},
+            {"geom": geo.MultiPolygon([geo.Polygon(shell), geo.Polygon(shell + 5)])},
+        ])
+        g = export(fc, "gml")
+        ET.fromstring(g)
+        assert "gml:interior" in g and "gml:MultiSurface" in g

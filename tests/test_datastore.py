@@ -282,3 +282,18 @@ class TestAntimeridianBBox:
         assert set(np.asarray(out.ids, np.int64).tolist()) == {1}
         out = ds.query("s2", "bbox(geom, 181, -10, 182, 10)")
         assert set(np.asarray(out.ids, np.int64).tolist()) == {0}
+
+    def test_non_finite_bbox_errors_cleanly(self):
+        """An overflowed bbox literal must not hang the planner's wrap
+        loop — it surfaces as a clean error."""
+        from geomesa_tpu.filter.predicates import BBox
+
+        sft = FeatureType.from_spec("s3", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("s3", FeatureCollection.from_columns(
+            sft, np.arange(2),
+            {"geom": (np.array([0.0, 1.0]), np.array([0.0, 1.0]))},
+        ))
+        with pytest.raises(ValueError):
+            ds.query("s3", BBox("geom", float("inf"), -10, float("inf"), 10))
