@@ -149,3 +149,55 @@ class TestAdapterSeam:
         assert ds.count("w") == 8 * 400
         ds.compact("w")
         assert ds.count("w") == 8 * 400
+
+
+class TestConcurrentReadWrite:
+    def test_readers_during_writes(self):
+        """Queries racing appends must never error and always see a
+        consistent snapshot (row counts monotonically between the
+        pre-write and post-write totals; ids unique)."""
+        import threading
+
+        sft = FeatureType.from_spec("rw", "v:Integer,*geom:Point:srid=4326")
+        ds = DataStore(tile=64)
+        ds.create_schema(sft)
+        rng = np.random.default_rng(3)
+        base = 2000
+        ds.write("rw", FeatureCollection.from_columns(
+            sft, np.arange(base),
+            {"v": np.arange(base),
+             "geom": (rng.uniform(-10, 10, base), rng.uniform(-10, 10, base))},
+        ), check_ids=False)
+
+        errors: list = []
+        counts: list = []
+        stop = threading.Event()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    out = ds.query("rw", "bbox(geom, -10, -10, 10, 10)")
+                    ids = np.asarray(out.ids)
+                    assert len(np.unique(ids)) == len(ids)
+                    counts.append(len(out))
+            except Exception as e:  # pragma: no cover - failure reporting
+                errors.append(e)
+
+        readers = [threading.Thread(target=reader) for _ in range(3)]
+        for r in readers:
+            r.start()
+        n_batches, per = 6, 500
+        for b in range(n_batches):
+            start = base + b * per
+            ds.write("rw", FeatureCollection.from_columns(
+                sft, np.arange(start, start + per),
+                {"v": np.arange(start, start + per),
+                 "geom": (rng.uniform(-10, 10, per), rng.uniform(-10, 10, per))},
+            ), check_ids=False)
+        stop.set()
+        for r in readers:
+            r.join(timeout=30)
+        assert not errors, errors[:3]
+        total = base + n_batches * per
+        assert len(ds.query("rw", "INCLUDE")) == total
+        assert counts and all(base <= c <= total for c in counts)
