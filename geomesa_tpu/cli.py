@@ -191,6 +191,23 @@ def cmd_export(args) -> int:
 
     ds = _load(args)
     out = ds.query(args.feature_name, args.cql or "INCLUDE", limit=args.max_features)
+    if args.format.lower() in ("shp", "shapefile"):
+        # multi-file sink: -o names the .shp (or the base path)
+        if not args.output:
+            print("shapefile export requires -o/--output", file=sys.stderr)
+            return 1
+        from geomesa_tpu.io.shapefile import write_shapefile
+
+        base = args.output
+        if base.lower().endswith(".shp"):
+            base = base[:-4]
+        try:
+            write_shapefile(out, base)
+        except ValueError as e:  # empty result / mixed geometry families
+            print(f"shapefile export failed: {e}", file=sys.stderr)
+            return 1
+        print(f"exported {len(out)} features to {base}.shp/.shx/.dbf")
+        return 0
     payload = export(out, args.format)
     if args.output:
         mode = "wb" if isinstance(payload, bytes) else "w"

@@ -315,3 +315,53 @@ class TestGmlExport:
         g = export(fc, "gml")
         ET.fromstring(g)
         assert "gml:interior" in g and "gml:MultiSurface" in g
+
+
+class TestCliShapefileExport:
+    def test_export_shp(self, tmp_path, capsys):
+        from geomesa_tpu.cli import main
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.io.shapefile import read_shapefile
+        from geomesa_tpu.storage import persist
+
+        sft = FeatureType.from_spec("p", "v:Integer,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(1)
+        n = 300
+        ds.write("p", FeatureCollection.from_columns(
+            sft, np.arange(n),
+            {"v": np.arange(n),
+             "geom": (rng.uniform(-20, 20, n), rng.uniform(-20, 20, n))},
+        ))
+        persist.save(ds, tmp_path / "s")
+        out = str(tmp_path / "out.shp")
+        rc = main([
+            "export", "-c", str(tmp_path / "s"), "-f", "p",
+            "-q", "bbox(geom, -10, -10, 10, 10)", "--format", "shp", "-o", out,
+        ])
+        assert rc == 0
+        back = read_shapefile(out)
+        assert len(back) > 0
+        assert (np.abs(back.geom_column.x) <= 10).all()
+        assert (np.abs(back.geom_column.y) <= 10).all()
+
+    def test_export_shp_empty_result_fails_cleanly(self, tmp_path, capsys):
+        from geomesa_tpu.cli import main
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.storage import persist
+
+        sft = FeatureType.from_spec("p", "*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("p", FeatureCollection.from_columns(
+            sft, np.arange(2), {"geom": (np.zeros(2), np.zeros(2))}
+        ))
+        persist.save(ds, tmp_path / "s")
+        rc = main([
+            "export", "-c", str(tmp_path / "s"), "-f", "p",
+            "-q", "bbox(geom, 50, 50, 51, 51)", "--format", "shp",
+            "-o", str(tmp_path / "o.shp"),
+        ])
+        assert rc == 1
+        assert "shapefile export failed" in capsys.readouterr().err
