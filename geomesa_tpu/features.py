@@ -12,8 +12,8 @@ predicates evaluate over (geomesa_tpu.filter.predicates).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Iterable, Mapping, Sequence
+from dataclasses import dataclass
+from typing import Mapping, Sequence
 
 import numpy as np
 

@@ -17,9 +17,8 @@ x/y — the point fast path) or a ``PackedGeometryColumn`` (extents).
 
 from __future__ import annotations
 
-import fnmatch
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Mapping, Sequence
 
 import numpy as np

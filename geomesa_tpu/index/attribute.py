@@ -30,7 +30,7 @@ from geomesa_tpu.filter.extract import (
     geometry_bounds,
 )
 from geomesa_tpu.filter.predicates import Filter, PointColumn
-from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.index.api import ScanConfig, WriteKeys, widen_boxes
 from geomesa_tpu.sft import FeatureType
 from geomesa_tpu.utils import lexicode
 

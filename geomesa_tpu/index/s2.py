@@ -19,7 +19,7 @@ from geomesa_tpu.curve.s2 import S2SFC
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter, PointColumn
-from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.index.api import ScanConfig, WriteKeys, widen_boxes
 from geomesa_tpu.index.z3 import WHOLE_WORLD, _bounds_only, clamp_bins
 
 

@@ -21,7 +21,7 @@ from geomesa_tpu.curve.xz2sfc import XZ2SFC
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter
-from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.index.api import ScanConfig, WriteKeys, widen_boxes
 from geomesa_tpu.sft import FeatureType
 
 

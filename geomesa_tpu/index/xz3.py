@@ -19,7 +19,7 @@ from geomesa_tpu.curve.xz3sfc import XZ3SFC
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter
-from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.index.api import ScanConfig, WriteKeys, widen_boxes
 from geomesa_tpu.index.z3 import WHOLE_WORLD, clamp_bins
 from geomesa_tpu.sft import FeatureType
 

@@ -16,7 +16,7 @@ from geomesa_tpu.curve.z2sfc import Z2SFC
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.extract import extract_geometries, geometry_bounds
 from geomesa_tpu.filter.predicates import Filter, PointColumn
-from geomesa_tpu.index.api import IndexKeySpace, ScanConfig, WriteKeys, widen_boxes
+from geomesa_tpu.index.api import ScanConfig, WriteKeys, widen_boxes
 from geomesa_tpu.sft import FeatureType
 
 

@@ -32,7 +32,6 @@ Every shape is static per (table, M-bucket): zero recompiles at query time.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax

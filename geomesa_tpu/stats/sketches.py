@@ -12,7 +12,6 @@ one).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
 
 import numpy as np
 
