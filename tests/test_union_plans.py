@@ -111,3 +111,43 @@ class TestUnionPlans:
         q = "bbox(geom, -60, -45, 60, 45) OR name = 'n5'"
         out = ds.query("u", q, limit=7)
         assert len(out) == 7
+
+
+def test_union_branches_under_seam_crossing_and():
+    """Mixed-kind OR (time/attribute) ANDed with a seam-crossing bbox:
+    union plans + antimeridian normalization must compose (caught
+    divergent in a soak harness that lacked wrap semantics — the engine
+    was right; this pins it)."""
+    import numpy as np
+
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+
+    rng = np.random.default_rng(5)
+    sft = FeatureType.from_spec(
+        "w", "code:Integer:index=true,dtg:Date,*geom:Point:srid=4326"
+    )
+    ds = DataStore(tile=64)
+    ds.create_schema(sft)
+    n = 4000
+    t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    t = t0 + rng.integers(0, 30 * 86400_000, n)
+    code = rng.integers(0, 50, n)
+    ds.write("w", FeatureCollection.from_columns(
+        sft, [str(i) for i in range(n)],
+        {"code": code.astype(np.int64), "dtg": t, "geom": (x, y)},
+    ))
+    lo = np.datetime64("2024-01-16", "ms").astype(np.int64)
+    hi = np.datetime64("2024-01-20", "ms").astype(np.int64)
+    expr = (
+        "((dtg DURING 2024-01-16T00:00:00Z/2024-01-20T00:00:00Z) OR "
+        "(code = 47)) AND bbox(geom, 131.7, -90, 191.7, 90)"
+    )
+    inner = ((t >= lo) & (t < hi)) | (code == 47)
+    wrapped = inner & ((x >= 131.7) | (x <= 191.7 - 360.0))
+    got = np.sort(np.asarray(ds.query("w", expr).ids, np.int64))
+    np.testing.assert_array_equal(got, np.flatnonzero(wrapped))
+    assert len(got) > 0
