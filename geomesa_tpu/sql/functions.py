@@ -298,3 +298,212 @@ def _translate_wkb(wkb: bytes, dx: float, dy: float) -> bytes:
         return geo.to_wkb(geo.Polygon(shift(g.shell), [shift(h) for h in g.holes]))
     parts = [geo.from_wkb(_translate_wkb(geo.to_wkb(p), dx, dy)) for p in g.parts]
     return geo.to_wkb(type(g)(parts))
+
+
+def _all_coords(g: geo.Geometry) -> np.ndarray:
+    """Every vertex of a geometry as [n, 2]."""
+    if isinstance(g, geo.Point):
+        return np.array([[g.x, g.y]])
+    if isinstance(g, geo.LineString):
+        return np.asarray(g.coords, dtype=np.float64)
+    if isinstance(g, geo.Polygon):
+        parts = [np.asarray(g.shell, dtype=np.float64)]
+        parts += [np.asarray(h, dtype=np.float64) for h in g.holes]
+        return np.concatenate(parts)
+    return np.concatenate([_all_coords(p) for p in g.parts])
+
+
+@_register
+def st_convexhull(g: geo.Geometry) -> geo.Geometry:
+    """Convex hull (Andrew monotone chain). Degenerate inputs return the
+    point / segment itself."""
+    # np.unique(axis=0) already yields (x, y)-lexicographic order
+    p = np.unique(_all_coords(g), axis=0)
+    if len(p) == 1:
+        return geo.Point(float(p[0, 0]), float(p[0, 1]))
+    if len(p) == 2:
+        return geo.LineString(p)
+
+    def cross2(a, b) -> float:  # 2-d cross product (np.cross 2-d is deprecated)
+        return float(a[0] * b[1] - a[1] * b[0])
+
+    def chain(points):
+        out: list = []
+        for q in points:
+            while len(out) >= 2 and cross2(out[-1] - out[-2], q - out[-1]) <= 0:
+                out.pop()
+            out.append(q)
+        return out
+
+    lower = chain(p)
+    upper = chain(p[::-1])
+    hull = np.array(lower[:-1] + upper[:-1])
+    if len(hull) < 3:  # collinear input
+        return geo.LineString(np.array([p[0], p[-1]]))
+    ring = np.concatenate([hull, hull[:1]])
+    return geo.Polygon(ring)
+
+
+def _dp_simplify(coords: np.ndarray, tol: float) -> np.ndarray:
+    """Douglas-Peucker on an open coordinate run."""
+    keep = np.zeros(len(coords), dtype=bool)
+    keep[0] = keep[-1] = True
+    stack = [(0, len(coords) - 1)]
+    while stack:
+        a, b = stack.pop()
+        if b - a < 2:
+            continue
+        seg = coords[b] - coords[a]
+        ln = np.hypot(*seg)
+        mid = coords[a + 1 : b]
+        if ln == 0:
+            d = np.hypot(mid[:, 0] - coords[a, 0], mid[:, 1] - coords[a, 1])
+        else:
+            rel = mid - coords[a]
+            d = np.abs(seg[0] * rel[:, 1] - seg[1] * rel[:, 0]) / ln
+        i = int(np.argmax(d))
+        if d[i] > tol:
+            k = a + 1 + i
+            keep[k] = True
+            stack += [(a, k), (k, b)]
+    return coords[keep]
+
+
+@_register
+def st_simplify(g: geo.Geometry, tolerance: float) -> geo.Geometry:
+    """Douglas-Peucker simplification (planar degrees tolerance). Polygon
+    rings that collapse below 4 points are dropped (holes) or kept at
+    minimum shape (shells keep their bounding triangle behavior by
+    falling back to the original ring)."""
+    if isinstance(g, geo.Point):
+        return g
+    if isinstance(g, geo.LineString):
+        return geo.LineString(_dp_simplify(np.asarray(g.coords, float), tolerance))
+    if isinstance(g, geo.Polygon):
+        def ring(r):
+            rr = np.asarray(r, dtype=np.float64)
+            # simplify the closed ring on its open form, re-close after
+            s = _dp_simplify(rr[:-1], tolerance) if len(rr) > 4 else rr[:-1]
+            return np.concatenate([s, s[:1]])
+
+        shell = ring(g.shell)
+        if len(shell) < 4:
+            shell = np.asarray(g.shell, dtype=np.float64)
+        holes = [h2 for h in g.holes if len(h2 := ring(h)) >= 4]
+        return geo.Polygon(shell, holes)
+    return type(g)([st_simplify(p, tolerance) for p in g.parts])
+
+
+@_register
+def st_boundary(g: geo.Geometry) -> geo.Geometry:
+    """Boundary (OGC): polygon/multipolygon -> rings, linestring ->
+    endpoints, multilinestring -> all endpoints, point -> empty multi."""
+    if isinstance(g, geo.Point):
+        return geo.MultiPoint([])  # a point's boundary is empty
+    if isinstance(g, geo.LineString):
+        c = np.asarray(g.coords)
+        return geo.MultiPoint([
+            geo.Point(float(c[0, 0]), float(c[0, 1])),
+            geo.Point(float(c[-1, 0]), float(c[-1, 1])),
+        ])
+    if isinstance(g, geo.Polygon):
+        rings = [geo.LineString(g.shell)] + [geo.LineString(h) for h in g.holes]
+        return rings[0] if len(rings) == 1 else geo.MultiLineString(rings)
+    if isinstance(g, geo.MultiPoint):
+        return geo.MultiPoint([])
+    if isinstance(g, (geo.MultiLineString, geo.MultiPolygon)):
+        pieces = [st_boundary(p) for p in g.parts]
+        flat: list = []
+        for b in pieces:
+            flat.extend(b.parts if hasattr(b, "parts") else [b])
+        if isinstance(g, geo.MultiLineString):
+            return geo.MultiPoint(flat)
+        return geo.MultiLineString(flat)
+    raise TypeError(f"st_boundary of {type(g).__name__} unsupported")
+
+
+@_register
+def st_numinteriorrings(g: geo.Polygon) -> int:
+    return len(g.holes)
+
+
+def _ogc_index(n: int, count: int, what: str) -> int:
+    """1-based OGC index with explicit range errors (a bare [n-1] would
+    silently return the LAST element for n=0)."""
+    if not 1 <= n <= count:
+        raise IndexError(f"{what} index {n} out of range [1, {count}]")
+    return n - 1
+
+
+@_register
+def st_interiorringn(g: geo.Polygon, n: int) -> geo.LineString:
+    return geo.LineString(g.holes[_ogc_index(n, len(g.holes), "interior ring")])
+
+
+@_register
+def st_pointn(g: geo.LineString, n: int) -> geo.Point:
+    c = np.asarray(g.coords)
+    i = _ogc_index(n, len(c), "point")
+    return geo.Point(float(c[i, 0]), float(c[i, 1]))
+
+
+@_register
+def st_startpoint(g: geo.LineString) -> geo.Point:
+    return st_pointn(g, 1)
+
+
+@_register
+def st_endpoint(g: geo.LineString) -> geo.Point:
+    return st_pointn(g, len(np.asarray(g.coords)))
+
+
+@_register
+def st_numgeometries(g: geo.Geometry) -> int:
+    return len(g.parts) if hasattr(g, "parts") else 1
+
+
+@_register
+def st_geometryn(g: geo.Geometry, n: int) -> geo.Geometry:
+    if hasattr(g, "parts"):
+        return g.parts[_ogc_index(n, len(g.parts), "geometry")]
+    if n == 1:
+        return g
+    raise IndexError(n)
+
+
+@_register
+def st_geohash(g: geo.Point, precision: int = 12) -> str:
+    from geomesa_tpu.utils import geohash
+
+    return str(geohash.encode(g.x, g.y, precision))
+
+
+@_register
+def st_geomfromgeohash(h: str) -> geo.Polygon:
+    """The geohash CELL as a polygon (reference ST_GeomFromGeoHash)."""
+    from geomesa_tpu.utils import geohash
+
+    x0, y0, x1, y1 = geohash.bbox(h)
+    return geo.box(x0, y0, x1, y1)
+
+
+@_register
+def st_pointfromgeohash(h: str) -> geo.Point:
+    from geomesa_tpu.utils import geohash
+
+    cx, cy = geohash.decode(h)
+    return geo.Point(cx, cy)
+
+
+@_register
+def st_astwkb(g: geo.Geometry, precision: int = 7) -> bytes:
+    from geomesa_tpu.io.twkb import to_twkb
+
+    return to_twkb(g, precision)
+
+
+@_register
+def st_geomfromtwkb(data: bytes) -> geo.Geometry:
+    from geomesa_tpu.io.twkb import from_twkb
+
+    return from_twkb(data)

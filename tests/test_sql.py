@@ -124,3 +124,65 @@ class TestSpatialJoin:
         b = _points_fc([(50, 50)], "b")
         li, _ = spatial_join(a, b)
         assert len(li) == 0
+
+
+class TestNewStFunctions:
+    """Round-4 ST_ additions: hull, simplify, boundary, accessors,
+    geohash/TWKB bridges."""
+
+    def test_convexhull(self):
+        from geomesa_tpu.sql import functions as F
+
+        rng = np.random.default_rng(0)
+        pts = geo.MultiPoint(
+            [geo.Point(float(x), float(y)) for x, y in rng.uniform(0, 1, (100, 2))]
+            + [geo.Point(0, 0), geo.Point(1, 0), geo.Point(1, 1), geo.Point(0, 1)]
+        )
+        h = F.st_convexhull(pts)
+        assert isinstance(h, geo.Polygon)
+        assert abs(h.area - 1.0) < 1e-9
+        # degenerate: single + collinear
+        assert isinstance(F.st_convexhull(geo.Point(1, 2)), geo.Point)
+        col = geo.MultiPoint([geo.Point(0, 0), geo.Point(1, 1), geo.Point(2, 2)])
+        assert isinstance(F.st_convexhull(col), geo.LineString)
+
+    def test_simplify_circle(self):
+        from geomesa_tpu.sql import functions as F
+
+        t = np.linspace(0, 2 * np.pi, 400)
+        ring = np.stack([np.cos(t), np.sin(t)], axis=1)
+        ring[-1] = ring[0]
+        s = F.st_simplify(geo.Polygon(ring), 0.05)
+        assert 8 <= len(s.shell) < 100
+        assert abs(s.area - np.pi) < 0.2
+
+    def test_boundary_and_accessors(self):
+        from geomesa_tpu.sql import functions as F
+
+        line = geo.LineString(np.array([[0, 0], [1, 1], [2, 0]], float))
+        assert F.st_startpoint(line).x == 0
+        assert F.st_endpoint(line).x == 2
+        assert F.st_pointn(line, 2).y == 1
+        assert len(F.st_boundary(line).parts) == 2
+        sq = geo.Polygon(
+            np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], float),
+            [np.array([[1, 1], [1, 2], [2, 2], [2, 1], [1, 1]], float)],
+        )
+        assert F.st_numinteriorrings(sq) == 1
+        assert isinstance(F.st_interiorringn(sq, 1), geo.LineString)
+        assert isinstance(F.st_boundary(sq), geo.MultiLineString)
+        mp = geo.MultiPoint([geo.Point(0, 0), geo.Point(1, 1)])
+        assert F.st_numgeometries(mp) == 2
+        assert F.st_geometryn(mp, 2).x == 1
+
+    def test_geohash_twkb_bridges(self):
+        from geomesa_tpu.sql import functions as F
+
+        p = geo.Point(10.40744, 57.64911)
+        assert F.st_geohash(p, 11) == "u4pruydqqvj"
+        cell = F.st_geomfromgeohash("u4pruydqqvj")
+        assert F.st_contains(cell, F.st_pointfromgeohash("u4pruydqqvj"))
+        g2 = F.st_geomfromtwkb(F.st_astwkb(p))
+        assert abs(g2.x - p.x) < 1e-7
+        # registry dispatch path
+        assert F.st_call("st_geohash", p, 5) == str(F.st_geohash(p, 5))
