@@ -173,7 +173,8 @@ class Frequency:
         self.count += len(col)
         idx = _cm_hashes(_to_u64_keys(col), self.depth, self.width)
         for d in range(self.depth):
-            np.add.at(self.table[d], idx[d], 1)
+            # bincount is ~20x np.add.at; runs per ingest batch
+            self.table[d] += np.bincount(idx[d], minlength=self.width)
 
     def __iadd__(self, other: "Frequency") -> "Frequency":
         self.table += other.table
