@@ -69,6 +69,35 @@ class MergedView:
     def count(self, f: "Filter | str" = INCLUDE) -> int:
         return len(self.query(f))
 
+    def density(
+        self, f, envelope: tuple, width: int = 256, height: int = 256
+    ) -> np.ndarray:
+        """Sum of the member stores' device density grids (the reference
+        merged view runs DensityScan per store and sums client-side).
+        Duplicate-id rows present in several stores count once per store
+        here — the aggregation trade-off the reference documents for
+        merged views."""
+        grid = None
+        for s in self.stores:
+            g = s.density(
+                self.type_name, f, envelope=envelope, width=width, height=height
+            )
+            grid = g if grid is None else grid + g
+        return grid
+
+    def bounds(self, f: "Filter | str" = INCLUDE, estimate: bool = True):
+        """Union envelope over member stores."""
+        env = None
+        for s in self.stores:
+            b = s.bounds(self.type_name, f, estimate=estimate)
+            if b is None:
+                continue
+            env = b if env is None else (
+                min(env[0], b[0]), min(env[1], b[1]),
+                max(env[2], b[2]), max(env[3], b[3]),
+            )
+        return env
+
 
 class RoutedView:
     """Route each query to exactly one store by a router function over the

@@ -297,3 +297,36 @@ class TestAntimeridianBBox:
         ))
         with pytest.raises(ValueError):
             ds.query("s3", BBox("geom", float("inf"), -10, float("inf"), 10))
+
+
+class TestMergedViewAggregations:
+    def test_density_and_bounds_over_stores(self):
+        from geomesa_tpu.views import MergedView
+
+        rng = np.random.default_rng(0)
+        stores, xs, ys = [], [], []
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        for k in range(2):
+            sft = FeatureType.from_spec("ev", "dtg:Date,*geom:Point:srid=4326")
+            ds = DataStore()
+            ds.create_schema(sft)
+            n = 5000
+            x = rng.uniform(-50, 50, n)
+            y = rng.uniform(-50, 50, n)
+            ds.write("ev", FeatureCollection.from_columns(
+                sft, np.arange(k * n, (k + 1) * n),
+                {"dtg": np.full(n, t0), "geom": (x, y)},
+            ), check_ids=False)
+            stores.append(ds)
+            xs.append(x)
+            ys.append(y)
+        v = MergedView(stores, "ev")
+        q = "bbox(geom, -10, -10, 10, 10)"
+        g = v.density(q, envelope=(-10, -10, 10, 10), width=32, height=32)
+        x = np.concatenate(xs)
+        y = np.concatenate(ys)
+        true = int(((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)).sum())
+        assert abs(float(g.sum()) - true) <= max(2, 0.02 * true)
+        b = v.bounds(q)
+        assert b is not None
+        assert b[0] >= -10.01 and b[1] >= -10.01 and b[2] <= 10.01 and b[3] <= 10.01
