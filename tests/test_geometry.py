@@ -231,7 +231,7 @@ class TestGeometryProperties:
         ang = np.sort(rng.uniform(0, 2 * np.pi, n))
         r = rng.uniform(0.5, 5.0, n)
         ring = np.stack([cx + r * np.cos(ang), cy + r * np.sin(ang)], axis=1)
-        return geo.Polygon(np.concatenate([ring, ring[:1]]))
+        return G.Polygon(np.concatenate([ring, ring[:1]]))
 
     def test_codecs_and_predicate_laws(self):
         from geomesa_tpu.io.twkb import from_twkb, to_twkb
@@ -240,14 +240,14 @@ class TestGeometryProperties:
         for _ in range(300):
             a, b = self._rand_poly(rng), self._rand_poly(rng)
             for codec in (
-                lambda g: geo.from_wkt(geo.to_wkt(g)),
-                lambda g: geo.from_wkb(geo.to_wkb(g)),
+                lambda g: G.from_wkt(G.to_wkt(g)),
+                lambda g: G.from_wkb(G.to_wkb(g)),
                 lambda g: from_twkb(to_twkb(g, 7)),
             ):
                 g2 = codec(a)
                 np.testing.assert_allclose(
                     np.asarray(g2.shell), np.asarray(a.shell), atol=1e-6
                 )
-            assert geo.intersects(a, b) == geo.intersects(b, a)
-            if geo.contains(a, b):
-                assert geo.intersects(a, b)
+            assert G.intersects(a, b) == G.intersects(b, a)
+            if G.contains(a, b):
+                assert G.intersects(a, b)
