@@ -570,3 +570,32 @@ class TestKnnAntimeridian:
         assert set(np.asarray(got.ids, np.int64).tolist()) == set(want.tolist())
         many = knn_many(ds, "s", [(179.8, 0.0)], k=2, estimated_distance_m=30_000)
         assert many[0].ids.tolist() == got.ids.tolist()
+
+
+class TestTubeBruteForce:
+    def test_matches_continuous_interpolation(self):
+        from geomesa_tpu.process import tube_select
+        from geomesa_tpu.process.knn import haversine_m
+
+        rng = np.random.default_rng(0)
+        sft = FeatureType.from_spec("ev", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        n = 20000
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        x = rng.uniform(-5, 15, n)
+        y = rng.uniform(-5, 15, n)
+        t = t0 + rng.integers(0, 3600_000, n)
+        ds.write("ev", FeatureCollection.from_columns(
+            sft, np.arange(n), {"dtg": t, "geom": (x, y)}
+        ), check_ids=False)
+        track = np.stack([np.linspace(0, 10, 20), np.linspace(0, 10, 20)], axis=1)
+        times = t0 + np.linspace(0, 3600_000, 20).astype(np.int64)
+        out = tube_select(ds, "ev", track, times, buffer_m=100_000, bin_ms=60_000)
+        cx = np.interp(t, times, track[:, 0])
+        cy = np.interp(t, times, track[:, 1])
+        exact = np.flatnonzero(haversine_m(x, y, cx, cy) <= 100_000)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(out.ids, np.int64)), exact
+        )
+        assert len(exact) > 50
