@@ -2,9 +2,13 @@
 (geomesa-process, SURVEY.md §2.5) re-based on the DataStore query path.
 
 Each process composes planner queries with vectorized post-compute:
-k-nearest-neighbour search, proximity search, tube (spatio-temporal
-corridor) select, unique-value enumeration, attribute joins, sampling,
-and density (the heatmap process wraps DataStore.density directly)."""
+k-nearest-neighbour search (single + pipelined batch), proximity and
+route search, tube (spatio-temporal corridor) select, unique-value
+enumeration, attribute joins, track transforms (point2point,
+track_label, date_offset), BIN/Arrow conversion, and thin
+query/sampling/minmax wrappers; density/stats wrap the DataStore
+push-downs directly. All window-building processes wrap the
+antimeridian."""
 
 from geomesa_tpu.process.join import join_search
 from geomesa_tpu.process.knn import knn_many, knn_search
