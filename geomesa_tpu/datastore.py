@@ -374,6 +374,21 @@ class DataStore:
                 _observe_sketch(stats, idx, idx.write_keys(fc))
         return stats
 
+    def warmup(self, type_name: str) -> int:
+        """Pre-compile every index table's scan-kernel variants (bucket
+        ladder x predicate flags x projections) so first queries skip the
+        XLA compile stall — on the tunneled TPU a cold variant costs
+        20-40 s. Returns total kernel calls issued."""
+        total = 0
+        for idx in self._indexes[type_name]:
+            try:
+                table = self.table(type_name, idx.name)
+            except KeyError:
+                continue
+            main = getattr(table, "main", table)  # unwrap the delta tier
+            total += main.warmup()
+        return total
+
     def analyze_stats(self, type_name: str):
         """Recompute this type's statistics from the stored data
         (reference geomesa-tools ``stats-analyze``: sketches accumulated

@@ -565,6 +565,51 @@ class IndexTable(SortedKeys):
         gb = np.asarray(bounds, dtype=np.float32).reshape(4)
         return self._device_density(blocks, config, gb, width, height)
 
+    # -- warmup ----------------------------------------------------------
+    def warmup(self) -> int:
+        """Pre-compile the scan-kernel variants this table can hit, so the
+        first real query never pays the (potentially tens-of-seconds) XLA
+        compile. Variants are keyed by (M bucket, projected columns,
+        predicate flags); this drives the shared device hook
+        (``_device_scan_submit`` — so the distributed table warms its
+        shard_map variants too) once per ladder bucket up to the table
+        size, for the table's natural flag combinations. Returns the
+        number of kernel calls issued."""
+        if self.n == 0:
+            return 0
+        # every ladder bucket at or below n_blocks, PLUS the bucket that
+        # n_blocks itself pads into (a query touching between the largest
+        # whole bucket and n_blocks compiles that one), plus the full-scan
+        # shape past the ladder
+        sizes = sorted({
+            *(m for m in bk.M_BUCKETS if m <= self.n_blocks),
+            min(bk.bucket_of(self.n_blocks), max(self.n_blocks, bk.M_BUCKETS[0])),
+        })
+        if self.n_blocks > bk.M_BUCKETS[-1]:
+            sizes.append(bk.M_BUCKETS[-1] + 1)  # triggers the full-scan shape
+        has_windows = "tbin" in self.col_names
+        # (False, False) is the attribute-only / no-predicate variant
+        # (validity-column projection) — real queries hit it too
+        flag_combos = [(True, False), (False, False)]
+        if has_windows:
+            flag_combos = [(True, True), (True, False), (False, True), (False, False)]
+        calls = 0
+        for m in sizes:
+            blocks = np.arange(min(m, self.n_blocks), dtype=np.int64)
+            for has_boxes, has_w in flag_combos:
+                cfg = ScanConfig(
+                    index="warmup",
+                    range_bins=np.zeros(1, np.int32),
+                    range_lo=np.zeros(1, np.uint64),
+                    range_hi=np.zeros(1, np.uint64),
+                    boxes=np.array([[0.0, 0.0, 1e-6, 1e-6]], np.float32)
+                    if has_boxes else None,
+                    windows=np.array([[0, 0, 0]], np.int32) if has_w else None,
+                )
+                self._device_scan_submit(blocks, cfg)()
+                calls += 1
+        return calls
+
     @property
     def nbytes_device(self) -> int:
         return sum(int(v.nbytes) for v in self.cols3.values())

@@ -85,3 +85,53 @@ def test_query_many_respects_delta_tier(store):
     assert sum(np.char.startswith(np.asarray(outs[0].ids, dtype=str), "x")) == 50
     after = len(ds.query_many("ev", ["bbox(geom, -180, -90, 180, 90)"])[0])
     assert after == before + 50  # no rows lost or double-counted
+
+
+def test_warmup_compiles_all_variants():
+    """After DataStore.warmup, a fresh mixed query batch triggers NO new
+    XLA compiles. A UNIQUE block size (tile) gives this store distinct
+    kernel shapes, so earlier tests' process-wide jit cache cannot mask a
+    warmup no-op."""
+    import logging
+
+    import jax
+
+    sft = FeatureType.from_spec(
+        "ev", "kind:String:index=true,dtg:Date,*geom:Point:srid=4326"
+    )
+    ds = DataStore(tile=8192)  # SUB=64: shapes unique to this test
+    ds.create_schema(sft)
+    rng = np.random.default_rng(5)
+    n = 60_000
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    ds.write("ev", FeatureCollection.from_columns(
+        sft, [str(i) for i in range(n)],
+        {
+            "kind": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+            "dtg": t0 + rng.integers(0, 20 * DAY, n),
+            "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n)),
+        },
+    ))
+    n_calls = ds.warmup("ev")
+    assert n_calls > 0
+    jax.config.update("jax_log_compiles", True)
+    records: list = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    loggers = [
+        logging.getLogger(n)
+        for n in ("jax._src.dispatch", "jax._src.interpreters.pxla", "jax._src.compiler")
+    ]
+    for lg in loggers:
+        lg.addHandler(handler)
+        lg.setLevel(logging.DEBUG)
+    try:
+        # spatial, spatio-temporal, attribute-only (False/False flags)
+        for q in QUERIES[:3] + ["bbox(geom, 3, 3, 9, 9)"]:
+            ds.query("ev", q)
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg in loggers:
+            lg.removeHandler(handler)
+    compiles = [m for m in records if "Compiling" in m and "block_scan" in m]
+    assert compiles == [], compiles
