@@ -75,6 +75,10 @@ class StatsStore:
                         h.observe(np.asarray(vals, dtype=np.float64))
                         st.histograms[attr.name + suffix] = h
                 continue
+            if attr.type == "Bytes":
+                # opaque blobs: equality/range selectivity sketches are
+                # meaningless and str-hashing binary data crashes
+                continue
             col = np.asarray(col)
             if col.dtype.kind in "iuf" or attr.type == "Date":
                 mm = MinMax()

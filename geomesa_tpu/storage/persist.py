@@ -177,6 +177,7 @@ def _plain_array(col) -> np.ndarray:
 
 
 def _pack_columns(sft: FeatureType, fc: FeatureCollection) -> dict:
+    types = {a.name: a.type for a in sft.attributes}
     out: dict = {"__ids__": _plain_array(fc.ids)}
     for name, col in fc.columns.items():
         if isinstance(col, PointColumn):
@@ -189,6 +190,21 @@ def _pack_columns(sft: FeatureType, fc: FeatureCollection) -> dict:
             out[f"pg:{name}:geom_part_offsets"] = col.geom_part_offsets
             out[f"pg:{name}:types"] = col.types
             out[f"pg:{name}:bboxes"] = col.bboxes
+        elif types.get(name) == "Bytes":
+            # variable-length binary: one concatenated buffer + offsets +
+            # null mask (str()-ing bytes would corrupt them; a mask keeps
+            # None distinct from a genuinely empty payload)
+            arr = np.asarray(col)
+            vals = [b"" if v is None else bytes(v) for v in arr]
+            out[f"by:{name}:data"] = np.frombuffer(
+                b"".join(vals), dtype=np.uint8
+            )
+            out[f"by:{name}:offsets"] = np.cumsum(
+                [0] + [len(v) for v in vals]
+            ).astype(np.int64)
+            out[f"by:{name}:null"] = np.array(
+                [v is None for v in arr], dtype=bool
+            )
         else:
             out[f"col:{name}"] = _plain_array(col)
     return out
@@ -210,6 +226,17 @@ def _unpack_columns(sft: FeatureType, z) -> FeatureCollection:
                 types=z[f"pg:{n}:types"],
                 bboxes=z[f"pg:{n}:bboxes"],
             )
+        elif f"by:{n}:data" in names:
+            data = z[f"by:{n}:data"].tobytes()
+            offs = z[f"by:{n}:offsets"]
+            null = z[f"by:{n}:null"] if f"by:{n}:null" in names else None
+            vals = np.empty(len(offs) - 1, dtype=object)
+            vals[:] = [
+                None if null is not None and null[i]
+                else data[offs[i] : offs[i + 1]]
+                for i in range(len(offs) - 1)
+            ]
+            cols[n] = vals
         elif f"col:{n}" in names:
             cols[n] = z[f"col:{n}"]
         else:

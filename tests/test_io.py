@@ -366,3 +366,58 @@ class TestDbapiConverter:
         np.testing.assert_allclose(x, [1.0, -3.0, 100.0])
         np.testing.assert_allclose(y, [2.0, 4.5, -45.0])
         conn.close()
+
+
+class TestBytesColumns:
+    def test_write_query_persist_roundtrip(self, tmp_path):
+        """Bytes attributes: write must not crash the sketches, queries
+        return them intact, and persistence is binary-safe (str()-ing
+        would corrupt; np.unique on bytes crashes fnv hashing)."""
+        from geomesa_tpu.datastore import DataStore
+
+        sft = FeatureType.from_spec(
+            "b", "payload:Bytes,flag:Boolean,*geom:Point:srid=4326"
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        vals = [b"\x00\x01", b"hello", b"\xff\xfe", b""]
+        payloads = np.empty(4, dtype=object)
+        payloads[:] = vals
+        ds.write("b", FeatureCollection.from_columns(
+            sft, np.arange(4),
+            {"payload": payloads, "flag": np.array([True, False, True, False]),
+             "geom": (np.arange(4.0), np.zeros(4))},
+        ))
+        out = ds.query("b", "bbox(geom, -1, -1, 5, 1)")
+        assert list(out.columns["payload"]) == vals
+        persist.save(ds, tmp_path / "s")
+        ds2 = persist.load(tmp_path / "s")
+        assert list(ds2.features("b").columns["payload"]) == vals
+        assert list(ds2.features("b").columns["flag"]) == [True, False, True, False]
+
+    def test_none_bytes_and_partitioned_path(self, tmp_path):
+        """None stays None through persistence (null mask, distinct from
+        b""), including on the time-partitioned save path."""
+        from geomesa_tpu.datastore import DataStore
+
+        sft = FeatureType.from_spec(
+            "bt", "payload:Bytes,dtg:Date,*geom:Point:srid=4326"
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        vals = [b"x", None, b"", b"\xff"]
+        payloads = np.empty(4, dtype=object)
+        payloads[:] = vals
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        # spread rows across two ~monthly partitions
+        dtg = np.array([t0, t0, t0 + 40 * 86400_000, t0 + 40 * 86400_000])
+        ds.write("bt", FeatureCollection.from_columns(
+            sft, np.arange(4),
+            {"payload": payloads, "dtg": dtg,
+             "geom": (np.arange(4.0), np.zeros(4))},
+        ))
+        persist.save(ds, tmp_path / "s2")
+        ds2 = persist.load(tmp_path / "s2")
+        back = ds2.features("bt")
+        got = {str(i): v for i, v in zip(back.ids, back.columns["payload"])}
+        assert got == {"0": b"x", "1": None, "2": b"", "3": b"\xff"}
