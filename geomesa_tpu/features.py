@@ -215,7 +215,12 @@ class FeatureCollection:
                 )
             elif attr.type in COLUMN_DTYPES:
                 cols[attr.name] = np.array(vals, dtype=COLUMN_DTYPES[attr.type])
-            else:  # String / Bytes / UUID -> unicode
+            elif attr.type == "Bytes":
+                # object column: str() would corrupt binary payloads
+                b = np.empty(n, dtype=object)
+                b[:] = [None if v is None else bytes(v) for v in vals]
+                cols[attr.name] = b
+            else:  # String / UUID -> unicode
                 cols[attr.name] = np.array(
                     ["" if v is None else str(v) for v in vals]
                 )

@@ -206,3 +206,18 @@ class TestXmlConverter:
         ds.write("obs", conv.convert(XML_DOC))
         out = ds.query("obs", "bbox(geom, 100, -90, 180, 0)")
         assert out.ids.tolist() == ["beta"]
+
+
+def test_avro_bytes_column_roundtrip():
+    """Bytes attributes survive the Avro container round trip as real
+    bytes (from_rows used to str() them on decode)."""
+    from geomesa_tpu.io.avro import read_avro, write_avro
+
+    sft = FeatureType.from_spec("b", "payload:Bytes,*geom:Point:srid=4326")
+    p = np.empty(3, dtype=object)
+    p[:] = [b"\x00\x01", None, b"\xff"]
+    fc = FeatureCollection.from_columns(
+        sft, np.arange(3), {"payload": p, "geom": (np.zeros(3), np.zeros(3))}
+    )
+    rt = read_avro(write_avro(fc))
+    assert list(rt.columns["payload"]) == [b"\x00\x01", None, b"\xff"]
