@@ -91,6 +91,10 @@ class StatsStore:
                     h.observe(col.astype(np.float64))
                     st.histograms[attr.name] = h
             else:
+                if col.dtype.kind == "O":
+                    # nulls sketch as "" (IsNull's empty-string semantics);
+                    # np.unique cannot sort mixed None/str
+                    col = np.array(["" if v is None else str(v) for v in col])
                 f = Frequency()
                 f.observe(col)
                 st.frequencies[attr.name] = f

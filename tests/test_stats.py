@@ -266,3 +266,19 @@ class TestTakeBoundsGuard:
         with pytest.raises(IndexError):
             fc.take(np.array([n]))
         assert int(np.asarray(fc.take(np.array([-1])).columns["v"])[0]) == n - 1
+
+
+def test_string_column_with_nones_writes_and_queries():
+    """None in a String column must not crash the write-path sketches
+    (np.unique can't sort mixed None/str); IS NULL and equality still
+    answer correctly."""
+    sft = FeatureType.from_spec("s", "name:String,*geom:Point:srid=4326")
+    ds = DataStore()
+    ds.create_schema(sft)
+    names = np.empty(4, dtype=object)
+    names[:] = ["a", None, "b", None]
+    ds.write("s", FeatureCollection.from_columns(
+        sft, np.arange(4), {"name": names, "geom": (np.arange(4.0), np.zeros(4))}
+    ))
+    assert sorted(np.asarray(ds.query("s", "name IS NULL").ids, np.int64).tolist()) == [1, 3]
+    assert np.asarray(ds.query("s", "name = 'a'").ids, np.int64).tolist() == [0]
