@@ -186,3 +186,385 @@ class TestNewStFunctions:
         assert abs(g2.x - p.x) < 1e-7
         # registry dispatch path
         assert F.st_call("st_geohash", p, 5) == str(F.st_geohash(p, 5))
+
+
+class TestUdfParitySweep:
+    """Reference spark-jts UDF parity: typed constructors, casts,
+    dimension/simplicity accessors, GeoJSON, DE-9IM relations, sphere
+    metrics, closest point, antimeridian split, limited overlay."""
+
+    def test_typed_wkt_constructors(self):
+        from geomesa_tpu.sql import functions as F
+
+        assert F.st_pointfromtext("POINT (3 4)").y == 4
+        assert F.st_linefromtext("LINESTRING (0 0, 1 1)").length > 0
+        assert F.st_polygonfromtext("POLYGON ((0 0, 1 0, 1 1, 0 0))").area > 0
+        assert len(F.st_mpointfromtext("MULTIPOINT ((0 0), (1 1))").parts) == 2
+        assert len(F.st_mlinefromtext(
+            "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))").parts) == 2
+        assert len(F.st_mpolyfromtext(
+            "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)))").parts) == 1
+        with pytest.raises(TypeError):
+            F.st_pointfromtext("LINESTRING (0 0, 1 1)")
+        p = F.st_pointfromwkb(geo.to_wkb(geo.Point(5, 6)))
+        assert (p.x, p.y) == (5, 6)
+        ring = geo.LineString(
+            np.array([[0, 0], [2, 0], [2, 2], [0, 0]], float))
+        assert isinstance(F.st_polygon(ring), geo.Polygon)
+        box = F.st_makebox(geo.Point(0, 1), geo.Point(2, 3))
+        assert box.bounds() == (0, 1, 2, 3)
+        assert F.st_makepointm(1, 2, 99).x == 1
+
+    def test_casts(self):
+        from geomesa_tpu.sql import functions as F
+
+        p = geo.Point(1, 2)
+        assert F.st_casttogeometry(p) is p
+        assert F.st_casttopoint(p) is p
+        with pytest.raises(TypeError):
+            F.st_casttolinestring(p)
+        with pytest.raises(TypeError):
+            F.st_casttopolygon(p)
+
+    def test_dimension_accessors(self):
+        from geomesa_tpu.sql import functions as F
+
+        line = geo.LineString(np.array([[0, 0], [1, 1]], float))
+        assert F.st_coorddim(line) == 2
+        assert F.st_dimension(geo.Point(0, 0)) == 0
+        assert F.st_dimension(line) == 1
+        assert F.st_dimension(geo.box(0, 0, 1, 1)) == 2
+        assert F.st_dimension(geo.MultiPolygon([geo.box(0, 0, 1, 1)])) == 2
+        assert not F.st_isempty(line)
+        assert F.st_isempty(geo.MultiPoint([]))
+        assert F.st_iscollection(geo.MultiPoint([]))
+        assert not F.st_iscollection(line)
+
+    def test_closed_simple_ring(self):
+        from geomesa_tpu.sql import functions as F
+
+        open_l = geo.LineString(np.array([[0, 0], [1, 1], [2, 0]], float))
+        ring = geo.LineString(
+            np.array([[0, 0], [1, 0], [1, 1], [0, 0]], float))
+        bowtie = geo.LineString(
+            np.array([[0, 0], [2, 2], [2, 0], [0, 2]], float))
+        assert not F.st_isclosed(open_l)
+        assert F.st_isclosed(ring)
+        assert F.st_issimple(open_l)
+        assert F.st_issimple(ring)
+        assert not F.st_issimple(bowtie)
+        assert F.st_isring(ring)
+        assert not F.st_isring(open_l)
+        dup = geo.MultiPoint([geo.Point(1, 1), geo.Point(1, 1)])
+        assert not F.st_issimple(dup)
+
+    def test_geojson_roundtrip(self):
+        import json
+
+        from geomesa_tpu.sql import functions as F
+
+        poly = geo.Polygon(
+            np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], float),
+            [np.array([[1, 1], [1, 2], [2, 2], [2, 1], [1, 1]], float)],
+        )
+        for g in (
+            geo.Point(1, 2),
+            geo.LineString(np.array([[0, 0], [1, 1]], float)),
+            poly,
+            geo.MultiPoint([geo.Point(0, 0), geo.Point(1, 1)]),
+            geo.MultiPolygon([poly]),
+        ):
+            s = F.st_asgeojson(g)
+            assert json.loads(s)["type"] == g.geom_type
+            g2 = F.st_geomfromgeojson(s)
+            assert g2 == g
+
+    def test_latlontext_bytearray(self):
+        from geomesa_tpu.sql import functions as F
+
+        txt = F.st_aslatlontext(geo.Point(-122.5, 37.75))
+        assert txt.endswith("W") and "N" in txt and "37°45'" in txt
+        assert F.st_bytearray("abc") == b"abc"
+
+    def test_touches_crosses(self):
+        from geomesa_tpu.sql import functions as F
+
+        a = geo.box(0, 0, 2, 2)
+        b = geo.box(2, 0, 4, 2)      # shares an edge with a
+        c = geo.box(1, 1, 3, 3)      # overlaps a
+        assert F.st_touches(a, b)
+        assert not F.st_touches(a, c)
+        line_through = geo.LineString(np.array([[-1, 1], [3, 1]], float))
+        line_touch = geo.LineString(np.array([[-1, 0], [3, 0]], float))
+        assert F.st_crosses(line_through, a)
+        assert not F.st_crosses(line_touch, a)
+        assert F.st_touches(line_touch, a)
+        # L/L proper crossing vs shared-run overlap
+        l1 = geo.LineString(np.array([[0, 0], [2, 2]], float))
+        l2 = geo.LineString(np.array([[0, 2], [2, 0]], float))
+        l3 = geo.LineString(np.array([[1, 1], [3, 3]], float))
+        assert F.st_crosses(l1, l2)
+        assert not F.st_crosses(l1, l3)  # collinear overlap, not a cross
+        # point in polygon interior crosses nothing (P/A is within)
+        assert not F.st_crosses(geo.Point(1, 1), a)
+
+    def test_relate(self):
+        from geomesa_tpu.sql import functions as F
+
+        a = geo.box(0, 0, 2, 2)
+        b = geo.box(2, 0, 4, 2)
+        c = geo.box(1, 1, 3, 3)
+        far = geo.box(10, 10, 11, 11)
+        assert F.st_relate(a, b) == "FF2F11212"   # edge-adjacent squares (JTS)
+        assert F.st_relatebool(a, b, "FF*FT****")  # touches pattern
+        assert F.st_relatebool(a, c, "T*T***T**")  # overlaps pattern
+        assert F.st_relatebool(a, far, "FF*FF****")  # disjoint
+        inside = geo.Point(1, 1)
+        assert F.st_relatebool(inside, a, "T*F**F***")  # within pattern
+
+    def test_sphere_metrics(self):
+        from geomesa_tpu.sql import functions as F
+
+        sf = geo.Point(-122.4194, 37.7749)
+        la = geo.Point(-118.2437, 34.0522)
+        d = F.st_distancesphere(sf, la)
+        assert 550_000 < d < 570_000  # ~559 km
+        line = geo.LineString(np.array([[-122.4194, 37.7749],
+                                        [-118.2437, 34.0522]], float))
+        assert abs(F.st_lengthsphere(line) - d) < 1.0
+        assert abs(F.st_aggregatedistancesphere([sf, la]) - d) < 1.0
+        assert F.st_aggregatedistancesphere([sf]) == 0.0
+
+    def test_closestpoint(self):
+        from geomesa_tpu.sql import functions as F
+
+        sq = geo.box(0, 0, 2, 2)
+        p = F.st_closestpoint(sq, geo.Point(5, 1))
+        assert (p.x, p.y) == (2, 1)
+        line = geo.LineString(np.array([[0, 0], [10, 0]], float))
+        p2 = F.st_closestpoint(line, geo.Point(3, 4))
+        assert (p2.x, p2.y) == (3, 0)
+        # crossing lines: the closest point is the crossing itself
+        l1 = geo.LineString(np.array([[0, 0], [2, 2]], float))
+        l2 = geo.LineString(np.array([[0, 2], [2, 0]], float))
+        px = F.st_closestpoint(l1, l2)
+        assert abs(px.x - 1) < 1e-9 and abs(px.y - 1) < 1e-9
+
+    def test_makevalid(self):
+        from geomesa_tpu.sql import functions as F
+
+        # ring with a duplicated vertex and an open end
+        ring = np.array([[0, 0], [0, 0], [4, 0], [4, 4], [0, 4]], float)
+        fixed = F.st_makevalid(geo.LineString(ring))
+        c = np.asarray(fixed.coords)
+        assert len(c) == 4  # duplicate dropped
+
+    def test_antimeridian_safe(self):
+        from geomesa_tpu.sql import functions as F
+
+        # polygon spanning 170..-170 (crosses the antimeridian)
+        poly = geo.Polygon(np.array(
+            [[170, 0], [-170, 0], [-170, 10], [170, 10], [170, 0]], float))
+        safe = F.st_antimeridiansafegeom(poly)
+        assert isinstance(safe, geo.MultiPolygon)
+        assert len(safe.parts) == 2
+        areas = sorted(p.area for p in safe.parts)
+        assert abs(sum(areas) - 200.0) < 1e-6  # 20 deg x 10 deg total
+        bounds = [p.bounds() for p in safe.parts]
+        assert all(b[2] <= 180.0 and b[0] >= -180.0 for b in bounds)
+        # non-crossing geometries pass through untouched
+        small = geo.box(0, 0, 1, 1)
+        assert F.st_antimeridiansafegeom(small) is small
+        line = geo.LineString(np.array([[175, 0], [-175, 5]], float))
+        safe_l = F.st_antimeridiansafegeom(line)
+        assert isinstance(safe_l, geo.MultiLineString)
+        assert len(safe_l.parts) == 2
+
+    def test_intersection_point_line(self):
+        from geomesa_tpu.sql import functions as F
+
+        sq = geo.box(0, 0, 4, 4)
+        assert F.st_intersection(geo.Point(1, 1), sq) == geo.Point(1, 1)
+        assert F.st_intersection(geo.Point(9, 9), sq)._coord_count() == 0
+        line = geo.LineString(np.array([[-2, 2], [6, 2]], float))
+        seg = F.st_intersection(line, sq)
+        assert isinstance(seg, geo.LineString)
+        c = np.asarray(seg.coords)
+        assert c[0].tolist() == [0, 2] and c[-1].tolist() == [4, 2]
+        # line passing outside
+        miss = geo.LineString(np.array([[-2, 9], [6, 9]], float))
+        assert F.st_intersection(miss, sq)._coord_count() == 0
+
+    def test_intersection_polygons(self):
+        from geomesa_tpu.sql import functions as F
+
+        a = geo.box(0, 0, 4, 4)
+        b = geo.box(2, 2, 6, 6)
+        out = F.st_intersection(a, b)
+        assert isinstance(out, geo.Polygon)
+        assert abs(out.area - 4.0) < 1e-9
+        assert out.bounds() == (2, 2, 4, 4)
+        # disjoint -> empty
+        assert F.st_intersection(a, geo.box(9, 9, 10, 10))._coord_count() == 0
+        # concave x concave raises rather than approximating
+        concave = geo.Polygon(np.array(
+            [[0, 0], [4, 0], [4, 4], [2, 1], [0, 4], [0, 0]], float))
+        with pytest.raises(ValueError):
+            F.st_intersection(concave, concave)
+
+    def test_difference(self):
+        from geomesa_tpu.sql import functions as F
+
+        sq = geo.box(0, 0, 4, 4)
+        assert F.st_difference(geo.Point(9, 9), sq) == geo.Point(9, 9)
+        line = geo.LineString(np.array([[-2, 2], [6, 2]], float))
+        out = F.st_difference(line, sq)
+        assert isinstance(out, geo.MultiLineString)
+        assert len(out.parts) == 2
+        total = sum(p.length for p in out.parts)
+        assert abs(total - 4.0) < 1e-9  # 2 outside on each side
+
+    def test_registry_covers_reference_names(self):
+        """Every implemented name resolves through st_call with the
+        reference's CamelCase spelling."""
+        from geomesa_tpu.sql import FUNCTIONS, st_call
+
+        assert len(FUNCTIONS) >= 75
+        sq = geo.box(0, 0, 2, 2)
+        assert st_call("ST_Touches", sq, geo.box(2, 0, 4, 2))
+        assert st_call("ST_Dimension", sq) == 2
+        assert st_call("ST_IsCollection", geo.MultiPoint([]))
+
+
+class TestUdfReviewFixes:
+    """Regression pins for the code-review findings on the UDF sweep."""
+
+    def test_antimeridian_line_west_piece_bounds(self):
+        from geomesa_tpu.sql import functions as F
+
+        line = geo.LineString(np.array([[175, 0], [-175, 5]], float))
+        safe = F.st_antimeridiansafegeom(line)
+        for part in safe.parts:
+            x0, _, x1, _ = part.bounds()
+            assert x1 - x0 <= 10.0, f"piece spans the map: {part.bounds()}"
+        # the west piece starts exactly at -180
+        west = min(safe.parts, key=lambda p: p.bounds()[0])
+        assert west.bounds()[0] == -180.0
+
+    def test_closestpoint_multipoint(self):
+        from geomesa_tpu.sql import functions as F
+
+        mp = geo.MultiPoint([geo.Point(0, 0), geo.Point(1, 1)])
+        p = F.st_closestpoint(mp, geo.Point(5, 5))
+        assert (p.x, p.y) == (1, 1)
+        # point-typed right operand against a polygon left operand
+        sq = geo.box(0, 0, 2, 2)
+        p2 = F.st_closestpoint(sq, mp)  # intersecting: a shared point
+        assert geo.intersects(geo.Point(p2.x, p2.y), sq)
+
+    def test_line_through_polygon_vertices(self):
+        from geomesa_tpu.sql import functions as F
+
+        sq = geo.box(0, 0, 2, 2)
+        diag = geo.LineString(np.array([[-1, -1], [3, 3]], float))
+        assert F.st_crosses(diag, sq)
+        assert not F.st_touches(diag, sq)
+        # symmetric corner-to-corner through-vertex entry (midpoint of the
+        # single edge is the box corner itself)
+        diag2 = geo.LineString(np.array([[-3, -3], [3, 3]], float))
+        assert F.st_crosses(diag2, sq)
+        # L/L crossing through a vertex of the other line
+        bent = geo.LineString(np.array([[0, 0], [1, 1], [2, 0]], float))
+        vert = geo.LineString(np.array([[1, 0], [1, 2]], float))
+        assert F.st_crosses(bent, vert)
+
+    def test_closed_line_boundary_empty(self):
+        from geomesa_tpu.sql import functions as F
+
+        ring = geo.LineString(np.array([[1, 1], [2, 1], [2, 2], [1, 1]], float))
+        assert F.st_boundary(ring)._coord_count() == 0
+        sq = geo.box(0, 0, 4, 4)
+        m = F.st_relate(ring, sq)
+        assert m[3] == "F"  # BI: closed line has no boundary
+        # mod-2: two open parts sharing one endpoint -> 2 odd endpoints
+        a = geo.LineString(np.array([[0, 0], [1, 0]], float))
+        b = geo.LineString(np.array([[1, 0], [2, 0]], float))
+        bd = F.st_boundary(geo.MultiLineString([a, b]))
+        assert sorted((p.x, p.y) for p in bd.parts) == [(0, 0), (2, 0)]
+
+    def test_dms_carry(self):
+        from geomesa_tpu.sql import functions as F
+
+        txt = F.st_aslatlontext(geo.Point(0.0, 8.9999999999))
+        assert txt.startswith("9°0'0.000\"N")
+        assert "60.000" not in txt
+
+    def test_simple_large_line_fast(self):
+        import time
+
+        from geomesa_tpu.sql import functions as F
+
+        t = np.linspace(0, 50 * np.pi, 20000)
+        spiral = geo.LineString(np.stack([t * np.cos(t), t * np.sin(t)], 1))
+        t0 = time.monotonic()
+        assert F.st_issimple(spiral)
+        assert time.monotonic() - t0 < 10.0
+
+
+class TestUdfReviewFixes2:
+    """Second review pass: boundary-identical interiors, on-meridian
+    vertices, chained-multiline interiors, degenerate overlay inputs."""
+
+    def test_equal_polygons_not_touching(self):
+        from geomesa_tpu.sql import functions as F
+
+        a = geo.box(0, 0, 2, 2)
+        assert not F.st_touches(a, geo.box(0, 0, 2, 2))
+        assert F.st_relate(a, geo.box(0, 0, 2, 2))[0] != "F"  # II nonempty
+        # one polygon tracing part of the other's boundary, overlapping
+        half = geo.box(0, 0, 1, 2)
+        assert not F.st_touches(a, half)
+
+    def test_antimeridian_vertex_on_meridian(self):
+        from geomesa_tpu.sql import functions as F
+
+        line = geo.LineString(np.array([[170, 0], [180, 0], [-170, 0]], float))
+        safe = F.st_antimeridiansafegeom(line)
+        parts = safe.parts if hasattr(safe, "parts") else [safe]
+        for p in parts:
+            x0, _, x1, _ = p.bounds()
+            assert -180.0 <= x0 and x1 <= 180.0, p.bounds()
+            assert x1 - x0 <= 10.0
+
+    def test_chained_multiline_interior_node(self):
+        from geomesa_tpu.sql import functions as F
+
+        chain = geo.MultiLineString([
+            geo.LineString(np.array([[0, 0], [1, 0]], float)),
+            geo.LineString(np.array([[1, 0], [2, 0]], float)),
+        ])
+        # (1,0) is interior by the mod-2 rule: a point there is WITHIN
+        assert not F.st_touches(geo.Point(1, 0), chain)
+        assert F.st_touches(geo.Point(0, 0), chain)  # a true endpoint
+
+    def test_makevalid_collapsed_shell(self):
+        from geomesa_tpu.sql import functions as F
+
+        degenerate = geo.Polygon(
+            np.array([[1, 1], [1, 1], [1, 1], [1, 1]], float))
+        out = F.st_makevalid(degenerate)
+        assert out._coord_count() == 0  # empty, not a crash
+
+    def test_disconnected_concave_intersection_refused(self):
+        from geomesa_tpu.sql import functions as F
+
+        u_shape = geo.Polygon(np.array(
+            [[0, 0], [5, 0], [5, 4], [4, 4], [4, 1], [1, 1], [1, 4],
+             [0, 4], [0, 0]], float))
+        band = geo.box(-1, 2, 6, 5)  # cuts the U into two prongs
+        with pytest.raises(ValueError):
+            F.st_intersection(u_shape, band)
+        # connected concave intersection still works
+        low_band = geo.box(-1, -1, 6, 0.5)
+        out = F.st_intersection(u_shape, low_band)
+        assert abs(out.area - 2.5) < 1e-9  # 5 wide x 0.5 tall
