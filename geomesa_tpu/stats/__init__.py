@@ -6,20 +6,24 @@ Reference: geomesa-index-api stats/ + geomesa-utils stats/ (SURVEY.md §2.2,
 
 from geomesa_tpu.stats.sketches import (
     CountStat,
+    DescriptiveStats,
     Frequency,
     Histogram,
     MinMax,
     TopK,
+    Z3Frequency,
     Z3Histogram,
 )
 from geomesa_tpu.stats.store import StatsStore
 
 __all__ = [
     "CountStat",
+    "DescriptiveStats",
     "Frequency",
     "Histogram",
     "MinMax",
     "TopK",
+    "Z3Frequency",
     "Z3Histogram",
     "StatsStore",
 ]

@@ -15,7 +15,16 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MinMax", "Histogram", "Frequency", "TopK", "Z3Histogram", "CountStat"]
+__all__ = [
+    "MinMax",
+    "Histogram",
+    "Frequency",
+    "TopK",
+    "Z3Histogram",
+    "CountStat",
+    "DescriptiveStats",
+    "Z3Frequency",
+]
 
 
 class CountStat:
@@ -217,6 +226,188 @@ class TopK:
 
     def to_json(self):
         return {"top": [[v, int(c)] for v, c in self.top()]}
+
+
+class DescriptiveStats:
+    """Mergeable moments over one or more numeric attributes: count, min,
+    max, sum, mean, population/sample variance + stddev, skewness,
+    kurtosis, and pairwise population/sample covariance + correlation
+    (reference DescriptiveStats.scala, which wraps commons-math; here the
+    moments are held directly and merged with Chan's parallel-update
+    formulas, so per-shard sketches combine exactly).
+    """
+
+    def __init__(self, n_attrs: int = 1):
+        d = n_attrs
+        self.d = d
+        self.count = 0
+        self.min = np.full(d, np.inf)
+        self.max = np.full(d, -np.inf)
+        self.mean = np.zeros(d)
+        self.m2 = np.zeros(d)  # sum of squared deviations (univariate)
+        self.m3 = np.zeros(d)
+        self.m4 = np.zeros(d)
+        self.comoment = np.zeros((d, d))  # sum of deviation products
+
+    def observe(self, *cols) -> None:
+        x = np.stack(
+            [np.asarray(c, dtype=np.float64) for c in cols], axis=1
+        )  # [n, d]
+        if x.shape[1] != self.d:
+            raise ValueError(f"expected {self.d} columns, got {x.shape[1]}")
+        # NaN is the null representation for numeric columns (see
+        # filter/predicates IS NULL): a null in any attribute drops the
+        # row, keeping the covariance pairing consistent (the reference
+        # skips null attributes the same way)
+        x = x[~np.isnan(x).any(axis=1)]
+        n = len(x)
+        if n == 0:
+            return
+        other = DescriptiveStats.__new__(DescriptiveStats)
+        other.d = self.d
+        other.count = n
+        other.min = x.min(axis=0)
+        other.max = x.max(axis=0)
+        other.mean = x.mean(axis=0)
+        dev = x - other.mean
+        other.m2 = (dev**2).sum(axis=0)
+        other.m3 = (dev**3).sum(axis=0)
+        other.m4 = (dev**4).sum(axis=0)
+        other.comoment = dev.T @ dev
+        self += other
+
+    def __iadd__(self, other: "DescriptiveStats") -> "DescriptiveStats":
+        if other.count == 0:
+            return self
+        if self.count == 0:
+            for f in ("count", "min", "max", "mean", "m2", "m3", "m4", "comoment"):
+                setattr(self, f, getattr(other, f))
+            return self
+        na, nb = self.count, other.count
+        n = na + nb
+        delta = other.mean - self.mean
+        # Chan et al. pairwise central-moment updates
+        m2 = self.m2 + other.m2 + delta**2 * na * nb / n
+        m3 = (
+            self.m3
+            + other.m3
+            + delta**3 * na * nb * (na - nb) / n**2
+            + 3.0 * delta * (na * other.m2 - nb * self.m2) / n
+        )
+        m4 = (
+            self.m4
+            + other.m4
+            + delta**4 * na * nb * (na**2 - na * nb + nb**2) / n**3
+            + 6.0 * delta**2 * (na**2 * other.m2 + nb**2 * self.m2) / n**2
+            + 4.0 * delta * (na * other.m3 - nb * self.m3) / n
+        )
+        self.comoment = (
+            self.comoment + other.comoment + np.outer(delta, delta) * na * nb / n
+        )
+        self.mean = self.mean + delta * nb / n
+        self.m2, self.m3, self.m4 = m2, m3, m4
+        self.min = np.minimum(self.min, other.min)
+        self.max = np.maximum(self.max, other.max)
+        self.count = n
+        return self
+
+    @property
+    def sum(self) -> np.ndarray:
+        return self.mean * self.count
+
+    def variance(self, sample: bool = True) -> np.ndarray:
+        div = max(self.count - 1, 1) if sample else max(self.count, 1)
+        return self.m2 / div
+
+    def stddev(self, sample: bool = True) -> np.ndarray:
+        return np.sqrt(self.variance(sample))
+
+    def skewness(self) -> np.ndarray:
+        """Population skewness g1 = (M3/n) / (M2/n)^1.5."""
+        n = max(self.count, 1)
+        s2 = self.m2 / n
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = (self.m3 / n) / np.power(s2, 1.5)
+        return np.where(s2 > 0, out, 0.0)
+
+    def kurtosis(self) -> np.ndarray:
+        """Population excess kurtosis g2 = n*M4/M2^2 - 3."""
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = self.count * self.m4 / self.m2**2 - 3.0
+        return np.where(self.m2 > 0, out, 0.0)
+
+    def covariance(self, sample: bool = True) -> np.ndarray:
+        div = max(self.count - 1, 1) if sample else max(self.count, 1)
+        return self.comoment / div
+
+    def correlation(self) -> np.ndarray:
+        sd = np.sqrt(np.diag(self.comoment))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = self.comoment / np.outer(sd, sd)
+        return np.where(np.outer(sd, sd) > 0, out, 0.0)
+
+    def to_json(self):
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": int(self.count),
+            "min": self.min.tolist(),
+            "max": self.max.tolist(),
+            "sum": self.sum.tolist(),
+            "mean": self.mean.tolist(),
+            "stddev_sample": self.stddev(True).tolist(),
+            "variance_sample": self.variance(True).tolist(),
+            "stddev_population": self.stddev(False).tolist(),
+            "variance_population": self.variance(False).tolist(),
+            "skewness": np.asarray(self.skewness()).tolist(),
+            "kurtosis": np.asarray(self.kurtosis()).tolist(),
+            "covariance_sample": self.covariance(True).tolist(),
+            "correlation": self.correlation().tolist(),
+        }
+
+
+class Z3Frequency:
+    """Count-min sketch keyed by (time bin, z3 prefix) cells: point-query
+    selectivity for spatio-temporal values, complementing Z3Histogram's
+    range estimates (reference Z3Frequency.scala)."""
+
+    def __init__(self, total_bits: int, prefix_bits: int = 16,
+                 depth: int = 4, width: int = 4096):
+        if not 1 <= prefix_bits <= 48:
+            raise ValueError(f"prefix_bits must be in [1, 48]: {prefix_bits}")
+        self.shift = np.uint64(max(0, total_bits - prefix_bits))
+        # retained z bits; bins occupy the field ABOVE them so distinct
+        # (bin, prefix) cells can never alias
+        self._prefix_bits = np.uint64(min(prefix_bits, total_bits))
+        self.freq = Frequency(depth=depth, width=width)
+
+    def _keys(self, bins, zs) -> np.ndarray:
+        return (
+            np.asarray(bins, dtype=np.uint64) << self._prefix_bits
+        ) | (np.asarray(zs, dtype=np.uint64) >> self.shift)
+
+    def observe(self, bins: np.ndarray, zs: np.ndarray) -> None:
+        self.freq.observe(self._keys(bins, zs))
+
+    def __iadd__(self, other: "Z3Frequency") -> "Z3Frequency":
+        if (self.shift, self._prefix_bits) != (other.shift, other._prefix_bits):
+            raise ValueError(
+                "cannot merge Z3Frequency sketches with different "
+                f"resolutions: {self.to_json()} vs {other.to_json()}"
+            )
+        self.freq += other.freq
+        return self
+
+    @property
+    def count(self) -> int:
+        return self.freq.count
+
+    def estimate(self, tbin: int, z: int) -> int:
+        """Upper-bound count of rows in the cell containing (bin, z)."""
+        return self.freq.estimate(self._keys([tbin], [z])[0])
+
+    def to_json(self):
+        return {"shift": int(self.shift), **self.freq.to_json()}
 
 
 class Z3Histogram:

@@ -11,7 +11,11 @@ utils/stats/Stat.scala:30-120) driving server-side StatsScan aggregation
     TopK(attr[,k])
     Frequency(attr[,width])      -> count-min sketch
     Histogram(attr,bins,lo,hi)
+    DescriptiveStats(attr[,attr2,...]) -> moments + covariance/correlation
     GroupBy(attr,<stat>)         -> one sub-stat per distinct value
+
+A ';'-separated list IS the reference's SeqStat: parse() returns one
+sketch per term and merge is element-wise.
 
 Stats evaluate column-at-a-time over a FeatureCollection (the reference
 folds one feature at a time inside iterators) and merge with ``+=`` for
@@ -24,7 +28,14 @@ import re
 
 import numpy as np
 
-from geomesa_tpu.stats.sketches import CountStat, Frequency, Histogram, MinMax, TopK
+from geomesa_tpu.stats.sketches import (
+    CountStat,
+    DescriptiveStats,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+)
 
 _CALL = re.compile(r"^\s*(\w+)\((.*)\)\s*$", re.S)
 
@@ -65,6 +76,9 @@ class _Eval:
         sk = self.make()
         if self.kind == "count":
             sk.observe(np.zeros(len(fc)))
+            return sk
+        if self.kind == "descriptive":  # attr is a LIST of attributes
+            sk.observe(*[_column(fc, a) for a in self.attr])
             return sk
         col = _column(fc, self.attr)
         if self.kind == "groupby":
@@ -108,6 +122,13 @@ def parse_one(spec: str) -> _Eval:
     if name == "histogram":
         bins, lo, hi = int(args[1]), float(args[2]), float(args[3])
         return _Eval("histogram", _strip(args[0]), lambda: Histogram(bins, lo, hi))
+    if name in ("descriptivestats", "descriptive", "stats"):
+        attrs = [_strip(a) for a in args]
+        if not attrs:
+            raise ValueError("DescriptiveStats requires at least one attribute")
+        return _Eval(
+            "descriptive", attrs, lambda: DescriptiveStats(len(attrs))
+        )
     if name == "groupby":
         # sub-stats re-enter the term grammar, which is ';'-separated
         return _Eval("groupby", _strip(args[0]), dict, sub=";".join(args[1:]))

@@ -282,3 +282,160 @@ def test_string_column_with_nones_writes_and_queries():
     ))
     assert sorted(np.asarray(ds.query("s", "name IS NULL").ids, np.int64).tolist()) == [1, 3]
     assert np.asarray(ds.query("s", "name = 'a'").ids, np.int64).tolist() == [0]
+
+
+class TestDescriptiveStats:
+    """Mergeable moments sketch vs numpy ground truth (reference
+    DescriptiveStats.scala)."""
+
+    def test_univariate_vs_numpy(self):
+        from geomesa_tpu.stats.sketches import DescriptiveStats
+
+        rng = np.random.default_rng(3)
+        x = rng.gamma(2.0, 3.0, 10_000)  # skewed so g1/g2 are non-trivial
+        d = DescriptiveStats(1)
+        d.observe(x)
+        assert d.count == len(x)
+        assert d.min[0] == x.min() and d.max[0] == x.max()
+        assert d.mean[0] == pytest.approx(x.mean(), rel=1e-12)
+        assert d.variance(sample=False)[0] == pytest.approx(x.var(), rel=1e-10)
+        assert d.variance(sample=True)[0] == pytest.approx(x.var(ddof=1), rel=1e-10)
+        m = x.mean()
+        g1 = np.mean((x - m) ** 3) / np.var(x) ** 1.5
+        g2 = np.mean((x - m) ** 4) / np.var(x) ** 2 - 3.0
+        assert d.skewness()[0] == pytest.approx(g1, rel=1e-8)
+        assert d.kurtosis()[0] == pytest.approx(g2, rel=1e-8)
+
+    def test_merge_exact(self):
+        from geomesa_tpu.stats.sketches import DescriptiveStats
+
+        rng = np.random.default_rng(4)
+        x = rng.normal(5, 2, 5000)
+        y = 0.5 * x + rng.normal(0, 1, 5000)
+        whole = DescriptiveStats(2)
+        whole.observe(x, y)
+        merged = DescriptiveStats(2)
+        for lo, hi in ((0, 1234), (1234, 1235), (1235, 5000)):
+            part = DescriptiveStats(2)
+            part.observe(x[lo:hi], y[lo:hi])
+            merged += part
+        assert merged.count == whole.count
+        np.testing.assert_allclose(merged.mean, whole.mean, rtol=1e-12)
+        np.testing.assert_allclose(merged.m2, whole.m2, rtol=1e-9)
+        np.testing.assert_allclose(merged.m3, whole.m3, rtol=1e-8)
+        np.testing.assert_allclose(merged.m4, whole.m4, rtol=1e-8)
+        np.testing.assert_allclose(merged.comoment, whole.comoment, rtol=1e-9)
+
+    def test_covariance_correlation(self):
+        from geomesa_tpu.stats.sketches import DescriptiveStats
+
+        rng = np.random.default_rng(5)
+        x = rng.normal(0, 1, 8000)
+        y = 0.8 * x + rng.normal(0, 0.6, 8000)
+        d = DescriptiveStats(2)
+        d.observe(x, y)
+        want = np.cov(np.stack([x, y]), ddof=1)
+        np.testing.assert_allclose(d.covariance(True), want, rtol=1e-9)
+        corr = np.corrcoef(x, y)
+        np.testing.assert_allclose(d.correlation(), corr, rtol=1e-9)
+        j = d.to_json()
+        assert j["count"] == 8000 and len(j["correlation"]) == 2
+
+    def test_empty_and_dsl(self):
+        from geomesa_tpu.stats import stat_spec
+        from geomesa_tpu.stats.sketches import DescriptiveStats
+
+        assert DescriptiveStats(1).to_json() == {"count": 0}
+        sft = FeatureType.from_spec("d", "a:Double,b:Double,*geom:Point:srid=4326")
+        n = 100
+        rng = np.random.default_rng(6)
+        a, b = rng.normal(0, 1, n), rng.normal(0, 1, n)
+        fc = FeatureCollection.from_columns(
+            sft, np.arange(n).astype(str),
+            {"a": a, "b": b, "geom": (np.zeros(n), np.zeros(n))},
+        )
+        (res,) = stat_spec.evaluate("DescriptiveStats(a,b)", fc)
+        assert res.count == n
+        assert res.mean[0] == pytest.approx(a.mean())
+        # SeqStat: a ';' list yields one sketch per term
+        seq = stat_spec.evaluate("Count();DescriptiveStats(a)", fc)
+        assert len(seq) == 2 and seq[0].count == n
+
+
+class TestZ3Frequency:
+    def test_point_estimates(self):
+        from geomesa_tpu.stats.sketches import Z3Frequency
+
+        rng = np.random.default_rng(7)
+        total_bits = 42
+        zf = Z3Frequency(total_bits=total_bits, prefix_bits=12)
+        # two hot cells + background noise
+        hot_z = np.uint64(0x123) << np.uint64(30)
+        bins = np.concatenate([
+            np.full(5000, 10), np.full(3000, 11),
+            rng.integers(0, 8, 2000),
+        ]).astype(np.uint64)
+        zs = np.concatenate([
+            np.full(5000, hot_z),
+            np.full(3000, hot_z),
+            rng.integers(0, 1 << 42, 2000).astype(np.uint64),
+        ])
+        zf.observe(bins, zs)
+        assert zf.count == 10000
+        # count-min overestimates only
+        assert zf.estimate(10, int(hot_z)) >= 5000
+        assert zf.estimate(11, int(hot_z)) >= 3000
+        assert zf.estimate(10, int(hot_z)) <= 5000 + 2000
+        # a cold cell stays near zero
+        assert zf.estimate(300, 0) < 500
+
+    def test_merge(self):
+        from geomesa_tpu.stats.sketches import Z3Frequency
+
+        a = Z3Frequency(total_bits=42)
+        b = Z3Frequency(total_bits=42)
+        a.observe(np.full(100, 5), np.full(100, 1 << 20))
+        b.observe(np.full(50, 5), np.full(50, 1 << 20))
+        a += b
+        assert a.estimate(5, 1 << 20) >= 150
+
+
+class TestStatsReviewFixes:
+    def test_nan_rows_skipped(self):
+        from geomesa_tpu.stats.sketches import DescriptiveStats
+
+        x = np.array([1.0, 2.0, np.nan, 4.0])
+        y = np.array([10.0, 20.0, 30.0, 40.0])
+        d = DescriptiveStats(2)
+        d.observe(x, y)
+        assert d.count == 3  # NaN row dropped entirely
+        assert d.mean[0] == pytest.approx(np.mean([1, 2, 4]))
+        assert d.mean[1] == pytest.approx(np.mean([10, 20, 40]))
+        assert not np.isnan(d.variance()).any()
+
+    def test_z3frequency_merge_mismatch_refused(self):
+        from geomesa_tpu.stats.sketches import Z3Frequency
+
+        a = Z3Frequency(total_bits=42, prefix_bits=12)
+        b = Z3Frequency(total_bits=42, prefix_bits=16)
+        with pytest.raises(ValueError):
+            a += b
+        with pytest.raises(ValueError):
+            Z3Frequency(total_bits=42, prefix_bits=0)
+
+    def test_z3frequency_no_bin_alias(self):
+        from geomesa_tpu.stats.sketches import Z3Frequency
+
+        # full-resolution prefix: z occupies 42 bits; bins must not bleed
+        zf = Z3Frequency(total_bits=42, prefix_bits=42)
+        z_big = (1 << 40) + 17
+        zf.observe(np.full(1000, 0), np.full(1000, z_big))
+        assert zf.estimate(0, z_big) >= 1000
+        assert zf.estimate(1, z_big) < 500  # distinct bin, same z
+        assert zf.estimate(1, z_big - (1 << 40)) < 500
+
+    def test_empty_spec_rejected(self):
+        from geomesa_tpu.stats import stat_spec
+
+        with pytest.raises(ValueError, match="at least one attribute"):
+            stat_spec.parse("DescriptiveStats()")
