@@ -229,3 +229,24 @@ class ArrowDeltaWriter:
         if self._writer is not None:
             self._writer.close()
         return self._sink.getvalue().to_pybytes()
+
+
+def flat_point_table(fc: FeatureCollection, dictionary: bool = True):
+    """Arrow table with point geometries flattened to ``<geom>_x`` /
+    ``<geom>_y`` double columns — the shared layout of the Parquet and
+    ORC writers (flat columns carry per-group/stripe statistics; nested
+    FixedSizeList columns do not)."""
+    import numpy as np
+
+    from geomesa_tpu.filter.predicates import PointColumn
+
+    pa = _pa()
+    table = to_arrow_table(fc, dictionary=dictionary)
+    geom = fc.sft.geom_field
+    if geom is not None and isinstance(fc.geom_column, PointColumn):
+        i = table.schema.get_field_index(geom)
+        table = table.remove_column(i)
+        col = fc.geom_column
+        table = table.append_column(f"{geom}_x", pa.array(np.asarray(col.x)))
+        table = table.append_column(f"{geom}_y", pa.array(np.asarray(col.y)))
+    return table

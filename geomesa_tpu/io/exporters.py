@@ -20,7 +20,10 @@ from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
 
-FORMATS = ("csv", "tsv", "geojson", "wkt", "json", "gml", "arrow", "avro", "parquet")
+FORMATS = (
+    "csv", "tsv", "geojson", "wkt", "json", "gml", "arrow", "avro",
+    "parquet", "orc", "leaflet",
+)
 
 
 def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | bytes":
@@ -51,6 +54,16 @@ def export(fc: FeatureCollection, fmt: str, fh: IO | None = None) -> "str | byte
         buf = _io.BytesIO()
         write_parquet(fc, buf)
         payload = buf.getvalue()
+    elif fmt == "orc":
+        import io as _io
+
+        from geomesa_tpu.io.orc import write_orc
+
+        buf = _io.BytesIO()
+        write_orc(fc, buf)
+        payload = buf.getvalue()
+    elif fmt == "leaflet":
+        payload = _leaflet(fc)
     else:
         raise ValueError(f"unknown format {fmt!r}; supported: {FORMATS}")
     if fh is not None:
@@ -263,3 +276,51 @@ def _gml(fc: FeatureCollection) -> str:
         parts.append(f"</geomesa:{name}></gml:featureMember>\n")
     parts.append("</gml:FeatureCollection>\n")
     return "".join(parts)
+
+
+def _leaflet(fc: FeatureCollection) -> str:
+    """Self-contained Leaflet HTML map with the features inlined as a
+    GeoJSON FeatureCollection (reference LeafletMapExporter: HTML shell +
+    CDN leaflet + `var points = <geojson>` + a density-weighted heat
+    layer; here the heat tint rides per-marker opacity)."""
+    from xml.sax.saxutils import escape
+
+    # '</' must not appear literally inside the <script> block: a string
+    # attribute containing '</script>' would otherwise terminate it and
+    # inject attacker-controlled markup into the exported page
+    gj = _geojson(fc).replace("</", "<\\/")
+    xs, ys = (
+        fc.representative_xy() if len(fc) and fc.sft.geom_field else ([0.0], [0.0])
+    )
+    cx = float(np.mean(np.asarray(ys))) if len(ys) else 0.0
+    cy = float(np.mean(np.asarray(xs))) if len(xs) else 0.0
+    title = escape(fc.sft.name)
+    return f"""<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>{title}</title>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>html, body, #map {{ height: 100%; margin: 0; }}</style>
+</head>
+<body>
+<div id="map"></div>
+<script>
+var points = {gj};
+var map = L.map('map').setView([{cx:.6f}, {cy:.6f}], 3);
+L.tileLayer('https://{{s}}.tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+  {{ attribution: '&copy; OpenStreetMap contributors' }}).addTo(map);
+var layer = L.geoJSON(points, {{
+  pointToLayer: function (feature, latlng) {{
+    return L.circleMarker(latlng, {{ radius: 4, weight: 1, fillOpacity: 0.6 }});
+  }},
+  onEachFeature: function (feature, l) {{
+    l.bindPopup('<pre>' + JSON.stringify(feature.properties, null, 1) + '</pre>');
+  }}
+}}).addTo(map);
+if (layer.getBounds().isValid()) {{ map.fitBounds(layer.getBounds()); }}
+</script>
+</body>
+</html>
+"""

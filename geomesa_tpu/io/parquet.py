@@ -32,22 +32,11 @@ def write_parquet(
     plain ``<geom>_x`` / ``<geom>_y`` double columns (so Parquet
     column statistics support bbox push-down); extent geometries a WKB
     binary column."""
-    import pyarrow as pa
     import pyarrow.parquet as pq
 
-    from geomesa_tpu.filter.predicates import PointColumn
-    from geomesa_tpu.io.arrow import to_arrow_table
+    from geomesa_tpu.io.arrow import flat_point_table
 
-    table = to_arrow_table(fc, dictionary=True)
-    geom = fc.sft.geom_field
-    if geom is not None and isinstance(fc.geom_column, PointColumn):
-        # replace the FixedSizeList arrow layout with two flat columns:
-        # parquet keeps min/max stats per row group on flat columns only
-        i = table.schema.get_field_index(geom)
-        table = table.remove_column(i)
-        col = fc.geom_column
-        table = table.append_column(f"{geom}_x", pa.array(np.asarray(col.x)))
-        table = table.append_column(f"{geom}_y", pa.array(np.asarray(col.y)))
+    table = flat_point_table(fc, dictionary=True)
     meta = dict(table.schema.metadata or {})
     meta[_SFT_KEY] = fc.sft.to_spec().encode()
     meta[_NAME_KEY] = fc.sft.name.encode()

@@ -421,3 +421,163 @@ class TestBytesColumns:
         back = ds2.features("bt")
         got = {str(i): v for i, v in zip(back.ids, back.columns["payload"])}
         assert got == {"0": b"x", "1": None, "2": b"", "3": b"\xff"}
+
+
+class TestOrc:
+    """ORC feature IO + the file-pruning OrcStorage directory
+    (reference OrcFileSystemStorage)."""
+
+    @staticmethod
+    def _fc(n=300, seed=0, name="orcs"):
+        rng = np.random.default_rng(seed)
+        sft = FeatureType.from_spec(
+            name, "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+        )
+        t0 = np.datetime64("2024-03-01T00:00:00", "ms").astype(np.int64)
+        return FeatureCollection.from_columns(
+            sft,
+            np.arange(n).astype(str),
+            {
+                "name": np.array([f"n{i % 17}" for i in range(n)], dtype=object),
+                "age": rng.integers(0, 90, n),
+                "dtg": t0 + rng.integers(0, 20 * 86400_000, n),
+                "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n)),
+            },
+        )
+
+    def test_roundtrip(self, tmp_path):
+        from geomesa_tpu.io.orc import read_orc, write_orc
+
+        fc = self._fc()
+        path = str(tmp_path / "f.orc")
+        write_orc(fc, path)
+        fc2 = read_orc(path)  # schema from the sidecar
+        assert fc2.sft.to_spec() == fc.sft.to_spec()
+        assert fc2.ids.tolist() == fc.ids.tolist()
+        np.testing.assert_array_equal(fc2.columns["age"], fc.columns["age"])
+        np.testing.assert_array_equal(fc2.columns["dtg"], fc.columns["dtg"])
+        assert list(fc2.columns["name"]) == list(fc.columns["name"])
+        np.testing.assert_allclose(fc2.geom_column.x, fc.geom_column.x)
+
+    def test_bbox_filter(self, tmp_path):
+        from geomesa_tpu.io.orc import read_orc, write_orc
+
+        fc = self._fc()
+        path = str(tmp_path / "f.orc")
+        write_orc(fc, path)
+        bbox = (-10.0, -10.0, 20.0, 15.0)
+        got = read_orc(path, bbox=bbox)
+        x, y = np.asarray(fc.geom_column.x), np.asarray(fc.geom_column.y)
+        m = (x >= bbox[0]) & (x <= bbox[2]) & (y >= bbox[1]) & (y <= bbox[3])
+        assert sorted(got.ids.tolist()) == sorted(np.asarray(fc.ids)[m].tolist())
+
+    def test_extent_geometries(self, tmp_path):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.io.orc import read_orc, write_orc
+
+        sft = FeatureType.from_spec("polys", "v:Int,*geom:Polygon:srid=4326")
+        polys = [geo.box(i, i, i + 2, i + 1) for i in range(5)]
+        fc = FeatureCollection.from_columns(
+            sft, np.arange(5).astype(str),
+            {"v": np.arange(5), "geom": polys},
+        )
+        path = str(tmp_path / "p.orc")
+        write_orc(fc, path)
+        fc2 = read_orc(path)
+        assert fc2.geom_column.geometry(3) == polys[3]
+
+    def test_storage_prunes_files(self, tmp_path):
+        from geomesa_tpu.io.orc import OrcStorage
+
+        root = str(tmp_path / "store")
+        st = OrcStorage(root)
+        # three spatially separated chunks
+        west = self._fc(seed=1)
+        west.geom_column.x[:] = np.abs(west.geom_column.x) * -1 - 100  # [-160,-100]
+        east = self._fc(seed=2)
+        east.geom_column.x[:] = np.abs(east.geom_column.x) + 100  # [100, 160]
+        mid = self._fc(seed=3)
+        st.write(west)
+        st.write(east)
+        st.write(mid)
+        assert len(st.meta["files"]) == 3
+        # a query box straddling only the east chunk prunes the others
+        files = st.files(bbox=(110, -10, 120, 10))
+        assert len(files) == 1 and "chunk-000001" in files[0]
+        got = st.query(bbox=(110, -10, 120, 10))
+        x = np.asarray(east.geom_column.x)
+        y = np.asarray(east.geom_column.y)
+        m = (x >= 110) & (x <= 120) & (y >= -10) & (y <= 10)
+        assert sorted(got.ids.tolist()) == sorted(np.asarray(east.ids)[m].tolist())
+        # reopening sees the same metadata
+        from geomesa_tpu.io.orc import OrcStorage as S2
+
+        st2 = S2(root)
+        assert len(st2.files()) == 3
+        assert st2.query(bbox=(0, 0, 1, 1)) is not None
+
+    def test_export_format(self):
+        import io as _io
+
+        import pyarrow.orc as orc
+
+        from geomesa_tpu.io.exporters import export
+
+        fc = self._fc(n=50)
+        payload = export(fc, "orc")
+        assert isinstance(payload, bytes)
+        t = orc.ORCFile(_io.BytesIO(payload)).read()
+        assert t.num_rows == 50 and "geom_x" in t.column_names
+
+
+class TestLeaflet:
+    def test_html_payload(self):
+        from geomesa_tpu.io.exporters import export
+
+        fc = TestOrc._fc(n=20, name="mapped")
+        html = export(fc, "leaflet")
+        assert html.startswith("<!DOCTYPE html>")
+        assert "var points = " in html
+        assert "L.geoJSON(points" in html
+        assert '"type": "FeatureCollection"' in html
+        # all 20 features inlined
+        assert html.count('"type": "Feature"') == 20
+
+
+class TestOrcLeafletReviewFixes:
+    def test_extent_storage_bbox_query(self, tmp_path):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.io.orc import OrcStorage
+
+        sft = FeatureType.from_spec("fp", "v:Int,*geom:Polygon:srid=4326")
+        polys = [geo.box(4 * i, 0, 4 * i + 3, 2) for i in range(10)]
+        fc = FeatureCollection.from_columns(
+            sft, np.arange(10).astype(str),
+            {"v": np.arange(10), "geom": polys},
+        )
+        st = OrcStorage(str(tmp_path / "s"))
+        st.write(fc)
+        got = st.query(bbox=(5, 0.5, 12, 1.5))  # intersects polys 1..3
+        assert sorted(got.ids.tolist()) == ["1", "2", "3"]
+
+    def test_leaflet_script_injection_escaped(self):
+        from geomesa_tpu.io.exporters import export
+
+        sft = FeatureType.from_spec("x<y", "name:String,*geom:Point:srid=4326")
+        fc = FeatureCollection.from_columns(
+            sft, ["0"],
+            {"name": np.array(["</script><img src=x onerror=alert(1)>"],
+                              dtype=object),
+             "geom": (np.array([1.0]), np.array([2.0]))},
+        )
+        html = export(fc, "leaflet")
+        assert "</script><img" not in html
+        assert "<title>x&lt;y</title>" in html
+
+    def test_uncompressed_orc(self, tmp_path):
+        from geomesa_tpu.io.orc import read_orc, write_orc
+
+        fc = TestOrc._fc(n=10)
+        path = str(tmp_path / "u.orc")
+        write_orc(fc, path, compression="uncompressed")
+        assert len(read_orc(path)) == 10
