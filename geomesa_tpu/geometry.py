@@ -469,6 +469,23 @@ def is_rectangle(g: "Geometry") -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _gather_rows(src: np.ndarray, flat: np.ndarray) -> np.ndarray:
+    """out[i] = src[flat[i]] through the threaded native row gather when
+    the pull is big enough to matter and the indices fit u32; the
+    random-row reads dominate big result pulls (PERF.md §4c)."""
+    if (
+        len(flat) > (1 << 16)
+        and int(flat.min()) >= 0
+        and int(flat.max()) < (1 << 32)
+    ):
+        from geomesa_tpu import native
+
+        out = native.take_rows(src, flat)
+        if out is not None:
+            return out
+    return src[flat]
+
+
 @dataclass
 class PackedGeometryColumn:
     """Arrow-style nested-list layout for a column of geometries.
@@ -571,7 +588,7 @@ class PackedGeometryColumn:
         lo = np.nextafter(b[:, :2].astype(np.float32), -np.inf)
         hi = np.nextafter(b[:, 2:].astype(np.float32), np.inf)
         idx = np.arange(n + 1, dtype=np.int32)
-        return PackedGeometryColumn(
+        col = PackedGeometryColumn(
             coords=coords.reshape(-1, 2),
             ring_offsets=idx * 5,
             part_ring_offsets=idx,
@@ -579,6 +596,14 @@ class PackedGeometryColumn:
             types=np.full(n, POLYGON, dtype=np.int8),
             bboxes=np.concatenate([lo, hi], axis=1).astype(np.float32),
         )
+        # every row is a rectangle by construction: seed the box_info
+        # cache (exact f64 bounds) so queries never pay the O(n) lazy
+        # rectangle detection on this column or its take() descendants,
+        # and mark the uniform 5-vertex layout so take() can use one
+        # width-10 row gather instead of nested offset expansion
+        col._box_info = (np.ones(n, dtype=bool), b.copy())
+        col._uniform_rect = True
+        return col
 
     def box_info(self) -> tuple[np.ndarray, np.ndarray]:
         """(mask [n] bool, bounds [n, 4] f64): which geometries are plain
@@ -678,6 +703,9 @@ class PackedGeometryColumn:
         """
         idx = np.asarray(idx, dtype=np.int64)
 
+        if getattr(self, "_uniform_rect", False):
+            return self._take_uniform_rect(idx)
+
         def expand(starts, ends):
             """Concatenate aranges [starts[i], ends[i]) -> flat index list."""
             lens = ends - starts
@@ -699,14 +727,45 @@ class PackedGeometryColumn:
             self.ring_offsets[r_flat].astype(np.int64),
             self.ring_offsets[r_flat + 1].astype(np.int64),
         )
-        return PackedGeometryColumn(
-            coords=self.coords[c_flat],
+
+        rows = _gather_rows
+        col = PackedGeometryColumn(
+            coords=rows(self.coords, c_flat),
             ring_offsets=ro,
             part_ring_offsets=pro,
             geom_part_offsets=gpo,
             types=self.types[idx],
-            bboxes=self.bboxes[idx],
+            bboxes=rows(self.bboxes, idx),
         )
+        cached = getattr(self, "_box_info", None)
+        if cached is not None:  # rectangle classification survives a subset
+            col._box_info = (cached[0][idx], rows(cached[1], idx))
+        return col
+
+    def _take_uniform_rect(self, idx: np.ndarray) -> "PackedGeometryColumn":
+        """take() fast path for from_boxes columns: every geometry is one
+        5-vertex ring, so the subset is a single [n, 10] row gather plus
+        arange offsets — ~5x fewer latency-bound lookups than the generic
+        nested expansion."""
+        rows = _gather_rows
+        n = len(idx)
+        coords10 = rows(
+            np.ascontiguousarray(self.coords).reshape(len(self), 10), idx
+        )
+        off = np.arange(n + 1, dtype=np.int32)
+        col = PackedGeometryColumn(
+            coords=coords10.reshape(-1, 2),
+            ring_offsets=off * 5,
+            part_ring_offsets=off,
+            geom_part_offsets=off,
+            types=self.types[idx],
+            bboxes=rows(self.bboxes, idx),
+        )
+        cached = getattr(self, "_box_info", None)
+        if cached is not None:
+            col._box_info = (cached[0][idx], rows(cached[1], idx))
+        col._uniform_rect = True
+        return col
 
     @staticmethod
     def concat(cols: Sequence["PackedGeometryColumn"]) -> "PackedGeometryColumn":
@@ -728,7 +787,7 @@ class PackedGeometryColumn:
         part_shift = np.concatenate(
             [[0], np.cumsum([len(c.part_ring_offsets) - 1 for c in cols])]
         )
-        return PackedGeometryColumn(
+        out = PackedGeometryColumn(
             coords=np.concatenate([c.coords for c in cols], axis=0),
             ring_offsets=stack_offsets([c.ring_offsets for c in cols], coord_shift),
             part_ring_offsets=stack_offsets(
@@ -740,6 +799,15 @@ class PackedGeometryColumn:
             types=np.concatenate([c.types for c in cols]),
             bboxes=np.concatenate([c.bboxes for c in cols], axis=0),
         )
+        caches = [getattr(c, "_box_info", None) for c in cols]
+        if all(c is not None for c in caches):
+            out._box_info = (
+                np.concatenate([c[0] for c in caches]),
+                np.concatenate([c[1] for c in caches], axis=0),
+            )
+        if all(getattr(c, "_uniform_rect", False) for c in cols):
+            out._uniform_rect = True
+        return out
 
 
 def pad_polygon(poly: "Polygon | MultiPolygon", max_verts: int):

@@ -250,3 +250,41 @@ def flat_point_table(fc: FeatureCollection, dictionary: bool = True):
         table = table.append_column(f"{geom}_x", pa.array(np.asarray(col.x)))
         table = table.append_column(f"{geom}_y", pa.array(np.asarray(col.y)))
     return table
+
+
+def table_to_collection(table, sft) -> FeatureCollection:
+    """Decode an arrow Table in the flat_point_table layout back into a
+    FeatureCollection — the single reader shared by the Parquet and ORC
+    formats (point x/y or WKB geometry, Date millis, dictionary or plain
+    strings, Bytes blobs)."""
+    import numpy as np
+
+    from geomesa_tpu import geometry as geo
+
+    geom = sft.geom_field
+    cols: dict = {}
+    for a in sft.attributes:
+        if a.name == geom:
+            if f"{geom}_x" in table.column_names:
+                cols[geom] = (
+                    np.asarray(table[f"{geom}_x"], dtype=np.float64),
+                    np.asarray(table[f"{geom}_y"], dtype=np.float64),
+                )
+            else:
+                cols[geom] = geo.PackedGeometryColumn.from_geometries(
+                    [geo.from_wkb(b) for b in table[geom].to_pylist()]
+                )
+            continue
+        arr = table[a.name]
+        if a.type == "Date":
+            cols[a.name] = np.asarray(arr).astype("datetime64[ms]").astype(np.int64)
+        elif a.type in ("String", "UUID", "Bytes"):
+            a2 = arr.combine_chunks()
+            try:  # dictionary-encoded on write (parquet)
+                a2 = a2.dictionary_decode()
+            except AttributeError:
+                pass
+            cols[a.name] = np.asarray(a2.to_pylist(), dtype=object)
+        else:
+            cols[a.name] = np.asarray(arr)
+    return FeatureCollection.from_columns(sft, np.asarray(table["id"]), cols)

@@ -316,7 +316,14 @@ var layer = L.geoJSON(points, {{
     return L.circleMarker(latlng, {{ radius: 4, weight: 1, fillOpacity: 0.6 }});
   }},
   onEachFeature: function (feature, l) {{
-    l.bindPopup('<pre>' + JSON.stringify(feature.properties, null, 1) + '</pre>');
+    var esc = function (s) {{
+      return s.replace(/[&<>]/g, function (c) {{
+        return {{'&': '&amp;', '<': '&lt;', '>': '&gt;'}}[c];
+      }});
+    }};
+    // bindPopup renders HTML: attribute values must be escaped or a
+    // hostile string attribute executes in the reader's browser
+    l.bindPopup('<pre>' + esc(JSON.stringify(feature.properties, null, 1)) + '</pre>');
   }}
 }}).addTo(map);
 if (layer.getBounds().isValid()) {{ map.fitBounds(layer.getBounds()); }}

@@ -50,35 +50,6 @@ def write_orc(fc: FeatureCollection, path, compression: str = "zstd") -> None:
             json.dump({"name": fc.sft.name, "spec": fc.sft.to_spec()}, f)
 
 
-def _table_to_fc(table, sft: FeatureType) -> FeatureCollection:
-    from geomesa_tpu import geometry as geo
-
-    geom = sft.geom_field
-    cols: dict = {}
-    for a in sft.attributes:
-        if a.name == geom:
-            if f"{geom}_x" in table.column_names:
-                cols[geom] = (
-                    np.asarray(table[f"{geom}_x"], dtype=np.float64),
-                    np.asarray(table[f"{geom}_y"], dtype=np.float64),
-                )
-            else:
-                cols[geom] = geo.PackedGeometryColumn.from_geometries(
-                    [geo.from_wkb(b) for b in table[geom].to_pylist()]
-                )
-            continue
-        arr = table[a.name]
-        if a.type == "Date":
-            cols[a.name] = np.asarray(arr).astype("datetime64[ms]").astype(np.int64)
-        elif a.type in ("String", "UUID"):
-            cols[a.name] = np.asarray(arr.to_pylist(), dtype=object)
-        elif a.type == "Bytes":
-            cols[a.name] = np.asarray(arr.to_pylist(), dtype=object)
-        else:
-            cols[a.name] = np.asarray(arr)
-    return FeatureCollection.from_columns(sft, np.asarray(table["id"]), cols)
-
-
 def read_orc(
     path,
     sft: "FeatureType | None" = None,
@@ -100,7 +71,9 @@ def read_orc(
             meta = json.load(f)
         sft = FeatureType.from_spec(meta["name"], meta["spec"])
     table = orc.ORCFile(path).read()
-    fc = _table_to_fc(table, sft)
+    from geomesa_tpu.io.arrow import table_to_collection
+
+    fc = table_to_collection(table, sft)
     if bbox is not None:
         geom = sft.geom_field
         x0, y0, x1, y1 = bbox
@@ -156,7 +129,8 @@ class OrcStorage:
 
         col = fc.geom_column
         if len(fc) == 0 or col is None:
-            bbox = [0.0, 0.0, -1.0, -1.0]  # empty extent matches nothing
+            # inverted infinite extent: prunes against EVERY query box
+            bbox = [float("inf"), float("inf"), float("-inf"), float("-inf")]
         elif isinstance(col, PointColumn):
             bbox = [
                 float(np.min(col.x)), float(np.min(col.y)),

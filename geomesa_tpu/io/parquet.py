@@ -57,8 +57,6 @@ def read_parquet(
     point schemas — the FilterConverter push-down analogue."""
     import pyarrow.parquet as pq
 
-    from geomesa_tpu import geometry as geo
-
     schema = pq.read_schema(path)  # footer only; the data reads once below
     meta = schema.metadata or {}
     if sft is None:
@@ -82,31 +80,6 @@ def read_parquet(
         ]
     table = pq.read_table(path, filters=filters)
 
-    cols: dict = {}
-    for a in sft.attributes:
-        if a.name == geom:
-            if f"{geom}_x" in table.column_names:
-                cols[geom] = (
-                    np.asarray(table[f"{geom}_x"], dtype=np.float64),
-                    np.asarray(table[f"{geom}_y"], dtype=np.float64),
-                )
-            else:
-                wkbs = table[geom].to_pylist()
-                cols[geom] = geo.PackedGeometryColumn.from_geometries(
-                    [geo.from_wkb(b) for b in wkbs]
-                )
-            continue
-        arr = table[a.name]
-        if a.type == "Date":
-            cols[a.name] = np.asarray(arr).astype("datetime64[ms]").astype(np.int64)
-        elif a.type in ("String", "UUID"):
-            a2 = arr.combine_chunks()
-            try:  # dictionary-encoded on write
-                a2 = a2.dictionary_decode()
-            except AttributeError:
-                pass
-            cols[a.name] = np.asarray(a2.to_pylist(), dtype=object)
-        else:
-            cols[a.name] = np.asarray(arr)
-    ids = np.asarray(table["id"])
-    return FeatureCollection.from_columns(sft, ids, cols)
+    from geomesa_tpu.io.arrow import table_to_collection
+
+    return table_to_collection(table, sft)

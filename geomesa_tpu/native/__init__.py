@@ -86,6 +86,10 @@ def _load():
             ("gather_u64", u64p), ("gather_f64", f64p),
         ):
             getattr(lib, name).argtypes = [tp, u32p, ctypes.c_int64, tp]
+        for name, tp in (("gather_rows_f32", f32p), ("gather_rows_f64", f64p)):
+            getattr(lib, name).argtypes = [
+                tp, u32p, ctypes.c_int64, ctypes.c_int64, tp
+            ]
         lib.zranges_cpp.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
             u64p, u64p, u64p, u64p,
@@ -245,6 +249,27 @@ def take(src: np.ndarray, idx: np.ndarray) -> "np.ndarray | None":
     idx = np.ascontiguousarray(idx, dtype=np.uint32)
     out = np.empty(len(idx), dtype=src.dtype)
     getattr(lib, name)(src, idx, len(idx), out)
+    return out
+
+
+_ROW_GATHERS = {
+    np.dtype(np.float32): "gather_rows_f32",
+    np.dtype(np.float64): "gather_rows_f64",
+}
+
+
+def take_rows(src: np.ndarray, idx: np.ndarray) -> "np.ndarray | None":
+    """out[i, :] = src[idx[i], :] for f32/f64 [n, width] arrays, or None.
+    The threaded row gather hides the random-access memory latency that
+    dominates numpy fancy indexing on multi-100k-row result pulls."""
+    lib = _load()
+    name = _ROW_GATHERS.get(src.dtype)
+    if lib is None or name is None or src.ndim != 2:
+        return None
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.uint32)
+    out = np.empty((len(idx), src.shape[1]), dtype=src.dtype)
+    getattr(lib, name)(src, idx, len(idx), src.shape[1], out)
     return out
 
 

@@ -250,6 +250,28 @@ extern "C" void gather_f64(const double* src, const uint32_t* idx, int64_t n, do
   for (int64_t i = 0; i < n; ++i) out[i] = src[idx[i]];
 }
 
+// row gathers for [n, width] arrays (packed-geometry coords/bboxes):
+// out[i, :] = src[idx[i], :]. The random-row reads are memory-latency
+// bound; threads hide the misses.
+extern "C" void gather_rows_f64(const double* src, const uint32_t* idx,
+                                int64_t n, int64_t width, double* out) {
+#pragma omp parallel for schedule(static) if (n > 65536)
+  for (int64_t i = 0; i < n; ++i) {
+    const double* s = src + (int64_t)idx[i] * width;
+    double* o = out + i * width;
+    for (int64_t w = 0; w < width; ++w) o[w] = s[w];
+  }
+}
+extern "C" void gather_rows_f32(const float* src, const uint32_t* idx,
+                                int64_t n, int64_t width, float* out) {
+#pragma omp parallel for schedule(static) if (n > 65536)
+  for (int64_t i = 0; i < n; ++i) {
+    const float* s = src + (int64_t)idx[i] * width;
+    float* o = out + i * width;
+    for (int64_t w = 0; w < width; ++w) o[w] = s[w];
+  }
+}
+
 // -------------------------------------------------------- z-range BFS
 // Query planning hot path: covering z-ranges for a union of ordinal boxes
 // (reference ZN.zranges quad/oct BFS + Tropf/Herzog zdiv tightening,

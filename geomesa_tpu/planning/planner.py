@@ -375,12 +375,17 @@ class QueryPlanner:
                     sub_mask = plan.filter.evaluate(candidates.take(unc).batch)
                 keep = certain.copy()
                 keep[unc] = sub_mask
-                candidates = candidates.mask(keep)
+                # all-true keep: `candidates` is already a fresh gather
+                # (fc.take above), so skipping the re-gather is safe and
+                # halves the host cost when refinement drops nothing
+                if not bool(keep.all()):
+                    candidates = candidates.mask(keep)
         elif not isinstance(plan.filter, Include):
             check_deadline(deadline, "residual refinement start")
             with exp.span("Residual filter refinement"):
                 mask = plan.filter.evaluate(candidates.batch)
-            candidates = candidates.mask(mask)
+            if not bool(np.all(mask)):  # see all-true note above
+                candidates = candidates.mask(mask)
         check_deadline(deadline, "refinement")
         return self._post(candidates, plan, hints, exp, skip_visibility)
 

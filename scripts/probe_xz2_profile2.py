@@ -1,0 +1,60 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.filter import ecql
+from geomesa_tpu.scan import block_kernels as bk
+
+n = 10_000_000
+rng = np.random.default_rng(62)
+cx = rng.uniform(-160, 160, 256); cy = rng.uniform(-55, 65, 256)
+which = rng.integers(0, 256, n)
+x0 = np.clip(cx[which] + rng.normal(0, 0.5, n), -179.9, 179.8)
+y0 = np.clip(cy[which] + rng.normal(0, 0.4, n), -89.9, 89.8)
+w = rng.uniform(0.0002, 0.002, n); h = rng.uniform(0.0002, 0.002, n)
+col = geo.PackedGeometryColumn.from_boxes(x0, y0, x0+w, y0+h)
+sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+sft.user_data["geomesa.indices.enabled"] = "xz2"
+ds = DataStore(); ds.create_schema(sft)
+fc = FeatureCollection.from_columns(sft, np.arange(n), {"geom": col})
+ds.write("bld", fc, check_ids=False)
+table = ds.table("bld", "xz2")
+print("n_blocks total:", table.n_blocks, "cols:", table.col_names, flush=True)
+
+idx = ds.indexes("bld")[0]
+
+def mk(seed, k):
+    r = np.random.default_rng(seed); out = []
+    for _ in range(k):
+        c = r.integers(0, 256); qw = float(r.choice([0.02, 0.05, 0.1, 0.5, 2.0]))
+        qx = cx[c]+r.uniform(-1, 1); qy = cy[c]+r.uniform(-0.8, 0.8)
+        poly = (f"POLYGON(({qx:.4f} {qy:.4f}, {qx+qw:.4f} {qy:.4f}, "
+                f"{qx+qw:.4f} {qy+qw:.4f}, {qx:.4f} {qy+qw:.4f}, {qx:.4f} {qy:.4f}))")
+        out.append(f"INTERSECTS(geom, {poly})")
+    return out
+
+for q in mk(1, 12):
+    ds.query("bld", q)  # warm compile
+
+for q in mk(2, 8):
+    cfg = idx.scan_config(ecql.parse(q))
+    t0 = time.perf_counter()
+    overlap, contained = table.candidate_spans_split(cfg)
+    t_spans = time.perf_counter() - t0
+    blocks = table.candidate_blocks(overlap)
+    blocks2 = table._full_or(blocks)
+    bids, n_real = bk.pad_bids(blocks2, table.n_blocks)
+    t1 = time.perf_counter()
+    finish = table._device_scan_submit(blocks, cfg)
+    jax.block_until_ready  # no-op marker
+    t_dispatch = time.perf_counter() - t1
+    t2 = time.perf_counter()
+    rows, certain = finish()
+    t_finish = time.perf_counter() - t2
+    print(f"spans={len(overlap):4d}+{len(contained):3d}  blocks={len(blocks):5d} bucket={len(bids):5d} "
+          f"spans_ms={t_spans*1e3:6.1f} dispatch_ms={t_dispatch*1e3:6.1f} "
+          f"finish_ms={t_finish*1e3:6.1f} rows={len(rows):6d}", flush=True)

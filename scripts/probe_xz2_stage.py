@@ -1,0 +1,54 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, cProfile, pstats
+import numpy as np
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.planning.explain import Explainer
+
+n = 50_000_000
+rng = np.random.default_rng(62)
+cx = rng.uniform(-160, 160, 256); cy = rng.uniform(-55, 65, 256)
+which = rng.integers(0, 256, n)
+x0 = np.clip(cx[which] + rng.normal(0, 0.5, n), -179.9, 179.8)
+y0 = np.clip(cy[which] + rng.normal(0, 0.4, n), -89.9, 89.8)
+w = rng.uniform(0.0002, 0.002, n); h = rng.uniform(0.0002, 0.002, n)
+col = geo.PackedGeometryColumn.from_boxes(x0, y0, x0+w, y0+h)
+sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+sft.user_data["geomesa.indices.enabled"] = "xz2"
+ds = DataStore(); ds.create_schema(sft)
+fc = FeatureCollection.from_columns(sft, np.arange(n), {"geom": col})
+ds.write("bld", fc, check_ids=False)
+
+r = np.random.default_rng(20020)
+# rebuild the worst query from probe seed 2: find a 2deg query with many hits
+qs = []
+rr = np.random.default_rng(2)
+for _ in range(40):
+    c = rr.integers(0, 256); qw = float(rr.choice([0.02, 0.05, 0.1, 0.5, 2.0]))
+    qx = cx[c]+rr.uniform(-1, 1); qy = cy[c]+rr.uniform(-0.8, 0.8)
+    qs.append((qw, qx, qy))
+# warm
+from geomesa_tpu.filter import ecql
+def q_of(qw, qx, qy):
+    return (f"INTERSECTS(geom, POLYGON(({qx:.4f} {qy:.4f}, {qx+qw:.4f} {qy:.4f}, "
+            f"{qx+qw:.4f} {qy+qw:.4f}, {qx:.4f} {qy+qw:.4f}, {qx:.4f} {qy:.4f})))")
+for qw, qx, qy in qs[:10]:
+    ds.query("bld", q_of(qw, qx, qy))
+# the biggest: run explain + cProfile
+best = max(qs, key=lambda t: t[0])
+q = q_of(*best)
+res = ds.query("bld", q)
+print("hits", len(res.ids), flush=True)
+e = Explainer()
+t0 = time.perf_counter()
+res = ds.query("bld", q, explain=e)
+print("total", round((time.perf_counter()-t0)*1e3), "ms")
+print(e.render())
+pr = cProfile.Profile(); pr.enable()
+for _ in range(3):
+    ds.query("bld", q)
+pr.disable()
+st = pstats.Stats(pr); st.sort_stats("cumulative")
+st.print_stats(18)

@@ -581,3 +581,16 @@ class TestOrcLeafletReviewFixes:
         path = str(tmp_path / "u.orc")
         write_orc(fc, path, compression="uncompressed")
         assert len(read_orc(path)) == 10
+
+
+class TestOrcEmptyChunk:
+    def test_empty_chunk_always_pruned(self, tmp_path):
+        from geomesa_tpu.io.orc import OrcStorage
+
+        st = OrcStorage(str(tmp_path / "s"))
+        st.write(TestOrc._fc(n=0))  # empty chunk
+        st.write(TestOrc._fc(n=50, seed=9))
+        # an origin-spanning box must still prune the empty chunk
+        files = st.files(bbox=(-1.0, -1.0, 1.0, 1.0))
+        assert all("chunk-000000" not in f for f in files)
+        assert st.query(bbox=(-1.0, -1.0, 1.0, 1.0)) is not None

@@ -568,3 +568,23 @@ class TestUdfReviewFixes2:
         low_band = geo.box(-1, -1, 6, 0.5)
         out = F.st_intersection(u_shape, low_band)
         assert abs(out.area - 2.5) < 1e-9  # 5 wide x 0.5 tall
+
+
+class TestLeafletPopupEscape:
+    def test_popup_sink_escaped(self):
+        import numpy as np
+
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.io.exporters import export
+        from geomesa_tpu.sft import FeatureType
+
+        sft = FeatureType.from_spec("m", "name:String,*geom:Point:srid=4326")
+        fc = FeatureCollection.from_columns(
+            sft, ["0"],
+            {"name": np.array(["<img src=x onerror=alert(1)>"], dtype=object),
+             "geom": (np.array([1.0]), np.array([2.0]))},
+        )
+        html = export(fc, "leaflet")
+        # the hostile value rides inside the GeoJSON (JS string), and the
+        # popup renderer escapes before inserting as HTML
+        assert "esc(JSON.stringify" in html
