@@ -115,6 +115,9 @@ def cmd_ingest(args) -> int:
     else:
         ds = DataStore()
 
+    if getattr(args, "file_format", None):
+        return _ingest_direct(ds, args)
+
     if not args.infer and args.workers and args.workers > 1:
         # distributed-ingest mode: process-pool converters, single writer
         from geomesa_tpu.io.ingest import ingest_files
@@ -150,19 +153,10 @@ def cmd_ingest(args) -> int:
             header = rows[0] if args.header else None
             body = rows[1:] if args.header else rows
             sft, conv = infer_schema(args.feature_name, body, header=header)
-            if args.feature_name not in ds.type_names():
-                ds.create_schema(sft)
-            else:
-                # a later file must infer the same shape as the stored
-                # schema — silently concatenating mismatched columns (Int
-                # vs Double, different geometry pair) corrupts the store
-                stored = ds.get_schema(args.feature_name).to_spec()
-                if sft.to_spec() != stored:
-                    raise SystemExit(
-                        f"inferred schema for {path!r} does not match the "
-                        f"existing {args.feature_name!r} schema:\n"
-                        f"  inferred: {sft.to_spec()}\n  stored:   {stored}"
-                    )
+            # a later file must infer the same shape as the stored
+            # schema — silently concatenating mismatched columns (Int
+            # vs Double, different geometry pair) corrupts the store
+            _ensure_schema(ds, args.feature_name, sft, path)
             if args.header:
                 conv.skip_lines = 1
         else:
@@ -181,6 +175,79 @@ def cmd_ingest(args) -> int:
         total += n
         if conv.errors:
             print(f"{path}: {conv.errors} records failed to parse", file=sys.stderr)
+    persist.save(ds, args.catalog)
+    print(f"ingested {total} features into '{args.feature_name}'")
+    return 0
+
+
+def _ensure_schema(ds, feature_name: str, sft, source: str):
+    """Create the schema on first contact, or verify the incoming spec
+    matches the stored one; returns the store's canonical FeatureType.
+    Shared by the infer and --file-format ingest paths."""
+    from geomesa_tpu.sft import FeatureType
+
+    if feature_name not in ds.type_names():
+        if sft.name != feature_name:
+            sft = FeatureType.from_spec(feature_name, sft.to_spec())
+        ds.create_schema(sft)
+        return sft
+    stored = ds.get_schema(feature_name)
+    if sft.to_spec() != stored.to_spec():
+        raise SystemExit(
+            f"{source!r} schema does not match the existing "
+            f"{feature_name!r} schema:\n"
+            f"  incoming: {sft.to_spec()}\n"
+            f"  stored:   {stored.to_spec()}"
+        )
+    return stored
+
+
+def _ingest_direct(ds, args) -> int:
+    """Self-describing file ingest: schema comes from the file itself
+    (reference geomesa-convert-parquet / geomesa-convert-shp). When the
+    catalog already holds the schema, it is offered to the readers so
+    externally-written files (no geomesa metadata/sidecar) still load."""
+    known = (
+        ds.get_schema(args.feature_name)
+        if args.feature_name in ds.type_names()
+        else None
+    )
+
+    def read(path):
+        if args.file_format in ("parquet", "orc"):
+            if args.file_format == "parquet":
+                from geomesa_tpu.io.parquet import read_parquet as reader
+            else:
+                from geomesa_tpu.io.orc import read_orc as reader
+            try:
+                # prefer the file's own schema so mismatches are caught
+                return reader(path)
+            except ValueError:
+                if known is None:
+                    raise
+                return reader(path, sft=known)
+        from geomesa_tpu.io.shapefile import read_shapefile
+
+        shp = path if path.lower().endswith(".shp") else f"{path}.shp"
+        return read_shapefile(shp, type_name=args.feature_name)
+
+    total = 0
+    for path in args.files:
+        try:
+            fc = read(path)
+        except ValueError as e:
+            print(f"cannot read {path!r}: {e}", file=sys.stderr)
+            return 1
+        sft = _ensure_schema(ds, args.feature_name, fc.sft, path)
+        if args.file_format == "shp":
+            # shapefiles carry no feature ids: the reader synthesizes
+            # running indices, which collide across files / repeat
+            # ingests — rebase on the store size like the CSV path
+            base = len(ds.features(args.feature_name))
+            ids = np.array([str(base + i) for i in range(len(fc))])
+        else:
+            ids = fc.ids
+        total += ds.write(args.feature_name, type(fc)(sft, ids, fc.columns))
     persist.save(ds, args.catalog)
     print(f"ingested {total} features into '{args.feature_name}'")
     return 0
@@ -318,6 +385,11 @@ def build_parser() -> argparse.ArgumentParser:
     how = sp.add_mutually_exclusive_group(required=True)
     how.add_argument("--converter", help="converter config (json)")
     how.add_argument("--infer", action="store_true", help="infer schema from csv")
+    how.add_argument(
+        "--file-format", choices=("parquet", "orc", "shp"),
+        help="ingest self-describing files directly (schema from the file; "
+        "reference geomesa-convert-parquet / -shp)",
+    )
     sp.add_argument("--header", action="store_true", help="first row is a header")
     sp.add_argument(
         "--workers", type=int, default=0,

@@ -594,3 +594,127 @@ class TestOrcEmptyChunk:
         files = st.files(bbox=(-1.0, -1.0, 1.0, 1.0))
         assert all("chunk-000000" not in f for f in files)
         assert st.query(bbox=(-1.0, -1.0, 1.0, 1.0)) is not None
+
+
+class TestDirectIngest:
+    """CLI --file-format ingest of self-describing files (reference
+    geomesa-convert-parquet / -shp)."""
+
+    def _run(self, argv):
+        from geomesa_tpu.cli import main
+
+        return main(argv)
+
+    def test_parquet_roundtrip(self, tmp_path, capsys):
+        from geomesa_tpu.io.parquet import write_parquet
+
+        fc = TestOrc._fc(n=120, name="direct")
+        pq_file = str(tmp_path / "data.parquet")
+        write_parquet(fc, pq_file)
+        cat = str(tmp_path / "cat")
+        rc = self._run([
+            "ingest", "-c", cat, "-f", "direct",
+            "--file-format", "parquet", pq_file,
+        ])
+        assert rc == 0
+        assert "ingested 120" in capsys.readouterr().out
+        rc = self._run(["count", "-c", cat, "-f", "direct"])
+        assert rc == 0
+        assert "120" in capsys.readouterr().out
+
+    def test_orc_appends_and_schema_check(self, tmp_path, capsys):
+        from geomesa_tpu.io.orc import write_orc
+
+        fc = TestOrc._fc(n=40, name="direct")
+        f1 = str(tmp_path / "a.orc"); f2 = str(tmp_path / "b.orc")
+        write_orc(fc, f1)
+        fc2 = TestOrc._fc(n=30, seed=5, name="direct")
+        fc2 = type(fc2)(fc2.sft, np.array([f"b{i}" for i in range(30)]), fc2.columns)
+        write_orc(fc2, f2)
+        cat = str(tmp_path / "cat")
+        rc = self._run([
+            "ingest", "-c", cat, "-f", "direct", "--file-format", "orc", f1, f2,
+        ])
+        assert rc == 0
+        assert "ingested 70" in capsys.readouterr().out
+        # mismatched schema rejected
+        other = FeatureCollection.from_columns(
+            FeatureType.from_spec("direct", "v:Int,*geom:Point:srid=4326"),
+            ["x"], {"v": np.array([1]), "geom": (np.array([0.0]), np.array([0.0]))},
+        )
+        f3 = str(tmp_path / "c.orc")
+        write_orc(other, f3)
+        with pytest.raises(SystemExit):
+            self._run([
+                "ingest", "-c", cat, "-f", "direct", "--file-format", "orc", f3,
+            ])
+
+    def test_shapefile(self, tmp_path, capsys):
+        from geomesa_tpu.io.shapefile import write_shapefile
+
+        fc = TestOrc._fc(n=25, name="shp_src")
+        base = str(tmp_path / "data")
+        write_shapefile(fc, base)
+        cat = str(tmp_path / "cat")
+        rc = self._run([
+            "ingest", "-c", cat, "-f", "ships",
+            "--file-format", "shp", base + ".shp",
+        ])
+        assert rc == 0
+        assert "ingested 25" in capsys.readouterr().out
+
+
+class TestDirectIngestReviewFixes:
+    def _run(self, argv):
+        from geomesa_tpu.cli import main
+
+        return main(argv)
+
+    def test_multi_shapefile_ids_rebased(self, tmp_path, capsys):
+        from geomesa_tpu.io.shapefile import write_shapefile
+
+        for stem, n in (("a", 10), ("b", 15)):
+            write_shapefile(TestOrc._fc(n=n, seed=n, name="s"), str(tmp_path / stem))
+        cat = str(tmp_path / "cat")
+        rc = self._run([
+            "ingest", "-c", cat, "-f", "ships", "--file-format", "shp",
+            str(tmp_path / "a.shp"), str(tmp_path / "b.shp"),
+        ])
+        assert rc == 0
+        assert "ingested 25" in capsys.readouterr().out
+
+    def test_external_parquet_with_known_schema(self, tmp_path, capsys):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        # externally-written file: correct columns, NO geomesa metadata
+        t = pa.table({
+            "id": ["x1", "x2"],
+            "name": ["a", "b"],
+            "dtg": pa.array(
+                np.array([1718000000000, 1718000001000]).astype("datetime64[ms]")
+            ),
+            "age": pa.array(np.array([3, 4], dtype=np.int32)),
+            "geom_x": pa.array([1.0, 2.0]),
+            "geom_y": pa.array([3.0, 4.0]),
+        })
+        p = str(tmp_path / "ext.parquet")
+        pq.write_table(t, p)
+        cat = str(tmp_path / "cat")
+        # no schema in the catalog either -> clean error, not a traceback
+        rc = self._run([
+            "ingest", "-c", cat, "-f", "orcs", "--file-format", "parquet", p,
+        ])
+        assert rc == 1
+        assert "pass sft explicitly" in capsys.readouterr().err
+        # with the schema pre-created, the external file ingests
+        rc = self._run([
+            "create-schema", "-c", cat, "-f", "orcs",
+            "-s", "name:String,age:Int,dtg:Date,*geom:Point:srid=4326",
+        ])
+        assert rc == 0
+        rc = self._run([
+            "ingest", "-c", cat, "-f", "orcs", "--file-format", "parquet", p,
+        ])
+        assert rc == 0
+        assert "ingested 2" in capsys.readouterr().out
