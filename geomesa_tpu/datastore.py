@@ -311,6 +311,100 @@ class DataStore:
         with self._write_lock:
             return self._delete_features_locked(type_name, f)
 
+    def upsert(self, type_name: str, features: "FeatureCollection | Sequence[Mapping]") -> int:
+        """Write a batch, replacing any existing features with the same
+        ids (reference GeoTools FeatureWriter update semantics; the
+        streaming hot tier has O(1) upserts — on the core store this is a
+        delete-and-rewrite maintenance op, since replaced rows must leave
+        every sorted index). Returns the number of features written."""
+        sft = self._schemas[type_name]
+        if not isinstance(features, FeatureCollection):
+            features = FeatureCollection.from_rows(sft, features)
+        if len(features) == 0:
+            return 0
+        self._validate_replacement(type_name, features)
+        from geomesa_tpu.filter.predicates import IdFilter
+
+        # the RLock makes delete+write one atomic compound op: no reader
+        # or racing writer observes the store between the two halves
+        with self._write_lock:
+            self.delete_features(
+                type_name, IdFilter(tuple(np.asarray(features.ids).tolist()))
+            )
+            return self.write(type_name, features)
+
+    def _validate_replacement(self, type_name: str, features) -> None:
+        """Fail BEFORE any row is deleted: a replacement batch that cannot
+        be written (duplicate ids within the batch, unencodable keys) must
+        leave the store untouched — mirroring write()'s own
+        build-before-mutate discipline."""
+        ids = np.asarray(features.ids)
+        if len(np.unique(ids)) != len(ids):
+            raise ValueError("duplicate feature ids in replacement batch")
+        for idx in self._indexes[type_name]:
+            idx.write_keys(features)  # dry-run encode; raises on bad data
+
+    def modify_features(
+        self, type_name: str, updates: Mapping, f: "Filter | str" = INCLUDE
+    ) -> int:
+        """Set attribute values on every feature matching ``f`` (reference
+        GeoTools FeatureStore.modifyFeatures). ``updates`` maps attribute
+        name -> new value (scalar, or a geometry for the geometry
+        attribute). Index keys are re-derived, so geometry/time updates
+        move rows to their new index cells. Returns the modified count."""
+        sft = self._schemas[type_name]
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.features import _date_to_millis
+        from geomesa_tpu.filter.predicates import IdFilter
+
+        # hold the lock across query+delete+write (RLock re-enters): the
+        # snapshot must not go stale between reading and rewriting rows,
+        # and readers must never observe the store between the halves
+        with self._write_lock:
+            matched = self.query(type_name, f)
+            n = len(matched)
+            if n == 0:
+                return 0
+            cols = dict(matched.columns)
+            for name, value in updates.items():
+                attr = next((a for a in sft.attributes if a.name == name), None)
+                if attr is None:
+                    raise KeyError(f"unknown attribute {name!r}")
+                if attr.is_geometry:
+                    # the column class follows the SCHEMA's geometry kind,
+                    # not the value's type: a point schema stores a
+                    # PointColumn, an extent schema a packed column
+                    if sft.is_points:
+                        if not isinstance(value, geo.Point):
+                            raise TypeError(
+                                f"{type_name!r} stores points; cannot set "
+                                f"geometry to a {value.geom_type}"
+                            )
+                        from geomesa_tpu.filter.predicates import PointColumn
+
+                        cols[name] = PointColumn(
+                            np.full(n, value.x), np.full(n, value.y)
+                        )
+                    else:
+                        cols[name] = geo.PackedGeometryColumn.from_geometries(
+                            [value] * n
+                        )
+                elif attr.type == "Date":
+                    cols[name] = np.full(n, _date_to_millis(value), dtype=np.int64)
+                else:
+                    base = np.asarray(matched.columns[name])
+                    if base.dtype == object:
+                        cols[name] = np.array([value] * n, dtype=object)
+                    else:
+                        cols[name] = np.full(n, value, dtype=base.dtype)
+            updated = FeatureCollection(sft, matched.ids, cols)
+            self._validate_replacement(type_name, updated)
+            self.delete_features(
+                type_name, IdFilter(tuple(np.asarray(matched.ids).tolist()))
+            )
+            self.write(type_name, updated)
+            return n
+
     def age_off(self, type_name: str, ttl_ms: int, now_ms: int | None = None) -> int:
         """Physically remove features older than ``ttl_ms`` (reference
         AgeOffIterator compaction semantics; pair with AgeOffInterceptor

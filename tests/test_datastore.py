@@ -330,3 +330,127 @@ class TestMergedViewAggregations:
         b = v.bounds(q)
         assert b is not None
         assert b[0] >= -10.01 and b[1] >= -10.01 and b[2] <= 10.01 and b[3] <= 10.01
+
+
+class TestUpdateSurface:
+    """upsert + modify_features (reference GeoTools FeatureWriter update /
+    FeatureStore.modifyFeatures)."""
+
+    @staticmethod
+    def _store():
+        from geomesa_tpu.datastore import DataStore
+
+        sft = FeatureType.from_spec(
+            "upd", "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(0)
+        n = 500
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        fc = FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"name": np.array([f"n{i % 7}" for i in range(n)], dtype=object),
+             "age": rng.integers(0, 90, n),
+             "dtg": t0 + rng.integers(0, 20 * 86400_000, n),
+             "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n))},
+        )
+        ds.write("upd", fc)
+        return ds, sft, fc
+
+    def test_upsert_replaces_by_id(self):
+        ds, sft, fc = self._store()
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        # replace rows 10..19 with new geometry far away + new ages
+        repl = FeatureCollection.from_columns(
+            sft, [str(i) for i in range(10, 20)],
+            {"name": np.array(["moved"] * 10, dtype=object),
+             "age": np.full(10, 999),
+             "dtg": np.full(10, t0),
+             "geom": (np.full(10, 150.0), np.full(10, 80.0))},
+        )
+        assert ds.upsert("upd", repl) == 10
+        assert ds.count("upd") == 500  # replaced, not appended
+        hits = ds.query("upd", "bbox(geom, 149, 79, 151, 81)")
+        assert sorted(hits.ids.tolist()) == [str(i) for i in range(10, 20)]
+        assert set(np.asarray(hits.columns["age"]).tolist()) == {999}
+        # new ids append
+        extra = FeatureCollection.from_columns(
+            sft, ["x1"],
+            {"name": np.array(["new"], dtype=object), "age": np.array([1]),
+             "dtg": np.array([t0]), "geom": (np.array([0.5]), np.array([0.5]))},
+        )
+        ds.upsert("upd", extra)
+        assert ds.count("upd") == 501
+
+    def test_modify_features_moves_index_cells(self):
+        ds, sft, fc = self._store()
+        moved = ds.modify_features(
+            "upd", {"geom": __import__("geomesa_tpu.geometry", fromlist=["Point"]).Point(170.0, 85.0), "age": 7},
+            "name = 'n3'",
+        )
+        want = int((np.asarray(fc.columns["name"]) == "n3").sum())
+        assert moved == want
+        # all moved rows now found at the NEW location through the index
+        hits = ds.query("upd", "bbox(geom, 169, 84, 171, 86)")
+        assert len(hits) == want
+        assert set(np.asarray(hits.columns["age"]).tolist()) == {7}
+        # and no n3 rows remain anywhere else
+        others = ds.query("upd", "name = 'n3' AND bbox(geom, -180, -90, 168, 83)")
+        assert len(others) == 0
+        assert ds.count("upd") == 500
+
+    def test_modify_unknown_attr_raises(self):
+        ds, _, _ = self._store()
+        with pytest.raises(KeyError):
+            ds.modify_features("upd", {"nope": 1}, "INCLUDE")
+
+
+class TestUpdateReviewFixes:
+    def test_upsert_bad_batch_leaves_store_untouched(self):
+        ds, sft, fc = TestUpdateSurface._store()
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        dup = FeatureCollection.from_columns(
+            sft, ["3", "3"],
+            {"name": np.array(["x", "x"], dtype=object),
+             "age": np.array([1, 2]), "dtg": np.array([t0, t0]),
+             "geom": (np.array([0.0, 0.0]), np.array([0.0, 0.0]))},
+        )
+        with pytest.raises(ValueError):
+            ds.upsert("upd", dup)
+        # the existing row 3 survived with its original attributes
+        assert ds.count("upd") == 500
+        row = ds.query("upd", "IN ('3')")
+        assert np.asarray(row.columns["name"]).tolist() == ["n3"]
+
+    def test_modify_extent_schema_geometry(self):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.datastore import DataStore
+
+        sft = FeatureType.from_spec("ext", "v:Int,*geom:Polygon:srid=4326")
+        ds = DataStore(); ds.create_schema(sft)
+        ds.write("ext", FeatureCollection.from_columns(
+            sft, ["a", "b"],
+            {"v": np.array([1, 2]),
+             "geom": [geo.box(0, 0, 1, 1), geo.box(5, 5, 6, 6)]}))
+        # a Point value on an extent schema stays in the packed column
+        # (the write path accepts heterogeneous geometries the same way)
+        # and, crucially, loses no rows
+        ds.modify_features("ext", {"geom": geo.Point(9, 9)}, "v = 1")
+        assert ds.count("ext") == 2
+        assert ds.query("ext", "bbox(geom, 8, 8, 10, 10)").ids.tolist() == ["a"]
+        # a polygon value moves the row's index cell
+        moved = ds.modify_features("ext", {"geom": geo.box(50, 50, 51, 51)}, "v = 1")
+        assert moved == 1
+        hits = ds.query(
+            "ext", "INTERSECTS(geom, POLYGON((49 49, 52 49, 52 52, 49 52, 49 49)))")
+        assert hits.ids.tolist() == ["a"]
+        assert ds.count("ext") == 2
+
+    def test_point_schema_rejects_polygon_value(self):
+        from geomesa_tpu import geometry as geo
+
+        ds, _, _ = TestUpdateSurface._store()
+        with pytest.raises(TypeError):
+            ds.modify_features("upd", {"geom": geo.box(0, 0, 1, 1)}, "INCLUDE")
+        assert ds.count("upd") == 500
