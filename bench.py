@@ -251,6 +251,12 @@ def config2_z2():
     measured = [q for q, _ in measured_full]
     lat, hits, wall = run_queries(ds, "osm", (warmup, measured), "z2")
 
+    t_pipe = time.perf_counter()
+    outs = ds.query_many("osm", measured)
+    pipe_wall = time.perf_counter() - t_pipe
+    pipe_hits = sum(len(o) for o in outs)
+    assert pipe_hits == hits, (pipe_hits, hits)
+
     times = []
     for _, (x0, y0, x1, y1) in measured_full[:6]:
         s = time.perf_counter()
@@ -266,6 +272,7 @@ def config2_z2():
             "n_points": n,
             "ingest_rate_per_s": round(n / ingest_s, 1),
             "device_gb": round(table.nbytes_device / 1e9, 3),
+            "pipelined_features_per_sec": round(pipe_hits / pipe_wall, 1),
         },
     )
     del ds, fc, table, x, y
@@ -327,6 +334,12 @@ def config3_xz2():
     measured = [q for q, _ in measured_full]
     lat, hits, wall = run_queries(ds, "bld", (warmup, measured), "xz2")
 
+    t_pipe = time.perf_counter()
+    outs = ds.query_many("bld", measured)
+    pipe_wall = time.perf_counter() - t_pipe
+    pipe_hits = sum(len(o) for o in outs)
+    assert pipe_hits == hits, (pipe_hits, hits)
+
     bx0, by0 = col.bboxes[:, 0], col.bboxes[:, 1]
     bx1, by1 = col.bboxes[:, 2], col.bboxes[:, 3]
     times = []
@@ -344,6 +357,7 @@ def config3_xz2():
             "n_polygons": n,
             "ingest_rate_per_s": round(n / ingest_s, 1),
             "device_gb": round(table.nbytes_device / 1e9, 3),
+            "pipelined_features_per_sec": round(pipe_hits / pipe_wall, 1),
         },
     )
     del ds, fc, table, col

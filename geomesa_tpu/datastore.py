@@ -325,8 +325,10 @@ class DataStore:
         self._validate_replacement(type_name, features)
         from geomesa_tpu.filter.predicates import IdFilter
 
-        # the RLock makes delete+write one atomic compound op: no reader
-        # or racing writer observes the store between the two halves
+        # the RLock serializes this compound op against other WRITERS
+        # (readers take no lock: a concurrent query may observe the gap
+        # between the delete and the write — the store's documented
+        # snapshot-read, single-writer-at-a-time semantics)
         with self._write_lock:
             self.delete_features(
                 type_name, IdFilter(tuple(np.asarray(features.ids).tolist()))
@@ -341,8 +343,12 @@ class DataStore:
         ids = np.asarray(features.ids)
         if len(np.unique(ids)) != len(ids):
             raise ValueError("duplicate feature ids in replacement batch")
+        # dry-run encode; raises on bad data. This doubles the encode
+        # cost (write() re-encodes) — an accepted price on a maintenance
+        # op for the guarantee that nothing is deleted unless the
+        # replacement is known writable.
         for idx in self._indexes[type_name]:
-            idx.write_keys(features)  # dry-run encode; raises on bad data
+            idx.write_keys(features)
 
     def modify_features(
         self, type_name: str, updates: Mapping, f: "Filter | str" = INCLUDE
@@ -357,9 +363,9 @@ class DataStore:
         from geomesa_tpu.features import _date_to_millis
         from geomesa_tpu.filter.predicates import IdFilter
 
-        # hold the lock across query+delete+write (RLock re-enters): the
-        # snapshot must not go stale between reading and rewriting rows,
-        # and readers must never observe the store between the halves
+        # hold the lock across query+delete+write (RLock re-enters) so
+        # the matched snapshot cannot go stale under a racing WRITER
+        # before the rewrite lands (readers take no lock; see upsert)
         with self._write_lock:
             matched = self.query(type_name, f)
             n = len(matched)
@@ -367,18 +373,19 @@ class DataStore:
                 return 0
             cols = dict(matched.columns)
             for name, value in updates.items():
-                attr = next((a for a in sft.attributes if a.name == name), None)
-                if attr is None:
-                    raise KeyError(f"unknown attribute {name!r}")
+                attr = sft.attr(name)  # raises KeyError on unknown names
                 if attr.is_geometry:
                     # the column class follows the SCHEMA's geometry kind,
                     # not the value's type: a point schema stores a
                     # PointColumn, an extent schema a packed column
                     if sft.is_points:
                         if not isinstance(value, geo.Point):
+                            kind = getattr(
+                                value, "geom_type", type(value).__name__
+                            )
                             raise TypeError(
                                 f"{type_name!r} stores points; cannot set "
-                                f"geometry to a {value.geom_type}"
+                                f"geometry to a {kind}"
                             )
                         from geomesa_tpu.filter.predicates import PointColumn
 
@@ -395,8 +402,19 @@ class DataStore:
                     base = np.asarray(matched.columns[name])
                     if base.dtype == object:
                         cols[name] = np.array([value] * n, dtype=object)
+                    elif base.dtype.kind in "US":
+                        # natural-width array: np.full with the stored
+                        # column's FIXED width silently truncates longer
+                        # values ('renamed' -> 're' in a <U2 column)
+                        cols[name] = np.full(n, str(value))
                     else:
-                        cols[name] = np.full(n, value, dtype=base.dtype)
+                        arr = np.full(n, value, dtype=base.dtype)
+                        if not np.all(arr == value):  # lossy cast refused
+                            raise TypeError(
+                                f"value {value!r} does not fit attribute "
+                                f"{name!r} ({base.dtype})"
+                            )
+                        cols[name] = arr
             updated = FeatureCollection(sft, matched.ids, cols)
             self._validate_replacement(type_name, updated)
             self.delete_features(

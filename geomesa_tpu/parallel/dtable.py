@@ -205,6 +205,10 @@ class DistributedIndexTable(IndexTable):
         fn = _dist_scan(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
         skip = bk.skip_inner_plane(kw["has_boxes"], kw["extent"])
         out = fn(bids2, boxes, wins, *self._cols_args(names))  # dispatched now
+        # async device->host copies: see IndexTable._device_scan_submit
+        for plane in out if isinstance(out, tuple) else (out,):
+            if hasattr(plane, "copy_to_host_async"):
+                plane.copy_to_host_async()
 
         def finish():
             if skip:

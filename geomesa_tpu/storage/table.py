@@ -441,6 +441,14 @@ class IndexTable(SortedKeys):
             self._cols_args(names), bids, boxes, wins,
             **self._kernel_kwargs(config, names),
         )
+        # start the device->host copy as soon as the kernel finishes: the
+        # tunneled link overlaps in-flight transfers, but a blocking
+        # device_get pays a full serialized roundtrip per query — measured
+        # 40 pulls 2.6 s -> 73 ms with async copies (PERF.md §4e), which is
+        # what makes query_many's pipelining actually pipeline
+        for plane in (wide, inner):
+            if plane is not None and hasattr(plane, "copy_to_host_async"):
+                plane.copy_to_host_async()
 
         def finish():
             # inner is None on extent box scans (skip_inner_plane): pull

@@ -1,0 +1,35 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# 40 small independent kernels; compare: sync pulls, async-copy pulls
+@jax.jit
+def f(x):
+    return (x * 2 + 1).sum(axis=-1).astype(jnp.int32)
+
+xs = [jnp.ones((128, 1024), jnp.float32) + i for i in range(40)]
+for x in xs[:2]:
+    np.asarray(f(x))
+
+# sync: dispatch+pull one by one
+t = time.perf_counter()
+outs = [np.asarray(f(x)) for x in xs]
+t_sync = time.perf_counter() - t
+
+# pipelined: dispatch all, then pull
+t = time.perf_counter()
+ys = [f(x) for x in xs]
+outs2 = [np.asarray(y) for y in ys]
+t_pipe = time.perf_counter() - t
+
+# pipelined + copy_to_host_async
+t = time.perf_counter()
+ys = [f(x) for x in xs]
+for y in ys:
+    y.copy_to_host_async()
+outs3 = [np.asarray(y) for y in ys]
+t_async = time.perf_counter() - t
+
+print(f"sync {t_sync*1e3:.0f} ms | dispatch-all {t_pipe*1e3:.0f} ms | +copy_to_host_async {t_async*1e3:.0f} ms")

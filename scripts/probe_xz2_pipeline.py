@@ -1,0 +1,44 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+
+n = 50_000_000
+rng = np.random.default_rng(62)
+cx = rng.uniform(-160, 160, 256); cy = rng.uniform(-55, 65, 256)
+which = rng.integers(0, 256, n)
+x0 = np.clip(cx[which] + rng.normal(0, 0.5, n), -179.9, 179.8)
+y0 = np.clip(cy[which] + rng.normal(0, 0.4, n), -89.9, 89.8)
+w = rng.uniform(0.0002, 0.002, n); h = rng.uniform(0.0002, 0.002, n)
+col = geo.PackedGeometryColumn.from_boxes(x0, y0, x0+w, y0+h)
+sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+sft.user_data["geomesa.indices.enabled"] = "xz2"
+ds = DataStore(); ds.create_schema(sft)
+ds.write("bld", FeatureCollection.from_columns(sft, np.arange(n), {"geom": col}), check_ids=False)
+
+def mk(seed, k):
+    r = np.random.default_rng(seed); out = []
+    for _ in range(k):
+        c = r.integers(0, 256); qw = float(r.choice([0.02, 0.05, 0.1, 0.5, 2.0]))
+        qx = cx[c]+r.uniform(-1, 1); qy = cy[c]+r.uniform(-0.8, 0.8)
+        out.append(f"INTERSECTS(geom, POLYGON(({qx:.4f} {qy:.4f}, {qx+qw:.4f} {qy:.4f}, "
+                   f"{qx+qw:.4f} {qy+qw:.4f}, {qx:.4f} {qy+qw:.4f}, {qx:.4f} {qy:.4f})))")
+    return out
+
+for q in mk(1, 40):
+    ds.query("bld", q)
+
+qs = mk(2, 40)
+t = time.perf_counter()
+seq = [ds.query("bld", q) for q in qs]
+t_seq = time.perf_counter() - t
+t = time.perf_counter()
+pipe = ds.query_many("bld", qs)
+t_pipe = time.perf_counter() - t
+hits = sum(len(r.ids) for r in seq)
+assert [sorted(r.ids.tolist()) for r in seq] == [sorted(r.ids.tolist()) for r in pipe]
+print(f"sequential: {t_seq:.2f}s ({hits/t_seq:,.0f} features/s)")
+print(f"pipelined : {t_pipe:.2f}s ({hits/t_pipe:,.0f} features/s)  speedup {t_seq/t_pipe:.2f}x")

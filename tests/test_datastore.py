@@ -454,3 +454,33 @@ class TestUpdateReviewFixes:
         with pytest.raises(TypeError):
             ds.modify_features("upd", {"geom": geo.box(0, 0, 1, 1)}, "INCLUDE")
         assert ds.count("upd") == 500
+
+
+class TestModifyDtypeSafety:
+    def test_fixed_width_string_not_truncated(self):
+        from geomesa_tpu.datastore import DataStore
+
+        sft = FeatureType.from_spec("fw", "name:String,*geom:Point:srid=4326")
+        ds = DataStore(); ds.create_schema(sft)
+        # fixed-width '<U2' column, as from_columns produces for plain lists
+        ds.write("fw", FeatureCollection.from_columns(
+            sft, ["a", "b"],
+            {"name": np.array(["n1", "n2"]),
+             "geom": (np.array([0.0, 1.0]), np.array([0.0, 1.0]))}))
+        ds.modify_features("fw", {"name": "renamed"}, "IN ('a')")
+        got = ds.query("fw", "IN ('a')")
+        assert np.asarray(got.columns["name"]).tolist() == ["renamed"]
+
+    def test_lossy_numeric_cast_refused(self):
+        ds, _, _ = TestUpdateSurface._store()
+        with pytest.raises(TypeError):
+            ds.modify_features("upd", {"age": 3.5}, "IN ('1')")
+        # whole-valued floats are fine
+        ds.modify_features("upd", {"age": 7.0}, "IN ('1')")
+        got = ds.query("upd", "IN ('1')")
+        assert np.asarray(got.columns["age"]).tolist() == [7]
+
+    def test_non_geometry_value_clean_error(self):
+        ds, _, _ = TestUpdateSurface._store()
+        with pytest.raises(TypeError, match="tuple"):
+            ds.modify_features("upd", {"geom": (1.0, 2.0)}, "IN ('1')")
