@@ -752,34 +752,75 @@ class DataStore:
         refined results (LocalQueryRunner semantics). Extent geometries
         weight their bbox centroid pixel.
         """
+        return self.density_many(
+            type_name, [(f, envelope)], width=width, height=height, weight=weight
+        )[0]
+
+    def density_many(
+        self,
+        type_name: str,
+        requests: Sequence,
+        width: int = 256,
+        height: int = 256,
+        weight: str | None = None,
+    ) -> list[np.ndarray]:
+        """Many density grids with pipelined device work — the map-TILE
+        workload (a WMS heatmap frame is a batch of per-tile DensityProcess
+        calls in the reference): every tile's grid kernel dispatches before
+        any grid is pulled, so the per-tile link roundtrip overlaps across
+        the batch. ``requests`` is a sequence of (filter, envelope) pairs
+        (envelope None = whole world). Results are identical to sequential
+        :meth:`density` calls."""
         from geomesa_tpu.filter import ecql
         from geomesa_tpu.planning.planner import mask_decides_filter
 
-        if isinstance(f, str):
-            f = ecql.parse(f)
-        if envelope is None:
-            envelope = (-180.0, -90.0, 180.0, 90.0)
-        plan = self.planner.plan(type_name, f)
-        cfg = plan.config
-        # gate on plan.filter: interceptors may have rewritten the query
-        device_ok = (
-            plan.index is not None
-            and weight is None
-            and not self._vis_active(type_name)
-            and mask_decides_filter(plan.filter, cfg, self._schemas[type_name])
-        )
-        if device_ok:
-            if cfg.disjoint:
+        staged: list = []  # (kind, payload) per request, in order
+        for f, envelope in requests:
+            if isinstance(f, str):
+                f = ecql.parse(f)
+            if envelope is None:
+                envelope = (-180.0, -90.0, 180.0, 90.0)
+            plan = self.planner.plan(type_name, f)
+            cfg = plan.config
+            # gate on plan.filter: interceptors may have rewritten it
+            device_ok = (
+                plan.index is not None
+                and weight is None
+                and not self._vis_active(type_name)
+                and mask_decides_filter(plan.filter, cfg, self._schemas[type_name])
+            )
+            if not device_ok:
+                staged.append(("host", (plan, envelope)))
+            elif cfg.disjoint:
                 self.record_query(plan, 0, 0.0)
-                return np.zeros((height, width), dtype=np.float32)
-            deadline = self._agg_deadline()
-            t0 = time.perf_counter()
-            grid = self.table(type_name, plan.index).density(cfg, envelope, width, height)
-            check_deadline(deadline, "density scan")
-            self.record_query(plan, int(grid.sum()), time.perf_counter() - t0)
-            return grid
-        out = self.planner.execute(plan)
-        return _host_density(out, envelope, width, height, weight)
+                staged.append(("empty", None))
+            else:
+                finish = self.table(type_name, plan.index).density_submit(
+                    cfg, envelope, width, height
+                )
+                staged.append(("device", (plan, finish)))
+
+        out: list = []
+        for kind, payload in staged:
+            if kind == "empty":
+                out.append(np.zeros((height, width), dtype=np.float32))
+            elif kind == "device":
+                plan, finish = payload
+                # fresh deadline + timing per tile, matching sequential
+                # density() semantics (a late pull in a long batch must
+                # not spuriously time out, and audited scan time is this
+                # tile's pull, not the whole batch's wall clock)
+                deadline = self._agg_deadline()
+                t0 = time.perf_counter()
+                grid = finish()
+                check_deadline(deadline, "density scan")
+                self.record_query(plan, int(grid.sum()), time.perf_counter() - t0)
+                out.append(grid)
+            else:
+                plan, envelope = payload
+                rows = self.planner.execute(plan)
+                out.append(_host_density(rows, envelope, width, height, weight))
+        return out
 
     def stats_query(
         self,

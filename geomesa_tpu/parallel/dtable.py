@@ -250,7 +250,7 @@ class DistributedIndexTable(IndexTable):
         order = np.argsort(gbids)
         return pops[order], gbids[order]
 
-    def _device_density(self, blocks, config, grid_bounds, width, height) -> np.ndarray:
+    def _device_density_submit(self, blocks, config, grid_bounds, width, height):
         bids2, _ = self._split_blocks(blocks, pad=-1)
         boxes, wins = self._params(config)
         names = self._agg_cols(config)
@@ -261,7 +261,9 @@ class DistributedIndexTable(IndexTable):
             width, height,
         )
         grid = fn(bids2, boxes, wins, grid_bounds, *self._cols_args(names))
-        return np.asarray(jax.device_get(grid))
+        if hasattr(grid, "copy_to_host_async"):
+            grid.copy_to_host_async()
+        return lambda: np.asarray(jax.device_get(grid))
 
     def _device_bounds(self, blocks, config):
         bids2, n_real = self._split_blocks(blocks, pad=-1)

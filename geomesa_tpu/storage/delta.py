@@ -158,7 +158,17 @@ class TieredTable:
         )
 
     def density(self, config: ScanConfig, bounds, width: int, height: int):
-        grid = self.main.density(config, bounds, width, height)
+        return self.density_submit(config, bounds, width, height)()
+
+    def density_submit(self, config: ScanConfig, bounds, width: int, height: int):
+        """Pipelined density: the main table's grid kernel dispatches now;
+        finish() pulls it and scatters the host delta rows on top."""
+        finish_main = self.main.density_submit(config, bounds, width, height)
+        return lambda: self._density_apply_delta(
+            finish_main(), config, bounds, width, height
+        )
+
+    def _density_apply_delta(self, grid, config: ScanConfig, bounds, width, height):
         d = self._delta_hits(config)
         if len(d):
             local = d - self.base

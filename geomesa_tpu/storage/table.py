@@ -209,7 +209,7 @@ class IndexTable(SortedKeys):
     This class is the WHOLE scan engine: subclasses (the distributed table,
     parallel.dtable) override only the device hooks — ``_round_blocks`` /
     ``_place_cols`` for layout and ``_device_scan`` / ``_device_pops`` /
-    ``_device_density`` / ``_device_bounds`` for execution — so the
+    ``_device_density_submit`` / ``_device_bounds`` for execution — so the
     single-chip and multi-chip paths share one pruning + exactness-tier +
     decode pipeline (the reference runs the same coprocessor push-down on
     every region server, geomesa-hbase-rpc/.../GeoMesaCoprocessor.scala:
@@ -478,7 +478,9 @@ class IndexTable(SortedKeys):
         pops = np.asarray(jax.device_get(pops))[:n_real].astype(np.int64)
         return pops, bids[:n_real].astype(np.int64)
 
-    def _device_density(self, blocks, config, grid_bounds, width, height) -> np.ndarray:
+    def _device_density_submit(self, blocks, config, grid_bounds, width, height):
+        """Dispatch the density kernel now (host copy started async);
+        return finish() -> [height, width] grid."""
         import jax
 
         from geomesa_tpu.scan import aggregations
@@ -492,7 +494,9 @@ class IndexTable(SortedKeys):
             self._cols_args(names), bids, boxes, wins, grid_bounds,
             width=width, height=height, **self._kernel_kwargs(config, names),
         )
-        return np.asarray(jax.device_get(grid))
+        if hasattr(grid, "copy_to_host_async"):
+            grid.copy_to_host_async()
+        return lambda: np.asarray(jax.device_get(grid))
 
     def _device_bounds(self, blocks, config):
         """(count, envelope | None) over wide-predicate hits."""
@@ -565,13 +569,19 @@ class IndexTable(SortedKeys):
     def density(self, config: ScanConfig, bounds, width: int, height: int) -> np.ndarray:
         """[height, width] density grid over ``bounds`` computed on device
         (the DensityScan push-down tier; see geomesa_tpu.scan.aggregations)."""
+        return self.density_submit(config, bounds, width, height)()
+
+    def density_submit(self, config: ScanConfig, bounds, width: int, height: int):
+        """Pipelined form of :meth:`density`: dispatch the grid kernel now,
+        return finish() -> grid. A batch of map tiles submits every tile's
+        kernel before pulling any grid (DataStore.density_many)."""
         if config.disjoint or self.n == 0:
-            return np.zeros((height, width), dtype=np.float32)
+            return lambda: np.zeros((height, width), dtype=np.float32)
         blocks = self._agg_blocks(config)
         if len(blocks) == 0:
-            return np.zeros((height, width), dtype=np.float32)
+            return lambda: np.zeros((height, width), dtype=np.float32)
         gb = np.asarray(bounds, dtype=np.float32).reshape(4)
-        return self._device_density(blocks, config, gb, width, height)
+        return self._device_density_submit(blocks, config, gb, width, height)
 
     # -- warmup ----------------------------------------------------------
     def warmup(self) -> int:

@@ -238,3 +238,72 @@ class TestBounds:
 class TestEmptyResults:
     def test_empty_bin_query(self, ds):
         assert ds.bin_query("pts", "bbox(geom, 179.99, 89.99, 180, 90)") == b""
+
+
+class TestDensityMany:
+    def test_matches_sequential(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.sft import FeatureType
+
+        rng = np.random.default_rng(0)
+        n = 30_000
+        sft = FeatureType.from_spec("d", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        ds.write("d", FeatureCollection.from_columns(
+            sft, np.arange(n).astype(str),
+            {"dtg": t0 + rng.integers(0, 10**9, n),
+             "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n))}))
+        # tile pyramid: 4 device tiles + 1 disjoint + 1 host-fallback (NOT)
+        reqs = [
+            ("bbox(geom, -60, -40, 0, 0)", (-60, -40, 0, 0)),
+            ("bbox(geom, 0, 0, 60, 40)", (0, 0, 60, 40)),
+            ("bbox(geom, -60, 0, 0, 40)", (-60, 0, 0, 40)),
+            ("bbox(geom, 0, -40, 60, 0)", (0, -40, 60, 0)),
+            ("bbox(geom, 100, 50, 120, 60) AND bbox(geom, -10, -10, -5, -5)",
+             (100, 50, 120, 60)),
+            ("NOT (bbox(geom, -60, -40, 0, 0))", (-60, -40, 60, 40)),
+        ]
+        many = ds.density_many("d", reqs, width=64, height=64)
+        for (f, env), grid in zip(reqs, many):
+            single = ds.density("d", f, envelope=env, width=64, height=64)
+            np.testing.assert_array_equal(grid, single)
+        # the four quadrant tiles cover every feature exactly once
+        total = sum(g.sum() for g in many[:4])
+        assert total == n
+
+    def test_density_with_pending_delta(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.sft import FeatureType
+
+        rng = np.random.default_rng(1)
+        sft = FeatureType.from_spec("dd", "dtg:Date,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+
+        def batch(n, seed, prefix):
+            r = np.random.default_rng(seed)
+            return FeatureCollection.from_columns(
+                sft, [f"{prefix}{i}" for i in range(n)],
+                {"dtg": t0 + r.integers(0, 10**9, n),
+                 "geom": (r.uniform(-50, 50, n), r.uniform(-30, 30, n))})
+
+        ds.write("dd", batch(200_000, 0, "a"))  # compacts
+        ds.write("dd", batch(500, 1, "b"))      # stays in the delta tier
+        env = (-50, -30, 50, 30)
+        grid = ds.density("dd", "bbox(geom, -50, -30, 50, 30)", envelope=env,
+                          width=64, height=64)
+        assert grid.sum() == 200_500  # main + delta rows both rendered
+        many = ds.density_many(
+            "dd", [("bbox(geom, -50, -30, 50, 30)", env)] * 3,
+            width=64, height=64)
+        for g in many:
+            np.testing.assert_array_equal(g, grid)
