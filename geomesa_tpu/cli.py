@@ -214,11 +214,13 @@ def _ingest_direct(ds, args) -> int:
     )
 
     def read(path):
-        if args.file_format in ("parquet", "orc"):
+        if args.file_format in ("parquet", "orc", "arrow"):
             if args.file_format == "parquet":
                 from geomesa_tpu.io.parquet import read_parquet as reader
-            else:
+            elif args.file_format == "orc":
                 from geomesa_tpu.io.orc import read_orc as reader
+            else:
+                from geomesa_tpu.io.arrow import read_arrow as reader
             try:
                 # prefer the file's own schema so mismatches are caught
                 return reader(path)
@@ -226,6 +228,13 @@ def _ingest_direct(ds, args) -> int:
                 if known is None:
                     raise
                 return reader(path, sft=known)
+        if args.file_format == "geojson":
+            from geomesa_tpu.io.geojson import read_geojson
+
+            base = len(ds.features(args.feature_name)) if known is not None else 0
+            return read_geojson(
+                path, type_name=args.feature_name, sft=known, id_offset=base
+            )
         from geomesa_tpu.io.shapefile import read_shapefile
 
         shp = path if path.lower().endswith(".shp") else f"{path}.shp"
@@ -386,9 +395,9 @@ def build_parser() -> argparse.ArgumentParser:
     how.add_argument("--converter", help="converter config (json)")
     how.add_argument("--infer", action="store_true", help="infer schema from csv")
     how.add_argument(
-        "--file-format", choices=("parquet", "orc", "shp"),
+        "--file-format", choices=("parquet", "orc", "shp", "geojson", "arrow"),
         help="ingest self-describing files directly (schema from the file; "
-        "reference geomesa-convert-parquet / -shp)",
+        "reference geomesa-convert-parquet / -shp / -json)",
     )
     sp.add_argument("--header", action="store_true", help="first row is a header")
     sp.add_argument(

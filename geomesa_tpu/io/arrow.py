@@ -89,15 +89,60 @@ def _attr_array(pa, fc: FeatureCollection, a, dictionary: bool):
     return pa.array(col)
 
 
+_SFT_KEY = b"geomesa.sft.spec"
+_NAME_KEY = b"geomesa.sft.name"
+
+
 def to_arrow_table(fc: FeatureCollection, dictionary: bool = True):
-    """The collection as a pyarrow Table (store columns, no Python rows)."""
+    """The collection as a pyarrow Table (store columns, no Python rows).
+    The SFT spec rides in the schema metadata so IPC payloads are
+    self-describing (read_arrow)."""
     pa = _pa()
     names = ["id"]
     arrays = [_id_array(pa, fc)]
     for a in fc.sft.attributes:
         names.append(a.name)
         arrays.append(_attr_array(pa, fc, a, dictionary))
-    return pa.table(dict(zip(names, arrays)))
+    table = pa.table(dict(zip(names, arrays)))
+    return table.replace_schema_metadata(
+        {_SFT_KEY: fc.sft.to_spec().encode(), _NAME_KEY: fc.sft.name.encode()}
+    )
+
+
+def read_arrow(source, sft=None) -> FeatureCollection:
+    """Decode an Arrow IPC stream written by :func:`arrow_stream` (or the
+    delta writer) back into a FeatureCollection — the ingest direction of
+    the Arrow interop path. ``source`` is bytes, a path, or a file-like;
+    the SFT comes from the stream's schema metadata unless given."""
+    import io as _io
+
+    from geomesa_tpu.sft import FeatureType
+
+    pa = _pa()
+    import pyarrow.ipc as ipc
+
+    opened = None
+    if isinstance(source, (bytes, bytearray)):
+        source = _io.BytesIO(source)
+    elif isinstance(source, str):
+        source = opened = open(source, "rb")
+    try:
+        with ipc.open_stream(source) as reader:
+            table = reader.read_all()
+    finally:
+        if opened is not None:
+            opened.close()
+    meta = table.schema.metadata or {}
+    if sft is None:
+        spec = meta.get(_SFT_KEY)
+        if spec is None:
+            raise ValueError(
+                "stream has no geomesa.sft.spec metadata; pass sft explicitly"
+            )
+        sft = FeatureType.from_spec(
+            meta.get(_NAME_KEY, b"features").decode(), spec.decode()
+        )
+    return table_to_collection(table, sft)
 
 
 def arrow_stream(
@@ -125,8 +170,9 @@ def arrow_stream(
     return payload
 
 
-def read_arrow(data: bytes):
-    """Parse an IPC stream back into a pyarrow Table (tests/consumers)."""
+def read_arrow_table(data: bytes):
+    """Parse an IPC stream back into a pyarrow Table (the low-level
+    sibling of :func:`read_arrow`, which decodes to a FeatureCollection)."""
     pa = _pa()
     import pyarrow.ipc as ipc
 
@@ -218,8 +264,14 @@ class ArrowDeltaWriter:
         pa = self._pa
         table = self._encode_batch(fc)
         if self._writer is None:
+            # same self-describing metadata as to_arrow_table, so delta
+            # streams round-trip through read_arrow without an sft
+            schema = table.schema.with_metadata(
+                {_SFT_KEY: self.sft.to_spec().encode(),
+                 _NAME_KEY: self.sft.name.encode()}
+            )
             self._writer = pa.ipc.new_stream(
-                self._sink, table.schema,
+                self._sink, schema,
                 options=pa.ipc.IpcWriteOptions(emit_dictionary_deltas=True),
             )
         for batch in table.to_batches(max_chunksize=self.batch_rows):
@@ -265,14 +317,21 @@ def table_to_collection(table, sft) -> FeatureCollection:
     cols: dict = {}
     for a in sft.attributes:
         if a.name == geom:
-            if f"{geom}_x" in table.column_names:
+            if f"{geom}_x" in table.column_names:  # flat parquet/orc layout
                 cols[geom] = (
                     np.asarray(table[f"{geom}_x"], dtype=np.float64),
                     np.asarray(table[f"{geom}_y"], dtype=np.float64),
                 )
-            else:
+                continue
+            arr = table[geom].combine_chunks()
+            import pyarrow as pa
+
+            if pa.types.is_fixed_size_list(arr.type):  # IPC point vectors
+                xy = np.asarray(arr.flatten(), dtype=np.float64)
+                cols[geom] = (xy[0::2], xy[1::2])
+            else:  # WKB binary
                 cols[geom] = geo.PackedGeometryColumn.from_geometries(
-                    [geo.from_wkb(b) for b in table[geom].to_pylist()]
+                    [geo.from_wkb(b) for b in arr.to_pylist()]
                 )
             continue
         arr = table[a.name]

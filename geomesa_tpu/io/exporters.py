@@ -130,16 +130,21 @@ def _quote(v: str, sep: str) -> str:
 
 def _geojson(fc: FeatureCollection) -> str:
     geom_field = fc.sft.geom_field
+    date_fields = {a.name for a in fc.sft.attributes if a.type == "Date"}
     feats = []
     for row in fc.to_rows():
         fid = row.pop("__id__")
         g = row.pop(geom_field, None)  # to_rows already decoded the geometry
+        props = {
+            k: (date_str(v) if k in date_fields and v is not None else _jsonable(v))
+            for k, v in row.items()
+        }
         feats.append(
             {
                 "type": "Feature",
                 "id": fid,
                 "geometry": _geojson_geom(g) if g is not None else None,
-                "properties": {k: _jsonable(v) for k, v in row.items()},
+                "properties": props,
             }
         )
     return json.dumps({"type": "FeatureCollection", "features": feats})

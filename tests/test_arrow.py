@@ -7,7 +7,7 @@ import pytest
 pa = pytest.importorskip("pyarrow")
 
 from geomesa_tpu import DataStore, FeatureCollection, FeatureType
-from geomesa_tpu.io.arrow import arrow_stream, read_arrow, to_arrow_table
+from geomesa_tpu.io.arrow import arrow_stream, read_arrow_table, to_arrow_table
 
 SPEC = "name:String,age:Int,score:Double,dtg:Date,*geom:Point:srid=4326"
 
@@ -33,7 +33,7 @@ class TestArrowStream:
     def test_roundtrip_with_dictionaries(self):
         _, fc = make_fc(5000)
         data = arrow_stream(fc)
-        table = read_arrow(data)
+        table = read_arrow_table(data)
         assert table.num_rows == 5000
         # string column is dictionary-encoded with 13 unique values
         field = table.schema.field("name")
@@ -70,7 +70,7 @@ class TestArrowStream:
 
         monkeypatch.setattr(FeatureCollection, "to_rows", boom)
         data = arrow_stream(fc)
-        assert read_arrow(data).num_rows == 100_000
+        assert read_arrow_table(data).num_rows == 100_000
 
     def test_store_query_export(self):
         sft, fc = make_fc(8000)
@@ -80,7 +80,7 @@ class TestArrowStream:
         out = ds.query("a", "bbox(geom, -30, -20, 30, 20)")
         from geomesa_tpu.io.exporters import export
 
-        table = read_arrow(export(out, "arrow"))
+        table = read_arrow_table(export(out, "arrow"))
         assert table.num_rows == len(out)
         assert pa.types.is_dictionary(table.schema.field("name").type)
 
@@ -95,7 +95,7 @@ class TestArrowStream:
             for i in range(50)
         ]
         fc = FeatureCollection.from_rows(sft, rows)
-        table = read_arrow(arrow_stream(fc))
+        table = read_arrow_table(arrow_stream(fc))
         from geomesa_tpu import geometry as geo
 
         g0 = geo.from_wkb(table.column("geom").to_pylist()[7])
@@ -103,7 +103,7 @@ class TestArrowStream:
 
     def test_plain_encoding_without_dictionary(self):
         _, fc = make_fc(100)
-        table = read_arrow(arrow_stream(fc, dictionary=False))
+        table = read_arrow_table(arrow_stream(fc, dictionary=False))
         assert pa.types.is_string(table.schema.field("name").type)
         assert table.column("name").to_pylist() == fc.columns["name"].tolist()
 
@@ -114,7 +114,7 @@ class TestDeltaWriter:
 
     def test_delta_stream_roundtrip(self):
         pytest.importorskip("pyarrow")
-        from geomesa_tpu.io.arrow import ArrowDeltaWriter, read_arrow
+        from geomesa_tpu.io.arrow import ArrowDeltaWriter, read_arrow_table
 
         sft = FeatureType.from_spec(
             "t", "name:String,v:Integer,*geom:Point:srid=4326"
@@ -137,7 +137,7 @@ class TestDeltaWriter:
             )
             w.write(fc)
             all_names.extend(names.tolist())
-        table = read_arrow(w.finish())
+        table = read_arrow_table(w.finish())
         assert table.num_rows == 3 * 700
         assert table["name"].to_pylist() == all_names
         # repeated values across batches share one dictionary code space

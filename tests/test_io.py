@@ -718,3 +718,117 @@ class TestDirectIngestReviewFixes:
         ])
         assert rc == 0
         assert "ingested 2" in capsys.readouterr().out
+
+
+class TestGeoJsonArrowReaders:
+    """Ingest direction of the GeoJSON and Arrow exporters."""
+
+    def test_geojson_roundtrip_via_exporter(self):
+        from geomesa_tpu.io.exporters import export
+        from geomesa_tpu.io.geojson import read_geojson
+
+        fc = TestOrc._fc(n=40, name="gj")
+        text = export(fc, "geojson")
+        back = read_geojson(text, type_name="gj")
+        assert len(back) == 40
+        assert back.sft.attr("dtg").type == "Date"  # ISO strings inferred
+        assert back.sft.attr("age").type == "Int"
+        np.testing.assert_array_equal(
+            np.asarray(back.columns["dtg"]), np.asarray(fc.columns["dtg"]))
+        np.testing.assert_allclose(back.geom_column.x, fc.geom_column.x)
+        assert back.ids.tolist() == fc.ids.tolist()
+
+    def test_geojson_polygons_and_missing_props(self):
+        from geomesa_tpu.io.geojson import read_geojson
+
+        obj = {
+            "type": "FeatureCollection",
+            "features": [
+                {"type": "Feature", "id": "p1",
+                 "geometry": {"type": "Polygon",
+                              "coordinates": [[[0, 0], [2, 0], [2, 2], [0, 0]]]},
+                 "properties": {"height": 10.5}},
+                {"type": "Feature",
+                 "geometry": {"type": "Polygon",
+                              "coordinates": [[[5, 5], [6, 5], [6, 6], [5, 5]]]},
+                 "properties": {}},
+            ],
+        }
+        fc = read_geojson(obj, type_name="bld", id_offset=100)
+        assert fc.sft.attr("height").type == "Double"
+        assert not fc.sft.is_points
+        assert fc.ids.tolist() == ["p1", "101"]
+
+    def test_arrow_ipc_roundtrip(self):
+        from geomesa_tpu.io.arrow import arrow_stream, read_arrow
+
+        fc = TestOrc._fc(n=60, name="ar")
+        payload = arrow_stream(fc)  # dictionary-encoded strings
+        back = read_arrow(payload)
+        assert back.sft.to_spec() == fc.sft.to_spec()
+        assert list(back.columns["name"]) == list(fc.columns["name"])
+        np.testing.assert_array_equal(
+            np.asarray(back.columns["dtg"]), np.asarray(fc.columns["dtg"]))
+        np.testing.assert_allclose(back.geom_column.y, fc.geom_column.y)
+
+    def test_arrow_ipc_extent_geometries(self, tmp_path):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.io.arrow import arrow_stream, read_arrow
+
+        sft = FeatureType.from_spec("pg", "v:Int,*geom:Polygon:srid=4326")
+        polys = [geo.box(i, 0, i + 1, 1) for i in range(4)]
+        fc = FeatureCollection.from_columns(
+            sft, np.arange(4).astype(str), {"v": np.arange(4), "geom": polys})
+        p = tmp_path / "x.arrow"
+        p.write_bytes(arrow_stream(fc))
+        back = read_arrow(str(p))
+        assert back.geom_column.geometry(2) == polys[2]
+
+    def test_cli_geojson_and_arrow_ingest(self, tmp_path, capsys):
+        from geomesa_tpu.cli import main
+        from geomesa_tpu.io.arrow import arrow_stream
+        from geomesa_tpu.io.exporters import export
+
+        fc = TestOrc._fc(n=30, name="mix")
+        gj = tmp_path / "d.geojson"
+        gj.write_text(export(fc, "geojson"))
+        cat = str(tmp_path / "cat")
+        assert main(["ingest", "-c", cat, "-f", "mix",
+                     "--file-format", "geojson", str(gj)]) == 0
+        assert "ingested 30" in capsys.readouterr().out
+        ar = tmp_path / "d.arrow"
+        fc2 = type(fc)(fc.sft, np.array([f"a{i}" for i in range(30)]), fc.columns)
+        ar.write_bytes(arrow_stream(fc2))
+        assert main(["ingest", "-c", cat, "-f", "mix",
+                     "--file-format", "arrow", str(ar)]) == 0
+        assert "ingested 30" in capsys.readouterr().out
+        assert main(["count", "-c", cat, "-f", "mix"]) == 0
+        assert "60" in capsys.readouterr().out
+
+
+class TestReaderReviewFixes:
+    def test_geojson_custom_geometry_name(self):
+        from geomesa_tpu.io.exporters import export
+        from geomesa_tpu.io.geojson import read_geojson
+
+        sft = FeatureType.from_spec("t", "v:Int,*loc:Point:srid=4326")
+        fc = FeatureCollection.from_columns(
+            sft, ["0", "1"],
+            {"v": np.array([1, 2]),
+             "loc": (np.array([1.0, 2.0]), np.array([3.0, 4.0]))})
+        text = export(fc, "geojson")
+        back = read_geojson(text, sft=sft)
+        assert back.sft.geom_field == "loc"
+        np.testing.assert_allclose(back.geom_column.x, [1.0, 2.0])
+
+    def test_delta_stream_self_describes(self):
+        from geomesa_tpu.io.arrow import ArrowDeltaWriter, read_arrow
+
+        fc = TestOrc._fc(n=25, name="dlt")
+        w = ArrowDeltaWriter(fc.sft)
+        w.write(fc.take(np.arange(10)))
+        w.write(fc.take(np.arange(10, 25)))
+        back = read_arrow(w.finish())  # no sft passed: metadata carries it
+        assert back.sft.to_spec() == fc.sft.to_spec()
+        assert len(back) == 25
+        assert list(back.columns["name"]) == list(fc.columns["name"])

@@ -444,11 +444,11 @@ class TestTransformProcesses:
 
     def test_arrow_conversion(self, tracks):
         pytest.importorskip("pyarrow")
-        from geomesa_tpu.io.arrow import read_arrow
+        from geomesa_tpu.io.arrow import read_arrow_table
         from geomesa_tpu.process import arrow_conversion
 
         fc, _ = tracks
-        table = read_arrow(arrow_conversion(fc))
+        table = read_arrow_table(arrow_conversion(fc))
         assert table.num_rows == len(fc)
 
 
