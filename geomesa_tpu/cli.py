@@ -231,7 +231,14 @@ def _ingest_direct(ds, args) -> int:
         if args.file_format == "geojson":
             from geomesa_tpu.io.geojson import read_geojson
 
-            base = len(ds.features(args.feature_name)) if known is not None else 0
+            # live store size per FILE: the schema may have been created
+            # by an earlier file this run, and synthesized ids must keep
+            # rebasing as each file lands (cf. the shp path below)
+            base = (
+                len(ds.features(args.feature_name))
+                if args.feature_name in ds.type_names()
+                else 0
+            )
             return read_geojson(
                 path, type_name=args.feature_name, sft=known, id_offset=base
             )
@@ -259,6 +266,37 @@ def _ingest_direct(ds, args) -> int:
         total += ds.write(args.feature_name, type(fc)(sft, ids, fc.columns))
     persist.save(ds, args.catalog)
     print(f"ingested {total} features into '{args.feature_name}'")
+    return 0
+
+
+def cmd_convert(args) -> int:
+    """Run a converter over files and render the features WITHOUT a store
+    (reference geomesa-tools ConvertCommand): convert -s <spec>
+    --converter conf.json --format geojson files..."""
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.io.exporters import export
+    from geomesa_tpu.sft import FeatureType
+
+    sft = FeatureType.from_spec("converted", args.spec)
+    conv = _converter_from_file(sft, args.converter)
+    parts = []
+    errors = 0
+    for path in args.files:
+        mode = "rb" if conv.fmt == "avro" else "r"
+        with open(path, mode) as fh:
+            parts.append(conv.convert(fh.read()))
+        errors += conv.errors
+    fc = parts[0] if len(parts) == 1 else FeatureCollection.concat(parts)
+    if errors:
+        print(f"{errors} records failed to parse", file=sys.stderr)
+    payload = export(fc, args.format)
+    if args.output:
+        mode = "wb" if isinstance(payload, bytes) else "w"
+        with open(args.output, mode) as fh:
+            fh.write(payload)
+        print(f"converted {len(fc)} features to {args.output}", file=sys.stderr)
+    else:
+        sys.stdout.write(payload if isinstance(payload, str) else payload.hex())
     return 0
 
 
@@ -405,6 +443,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="parallel converter processes (0 = in-process; reference "
         "distributed MapReduce ingest)",
     )
+    sp.add_argument("files", nargs="+")
+
+    sp = add("convert", cmd_convert, catalog=False)
+    sp.add_argument("-s", "--spec", required=True, help="SFT spec string")
+    sp.add_argument("--converter", required=True, help="converter config (json)")
+    sp.add_argument("--format", default="csv", help="output format")
+    sp.add_argument("-o", "--output")
     sp.add_argument("files", nargs="+")
 
     sp = add("export", cmd_export, feature=True)

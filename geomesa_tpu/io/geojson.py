@@ -52,8 +52,8 @@ def read_geojson(
     ingests can rebase on the store size)."""
     if isinstance(source, dict):
         obj = source
-    elif isinstance(source, (str, bytes)) and not (
-        isinstance(source, str) and source.lstrip().startswith("{")
+    elif isinstance(source, (str, bytes)) and not source.lstrip().startswith(
+        "{" if isinstance(source, str) else b"{"
     ):
         with open(source) as f:
             obj = json.load(f)

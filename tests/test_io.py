@@ -832,3 +832,72 @@ class TestReaderReviewFixes:
         assert back.sft.to_spec() == fc.sft.to_spec()
         assert len(back) == 25
         assert list(back.columns["name"]) == list(fc.columns["name"])
+
+
+class TestConvertCommand:
+    """Store-less converter run (reference ConvertCommand)."""
+
+    def test_csv_to_geojson(self, tmp_path, capsys):
+        import json as _json
+
+        from geomesa_tpu import cli
+
+        csv_file = tmp_path / "in.csv"
+        csv_file.write_text(
+            "alpha,1.5,2.5,2024-01-02T00:00:00Z\n"
+            "beta,-3.0,4.0,2024-02-03T00:00:00Z\n"
+        )
+        conf = tmp_path / "conv.json"
+        conf.write_text(_json.dumps({
+            "format": "delimited",
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "dtg", "transform": "datetime($4)"},
+                {"name": "geom", "transform": "point($2, $3)"},
+            ],
+        }))
+        rc = cli.main([
+            "convert", "-s", "name:String,dtg:Date,*geom:Point:srid=4326",
+            "--converter", str(conf), "--format", "geojson", str(csv_file),
+        ])
+        assert rc == 0
+        payload = capsys.readouterr().out
+        gj = _json.loads(payload)
+        assert len(gj["features"]) == 2
+        assert gj["features"][1]["properties"]["name"] == "beta"
+        assert gj["features"][0]["geometry"]["coordinates"] == [1.5, 2.5]
+
+
+class TestReaderReviewFixes2:
+    def test_multi_geojson_fresh_catalog(self, tmp_path, capsys):
+        from geomesa_tpu import cli
+        from geomesa_tpu.io.exporters import export
+
+        for stem, n, seed in (("a", 12, 1), ("b", 14, 2)):
+            fc = TestOrc._fc(n=n, seed=seed, name="mix")
+            (tmp_path / f"{stem}.geojson").write_text(export(fc, "geojson"))
+        # rewrite files WITHOUT ids to force synthesis
+        import json as _json
+
+        for stem in ("a", "b"):
+            p = tmp_path / f"{stem}.geojson"
+            obj = _json.loads(p.read_text())
+            for f in obj["features"]:
+                f.pop("id", None)
+            p.write_text(_json.dumps(obj))
+        cat = str(tmp_path / "cat")
+        rc = cli.main([
+            "ingest", "-c", cat, "-f", "mix", "--file-format", "geojson",
+            str(tmp_path / "a.geojson"), str(tmp_path / "b.geojson"),
+        ])
+        assert rc == 0
+        assert "ingested 26" in capsys.readouterr().out
+
+    def test_geojson_bytes_content(self):
+        from geomesa_tpu.io.geojson import read_geojson
+
+        payload = (b'{"type": "FeatureCollection", "features": ['
+                   b'{"type": "Feature", "geometry": {"type": "Point", '
+                   b'"coordinates": [1, 2]}, "properties": {"v": 3}}]}')
+        fc = read_geojson(payload)
+        assert len(fc) == 1 and fc.geom_column.x[0] == 1.0
