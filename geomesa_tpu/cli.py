@@ -205,15 +205,17 @@ def _ensure_schema(ds, feature_name: str, sft, source: str):
 def _ingest_direct(ds, args) -> int:
     """Self-describing file ingest: schema comes from the file itself
     (reference geomesa-convert-parquet / geomesa-convert-shp). When the
-    catalog already holds the schema, it is offered to the readers so
-    externally-written files (no geomesa metadata/sidecar) still load."""
-    known = (
-        ds.get_schema(args.feature_name)
-        if args.feature_name in ds.type_names()
-        else None
-    )
+    catalog holds the schema — including one created by an earlier file
+    THIS run — it is offered to the readers so externally-written files
+    (no geomesa metadata/sidecar) still load and later files coerce to
+    the stored shape."""
 
     def read(path):
+        known = (
+            ds.get_schema(args.feature_name)
+            if args.feature_name in ds.type_names()
+            else None
+        )
         if args.file_format in ("parquet", "orc", "arrow"):
             if args.file_format == "parquet":
                 from geomesa_tpu.io.parquet import read_parquet as reader
@@ -281,14 +283,28 @@ def cmd_convert(args) -> int:
     conv = _converter_from_file(sft, args.converter)
     parts = []
     errors = 0
+    base = 0
     for path in args.files:
         mode = "rb" if conv.fmt == "avro" else "r"
         with open(path, mode) as fh:
-            parts.append(conv.convert(fh.read()))
+            part = conv.convert(fh.read())
+        if conv._id_expr is None and len(part):
+            # default running-index ids restart per file (cf. cmd_ingest)
+            part = type(part)(
+                part.sft,
+                np.array([str(base + i) for i in range(len(part))]),
+                part.columns,
+            )
+        base += len(part)
+        parts.append(part)
         errors += conv.errors
-    fc = parts[0] if len(parts) == 1 else FeatureCollection.concat(parts)
     if errors:
         print(f"{errors} records failed to parse", file=sys.stderr)
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        print("no features converted", file=sys.stderr)
+        return 1
+    fc = parts[0] if len(parts) == 1 else FeatureCollection.concat(parts)
     payload = export(fc, args.format)
     if args.output:
         mode = "wb" if isinstance(payload, bytes) else "w"

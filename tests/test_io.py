@@ -901,3 +901,76 @@ class TestReaderReviewFixes2:
                    b'"coordinates": [1, 2]}, "properties": {"v": 3}}]}')
         fc = read_geojson(payload)
         assert len(fc) == 1 and fc.geom_column.x[0] == 1.0
+
+
+class TestConvertReviewFixes:
+    def test_multi_file_convert_rebases_ids(self, tmp_path, capsys):
+        import json as _json
+
+        from geomesa_tpu import cli
+
+        for stem in ("a", "b"):
+            (tmp_path / f"{stem}.csv").write_text("x,1,2\ny,3,4\n")
+        conf = tmp_path / "c.json"
+        conf.write_text(_json.dumps({
+            "format": "delimited",
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "geom", "transform": "point($2, $3)"},
+            ]}))
+        rc = cli.main([
+            "convert", "-s", "name:String,*geom:Point:srid=4326",
+            "--converter", str(conf), "--format", "geojson",
+            str(tmp_path / "a.csv"), str(tmp_path / "b.csv"),
+        ])
+        assert rc == 0
+        gj = _json.loads(capsys.readouterr().out)
+        ids = [f["id"] for f in gj["features"]]
+        assert len(set(ids)) == 4  # no collisions across files
+
+    def test_all_failed_clean_error(self, tmp_path, capsys):
+        import json as _json
+
+        from geomesa_tpu import cli
+
+        (tmp_path / "bad.csv").write_text("only-one-column\n")
+        conf = tmp_path / "c.json"
+        conf.write_text(_json.dumps({
+            "format": "delimited",
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "geom", "transform": "point($2, $3)"},
+            ]}))
+        rc = cli.main([
+            "convert", "-s", "name:String,*geom:Point:srid=4326",
+            "--converter", str(conf), str(tmp_path / "bad.csv"),
+            str(tmp_path / "bad.csv"),
+        ])
+        assert rc == 1
+        assert "no features converted" in capsys.readouterr().err
+
+    def test_geojson_second_file_coerces_to_stored_schema(self, tmp_path, capsys):
+        import json as _json
+
+        from geomesa_tpu import cli
+
+        def gj(vals):
+            return _json.dumps({
+                "type": "FeatureCollection",
+                "features": [
+                    {"type": "Feature",
+                     "geometry": {"type": "Point", "coordinates": [i, i]},
+                     "properties": {"v": v}}
+                    for i, v in enumerate(vals)
+                ],
+            })
+
+        (tmp_path / "a.geojson").write_text(gj([1.5, 2.5]))  # Double
+        (tmp_path / "b.geojson").write_text(gj([3, 4]))      # would infer Int
+        cat = str(tmp_path / "cat")
+        rc = cli.main([
+            "ingest", "-c", cat, "-f", "t", "--file-format", "geojson",
+            str(tmp_path / "a.geojson"), str(tmp_path / "b.geojson"),
+        ])
+        assert rc == 0
+        assert "ingested 4" in capsys.readouterr().out

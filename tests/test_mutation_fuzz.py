@@ -1,0 +1,117 @@
+"""Model-based mutation fuzz: random write/upsert/modify/delete/age_off
+sequences on a DataStore, cross-checked after every op against a plain
+dict-of-rows reference model (the update-surface analogue of the query
+fuzz in test_fuzz_queries)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu import geometry as geo
+
+T0 = 1704067200000  # 2024-01-01
+DAY = 86_400_000
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _batch(sft, rng, ids):
+    n = len(ids)
+    return FeatureCollection.from_columns(
+        sft, ids,
+        {"name": np.array([f"n{rng.integers(0, 6)}" for _ in range(n)],
+                          dtype=object),
+         "age": rng.integers(0, 100, n),
+         "dtg": T0 + rng.integers(0, 60 * DAY, n),
+         "geom": (rng.uniform(-170, 170, n), rng.uniform(-85, 85, n))},
+    )
+
+
+def _model_rows(fc):
+    out = {}
+    x, y = np.asarray(fc.geom_column.x), np.asarray(fc.geom_column.y)
+    for i, fid in enumerate(np.asarray(fc.ids).tolist()):
+        out[str(fid)] = {
+            "name": fc.columns["name"][i],
+            "age": int(np.asarray(fc.columns["age"])[i]),
+            "dtg": int(np.asarray(fc.columns["dtg"])[i]),
+            "x": float(x[i]), "y": float(y[i]),
+        }
+    return out
+
+
+def _check(ds, model, rng):
+    """Random queries against the model after a mutation."""
+    # full count
+    assert ds.count("m") == len(model)
+    for _ in range(3):
+        # boxes stay inside [-180, 180]: wrap semantics are pinned
+        # elsewhere (test_datastore), and the flat model here doesn't wrap
+        x0 = float(rng.uniform(-180, 100))
+        y0 = float(rng.uniform(-90, 50))
+        w = float(rng.uniform(5, min(80.0, 180.0 - x0)))
+        t_lo = T0 + int(rng.integers(0, 40 * DAY))
+        t_hi = t_lo + int(rng.integers(DAY, 30 * DAY))
+        q = (f"bbox(geom, {x0}, {y0}, {x0 + w}, {y0 + w}) AND dtg DURING "
+             f"{np.datetime64(t_lo, 'ms')}Z/{np.datetime64(t_hi, 'ms')}Z")
+        got = sorted(np.asarray(ds.query("m", q).ids).tolist())
+        want = sorted(
+            fid for fid, r in model.items()
+            if x0 <= r["x"] <= x0 + w and y0 <= r["y"] <= y0 + w
+            and t_lo <= r["dtg"] <= t_hi
+        )
+        assert got == want, f"query mismatch after mutation: {q}"
+    # attribute query
+    name = f"n{rng.integers(0, 6)}"
+    got = sorted(np.asarray(ds.query("m", f"name = '{name}'").ids).tolist())
+    want = sorted(fid for fid, r in model.items() if r["name"] == name)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_mutation_sequences(seed):
+    rng = np.random.default_rng(seed)
+    sft = FeatureType.from_spec("m", SPEC)
+    ds = DataStore()
+    ds.create_schema(sft)
+    model: dict = {}
+    next_id = 0
+
+    for step in range(12):
+        op = rng.choice(["write", "upsert", "modify", "delete"])
+        if op == "write" or not model:
+            n = int(rng.integers(50, 400))
+            ids = [str(next_id + i) for i in range(n)]
+            next_id += n
+            fc = _batch(sft, rng, ids)
+            ds.write("m", fc)
+            model.update(_model_rows(fc))
+        elif op == "upsert":
+            # replace a random existing subset + some fresh ids
+            existing = list(model)
+            k = int(rng.integers(1, min(80, len(existing)) + 1))
+            chosen = list(rng.choice(existing, k, replace=False))
+            fresh = [str(next_id + i) for i in range(int(rng.integers(0, 20)))]
+            next_id += len(fresh)
+            fc = _batch(sft, rng, chosen + fresh)
+            ds.upsert("m", fc)
+            model.update(_model_rows(fc))
+        elif op == "modify":
+            name = f"n{rng.integers(0, 6)}"
+            new_age = int(rng.integers(200, 300))
+            px, py = float(rng.uniform(-170, 170)), float(rng.uniform(-85, 85))
+            moved = ds.modify_features(
+                "m", {"age": new_age, "geom": geo.Point(px, py)},
+                f"name = '{name}'",
+            )
+            want = [fid for fid, r in model.items() if r["name"] == name]
+            assert moved == len(want)
+            for fid in want:
+                model[fid].update({"age": new_age, "x": px, "y": py})
+        else:  # delete
+            cutoff = int(rng.integers(150, 250))
+            removed = ds.delete_features("m", f"age > {cutoff}")
+            want = [fid for fid, r in model.items() if r["age"] > cutoff]
+            assert removed == len(want)
+            for fid in want:
+                del model[fid]
+        _check(ds, model, rng)
