@@ -876,9 +876,26 @@ def points_in_ring(px, py, ring: np.ndarray) -> np.ndarray:
 
 
 def points_in_polygon(px, py, poly: "Polygon | MultiPolygon") -> np.ndarray:
-    """Point-in-polygon with holes via even-odd parity over all rings."""
+    """Point-in-polygon with holes via even-odd parity over all rings.
+
+    Large batches route through the native threaded ray cast (identical
+    crossing construction): the numpy path materializes an
+    [n_points, n_edges] matrix, which dominates host refinement of
+    polygon queries over point stores."""
     px = np.asarray(px, dtype=np.float64)
     py = np.asarray(py, dtype=np.float64)
+    if px.ndim == 1 and px.shape == py.shape and len(px) > 4096:
+        parts = poly.parts if isinstance(poly, MultiPolygon) else [poly]
+        rings, ring_part = [], []
+        for pi, p in enumerate(parts):
+            for r in [p.shell, *p.holes]:
+                rings.append(np.asarray(r, dtype=np.float64))
+                ring_part.append(pi)
+        from geomesa_tpu import native
+
+        out = native.points_in_polygon(px, py, rings, ring_part)
+        if out is not None:
+            return out
     if isinstance(poly, MultiPolygon):
         out = np.zeros(np.broadcast(px, py).shape, dtype=bool)
         for p in poly.parts:

@@ -29,7 +29,14 @@ _lib: "ctypes.CDLL | None | bool" = None  # None = untried, False = unavailable
 
 def _build() -> bool:
     _LIB.parent.mkdir(exist_ok=True)
-    base = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_LIB)]
+    # -ffp-contract=off: the point-in-polygon ray cast promises bit-exact
+    # parity with numpy's two-rounding float sequence; fused multiply-adds
+    # (default under -O3 on FMA targets) would round differently for
+    # points lying exactly on slanted edges
+    base = [
+        "g++", "-O3", "-ffp-contract=off", "-shared", "-fPIC",
+        str(_SRC), "-o", str(_LIB),
+    ]
     for extra in (["-fopenmp"], []):  # prefer threaded; fall back
         try:
             r = subprocess.run(
@@ -90,6 +97,9 @@ def _load():
             getattr(lib, name).argtypes = [
                 tp, u32p, ctypes.c_int64, ctypes.c_int64, tp
             ]
+        lib.points_in_polygon_cpp.argtypes = [
+            f64p, f64p, ctypes.c_int64, f64p, i64p, ctypes.c_int64, i32p, u8p
+        ]
         lib.zranges_cpp.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
             u64p, u64p, u64p, u64p,
@@ -409,3 +419,28 @@ def zranges(dims, bits_per_dim, mins, maxes, inner_mins, inner_maxes,
     if n < 0:
         return None
     return lo[:n].copy(), hi[:n].copy(), cont[:n].astype(bool)
+
+
+def points_in_polygon(px, py, rings, ring_part) -> "np.ndarray | None":
+    """Even-odd point-in-polygon over flattened rings, or None when the
+    native library is unavailable. ``rings`` is a list of closed [k, 2]
+    f64 rings; ``ring_part[r]`` groups rings into multipolygon parts
+    (within a part parity XORs; parts OR). Crossing semantics match
+    geometry.points_in_ring exactly."""
+    lib = _load()
+    if lib is None:
+        return None
+    px = np.ascontiguousarray(px, dtype=np.float64)
+    py = np.ascontiguousarray(py, dtype=np.float64)
+    verts = np.ascontiguousarray(
+        np.concatenate(rings, axis=0), dtype=np.float64
+    )
+    offsets = np.concatenate(
+        [[0], np.cumsum([len(r) for r in rings])]
+    ).astype(np.int64)
+    part = np.ascontiguousarray(ring_part, dtype=np.int32)
+    out = np.empty(len(px), dtype=np.uint8)
+    lib.points_in_polygon_cpp(
+        px, py, len(px), verts, offsets, len(rings), part, out
+    )
+    return out.astype(bool)

@@ -304,3 +304,73 @@ def test_xz_ranges_parity():
     for r in full:
         i = np.searchsorted(lows, r.lower, side="right") - 1
         assert i >= 0 and highs[i] >= r.upper  # covered
+
+
+class TestNativePointsInPolygon:
+    def test_parity_vs_numpy(self):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu import native
+
+        if not native.available():
+            pytest.skip("native unavailable")
+        rng = np.random.default_rng(0)
+        n = 50_000
+        px = rng.uniform(-5, 15, n)
+        py = rng.uniform(-5, 15, n)
+        # concave polygon with a hole + a second disjoint part
+        shell = np.array(
+            [[0, 0], [10, 0], [10, 10], [6, 10], [6, 4], [4, 4], [4, 10],
+             [0, 10], [0, 0]], float)
+        hole = np.array([[1, 1], [3, 1], [3, 3], [1, 3], [1, 1]], float)
+        part2 = geo.Polygon(np.array(
+            [[12, 12], [14, 12], [14, 14], [12, 14], [12, 12]], float))
+        mp = geo.MultiPolygon([geo.Polygon(shell, [hole]), part2])
+        got = native.points_in_polygon(
+            px, py,
+            [shell, hole, np.asarray(part2.shell)], [0, 0, 1],
+        )
+        # numpy truth via the per-ring path (force below native threshold)
+        want = np.zeros(n, dtype=bool)
+        for pi, p in enumerate([geo.Polygon(shell, [hole]), part2]):
+            parity = geo.points_in_ring(px, py, p.shell)
+            for h in p.holes:
+                parity ^= geo.points_in_ring(px, py, h)
+            want |= parity
+        np.testing.assert_array_equal(got, want)
+        # and the public entry point routes identically above threshold
+        via_public = geo.points_in_polygon(px, py, mp)
+        np.testing.assert_array_equal(via_public, want)
+
+    def test_boundary_grid_cases(self):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu import native
+
+        if not native.available():
+            pytest.skip("native unavailable")
+        # points exactly on integer grid lines of a unit-square lattice:
+        # parity semantics must match numpy bit-for-bit
+        xs, ys = np.meshgrid(np.linspace(-1, 3, 41), np.linspace(-1, 3, 41))
+        px, py = xs.ravel(), ys.ravel()
+        sq = geo.box(0, 0, 2, 2)
+        got = native.points_in_polygon(px, py, [np.asarray(sq.shell)], [0])
+        want = geo.points_in_ring(px, py, np.asarray(sq.shell))
+        np.testing.assert_array_equal(got, want)
+
+    def test_slanted_edge_points(self):
+        """Points exactly ON slanted edges: native must match numpy even
+        where FMA contraction could flip the strict x comparison."""
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu import native
+
+        if not native.available():
+            pytest.skip("native unavailable")
+        tri = np.array([[0, 0], [7, 3], [2, 9], [0, 0]], float)
+        # sample points ON each edge at irrational-ish parameters
+        ts = np.linspace(0.01, 0.99, 997)
+        pts = []
+        for a, b in zip(tri[:-1], tri[1:]):
+            pts.append(a + ts[:, None] * (b - a))
+        p = np.concatenate(pts)
+        got = native.points_in_polygon(p[:, 0], p[:, 1], [tri], [0])
+        want = geo.points_in_ring(p[:, 0], p[:, 1], tri)
+        np.testing.assert_array_equal(got, want)
