@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+import numpy as np
+
 
 @dataclass
 class QueryHints:
@@ -28,6 +30,9 @@ class QueryHints:
     - ``loose``: accept the widened device mask without exact host
       refinement of spatial/temporal predicates — the reference's
       LOOSE_BBOX fast path. Non-indexed predicates are still applied.
+    - ``offset``: skip this many results after sorting (reference
+      GeoTools Query.startIndex paging; pair with the query ``limit`` for
+      stable pages under a ``sort_by``)
     - ``timeout``: wall-clock budget in seconds for this query; checked at
       stage boundaries, raises QueryTimeout when exceeded (reference
       per-plan timeouts + ThreadManagement scan registration). Overrides
@@ -36,6 +41,7 @@ class QueryHints:
 
     transforms: Optional[Sequence[str]] = None
     sort_by: Optional[str] = None
+    offset: Optional[int] = None
     sample: Optional[float] = None
     sample_by: Optional[str] = None
     loose: bool = False
@@ -46,3 +52,7 @@ class QueryHints:
             raise ValueError(f"sample must be in (0, 1], got {self.sample}")
         if self.timeout is not None and self.timeout <= 0:
             raise ValueError(f"timeout must be positive, got {self.timeout}")
+        if self.offset is not None and (
+            not isinstance(self.offset, (int, np.integer)) or self.offset < 0
+        ):
+            raise ValueError(f"offset must be a non-negative int, got {self.offset!r}")

@@ -459,8 +459,9 @@ class QueryPlanner:
 
     def _post(self, out, plan, hints, exp, skip_visibility: bool = False):
         """Client-side reduce pipeline: visibility -> sample -> sort ->
-        limit -> project (reference QueryPlanner.scala:66-102 runs the same
-        stages after the scan: reducer, sort, maxFeatures, projection)."""
+        offset -> limit -> project (reference QueryPlanner.scala:66-102
+        runs the same stages after the scan: reducer, sort, startIndex,
+        maxFeatures, projection)."""
         # row-level security: mask rows whose visibility label the store's
         # auths cannot satisfy (reference VisibilityEvaluator tier)
         auths = None if skip_visibility else getattr(self.store, "auths", None)
@@ -479,8 +480,16 @@ class QueryPlanner:
                 exp(f"Sampled: {len(out)}")
             if hints.sort_by:
                 out = out.sort_values(hints.sort_by)
-        if plan.limit is not None and len(out) > plan.limit:
-            out = out.take(np.arange(plan.limit))
+        off = hints.offset if hints is not None and hints.offset else 0
+        if off or (plan.limit is not None and len(out) > plan.limit):
+            # one gather for the page: materializing the whole post-offset
+            # tail before the limit would copy every column of a large
+            # result just to keep a page of it
+            lo = min(off, len(out))
+            hi = len(out) if plan.limit is None else min(lo + plan.limit, len(out))
+            out = out.take(np.arange(lo, hi))
+            if off:
+                exp(f"Offset {off}: rows [{lo}, {hi})")
         if hints is not None and hints.transforms is not None:
             out = out.project(hints.transforms)
         return out

@@ -484,3 +484,29 @@ class TestModifyDtypeSafety:
         ds, _, _ = TestUpdateSurface._store()
         with pytest.raises(TypeError, match="tuple"):
             ds.modify_features("upd", {"geom": (1.0, 2.0)}, "IN ('1')")
+
+
+class TestPagingOffset:
+    def test_offset_pages_are_stable_and_disjoint(self):
+        from geomesa_tpu.planning.hints import QueryHints
+
+        ds, fc = make_point_store(n=500, seed=3)
+        f = "bbox(geom, -180, -90, 180, 90)"
+        pages = []
+        for off in range(0, 500, 100):
+            h = QueryHints(sort_by="count", offset=off)
+            page = ds.query("gdelt", f, limit=100, hints=h)
+            pages.append(page.ids.tolist())
+        flat = [i for p in pages for i in p]
+        assert len(flat) == 500 and len(set(flat)) == 500
+        # pages follow the sort order
+        h_all = QueryHints(sort_by="count")
+        want = ds.query("gdelt", f, hints=h_all).ids.tolist()
+        assert flat == want
+        # offset past the end yields empty, negative rejected
+        h = QueryHints(offset=10_000)
+        assert len(ds.query("gdelt", f, hints=h)) == 0
+        with pytest.raises(ValueError):
+            QueryHints(offset=-1).validate()
+        with pytest.raises(ValueError):
+            QueryHints(offset=2.5).validate()
