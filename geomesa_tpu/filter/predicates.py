@@ -159,8 +159,35 @@ def _packed_box_intersects(
         & (bb[:, 0] <= q[2]) & (bb[:, 2] >= q[0])
         & (bb[:, 1] <= q[3]) & (bb[:, 3] >= q[1])
     )
-    for i in np.nonzero(rough & ~bmask)[0]:
-        out[i] = geo.intersects(col.geometry(int(i)), g)
+    hard = rough & ~bmask
+    n_hard = int(hard.sum())
+    if 0 < n_hard <= 64:
+        # a handful of non-rect candidates (e.g. a few odd polygons in a
+        # mostly-rectangle column): the per-geometry loop beats scanning
+        # the whole coords pool
+        for i in np.nonzero(hard)[0]:
+            out[i] = geo.intersects(col.geometry(int(i)), g)
+    elif n_hard:
+        # vectorized accept tier for arbitrary (non-rectangle) geometries:
+        # the query here is ALWAYS an axis-aligned rect (both call sites
+        # gate on is_rectangle), so any geometry VERTEX inside it proves
+        # intersection. Each geometry's coords are one contiguous pool
+        # slice; a cumsum turns the per-vertex test into per-geometry
+        # counts. Only vertex-free overlaps (rect fully inside the
+        # geometry, or pure edge crossings) fall to the per-geometry loop.
+        c = col.coords
+        inb = (
+            (c[:, 0] >= q[0]) & (c[:, 0] <= q[2])
+            & (c[:, 1] >= q[1]) & (c[:, 1] <= q[3])
+        )
+        csum = np.concatenate([[0], np.cumsum(inb)])
+        first_ring = col.part_ring_offsets[col.geom_part_offsets].astype(np.int64)
+        bounds_ix = col.ring_offsets[first_ring].astype(np.int64)
+        start, end = bounds_ix[:-1], bounds_ix[1:]
+        any_vertex = (csum[end] - csum[start]) > 0
+        out |= hard & any_vertex
+        for i in np.nonzero(hard & ~any_vertex)[0]:
+            out[i] = geo.intersects(col.geometry(int(i)), g)
     return out
 
 
