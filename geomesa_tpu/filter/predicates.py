@@ -98,16 +98,38 @@ EXCLUDE = Exclude()
 # ---------------------------------------------------------------------------
 
 
-def _eval_spatial(col, fn_points, fn_geom) -> np.ndarray:
+def _ulp_out(x0: float, y0: float, x1: float, y1: float):
+    """Bounds widened one f32 ulp outward — matching the widening the
+    packed column applied to its stored bboxes, so bbox prefilters built
+    on >=/<= comparisons stay conservative."""
+    lo = np.nextafter(np.array([x0, y0], dtype=np.float32), -np.inf).astype(np.float64)
+    hi = np.nextafter(np.array([x1, y1], dtype=np.float32), np.inf).astype(np.float64)
+    return float(lo[0]), float(lo[1]), float(hi[0]), float(hi[1])
+
+
+def _eval_spatial(col, fn_points, fn_geom, candidates=None) -> np.ndarray:
+    """Exact per-geometry evaluation over a packed column, restricted to
+    ``candidates`` (a bool mask from a vectorized bbox prefilter — rows
+    outside it are definitively False)."""
     if isinstance(col, PointColumn):
         return fn_points(col.x, col.y)
     if isinstance(col, geo.PackedGeometryColumn):
         out = np.zeros(len(col), dtype=bool)
-        # bbox prefilter then exact per-geometry
-        for i in range(len(col)):
-            out[i] = fn_geom(col.geometry(i))
+        rows = range(len(col)) if candidates is None else np.nonzero(candidates)[0]
+        for i in rows:
+            out[i] = fn_geom(col.geometry(int(i)))
         return out
     raise TypeError(f"not a geometry column: {type(col)}")
+
+
+def _per_geom_vertex_counts(col: "geo.PackedGeometryColumn", vertex_mask):
+    """How many of each geometry's pool vertices satisfy ``vertex_mask``
+    ([total_verts] bool) — the cumsum reduction over the contiguous
+    per-geometry coord slices."""
+    csum = np.concatenate([[0], np.cumsum(vertex_mask)])
+    first_ring = col.part_ring_offsets[col.geom_part_offsets].astype(np.int64)
+    bounds_ix = col.ring_offsets[first_ring].astype(np.int64)
+    return csum[bounds_ix[1:]] - csum[bounds_ix[:-1]]
 
 
 @dataclass(frozen=True)
@@ -180,11 +202,7 @@ def _packed_box_intersects(
             (c[:, 0] >= q[0]) & (c[:, 0] <= q[2])
             & (c[:, 1] >= q[1]) & (c[:, 1] <= q[3])
         )
-        csum = np.concatenate([[0], np.cumsum(inb)])
-        first_ring = col.part_ring_offsets[col.geom_part_offsets].astype(np.int64)
-        bounds_ix = col.ring_offsets[first_ring].astype(np.int64)
-        start, end = bounds_ix[:-1], bounds_ix[1:]
-        any_vertex = (csum[end] - csum[start]) > 0
+        any_vertex = _per_geom_vertex_counts(col, inb) > 0
         out |= hard & any_vertex
         for i in np.nonzero(hard & ~any_vertex)[0]:
             out[i] = geo.intersects(col.geometry(int(i)), g)
@@ -225,6 +243,16 @@ class Intersects(Filter):
                 return _packed_box_intersects(col, q, g)
             rough = geo.bbox_intersects(col.bboxes.astype(np.float64), q)
             out = np.zeros(len(col), dtype=bool)
+            n_rough = int(rough.sum())
+            if n_rough > 64 and isinstance(g, (geo.Polygon, geo.MultiPolygon)):
+                # accept tier for a POLYGON query over arbitrary features:
+                # any feature vertex inside the query polygon proves
+                # intersection (one native ray cast over the coords pool)
+                c = col.coords
+                inside = geo.points_in_polygon(c[:, 0], c[:, 1], g)
+                n_in = _per_geom_vertex_counts(col, inside)
+                out |= rough & (n_in > 0)
+                rough &= ~out
             for i in np.nonzero(rough)[0]:
                 out[i] = geo.intersects(col.geometry(int(i)), g)
             return out
@@ -245,7 +273,34 @@ class Within(Filter):
             raise ValueError("WITHIN requires a polygonal query geometry")
         if isinstance(col, PointColumn):
             return geo.points_in_polygon(col.x, col.y, g)
-        return _eval_spatial(col, None, lambda feat: geo.contains(g, feat))
+        # necessary condition, vectorized: the feature's bbox lies inside
+        # the query's bbox (within implies bbox containment). Stored
+        # bboxes are f32-widened one ulp OUTWARD, so the query bounds
+        # widen by an ulp too — no true-within row is ever excluded;
+        # extra grazers fall to the exact check below.
+        x0, y0, x1, y1 = _ulp_out(*g.bounds())
+        b = col.bboxes.astype(np.float64)
+        cand = (b[:, 0] >= x0) & (b[:, 1] >= y0) & (b[:, 2] <= x1) & (b[:, 3] <= y1)
+        if geo.is_rectangle(g):
+            # two-tier for a rect query: rows whose OUTWARD-widened stored
+            # bbox fits inside the RAW query bounds are definitely within
+            # (true bbox subset of stored; boundary contact allowed, as
+            # JTS `within` permits boundary points). Only the sub-ulp
+            # boundary band (cand minus sure) needs the exact check, so
+            # a protruding vertex 1 ulp past the edge is never accepted.
+            rx0, ry0, rx1, ry1 = g.bounds()
+            sure = (
+                (b[:, 0] >= rx0) & (b[:, 1] >= ry0)
+                & (b[:, 2] <= rx1) & (b[:, 3] <= ry1)
+            )
+            out = _eval_spatial(
+                col, None, lambda feat: geo.contains(g, feat),
+                candidates=cand & ~sure,
+            )
+            return out | sure
+        return _eval_spatial(
+            col, None, lambda feat: geo.contains(g, feat), candidates=cand
+        )
 
 
 @dataclass(frozen=True)
@@ -261,9 +316,16 @@ class Contains(Filter):
             if isinstance(self.geom, geo.Point):
                 return (col.x == self.geom.x) & (col.y == self.geom.y)
             return np.zeros(len(col), dtype=bool)
+        # necessary condition, vectorized: the feature's bbox covers the
+        # query geometry's bbox (stored bboxes widen outward, so the
+        # direct comparison is already conservative for covering)
+        x0, y0, x1, y1 = self.geom.bounds()
+        b = col.bboxes.astype(np.float64)
+        cand = (b[:, 0] <= x0) & (b[:, 1] <= y0) & (b[:, 2] >= x1) & (b[:, 3] >= y1)
         return _eval_spatial(
             col, None, lambda feat: isinstance(feat, (geo.Polygon, geo.MultiPolygon))
-            and geo.contains(feat, self.geom)
+            and geo.contains(feat, self.geom),
+            candidates=cand,
         )
 
 
@@ -280,14 +342,24 @@ class DWithin(Filter):
         if isinstance(col, PointColumn):
             if isinstance(self.geom, geo.Point):
                 return np.hypot(col.x - self.geom.x, col.y - self.geom.y) <= self.dist
+            # bbox prefilter: only points inside the distance-expanded
+            # envelope can be within range
+            x0, y0, x1, y1 = self.bounds
+            near = (col.x >= x0) & (col.x <= x1) & (col.y >= y0) & (col.y <= y1)
             out = np.zeros(len(col), dtype=bool)
-            for i in range(len(col)):
+            for i in np.nonzero(near)[0]:
                 out[i] = (
                     geo._point_geom_distance(float(col.x[i]), float(col.y[i]), self.geom)
                     <= self.dist
                 )
             return out
-        return _eval_spatial(col, None, lambda feat: geo.distance(feat, self.geom) <= self.dist)
+        x0, y0, x1, y1 = _ulp_out(*self.bounds)
+        b = col.bboxes.astype(np.float64)
+        cand = (b[:, 0] <= x1) & (b[:, 2] >= x0) & (b[:, 1] <= y1) & (b[:, 3] >= y0)
+        return _eval_spatial(
+            col, None, lambda feat: geo.distance(feat, self.geom) <= self.dist,
+            candidates=cand,
+        )
 
     @property
     def bounds(self) -> tuple[float, float, float, float]:

@@ -265,3 +265,91 @@ class TestPackedBoxIntersectsFastTier:
         many = geo.PackedGeometryColumn.from_geometries(diamonds * 40)
         got_many = _packed_box_intersects(many, q, geo.box(*q))
         np.testing.assert_array_equal(got_many, np.tile(want, 40))
+
+
+class TestSpatialPrefilters:
+    """Bbox prefilters on Within/Contains/DWithin and the polygon
+    vertex-accept tier on non-rect INTERSECTS: results must equal the
+    exhaustive per-geometry evaluation."""
+
+    @staticmethod
+    def _col(n=3000, seed=0):
+        rng = np.random.default_rng(seed)
+        cx, cy = rng.uniform(-40, 40, n), rng.uniform(-25, 25, n)
+        polys = []
+        for i in range(n):
+            a = np.sort(rng.uniform(0, 2 * np.pi, 4))
+            r = rng.uniform(0.05, 0.8, 4)
+            ring = np.stack([cx[i] + r * np.cos(a), cy[i] + r * np.sin(a)], 1)
+            polys.append(geo.Polygon(np.concatenate([ring, ring[:1]])))
+        return polys, geo.PackedGeometryColumn.from_geometries(polys)
+
+    def test_within_contains_dwithin(self):
+        from geomesa_tpu.filter.predicates import Contains, DWithin, Within
+
+        polys, col = self._col()
+        batch = {"geom": col}
+        big = geo.Polygon(np.array(
+            [[-10, -10], [20, -12], [22, 15], [-12, 14], [-10, -10]], float))
+        w = Within("geom", big).evaluate(batch)
+        want_w = np.array([geo.contains(big, p) for p in polys])
+        np.testing.assert_array_equal(w, want_w)
+        assert want_w.any()
+        tiny = geo.Point(polys[7].shell[:-1].mean(axis=0)[0],
+                         polys[7].shell[:-1].mean(axis=0)[1])
+        c = Contains("geom", tiny).evaluate(batch)
+        want_c = np.array([geo.contains(p, tiny) for p in polys])
+        np.testing.assert_array_equal(c, want_c)
+        d = DWithin("geom", geo.Point(0.0, 0.0), 5.0).evaluate(batch)
+        want_d = np.array([geo.distance(p, geo.Point(0.0, 0.0)) <= 5.0 for p in polys])
+        np.testing.assert_array_equal(d, want_d)
+        assert want_d.any()
+
+    def test_dwithin_points_line(self):
+        from geomesa_tpu.filter.predicates import DWithin, PointColumn
+
+        rng = np.random.default_rng(1)
+        n = 5000
+        px, py = rng.uniform(-30, 30, n), rng.uniform(-30, 30, n)
+        line = geo.LineString(np.array([[-10, -10], [0, 5], [12, 3]], float))
+        got = DWithin("geom", line, 2.5).evaluate(
+            {"geom": PointColumn(px, py)})
+        want = np.array([
+            geo._point_geom_distance(float(px[i]), float(py[i]), line) <= 2.5
+            for i in range(n)])
+        np.testing.assert_array_equal(got, want)
+        assert want.any()
+
+    def test_intersects_concave_query_polygon(self):
+        from geomesa_tpu.filter.predicates import Intersects
+
+        polys, col = self._col(n=2000, seed=2)
+        # concave star query: the vertex-accept tier plus exact fallback
+        t = np.linspace(0, 2 * np.pi, 11)
+        r = np.where(np.arange(11) % 2 == 0, 18.0, 6.0)
+        star = geo.Polygon(np.stack(
+            [5 + r * np.cos(t), 2 + r * np.sin(t)], 1))
+        got = Intersects("geom", star).evaluate({"geom": col})
+        want = np.array([geo.intersects(p, star) for p in polys])
+        np.testing.assert_array_equal(got, want)
+        assert want.any() and not want.all()
+
+
+class TestWithinBoundaryBand:
+    def test_protruding_vertex_rejected(self):
+        from geomesa_tpu.filter.predicates import Within
+
+        rect = geo.box(0, 0, 100, 100)
+        inside = geo.Polygon(np.array(
+            [[10, 10], [20, 10], [15, 20], [10, 10]], float))
+        # vertex 1 f32-ulp past the edge: widened-bbox prefilter alone
+        # would accept it; the boundary band must reject exactly
+        poke = geo.Polygon(np.array(
+            [[90, 10], [100.000003, 10], [95, 20], [90, 10]], float))
+        far = geo.Polygon(np.array(
+            [[200, 10], [210, 10], [205, 20], [200, 10]], float))
+        col = geo.PackedGeometryColumn.from_geometries([inside, poke, far])
+        got = Within("geom", rect).evaluate({"geom": col})
+        want = [geo.contains(rect, g) for g in (inside, poke, far)]
+        np.testing.assert_array_equal(got, np.array(want))
+        assert got[0] and not got[1] and not got[2]
