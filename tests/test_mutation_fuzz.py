@@ -115,3 +115,78 @@ def test_mutation_sequences(seed):
             for fid in want:
                 del model[fid]
         _check(ds, model, rng)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_extent_mutation_sequences(seed):
+    """The same model-based check over an XZ2 polygon store: writes,
+    geometry-moving modifies, and deletes keep index results exact."""
+    rng = np.random.default_rng(100 + seed)
+    sft = FeatureType.from_spec("me", "tag:String,*geom:Polygon:srid=4326")
+    ds = DataStore()
+    ds.create_schema(sft)
+    model: dict = {}  # id -> (tag, (x0, y0, x1, y1))
+    next_id = 0
+
+    def rects(n):
+        x0 = rng.uniform(-170, 165, n)
+        y0 = rng.uniform(-85, 80, n)
+        w = rng.uniform(0.01, 2.0, n)
+        h = rng.uniform(0.01, 2.0, n)
+        return x0, y0, x0 + w, y0 + h
+
+    def batch(ids):
+        n = len(ids)
+        x0, y0, x1, y1 = rects(n)
+        col = geo.PackedGeometryColumn.from_boxes(x0, y0, x1, y1)
+        tags = np.array([f"t{rng.integers(0, 4)}" for _ in range(n)], dtype=object)
+        fc = FeatureCollection.from_columns(sft, ids, {"tag": tags, "geom": col})
+        rows = {
+            str(fid): (tags[i], (x0[i], y0[i], x1[i], y1[i]))
+            for i, fid in enumerate(ids)
+        }
+        return fc, rows
+
+    def check():
+        assert ds.count("me") == len(model)
+        for _ in range(3):
+            qx = float(rng.uniform(-170, 120))
+            qy = float(rng.uniform(-85, 40))
+            w = float(rng.uniform(2, 40))
+            q = f"bbox(geom, {qx}, {qy}, {qx + w}, {qy + w})"
+            got = sorted(np.asarray(ds.query("me", q).ids).tolist())
+            want = sorted(
+                fid for fid, (_, (x0, y0, x1, y1)) in model.items()
+                if x0 <= qx + w and x1 >= qx and y0 <= qy + w and y1 >= qy
+            )
+            assert got == want, q
+
+    for step in range(8):
+        op = rng.choice(["write", "modify", "delete"])
+        if op == "write" or not model:
+            n = int(rng.integers(100, 600))
+            ids = [str(next_id + i) for i in range(n)]
+            next_id += n
+            fc, rows = batch(ids)
+            ds.write("me", fc)
+            model.update(rows)
+        elif op == "modify":
+            tag = f"t{rng.integers(0, 4)}"
+            # random destination cell so XZ2 re-keying is exercised at
+            # varying resolutions/signs, like the point-store fuzz
+            dx0, dy0, dx1, dy1 = (float(v[0]) for v in rects(1))
+            moved = ds.modify_features(
+                "me", {"geom": geo.box(dx0, dy0, dx1, dy1)}, f"tag = '{tag}'"
+            )
+            want = [fid for fid, (t, _) in model.items() if t == tag]
+            assert moved == len(want)
+            for fid in want:
+                model[fid] = (tag, (dx0, dy0, dx1, dy1))
+        else:
+            tag = f"t{rng.integers(0, 4)}"
+            removed = ds.delete_features("me", f"tag = '{tag}'")
+            want = [fid for fid, (t, _) in model.items() if t == tag]
+            assert removed == len(want)
+            for fid in want:
+                del model[fid]
+        check()
