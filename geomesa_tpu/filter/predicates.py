@@ -228,10 +228,14 @@ class Intersects(Filter):
                 inside = np.zeros(len(col), dtype=bool)
                 ni = np.nonzero(near)[0]
                 inside[ni] = geo.points_in_polygon(col.x[ni], col.y[ni], g)
-                # boundary counts for intersects
-                for i in ni[~inside[ni]]:
-                    if geo._point_on_rings(g, float(col.x[i]), float(col.y[i])):
-                        inside[i] = True
+                # boundary counts for intersects — vectorized over the
+                # near-but-not-inside candidates (a per-point loop here
+                # cost seconds on dense bbox-near outside regions)
+                nb = ni[~inside[ni]]
+                if len(nb):
+                    inside[nb] = geo.points_on_boundary(
+                        col.x[nb], col.y[nb], g
+                    )
                 return inside
             out = np.zeros(len(col), dtype=bool)
             for i in np.nonzero(near)[0]:
