@@ -330,10 +330,19 @@ class DataStore:
         # between the delete and the write — the store's documented
         # snapshot-read, single-writer-at-a-time semantics)
         with self._write_lock:
-            self.delete_features(
-                type_name, IdFilter(tuple(np.asarray(features.ids).tolist()))
+            ids = tuple(np.asarray(features.ids).tolist())
+            # the delete returns the removed rows (one scan) so a write()
+            # failure past the dry-run validation — device OOM, say —
+            # restores them instead of silently losing the replaced rows
+            existing = self._delete_features_locked(
+                type_name, IdFilter(ids), return_removed=True
             )
-            return self.write(type_name, features)
+            try:
+                return self.write(type_name, features)
+            except BaseException:
+                if len(existing):
+                    self.write(type_name, existing)  # best-effort rollback
+                raise
 
     def _validate_replacement(self, type_name: str, features) -> None:
         """Fail BEFORE any row is deleted: a replacement batch that cannot
@@ -408,9 +417,21 @@ class DataStore:
                         # values ('renamed' -> 're' in a <U2 column)
                         cols[name] = np.full(n, str(value))
                     else:
+                        # NaN is the store's null representation (IS NULL,
+                        # DescriptiveStats): None and NaN both null a float
+                        # attribute — not a lossy cast (NaN != NaN would
+                        # always fail the == check below)
+                        if value is None and np.issubdtype(base.dtype, np.floating):
+                            value = float("nan")
                         arr = np.full(n, value, dtype=base.dtype)
-                        if not np.all(arr == value):  # lossy cast refused
-                            raise TypeError(
+                        try:
+                            nan_null = np.issubdtype(
+                                base.dtype, np.floating
+                            ) and bool(np.isnan(value))
+                        except TypeError:
+                            nan_null = False
+                        if not (nan_null or np.all(arr == value)):
+                            raise TypeError(  # lossy cast refused
                                 f"value {value!r} does not fit attribute "
                                 f"{name!r} ({base.dtype})"
                             )
@@ -420,7 +441,13 @@ class DataStore:
             self.delete_features(
                 type_name, IdFilter(tuple(np.asarray(matched.ids).tolist()))
             )
-            self.write(type_name, updated)
+            try:
+                self.write(type_name, updated)
+            except BaseException:
+                # ``matched`` is the pre-delete snapshot: restore it so a
+                # write failure past validation doesn't lose the rows
+                self.write(type_name, matched)  # best-effort rollback
+                raise
             return n
 
     def age_off(self, type_name: str, ttl_ms: int, now_ms: int | None = None) -> int:
@@ -437,14 +464,19 @@ class DataStore:
 
         return self.delete_features(type_name, Cmp(sft.dtg_field, "<", now - ttl_ms))
 
-    def _delete_features_locked(self, type_name: str, f: "Filter | str") -> int:
+    def _delete_features_locked(
+        self, type_name: str, f: "Filter | str", return_removed: bool = False
+    ):
+        """``return_removed=True`` returns the removed rows (for compound
+        ops that need a rollback snapshot — one scan, not two) instead of
+        the count."""
         # maintenance scan: the RAW filter decides what is removed — an
         # interceptor (age-off TTL, say) must not rewrite a deletion of
         # expired rows into a contradiction
         plan = self.planner.plan(type_name, f, intercept=False)
         out = self.planner.execute(plan)
         if len(out) == 0:
-            return 0
+            return out if return_removed else 0
         ordinals = self.id_lookup(type_name, out.ids)
         full = self.features(type_name)
         keep = np.ones(len(full), dtype=bool)
@@ -474,7 +506,7 @@ class DataStore:
         )
         self._main_rows[type_name] = 0  # force table rebuild
         self.compact(type_name)
-        return int((~keep).sum())
+        return out if return_removed else int((~keep).sum())
 
     def _build_stats_fresh(self, type_name: str, fc: FeatureCollection):
         from geomesa_tpu.stats.store import StatsStore

@@ -94,10 +94,21 @@ def read_geojson(
         parts.append(f"*{geom_name}:{gtype}:srid=4326")
         sft = FeatureType.from_spec(type_name, ",".join(parts))
 
-    ids = [
-        str(f.get("id")) if f.get("id") is not None else str(id_offset + i)
-        for i, f in enumerate(feats)
-    ]
+    # synthesized ids must not collide with explicit ids in the same batch
+    # (a file mixing id-less features with explicit numeric ids): number
+    # only the id-less features with a separate counter, skipping values
+    # already taken by an explicit id
+    explicit = {str(f["id"]) for f in feats if f.get("id") is not None}
+    ids: list[str] = []
+    next_id = id_offset
+    for f in feats:
+        if f.get("id") is not None:
+            ids.append(str(f["id"]))
+        else:
+            while str(next_id) in explicit:
+                next_id += 1
+            ids.append(str(next_id))
+            next_id += 1
     rows = []
     for i, f in enumerate(feats):
         row = dict(f.get("properties") or {})

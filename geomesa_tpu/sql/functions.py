@@ -1015,30 +1015,43 @@ def _boundary_or_none(g: geo.Geometry):
     return None if b._coord_count() == 0 else b
 
 
+def _int_dim(sa: int, sb: int, ga, gb) -> int:
+    """Dimension of the intersection of two point sets with dims
+    ``sa``/``sb`` (carried by geometries ``ga``/``gb``): min(sa, sb) —
+    except two 1-dimensional sets, which meet in isolated points (dim 0)
+    unless they share a positive-length collinear run (JTS reports the
+    true dimension here, not the generic min; e.g. two overlapping boxes'
+    boundaries cross at two POINTS -> '0')."""
+    if sa == 1 and sb == 1:
+        return 1 if _collinear_overlap(ga, gb) else 0
+    return min(sa, sb)
+
+
 @_register
 def st_relate(a: geo.Geometry, b: geo.Geometry) -> str:
     """DE-9IM matrix. Entries are computed from the predicate engine;
-    dimensions are the generic-position values (see module note)."""
+    1-dim x 1-dim entries resolve point-vs-collinear-run exactly
+    (_int_dim); remaining dimensions are the generic-position values."""
     da, db = st_dimension(a), st_dimension(b)
     ba, bb_ = _boundary_or_none(a), _boundary_or_none(b)
 
     def dim_or_f(hit: bool, dim: int) -> str:
         return str(dim) if hit else "F"
 
-    ii = dim_or_f(_interiors_intersect(a, b), min(da, db)
-                  if not (da == db == 1) or _collinear_overlap(a, b) else 0)
+    ii = dim_or_f(_interiors_intersect(a, b), _int_dim(da, db, a, b))
     ib = dim_or_f(
-        bb_ is not None and _interiors_intersect(a, bb_), min(da, db - 1)
-        if bb_ is not None else 0,
+        bb_ is not None and _interiors_intersect(a, bb_),
+        _int_dim(da, db - 1, a, bb_) if bb_ is not None else 0,
     )
     ie = dim_or_f(_has_point_outside(a, b), da)
     bi = dim_or_f(
-        ba is not None and _interiors_intersect(ba, b), min(da - 1, db)
-        if ba is not None else 0,
+        ba is not None and _interiors_intersect(ba, b),
+        _int_dim(da - 1, db, ba, b) if ba is not None else 0,
     )
     bb2 = dim_or_f(
         ba is not None and bb_ is not None and geo.intersects(ba, bb_),
-        min(da - 1, db - 1) if ba is not None and bb_ is not None else 0,
+        _int_dim(da - 1, db - 1, ba, bb_)
+        if ba is not None and bb_ is not None else 0,
     )
     be = dim_or_f(
         ba is not None and _has_point_outside(ba, b), da - 1 if ba is not None else 0
@@ -1073,9 +1086,19 @@ def st_relatebool(a: geo.Geometry, b: geo.Geometry, pattern: str) -> bool:
 
 @_register
 def st_distancesphere(a: geo.Geometry, b: geo.Geometry) -> float:
-    """Great-circle meters between representative points (reference
-    ST_DistanceSphere)."""
-    return st_distancespheroid(a, b)
+    """Great-circle meters between two geometries (reference
+    ST_DistanceSphere): 0 when they intersect, else the haversine
+    distance between the planar nearest-point pair — exact at vertices,
+    a documented approximation when the true geodesic nearest points
+    fall mid-edge (planar projection picks the edge points)."""
+    if geo.intersects(a, b):
+        return 0.0
+    pa = st_closestpoint(a, b)
+    # derive b's point FROM pa: independent closest points can come from
+    # different tie-minimizing pairs (parallel overlapping lines) and
+    # pairing them would overstate the distance
+    pb = st_closestpoint(b, pa)
+    return float(haversine_m(pa.x, pa.y, pb.x, pb.y))
 
 
 @_register

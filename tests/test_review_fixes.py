@@ -108,3 +108,159 @@ class TestPackedColumnSurgery:
         both = geo.PackedGeometryColumn.concat([col, col.take(np.array([1, 3]))])
         expect = [g.wkt for g in geoms] + [geoms[1].wkt, geoms[3].wkt]
         assert [g.wkt for g in both.geometries()] == expect
+
+
+class TestAdvisorRound4Fixes:
+    """Regressions for ADVICE.md round-4 findings."""
+
+    def test_st_relate_1dim_sets_meet_in_points(self):
+        # overlapping boxes: boundaries cross at two POINTS (JTS 212101212,
+        # not the generic min-dim 212111212)
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.sql.functions import st_relate, st_relatebool
+
+        a, b = geo.box(0, 0, 2, 2), geo.box(1, 1, 3, 3)
+        assert st_relate(a, b) == "212101212"
+        # line crossing a polygon: I(L) x B(P) is points -> 101FF0212
+        line = geo.from_wkt("LINESTRING(-1 1, 3 1)")
+        assert st_relate(line, geo.box(0, 0, 2, 2)) == "101FF0212"
+        # edge-adjacent squares share a collinear boundary run: dim 1 kept
+        assert st_relate(geo.box(0, 0, 1, 1), geo.box(1, 0, 2, 1)) == "FF2F11212"
+        # digit-bearing pattern matching now agrees with JTS
+        assert st_relatebool(a, b, "T*T***T*T")
+        assert not st_relatebool(a, b, "****1****")  # BB is points, not a run
+        assert st_relatebool(a, b, "****0****")
+
+    def test_modify_features_nan_nulls_float_attr(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.sft import FeatureType
+
+        sft = FeatureType.from_spec("t", "v:Double,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("t", FeatureCollection.from_columns(
+            sft, np.arange(4), {"v": np.arange(4.0), "geom": (np.zeros(4), np.zeros(4))}
+        ))
+        n = ds.modify_features("t", {"v": float("nan")}, "IN ('1', '2')")
+        assert n == 2
+        out = ds.query("t", "v IS NULL")
+        assert sorted(np.asarray(out.ids).tolist()) == [1, 2]
+        # lossy casts still refused on int columns
+        sft2 = FeatureType.from_spec("t2", "k:Integer,*geom:Point:srid=4326")
+        ds.create_schema(sft2)
+        ds.write("t2", FeatureCollection.from_columns(
+            sft2, np.arange(2), {"k": np.arange(2, dtype=np.int32),
+                                 "geom": (np.zeros(2), np.zeros(2))}
+        ))
+        import pytest
+
+        with pytest.raises(TypeError):
+            ds.modify_features("t2", {"k": 1.5})
+
+    def test_geojson_synth_ids_avoid_explicit_collisions(self):
+        import json as _json
+
+        from geomesa_tpu.io.geojson import read_geojson
+
+        fc = {"type": "FeatureCollection", "features": [
+            {"type": "Feature", "id": 3,
+             "geometry": {"type": "Point", "coordinates": [0, 0]},
+             "properties": {"a": 1}},
+            {"type": "Feature",
+             "geometry": {"type": "Point", "coordinates": [1, 1]},
+             "properties": {"a": 2}},
+            {"type": "Feature",
+             "geometry": {"type": "Point", "coordinates": [2, 2]},
+             "properties": {"a": 3}},
+        ]}
+        out = read_geojson(_json.dumps(fc), "g")
+        ids = list(out.ids)
+        assert ids[0] == "3"
+        assert len(set(ids)) == 3  # no collision between synth + explicit
+
+    def test_st_distancesphere_uses_nearest_points(self):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.process.knn import haversine_m
+        from geomesa_tpu.sql.functions import st_distancesphere
+
+        # long line whose NEAR end is 1 degree from the point; the
+        # representative-point (midpoint/centroid) distance would be ~25x
+        line = geo.from_wkt("LINESTRING(10 0, 60 0)")
+        p = geo.Point(9.0, 0.0)
+        d = st_distancesphere(line, p)
+        expect = float(haversine_m(10.0, 0.0, 9.0, 0.0))
+        assert abs(d - expect) < 1.0
+        # intersecting geometries are at distance 0
+        assert st_distancesphere(line, geo.Point(30.0, 0.0)) == 0.0
+
+    def test_upsert_rollback_on_write_failure(self):
+        import numpy as np
+        import pytest
+
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.sft import FeatureType
+
+        sft = FeatureType.from_spec("t", "v:Integer,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("t", FeatureCollection.from_columns(
+            sft, np.arange(3), {"v": np.arange(3, dtype=np.int32),
+                                "geom": (np.zeros(3), np.zeros(3))}
+        ))
+        repl = FeatureCollection.from_columns(
+            sft, np.array([1]), {"v": np.array([9], dtype=np.int32),
+                                 "geom": (np.ones(1), np.ones(1))}
+        )
+        # force write() to fail AFTER the delete (validation passes)
+        orig_write = ds.write
+        calls = {"n": 0}
+
+        def failing_write(type_name, features, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise MemoryError("simulated device OOM")
+            return orig_write(type_name, features, **kw)
+
+        ds.write = failing_write
+        with pytest.raises(MemoryError):
+            ds.upsert("t", repl)
+        ds.write = orig_write
+        # the replaced row was restored, not lost
+        out = ds.query("t", "IN ('1')")
+        assert len(out) == 1
+        assert int(np.asarray(out.columns["v"])[0]) == 1
+
+
+    def test_st_distancesphere_parallel_overlap_ties(self):
+        from geomesa_tpu import geometry as geo
+        from geomesa_tpu.process.knn import haversine_m
+        from geomesa_tpu.sql.functions import st_distancesphere
+
+        a = geo.from_wkt("LINESTRING(0 0, 10 0)")
+        b = geo.from_wkt("LINESTRING(5 1, 15 1)")
+        # every point of the 5-unit overlap minimizes: pair must be
+        # consistent (~1 degree apart), not ends of different ties
+        d = st_distancesphere(a, b)
+        expect = float(haversine_m(5.0, 0.0, 5.0, 1.0))
+        assert abs(d - expect) / expect < 0.01
+
+    def test_modify_features_none_nulls_float_attr(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.features import FeatureCollection
+        from geomesa_tpu.sft import FeatureType
+
+        sft = FeatureType.from_spec("tn", "v:Double,*geom:Point:srid=4326")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("tn", FeatureCollection.from_columns(
+            sft, np.arange(2), {"v": np.arange(2.0),
+                                "geom": (np.zeros(2), np.zeros(2))}
+        ))
+        assert ds.modify_features("tn", {"v": None}, "IN ('0')") == 1
+        assert len(ds.query("tn", "v IS NULL")) == 1
