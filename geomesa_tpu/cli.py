@@ -320,7 +320,15 @@ def cmd_export(args) -> int:
     from geomesa_tpu.io.exporters import export
 
     ds = _load(args)
-    out = ds.query(args.feature_name, args.cql or "INCLUDE", limit=args.max_features)
+    hints = None
+    if getattr(args, "reproject", None):
+        from geomesa_tpu.planning.hints import QueryHints
+
+        hints = QueryHints(reproject=args.reproject)
+    out = ds.query(
+        args.feature_name, args.cql or "INCLUDE", limit=args.max_features,
+        hints=hints,
+    )
     if args.format.lower() in ("shp", "shapefile"):
         # multi-file sink: -o names the .shp (or the base path)
         if not args.output:
@@ -473,6 +481,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--format", default="csv")
     sp.add_argument("-o", "--output")
     sp.add_argument("-m", "--max-features", type=int)
+    sp.add_argument(
+        "--reproject", help="output CRS (e.g. EPSG:3857); store is EPSG:4326"
+    )
 
     sp = add("explain", cmd_explain, feature=True)
     sp.add_argument("-q", "--cql", required=True)

@@ -228,11 +228,27 @@ class _Parser:
         for _ in range(3):
             self.expect_punct(",")
             nums.append(self.literal())
-        # optional CRS argument
+        # optional CRS argument: reproject the box to the store's native
+        # 4326 (unsupported CRSs raise — silently dropping the argument
+        # would evaluate the box in the wrong CRS)
+        crs = None
         if self.accept_punct(","):
-            self.next()
+            # the CRS may be one quoted string ('EPSG:3857') or unquoted
+            # tokens (EPSG : 3857): join everything up to the ')'
+            parts = []
+            while True:
+                t = self.peek()
+                if t is None or (t.kind == "punct" and t.value == ")"):
+                    break
+                parts.append(str(self.next().value))
+            crs = "".join(parts).strip("'\"")
         self.expect_punct(")")
-        return BBox(prop, float(nums[0]), float(nums[1]), float(nums[2]), float(nums[3]))
+        x0, y0, x1, y1 = (float(v) for v in nums)
+        if crs is not None:
+            from geomesa_tpu.crs import bbox_to_4326
+
+            x0, y0, x1, y1 = bbox_to_4326(x0, y0, x1, y1, crs)
+        return BBox(prop, x0, y0, x1, y1)
 
     def _wkt_geometry(self) -> geo.Geometry:
         t = self.peek()

@@ -40,7 +40,7 @@ from geomesa_tpu.sft import FeatureType
 
 _TOKEN = re.compile(
     r"\s*(?:(?P<col>\$\d+)|(?P<path>\$(?:\.@?\w+)+)|(?P<name>\w+)\s*\(|(?P<lit>'[^']*')"
-    r"|(?P<num>-?\d+(?:\.\d+)?)|(?P<close>\))|(?P<comma>,)|(?P<cast>::\w+))"
+    r"|(?P<num>-?\d+(?:\.\d+)?)|(?P<ident>\w+)|(?P<close>\))|(?P<comma>,)|(?P<cast>::\w+))"
 )
 
 
@@ -98,6 +98,15 @@ def _compile_fns(name: str, args: list):
         return lambda rec: hashlib.md5(str(args[0](rec)).encode()).hexdigest()
     if name == "uuid":
         return lambda rec: str(_uuid.uuid4())
+    if name.startswith("st_"):
+        # the ST_ function library (sql.functions) is shared with query
+        # transforms: st_x(geom), st_buffer(geom, 1), ... evaluate over
+        # the record's geometry values
+        from geomesa_tpu.sql.functions import FUNCTIONS
+
+        fn = FUNCTIONS.get(name)
+        if fn is not None:
+            return lambda rec: fn(*(a(rec) for a in args))
     raise ValueError(f"unknown transform function {name!r}")
 
 
@@ -123,6 +132,23 @@ def compile_expression(text: str) -> Expression:
         elif m.group("num"):
             v = float(m.group("num")) if "." in m.group("num") else int(m.group("num"))
             base = lambda rec: v
+        elif m.group("ident"):
+            # bare identifier: a record-field reference by name (query
+            # transforms evaluate over {attribute: value} row dicts).
+            # Unknown names raise — a typo must not fabricate a column
+            key = m.group("ident")
+
+            def _field(rec, key=key):
+                if isinstance(rec, dict):
+                    if key not in rec:
+                        raise KeyError(f"unknown field {key!r} in expression")
+                    return rec[key]
+                raise ValueError(
+                    f"bare identifier {key!r} needs a named record; use "
+                    "$N for positional fields"
+                )
+
+            base = _field
         elif m.group("name"):
             fname = m.group("name").lower()
             args: list = []

@@ -214,16 +214,17 @@ def _gml_coords(coords) -> str:
     return " ".join(f"{x:.10g} {y:.10g}" for x, y in np.asarray(coords))
 
 
-def _gml_geom(g: "geo.Geometry") -> str:
-    """GML 3.1 geometry element (srsName EPSG:4326, lon/lat order kept)."""
+def _gml_geom(g: "geo.Geometry", srs: str = "EPSG:4326") -> str:
+    """GML 3.1 geometry element (srsName from the collection's CRS,
+    lon/lat order kept)."""
     if isinstance(g, geo.Point):
         return (
-            f'<gml:Point srsName="EPSG:4326"><gml:pos>{g.x:.10g} {g.y:.10g}'
+            f'<gml:Point srsName="{srs}"><gml:pos>{g.x:.10g} {g.y:.10g}'
             "</gml:pos></gml:Point>"
         )
     if isinstance(g, geo.LineString):
         return (
-            '<gml:LineString srsName="EPSG:4326"><gml:posList>'
+            f'<gml:LineString srsName="{srs}"><gml:posList>'
             f"{_gml_coords(g.coords)}</gml:posList></gml:LineString>"
         )
     if isinstance(g, geo.Polygon):
@@ -237,7 +238,7 @@ def _gml_geom(g: "geo.Geometry") -> str:
                 f"{_gml_coords(h)}</gml:posList></gml:LinearRing></gml:interior>"
             )
         return (
-            f'<gml:Polygon srsName="EPSG:4326">{"".join(rings)}</gml:Polygon>'
+            f'<gml:Polygon srsName="{srs}">{"".join(rings)}</gml:Polygon>'
         )
     if isinstance(g, (geo.MultiPoint, geo.MultiLineString, geo.MultiPolygon)):
         tag = {
@@ -245,8 +246,10 @@ def _gml_geom(g: "geo.Geometry") -> str:
             geo.MultiLineString: ("gml:MultiCurve", "gml:curveMember"),
             geo.MultiPolygon: ("gml:MultiSurface", "gml:surfaceMember"),
         }[type(g)]
-        inner = "".join(f"<{tag[1]}>{_gml_geom(p)}</{tag[1]}>" for p in g.parts)
-        return f'<{tag[0]} srsName="EPSG:4326">{inner}</{tag[0]}>'
+        inner = "".join(
+            f"<{tag[1]}>{_gml_geom(p, srs)}</{tag[1]}>" for p in g.parts
+        )
+        return f'<{tag[0]} srsName="{srs}">{inner}</{tag[0]}>'
     raise ValueError(f"cannot GML-encode {type(g).__name__}")
 
 
@@ -257,6 +260,8 @@ def _gml(fc: FeatureCollection) -> str:
 
     sft = fc.sft
     name = escape(sft.name or "features")
+    # a reprojected collection stamps its CRS in user_data (crs.py)
+    srs = str(sft.user_data.get("geomesa.crs", "EPSG:4326"))
     geoms = fc.geometries()
     parts = [
         '<?xml version="1.0" encoding="UTF-8"?>\n'
@@ -271,7 +276,8 @@ def _gml(fc: FeatureCollection) -> str:
         for a in sft.attributes:
             if a.is_geometry:
                 parts.append(
-                    f"<geomesa:{a.name}>{_gml_geom(geoms[i])}</geomesa:{a.name}>"
+                    f"<geomesa:{a.name}>{_gml_geom(geoms[i], srs)}"
+                    f"</geomesa:{a.name}>"
                 )
                 continue
             v = fc.columns[a.name][i]

@@ -46,8 +46,16 @@ class QueryHints:
     sample_by: Optional[str] = None
     loose: bool = False
     timeout: Optional[float] = None
+    # reproject result geometries from the store-native EPSG:4326 to this
+    # CRS (reference QueryPlanner.scala:292 reprojection hints); applied
+    # after refinement, before transforms. Unsupported CRSs raise.
+    reproject: Optional[str] = None
 
     def validate(self) -> None:
+        if self.reproject is not None:
+            from geomesa_tpu.crs import normalize_crs
+
+            normalize_crs(self.reproject)  # raises on unsupported
         if self.sample is not None and not (0.0 < self.sample <= 1.0):
             raise ValueError(f"sample must be in (0, 1], got {self.sample}")
         if self.timeout is not None and self.timeout <= 0:

@@ -490,6 +490,11 @@ class QueryPlanner:
             out = out.take(np.arange(lo, hi))
             if off:
                 exp(f"Offset {off}: rows [{lo}, {hi})")
+        if hints is not None and hints.reproject is not None:
+            from geomesa_tpu.crs import reproject_collection
+
+            out = reproject_collection(out, hints.reproject)
+            exp(f"Reprojected to {hints.reproject}")
         if hints is not None and hints.transforms is not None:
-            out = out.project(hints.transforms)
+            out = out.transform(hints.transforms)
         return out
