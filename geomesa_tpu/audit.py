@@ -53,3 +53,33 @@ class AuditWriter:
         out = [e.to_json() for e in self.events]
         self.events.clear()
         return out
+
+
+class FileAuditWriter(AuditWriter):
+    """Audit sink persisted as JSON lines (reference AuditWriter.scala:
+    31-63 writes audited events to a backend table; the Accumulo variant
+    persists QueryEvents — here one JSONL file plays that role). Events
+    also stay in the in-memory ring for drain()."""
+
+    def __init__(self, path: str, capacity: int = 10_000):
+        super().__init__(capacity)
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def write(self, event: AuditedEvent) -> None:
+        super().write(event)
+        import json
+
+        self._fh.write(json.dumps(event.to_json()) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Load persisted events back (analysis/inspection helper)."""
+        import json
+
+        with open(path, encoding="utf-8") as fh:
+            return [json.loads(line) for line in fh if line.strip()]

@@ -140,9 +140,20 @@ class FeatureCollection:
         from geomesa_tpu.io.converters import compile_expression
         from geomesa_tpu.sft import AttributeDescriptor
 
+        import re as _re
+
         n = len(self)
         cols: dict = {}
         attrs: list[AttributeDescriptor] = []
+        # only the columns the expressions actually reference materialize
+        # into row dicts — decoding every packed geometry for a scalar
+        # rename would put O(n x n_attrs) Python-object churn on the
+        # query hot path
+        referenced: set[str] = set()
+        for s in specs:
+            if "=" in s:
+                referenced |= set(_re.findall(r"\w+", s.split("=", 1)[1]))
+        referenced &= set(self.columns)
         rows_cache: list[dict] | None = None
 
         def rows() -> list[dict]:
@@ -152,7 +163,8 @@ class FeatureCollection:
             nonlocal rows_cache
             if rows_cache is None:
                 base: dict[str, list] = {}
-                for aname, col in self.columns.items():
+                for aname in referenced:
+                    col = self.columns[aname]
                     if isinstance(col, PointColumn):
                         base[aname] = [
                             geo.Point(float(x), float(y))

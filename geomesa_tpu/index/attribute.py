@@ -43,6 +43,9 @@ class AttributeIndex:
         self.attr = attr
         self.name = f"attr_{attr}"
         self.attr_type = sft.attr(attr).type
+        self._is_string = self.attr_type not in (
+            "Integer", "Int", "Long", "Date", "Float", "Double", "Boolean",
+        )
         self.geom = sft.geom_field
         self.dtg = sft.dtg_field
         self.binner = (
@@ -73,10 +76,19 @@ class AttributeIndex:
             binned = self.binner.to_binned(millis)
             device_cols["tbin"] = binned.bin.astype(np.int32)
             device_cols["toff"] = binned.offset.astype(np.int32)
+        # string values carry variable-width secondary sort words (lexicode
+        # bytes past the 8-byte prefix) so prefix-tie runs stay value-
+        # sorted and the scan side prunes boundary runs exactly (reference
+        # AttributeIndexKey lexicodes FULL values; AttributeIndexKey.scala:
+        # 21-70). Cost: 8 bytes/row/word, host-side only.
+        sub = None
+        if self._is_string:
+            sub = lexicode.lex_string_words(fc.columns[self.attr])
         return WriteKeys(
             bins=np.zeros(n, dtype=np.int32),
             zs=codes.astype(np.uint64),
             device_cols=device_cols,
+            sub=sub,
         )
 
     # -- read side -------------------------------------------------------
@@ -87,10 +99,15 @@ class AttributeIndex:
         if not bounds.values:
             return None  # no bound on this attribute: index cannot serve
         los, his = [], []
+        los2, his2 = [], []
         for b in bounds.values:
             lo, hi = lexicode.bounds_to_range(b.lo, b.hi, self.attr_type)
             los.append(lo)
             his.append(hi)
+            if self._is_string:
+                lo2, hi2 = lexicode.bounds_sub_words(b.lo, b.hi)
+                los2.append(lo2)
+                his2.append(hi2)
 
         # secondary spatial predicate (device mask inside candidate tiles)
         boxes = None
@@ -142,4 +159,6 @@ class AttributeIndex:
             # value-range spans are row-exact: kernel hits (block granular)
             # must clip back to them before refinement
             clip_rows=True,
+            range_lo2=np.stack(los2).astype(np.uint64) if los2 else None,
+            range_hi2=np.stack(his2).astype(np.uint64) if his2 else None,
         )

@@ -130,6 +130,7 @@ def _quote(v: str, sep: str) -> str:
 
 def _geojson(fc: FeatureCollection) -> str:
     geom_field = fc.sft.geom_field
+    crs = str(fc.sft.user_data.get("geomesa.crs", "EPSG:4326"))
     date_fields = {a.name for a in fc.sft.attributes if a.type == "Date"}
     feats = []
     for row in fc.to_rows():
@@ -147,7 +148,17 @@ def _geojson(fc: FeatureCollection) -> str:
                 "properties": props,
             }
         )
-    return json.dumps({"type": "FeatureCollection", "features": feats})
+    out = {"type": "FeatureCollection", "features": feats}
+    if crs != "EPSG:4326":
+        # RFC 7946 mandates WGS84; reprojected collections carry the
+        # legacy (GeoJSON 2008) named-CRS member so the coordinates are
+        # not silently misread as degrees
+        code = crs.split(":")[-1]
+        out["crs"] = {
+            "type": "name",
+            "properties": {"name": f"urn:ogc:def:crs:EPSG::{code}"},
+        }
+    return json.dumps(out)
 
 
 def _geojson_geom(g: geo.Geometry) -> dict:
@@ -296,6 +307,14 @@ def _leaflet(fc: FeatureCollection) -> str:
     layer; here the heat tint rides per-marker opacity)."""
     from xml.sax.saxutils import escape
 
+    if str(fc.sft.user_data.get("geomesa.crs", "EPSG:4326")) != "EPSG:4326":
+        # the Leaflet map template interprets coordinates as lon/lat
+        # degrees; a reprojected collection would render at garbage
+        # positions with no error
+        raise ValueError(
+            "leaflet export requires EPSG:4326 coordinates; drop the "
+            "reproject hint"
+        )
     # '</' must not appear literally inside the <script> block: a string
     # attribute containing '</script>' would otherwise terminate it and
     # inject attacker-controlled markup into the exported page

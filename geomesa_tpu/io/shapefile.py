@@ -205,10 +205,25 @@ def read_shapefile(
     ts = {type(shapes[i]).__name__ for i in keep}
     if len(ts) == 1:
         gtype = ts.pop()
+    # the sibling .prj decides the srid stamp (written by write_shapefile;
+    # Web-Mercator files must not round-trip mislabeled as degrees)
+    srid = "4326"
+    if isinstance(shp, str):
+        import os
+
+        prj = (shp[:-4] if shp.lower().endswith(".shp") else shp) + ".prj"
+        if os.path.exists(prj):
+            with open(prj, encoding="ascii", errors="replace") as fh:
+                wkt = fh.read()
+            if "Mercator" in wkt or "3857" in wkt:
+                srid = "3857"
     spec = ",".join(
-        [f"{n}:{k}" for n, k in zip(names, kinds)] + [f"*{geom_name}:{gtype}:srid=4326"]
+        [f"{n}:{k}" for n, k in zip(names, kinds)]
+        + [f"*{geom_name}:{gtype}:srid={srid}"]
     )
     sft = FeatureType.from_spec(type_name, spec)
+    if srid == "3857":
+        sft.user_data["geomesa.crs"] = "EPSG:3857"
     rows = []
     for i in keep:
         row = {geom_name: shapes[i]}
@@ -369,3 +384,30 @@ def write_shapefile(fc: FeatureCollection, base: str) -> None:
             body += cell.ljust(width)
     with open(base + ".dbf", "wb") as fh:
         fh.write(bytes(hdr) + bytes(body) + b"\x1a")
+
+    # .prj: label the coordinates we actually wrote (a reprojected
+    # collection stamps its CRS in user_data — crs.reproject_collection)
+    crs = str(sft.user_data.get("geomesa.crs", "EPSG:4326"))
+    with open(base + ".prj", "w", encoding="ascii") as fh:
+        fh.write(_PRJ_WKT.get(crs, _PRJ_WKT["EPSG:4326"]))
+
+
+# standard ESRI WKT strings for the supported CRSs
+_PRJ_WKT = {
+    "EPSG:4326": (
+        'GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID["WGS_1984",'
+        '6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
+        'UNIT["Degree",0.0174532925199433]]'
+    ),
+    "EPSG:3857": (
+        'PROJCS["WGS_1984_Web_Mercator_Auxiliary_Sphere",'
+        'GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID["WGS_1984",'
+        '6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
+        'UNIT["Degree",0.0174532925199433]],'
+        'PROJECTION["Mercator_Auxiliary_Sphere"],'
+        'PARAMETER["False_Easting",0.0],PARAMETER["False_Northing",0.0],'
+        'PARAMETER["Central_Meridian",0.0],'
+        'PARAMETER["Standard_Parallel_1",0.0],'
+        'PARAMETER["Auxiliary_Sphere_Type",0.0],UNIT["Meter",1.0]]'
+    ),
+}

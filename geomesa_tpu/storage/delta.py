@@ -29,7 +29,26 @@ def concat_keys(parts: list[WriteKeys]) -> WriteKeys:
             name: np.concatenate([p.device_cols[name] for p in parts])
             for name in parts[0].device_cols
         },
+        sub=_concat_sub(parts),
     )
+
+
+def _concat_sub(parts: list[WriteKeys]) -> "np.ndarray | None":
+    """Concatenate variable-width secondary sort words, zero-padding
+    narrower batches to the widest word count (0 is the correct pad: a
+    shorter string sorts before any extension)."""
+    subs = [p.sub for p in parts]
+    if all(s is None for s in subs):
+        return None
+    w = max(s.shape[1] for s in subs if s is not None)
+    out = []
+    for p, s in zip(parts, subs):
+        if s is None:
+            s = np.zeros((len(p.bins), w), dtype=np.uint64)
+        elif s.shape[1] < w:
+            s = np.pad(s, ((0, 0), (0, w - s.shape[1])))
+        out.append(s)
+    return np.concatenate(out)
 
 
 def delta_wide_mask(config: ScanConfig, keys: WriteKeys) -> np.ndarray:

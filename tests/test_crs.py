@@ -128,3 +128,37 @@ class TestCrsStamping:
         out = ds.query("pts", "INCLUDE", hints=QueryHints(reproject="EPSG:3857"))
         assert out.sft.attr(out.sft.geom_field).options["srid"] == "3857"
         assert out.sft.user_data["geomesa.crs"] == "EPSG:3857"
+
+    def test_geojson_reprojected_carries_crs_member(self):
+        import json
+        from geomesa_tpu.io.exporters import export
+        ds, _, _ = _point_store(n=10)
+        out = ds.query("pts", "INCLUDE", hints=QueryHints(reproject="EPSG:3857"))
+        gj = json.loads(export(out, "geojson"))
+        assert gj["crs"]["properties"]["name"].endswith("EPSG::3857")
+        # plain 4326 output has no crs member (RFC 7946 form)
+        gj2 = json.loads(export(ds.query("pts", "INCLUDE"), "geojson"))
+        assert "crs" not in gj2
+
+    def test_leaflet_rejects_reprojected(self):
+        from geomesa_tpu.io.exporters import export
+        ds, _, _ = _point_store(n=5)
+        out = ds.query("pts", "INCLUDE", hints=QueryHints(reproject="EPSG:3857"))
+        with pytest.raises(ValueError, match="4326"):
+            export(out, "leaflet")
+
+    def test_shapefile_prj_roundtrip(self, tmp_path):
+        from geomesa_tpu.io.shapefile import read_shapefile, write_shapefile
+        ds, _, _ = _point_store(n=8)
+        out = ds.query("pts", "INCLUDE", hints=QueryHints(reproject="EPSG:3857"))
+        base = str(tmp_path / "m")
+        write_shapefile(out, base)
+        assert "Mercator" in open(base + ".prj").read()
+        back = read_shapefile(base + ".shp")
+        assert back.sft.user_data.get("geomesa.crs") == "EPSG:3857"
+        # 4326 write has a GEOGCS prj and reads back without the stamp
+        base2 = str(tmp_path / "d")
+        write_shapefile(ds.query("pts", "INCLUDE"), base2)
+        assert open(base2 + ".prj").read().startswith("GEOGCS")
+        back2 = read_shapefile(base2 + ".shp")
+        assert "geomesa.crs" not in back2.sft.user_data
