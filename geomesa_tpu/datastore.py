@@ -838,7 +838,10 @@ class DataStore:
             fast_eligible = (
                 plan.index is not None
                 and weight is None
-                and mask_decides_filter(plan.filter, cfg, self._schemas[type_name])
+                and mask_decides_filter(
+                    plan.filter, cfg, self._schemas[type_name],
+                    for_aggregation=True,
+                )
             )
             device_ok = fast_eligible and not self._vis_active(type_name)
             if not device_ok:
@@ -902,7 +905,8 @@ class DataStore:
         plan = self.planner.plan(type_name, f)
         if estimate and all(t.kind == "count" for t in terms):
             fast_eligible = plan.index is not None and mask_decides_filter(
-                plan.filter, plan.config, self._schemas[type_name]
+                plan.filter, plan.config, self._schemas[type_name],
+                for_aggregation=True,
             )
             if fast_eligible and self._vis_active(type_name):
                 self._note_vis_fallback(explain, "count estimate")
@@ -946,7 +950,10 @@ class DataStore:
         bounds_eligible = (
             estimate
             and plan.index is not None
-            and mask_decides_filter(plan.filter, plan.config, self._schemas[type_name])
+            and mask_decides_filter(
+                plan.filter, plan.config, self._schemas[type_name],
+                for_aggregation=True,
+            )
         )
         if bounds_eligible and self._vis_active(type_name):
             self._note_vis_fallback(explain, "bounds")

@@ -68,9 +68,10 @@ class Z2Index:
         if not ranges:
             return ScanConfig.empty(self.name)
         from geomesa_tpu.index.api import shrink_boxes
-        from geomesa_tpu.index.z3 import _bounds_only
+        from geomesa_tpu.index.z3 import _bounds_only, _poly_edges
 
-        geom_precise = geoms.precise and _bounds_only(geoms.values)
+        bounds_exact = geoms.precise and _bounds_only(geoms.values)
+        poly = None if bounds_exact else _poly_edges(geoms)
         return ScanConfig(
             index=self.name,
             range_bins=np.zeros(len(ranges), dtype=np.int32),
@@ -78,8 +79,12 @@ class Z2Index:
             range_hi=np.array([r.upper for r in ranges], dtype=np.uint64),
             boxes=widen_boxes(bounds),
             windows=None,
-            geom_precise=geom_precise,
+            # the device PIP tier answers polygon queries exactly (host
+            # refines only the uncertainty band), so the mask decides the
+            # filter; contained-range certainty stays bbox-only
+            geom_precise=bounds_exact or poly is not None,
             range_contained=np.array([r.contained for r in ranges], dtype=bool),
-            contained_exact=bool(geom_precise),
+            contained_exact=bool(bounds_exact),
             boxes_inner=shrink_boxes(bounds),
+            poly=poly,
         )

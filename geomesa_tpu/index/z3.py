@@ -163,7 +163,8 @@ class Z3Index:
                 range_cont.append(rc)
         if not range_bins:
             return ScanConfig.empty(self.name)
-        geom_precise = geoms.precise and _bounds_only(geoms.values)
+        bounds_exact = geoms.precise and _bounds_only(geoms.values)
+        poly = None if (no_geom or bounds_exact) else _poly_edges(geoms)
         return ScanConfig(
             index=self.name,
             range_bins=np.concatenate(range_bins),
@@ -171,15 +172,18 @@ class Z3Index:
             range_hi=np.concatenate(range_hi),
             boxes=None if no_geom else widen_boxes(bounds),
             windows=windows.astype(np.int32),
-            geom_precise=geom_precise,
+            # the device PIP tier makes single-polygon queries precise on
+            # device (see z2); contained certainty stays bbox-only
+            geom_precise=bounds_exact or poly is not None,
             time_precise=intervals.precise,
             range_contained=np.concatenate(range_cont),
             # contained certainty additionally requires the *filter* to be
             # decided by bbox+interval alone — the planner checks kinds; here
             # we require the geometry values themselves to be plain boxes
-            contained_exact=bool(geom_precise and intervals.precise),
+            contained_exact=bool(bounds_exact and intervals.precise),
             boxes_inner=None if no_geom else shrink_boxes(bounds),
             windows_inner=windows_inner.astype(np.int32),
+            poly=poly,
         )
 
 
@@ -202,3 +206,16 @@ def _bounds_only(geom_values) -> bool:
     from geomesa_tpu.filter.extract import _is_box
 
     return all(_is_box(g) for g in geom_values)
+
+
+def _poly_edges(geoms) -> "np.ndarray | None":
+    """Packed edge block for the device point-in-polygon tier, or None
+    when the extraction cannot ride it: it needs ONE precisely-extracted
+    Polygon/MultiPolygon whose edge count fits the kernel's bucket ladder
+    (block_kernels.pack_edges). Imprecise extractions (NOT branches,
+    DWithin, non-polygon geometries) keep the bbox + host-refine path."""
+    from geomesa_tpu.scan import block_kernels as bk
+
+    if not geoms.precise or len(geoms.values) != 1:
+        return None
+    return bk.pack_edges(geoms.values[0])

@@ -39,23 +39,35 @@ from geomesa_tpu.storage.table import IndexTable
 
 
 @lru_cache(maxsize=256)
-def _dist_scan(mesh, names, has_boxes, has_windows, extent):
+def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0):
     """jit(shard_map): per-device block-bitmask scan -> (wide, inner)
     planes [D, M, PACK, 128], sharded along the mesh axis so the host's one
-    device_get is the only cross-host movement."""
+    device_get is the only cross-host movement. ``n_edges`` > 0 runs the
+    device point-in-polygon tier (edge block replicated to every device)."""
     axis = mesh.axis_names[0]
 
     skip = bk.skip_inner_plane(has_boxes, extent)
 
-    def body(bids, boxes, wins, *cols):
-        w, i = bk.block_scan(
-            tuple(c[0] for c in cols), bids[0], boxes, wins,
-            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
-            extent=extent,
-        )
-        return w[None] if skip else (w[None], i[None])
+    if n_edges:
+        def body(bids, boxes, wins, edges, *cols):
+            w, i = bk.block_scan(
+                tuple(c[0] for c in cols), bids[0], boxes, wins,
+                col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+                extent=extent, edges=edges, n_edges=n_edges,
+            )
+            return w[None] if skip else (w[None], i[None])
 
-    in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
+        in_specs = (P(axis), P(), P(), P()) + (P(axis),) * len(names)
+    else:
+        def body(bids, boxes, wins, *cols):
+            w, i = bk.block_scan(
+                tuple(c[0] for c in cols), bids[0], boxes, wins,
+                col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+                extent=extent,
+            )
+            return w[None] if skip else (w[None], i[None])
+
+        in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
     return jax.jit(
         jax.shard_map(
             body, mesh=mesh, in_specs=in_specs,
@@ -199,12 +211,17 @@ class DistributedIndexTable(IndexTable):
         D = self.n_devices
         bids2, n_real = self._split_blocks(blocks)
         boxes, wins = self._params(config)
-        kw = self._kernel_kwargs(config)
+        kw = self._scan_kernel_kwargs(config, self._scan_cols(config))
         names = kw["col_names"]
+        n_edges = kw.get("n_edges", 0)
         self._record_scan(names, bids2.size)
-        fn = _dist_scan(self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"])
+        fn = _dist_scan(
+            self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"],
+            n_edges,
+        )
         skip = bk.skip_inner_plane(kw["has_boxes"], kw["extent"])
-        out = fn(bids2, boxes, wins, *self._cols_args(names))  # dispatched now
+        edge_args = (kw["edges"],) if n_edges else ()
+        out = fn(bids2, boxes, wins, *edge_args, *self._cols_args(names))  # dispatched now
         # async device->host copies: see IndexTable._device_scan_submit
         for plane in out if isinstance(out, tuple) else (out,):
             if hasattr(plane, "copy_to_host_async"):

@@ -115,7 +115,9 @@ def _filter_leaf_kinds(
     return walk(f)
 
 
-def mask_decides_filter(f: Filter, config: Optional[ScanConfig], sft) -> bool:
+def mask_decides_filter(
+    f: Filter, config: Optional[ScanConfig], sft, for_aggregation: bool = False
+) -> bool:
     """True when the device scan mask decides this filter entirely, so
     loose mode / aggregation push-down may skip host refinement. Requires
     (a) every predicate to be an indexable spatial/temporal leaf, (b) the
@@ -123,8 +125,15 @@ def mask_decides_filter(f: Filter, config: Optional[ScanConfig], sft) -> bool:
     enforce each predicate kind present — an atemporal index (z2) leaves
     ``windows=None`` and must not satisfy a temporal filter. Gate for the
     LOOSE_BBOX fast path (reference Z3IndexKeySpace.useFullFilter,
-    Z3IndexKeySpace.scala:240-254)."""
+    Z3IndexKeySpace.scala:240-254).
+
+    ``for_aggregation``: device aggregation kernels evaluate the BOX wide
+    plane only — a polygon-tier config (config.poly) decides the filter
+    for row scans (certainty vector + host near-band refinement) but NOT
+    for gather-free aggregations, which would count the whole bbox."""
     if config is None or not (config.geom_precise and config.time_precise):
+        return False
+    if for_aggregation and config.poly is not None:
         return False
     kinds = _filter_leaf_kinds(f, sft.geom_field, sft.dtg_field)
     if kinds is None:

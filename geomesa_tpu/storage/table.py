@@ -471,6 +471,17 @@ class IndexTable(SortedKeys):
             extent=self.extent,
         )
 
+    def _scan_kernel_kwargs(self, config: ScanConfig, names: tuple) -> dict:
+        """Kernel kwargs for the SCAN path only: adds the device PIP tier
+        (aggregation kernels keep the box test — their wide-plane math
+        cannot carry the near-band uncertainty, so poly configs take the
+        host aggregation path via mask_decides_filter)."""
+        kw = self._kernel_kwargs(config, names)
+        if config.poly is not None and not self.extent:
+            kw["edges"] = config.poly
+            kw["n_edges"] = bk.n_edges_of(config.poly)
+        return kw
+
     def _cols_args(self, names: tuple) -> tuple:
         return tuple(self.cols3[k] for k in names)
 
@@ -497,7 +508,7 @@ class IndexTable(SortedKeys):
         self._record_scan(names, len(bids))
         wide, inner = bk.block_scan(
             self._cols_args(names), bids, boxes, wins,
-            **self._kernel_kwargs(config, names),
+            **self._scan_kernel_kwargs(config, names),
         )
         # start the device->host copy as soon as the kernel finishes: the
         # tunneled link overlaps in-flight transfers, but a blocking
