@@ -6,7 +6,9 @@
   ~half of v5e HBM).
 - config 2: Z2 point index, BBOX-only queries (OSM-GPS-shaped).
 - config 3: XZ2 polygon index, ST_Intersects queries over building-
-  footprint-shaped rectangles.
+  footprint-shaped rectangles (default N3=200M — the OSM building layer
+  is ~500M footprints; 50M in rounds 3-4 understated the table the
+  baseline has to scan).
 - config 4: grid-partitioned spatial join, points x admin polygons.
 - config 5: kNN process over trajectory-shaped points.
 
@@ -44,7 +46,7 @@ import numpy as np
 
 N1 = int(os.environ.get("GEOMESA_BENCH_N", 500_000_000))
 N2 = int(os.environ.get("GEOMESA_BENCH_N2", 200_000_000))
-N3 = int(os.environ.get("GEOMESA_BENCH_N3", 50_000_000))
+N3 = int(os.environ.get("GEOMESA_BENCH_N3", 200_000_000))
 N_QUERIES = int(os.environ.get("GEOMESA_BENCH_QUERIES", 40))
 CONFIGS = os.environ.get("GEOMESA_BENCH_CONFIGS", "1,2,3,4,5").split(",")
 SEED = 42
@@ -375,15 +377,18 @@ def config3_xz2():
 
 def config4_join():
     """Spatial join: GDELT-shaped points x admin-polygon-shaped rectangles
-    (BASELINE config 4; the geomesa-spark grid-partitioned join). Baseline:
-    the ungridded per-polygon scan (bbox mask over ALL points + exact
-    point-in-polygon) — what a naive executor does without the grid."""
+    (BASELINE config 4; the geomesa-spark broadcast join — the point side
+    is the GeoMesa-INDEXED relation, so the join runs as pipelined device
+    scans against the store's z2 table, round-5 spatial_join_indexed).
+    Baseline: the ungridded per-polygon scan (bbox mask over ALL points) —
+    what a naive executor does without the index."""
     from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
     from geomesa_tpu.features import FeatureCollection
     from geomesa_tpu.sft import FeatureType
-    from geomesa_tpu.sql.join import spatial_join
+    from geomesa_tpu.sql.join import spatial_join, spatial_join_indexed
 
-    n_pts = int(os.environ.get("GEOMESA_BENCH_N4", 2_000_000))
+    n_pts = int(os.environ.get("GEOMESA_BENCH_N4", 20_000_000))
     n_poly = 256
     rng = np.random.default_rng(SEED + 30)
     x, y = gdelt_points(n_pts, rng)
@@ -394,32 +399,49 @@ def config4_join():
     polys = geo.PackedGeometryColumn.from_boxes(px0, py0, px0 + pw, py0 + ph)
 
     psft = FeatureType.from_spec("pts", "*geom:Point:srid=4326")
+    psft.user_data["geomesa.indices.enabled"] = "z2"
     gsft = FeatureType.from_spec("adm", "*geom:Polygon:srid=4326")
-    pts_fc = FeatureCollection.from_columns(psft, np.arange(n_pts), {"geom": (x, y)})
     poly_fc = FeatureCollection.from_columns(gsft, np.arange(n_poly), {"geom": polys})
+    ds = DataStore()
+    ds.create_schema(psft)
+    log(f"[join] building {n_pts:,} point store ...")
+    ds.write("pts", FeatureCollection.from_columns(
+        psft, np.arange(n_pts), {"geom": (x, y)}), check_ids=False)
 
-    spatial_join(poly_fc, pts_fc, "contains")  # full-size warmup (first-touch)
+    spatial_join_indexed(ds, "pts", poly_fc, "contains")  # warmup compiles
     lats = []
     for _ in range(3):
         t0 = time.perf_counter()
-        li, ri = spatial_join(poly_fc, pts_fc, "contains")
+        li, ri = spatial_join_indexed(ds, "pts", poly_fc, "contains")
         lats.append(time.perf_counter() - t0)
     t_join = float(np.median(lats))
 
-    # baseline warmed the same way (x/y already touched by the join above)
+    # host grid join on the same data, for the record (the r4 path)
+    t0 = time.perf_counter()
+    hl, hr = spatial_join(poly_fc, ds.features("pts"), "contains")
+    t_host = time.perf_counter() - t0
+    assert len(hl) == len(li), (len(hl), len(li))
+
+    # baseline: ungridded per-polygon bbox mask, sampled + extrapolated
     for _ in range(2):
         t0 = time.perf_counter()
         total = 0
-        for p in range(min(n_poly, 16)):  # baseline sampled, extrapolated
+        for p in range(min(n_poly, 16)):
             bx0, by0, bx1, by1 = px0[p], py0[p], px0[p] + pw[p], py0[p] + ph[p]
             m = (x >= bx0) & (x <= bx1) & (y >= by0) & (y <= by1)
             total += int(m.sum())
         base = (time.perf_counter() - t0) * (n_poly / 16)
 
-    return result_line(
+    rec = result_line(
         "gdelt_join_pairs_per_sec", np.array([t_join]), len(li), t_join, base,
-        {"n_points": n_pts, "n_polygons": n_poly, "pairs": len(li)},
+        {
+            "n_points": n_pts, "n_polygons": n_poly, "pairs": len(li),
+            "host_grid_join_ms": round(t_host * 1e3, 1),
+        },
     )
+    del ds, x, y
+    gc.collect()
+    return rec
 
 
 # ------------------------------------------------------------- config 5
@@ -434,7 +456,7 @@ def config5_knn():
     from geomesa_tpu.process.knn import haversine_m
     from geomesa_tpu.sft import FeatureType
 
-    n = int(os.environ.get("GEOMESA_BENCH_N5", 5_000_000))
+    n = int(os.environ.get("GEOMESA_BENCH_N5", 20_000_000))
     rng = np.random.default_rng(SEED + 40)
     # trajectory-shaped: random walks from seed ports
     n_tracks = 2000

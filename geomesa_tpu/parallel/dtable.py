@@ -48,26 +48,21 @@ def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0):
 
     skip = bk.skip_inner_plane(has_boxes, extent)
 
-    if n_edges:
-        def body(bids, boxes, wins, edges, *cols):
-            w, i = bk.block_scan(
-                tuple(c[0] for c in cols), bids[0], boxes, wins,
-                col_names=names, has_boxes=has_boxes, has_windows=has_windows,
-                extent=extent, edges=edges, n_edges=n_edges,
-            )
-            return w[None] if skip else (w[None], i[None])
+    def body(bids, boxes, wins, *rest):
+        # with edges, one extra replicated arg precedes the sharded cols
+        edges, cols = (rest[0], rest[1:]) if n_edges else (None, rest)
+        w, i = bk.block_scan(
+            tuple(c[0] for c in cols), bids[0], boxes, wins,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent, edges=edges, n_edges=n_edges,
+        )
+        return w[None] if skip else (w[None], i[None])
 
-        in_specs = (P(axis), P(), P(), P()) + (P(axis),) * len(names)
-    else:
-        def body(bids, boxes, wins, *cols):
-            w, i = bk.block_scan(
-                tuple(c[0] for c in cols), bids[0], boxes, wins,
-                col_names=names, has_boxes=has_boxes, has_windows=has_windows,
-                extent=extent,
-            )
-            return w[None] if skip else (w[None], i[None])
-
-        in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
+    in_specs = (
+        (P(axis), P(), P())
+        + ((P(),) if n_edges else ())
+        + (P(axis),) * len(names)
+    )
     return jax.jit(
         jax.shard_map(
             body, mesh=mesh, in_specs=in_specs,

@@ -110,34 +110,61 @@ def knn_many(
             else float(estimated_distance_m)
         )
         radii[i] = min(max(r, 1.0), float(max_distance_m))
+    # speculative dual-window rounds: each pending query submits its
+    # radius window AND the 4x window in the SAME pipelined sweep, so a
+    # sketch under-estimate costs zero extra device round-trips (the
+    # round-trip floor dominates kNN latency, PERF.md §1). The larger
+    # window's finish() only runs when the smaller missed — its plane
+    # pull already overlapped either way. Radius jumps 16x between
+    # rounds (both windows missed => the estimate was far off).
+    SPEC = 4.0
+
+    def _submit(i: int, r: float):
+        x, y = pts[i]
+        deg = _meters_to_degrees(r, float(y))
+        box = _window_filter(geom, float(x), float(y), deg)
+        f = box if isinstance(filter, Include) else And((box, filter))
+        plan = store.planner.plan(type_name, f)
+        return store.planner.submit(plan)
+
+    def _resolve(i: int, res, r: float):
+        """k-or-more within r -> the k nearest, else None (miss)."""
+        x, y = pts[i]
+        if len(res):
+            cx, cy = res.representative_xy()
+            d = haversine_m(x, y, cx, cy)
+            in_radius = d <= r
+            if in_radius.sum() >= k or r >= max_distance_m:
+                keep = np.nonzero(in_radius)[0]
+                order = keep[np.argsort(d[keep], kind="stable")][:k]
+                return res.take(order)
+        elif r >= max_distance_m:
+            return res
+        return None
+
     pending = list(range(len(pts)))
     while pending:
         finishes = []
         for i in pending:
-            x, y = pts[i]
-            deg = _meters_to_degrees(float(radii[i]), float(y))
-            box = _window_filter(geom, float(x), float(y), deg)
-            f = box if isinstance(filter, Include) else And((box, filter))
-            plan = store.planner.plan(type_name, f)
-            finishes.append((i, store.planner.submit(plan)))
-        nxt = []
-        for i, finish in finishes:
-            res = finish()
-            x, y = pts[i]
             r = float(radii[i])
-            if len(res):
-                cx, cy = res.representative_xy()
-                d = haversine_m(x, y, cx, cy)
-                in_radius = d <= r
-                if in_radius.sum() >= k or r >= max_distance_m:
-                    keep = np.nonzero(in_radius)[0]
-                    order = keep[np.argsort(d[keep], kind="stable")][:k]
-                    out[i] = res.take(order)
-                    continue
-            elif r >= max_distance_m:
-                out[i] = res
+            wide_r = min(r * SPEC, max_distance_m)
+            finishes.append((
+                i,
+                _submit(i, r),
+                _submit(i, wide_r) if wide_r > r else None,
+            ))
+        nxt = []
+        for i, fin, fin_wide in finishes:
+            r = float(radii[i])
+            got = _resolve(i, fin(), r)
+            if got is None and fin_wide is not None:
+                wide_r = min(r * SPEC, max_distance_m)
+                got = _resolve(i, fin_wide(), wide_r)
+                r = wide_r
+            if got is not None:
+                out[i] = got
                 continue
-            radii[i] = min(r * 2.0, max_distance_m)
+            radii[i] = min(float(radii[i]) * SPEC * SPEC, max_distance_m)
             nxt.append(i)
         pending = nxt
     return out

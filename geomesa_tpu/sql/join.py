@@ -271,3 +271,108 @@ def _predicate(predicate, max_distance):
             raise ValueError("dwithin requires max_distance")
         return lambda a, b: geo.distance(a, b) <= max_distance
     raise ValueError(f"unknown predicate {predicate!r}")
+
+
+def spatial_join_indexed(
+    ds,
+    type_name: str,
+    left: FeatureCollection,
+    predicate: str = "contains",
+    index: str = "z2",
+) -> tuple[np.ndarray, np.ndarray]:
+    """Device-side spatial join against an INDEXED point store (VERDICT
+    r4 #3): every left geometry becomes one pipelined device scan over the
+    store's z2 table — candidate blocks from its z-ranges, the bbox (or
+    device point-in-polygon) kernel masks points on device, and ALL scans
+    dispatch before any plane pulls, so the per-polygon link round-trip
+    overlaps across the batch (the same async pipeline as query_many,
+    PERF.md §4e).
+
+    Returns (left_idx, right_ordinal) pairs sorted by (left, right) —
+    right ordinals index ``ds.features(type_name)``. This is the
+    reference's broadcast join shape (geomesa-spark GeoMesaJoinRelation:
+    the point side IS the GeoMesa-indexed relation); use
+    :func:`spatial_join` for two bare collections.
+
+    ``predicate``: "contains" (left polygon strictly contains the point)
+    or "intersects" (boundary points count).
+    """
+    if predicate not in ("contains", "intersects"):
+        raise ValueError(f"indexed join supports contains/intersects, got {predicate!r}")
+    n_left = len(left)
+    if n_left == 0 or len(ds.features(type_name)) == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+
+    from geomesa_tpu.filter.predicates import BBox, Intersects
+
+    sft = ds.get_schema(type_name)
+    gf = sft.geom_field
+    idx = next((i for i in ds.indexes(type_name) if i.name == index), None)
+    if idx is None:
+        have = [i.name for i in ds.indexes(type_name)]
+        raise ValueError(
+            f"indexed join needs the {index!r} index on {type_name!r}; "
+            f"store has {have}"
+        )
+    table = ds.table(type_name, index)
+    pts = ds.features(type_name).geom_column
+    if not isinstance(pts, PointColumn):
+        raise TypeError("indexed join requires a point store")
+
+    lgeoms = left.geometries()
+    # dispatch EVERY left geometry's scan before pulling any result
+    finishes = []
+    for g in lgeoms:
+        rect = geo.is_rectangle(g)
+        f = BBox(gf, *g.bounds()) if rect else Intersects(gf, g)
+        cfg = idx.scan_config(f)
+        if cfg is None or cfg.disjoint:
+            finishes.append(None)
+        else:
+            # certainty is only trustworthy when the device evaluated the
+            # TRUE predicate: the shrunk box for rectangles, the PIP tier
+            # for polygons. A polygon past the edge-bucket ladder
+            # (cfg.poly None) gets bbox certainty only — every row must
+            # host-refine or bbox-inside-but-outside-polygon points would
+            # join as false pairs
+            exact_on_device = rect or cfg.poly is not None
+            finishes.append((table.scan_submit(cfg), exact_on_device))
+
+    lo_parts: list[np.ndarray] = []
+    ro_parts: list[np.ndarray] = []
+    for k, fin in enumerate(finishes):
+        if fin is None:
+            continue
+        fin, exact_on_device = fin
+        ordinals, certain = fin()
+        if not exact_on_device:
+            certain = np.zeros(len(ordinals), dtype=bool)
+        if len(ordinals) == 0:
+            continue
+        g = lgeoms[k]
+        unc = np.flatnonzero(~certain)
+        if len(unc):
+            # exact host check over the uncertainty band only (f32 box
+            # rounding / PIP near band): vectorized rect compare or the
+            # native threaded ray cast
+            ux, uy = pts.x[ordinals[unc]], pts.y[ordinals[unc]]
+            if geo.is_rectangle(g):
+                x0, y0, x1, y1 = g.bounds()
+                if predicate == "contains":
+                    ok = (ux > x0) & (ux < x1) & (uy > y0) & (uy < y1)
+                else:
+                    ok = (ux >= x0) & (ux <= x1) & (uy >= y0) & (uy <= y1)
+            else:
+                ok = geo.points_in_polygon(ux, uy, g)
+                if predicate == "intersects":
+                    nb = np.flatnonzero(~ok)
+                    if len(nb):
+                        ok[nb] = geo.points_on_boundary(ux[nb], uy[nb], g)
+            keep = certain.copy()
+            keep[unc] = ok
+            ordinals = ordinals[keep]
+        lo_parts.append(np.full(len(ordinals), k, dtype=np.int64))
+        ro_parts.append(ordinals)
+    if not lo_parts:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    return np.concatenate(lo_parts), np.concatenate(ro_parts)
