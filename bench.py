@@ -159,6 +159,12 @@ def config1_z3():
 
     sft = FeatureType.from_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
     sft.user_data["geomesa.indices.enabled"] = "z3"
+    if n > 600_000_000:
+        # the 1B-row north-star configuration: packed-time device layout
+        # (12 B/row -> 12 GB at 1e9; the 16 B/row (tbin, toff) layout
+        # would blow the v5e's 16 GB HBM). Results identical — tick
+        # boundaries refine on host (tests/test_packed_time.py)
+        sft.user_data["geomesa.z3.packed-time"] = "true"
     ds = DataStore()
     ds.create_schema(sft)
     fc = FeatureCollection.from_columns(sft, np.arange(n), {"dtg": t, "geom": (x, y)})

@@ -277,6 +277,12 @@ class DataStore:
             # from the tbin device column, not the sort bins.
             for idx in self._indexes[type_name]:
                 tb = new_keys[idx.name].device_cols.get("tbin")
+                if tb is None:
+                    tw = new_keys[idx.name].device_cols.get("tw")
+                    if tw is not None:
+                        from geomesa_tpu.index.z3 import unpack_tw
+
+                        tb = unpack_tw(tw)[0]
                 if tb is not None and len(tb):
                     lo, hi = int(tb.min()), int(tb.max())
                     p = idx.bin_range

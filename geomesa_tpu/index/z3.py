@@ -37,6 +37,65 @@ _OFFSET_UNIT_MS = {
     TimePeriod.YEAR: 60_000,
 }
 
+# packed-time tick shift per period (geomesa.z3.packed-time user-data
+# flag; the 1B-row layout — see block_kernels.TW_BITS): device offsets
+# store as (offset >> shift) so max_offset >> shift < 2^16. Ticks: day
+# ~2 s, week/month 32 s, year 16 min. Bins must fit 15 bits (day-period
+# data past 2059-09 must stay unpacked).
+PACKED_SHIFT = {
+    TimePeriod.DAY: 11,  # 86,400,000 ms >> 11 = 42,187 ticks (~2 s)
+    TimePeriod.WEEK: 5,  # 604,800 s  >> 5 = 18,900 ticks (32 s)
+    TimePeriod.MONTH: 6,  # 2,678,400 s >> 6 = 41,850 ticks (64 s)
+    TimePeriod.YEAR: 4,  # 527,040 min >> 4 = 32,940 ticks (16 min)
+}
+PACKED_KEY = "geomesa.z3.packed-time"
+
+
+def pack_tw(tbin: np.ndarray, toff: np.ndarray, shift: int) -> np.ndarray:
+    """(tbin, toff) -> packed i32 tw column. Raises when a bin exceeds
+    the 15-bit budget or a shifted offset the 16-bit tick field (both
+    would silently corrupt neighbouring bits)."""
+    from geomesa_tpu.scan.block_kernels import TW_BITS, TW_MASK
+
+    if len(tbin) and int(tbin.max()) >= (1 << (31 - TW_BITS)):
+        raise ValueError(
+            "packed-time bins exceed 15 bits; disable "
+            f"{PACKED_KEY!r} for this data range"
+        )
+    ticks = toff.astype(np.int64) >> shift
+    if len(ticks) and int(ticks.max()) > TW_MASK:
+        raise ValueError(
+            f"packed-time tick overflow (shift {shift}): offset "
+            f"{int(toff.max())} >> {shift} exceeds {TW_MASK}"
+        )
+    return ((tbin.astype(np.int64) << TW_BITS) | ticks).astype(np.int32)
+
+
+def unpack_tw(tw: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Packed i32 tw -> (tbin, tick) — the ONE host-side unpack next to
+    pack_tw (the jnp kernel shares the constants in block_kernels)."""
+    from geomesa_tpu.scan.block_kernels import TW_BITS, TW_MASK
+
+    return tw >> TW_BITS, tw & TW_MASK
+
+
+def windows_to_ticks(w: "np.ndarray | None", shift: int, inner: bool):
+    """[W, 3] (bin, off_lo, off_hi) native-unit windows -> tick windows.
+    Wide windows floor both ends (superset: a row's tick is its floored
+    offset); inner windows shrink to ticks FULLY inside the interval so
+    certainty never overclaims — boundary ticks refine on host."""
+    if w is None or len(w) == 0:
+        return w
+    w = np.asarray(w, np.int64).copy()
+    one = 1 << shift
+    if inner:
+        w[:, 1] = (w[:, 1] + one - 1) >> shift
+        w[:, 2] = (w[:, 2] - one + 1) >> shift
+    else:
+        w[:, 1] >>= shift
+        w[:, 2] >>= shift
+    return w
+
 
 class Z3Index:
     """Spatio-temporal point index."""
@@ -49,6 +108,14 @@ class Z3Index:
         self.period = TimePeriod.parse(sft.z3_interval)
         self.sfc = Z3SFC.for_period(self.period)
         self.binner = BinnedTime(self.period)
+        # packed-time device layout: one i32 tw column instead of
+        # (tbin, toff) — 12 B/row, the 1e9-rows-on-one-chip budget.
+        # Tables read this via getattr(keyspace, "packed_time", None)
+        self.packed_time = (
+            PACKED_SHIFT[self.period]
+            if str(sft.user_data.get(PACKED_KEY, "")).lower() in ("true", "1")
+            else None
+        )
         # (min_bin, max_bin) actually present in the store, maintained by
         # DataStore on write: open-ended time predicates (dtg >= x) clamp
         # to it, so they cost the data's bins, not every representable bin
@@ -76,20 +143,32 @@ class Z3Index:
         )
         if fused is not None:
             bins, zs, device_cols = fused
-            return WriteKeys(bins=bins, zs=zs, device_cols=device_cols)
+            return WriteKeys(
+                bins=bins, zs=zs, device_cols=self._pack_cols(device_cols)
+            )
 
         binned = self.binner.to_binned(millis)
         z = self.sfc.index(col.x, col.y, binned.offset.astype(np.float64))
         return WriteKeys(
             bins=binned.bin.astype(np.int32),
             zs=z.astype(np.uint64),
-            device_cols={
+            device_cols=self._pack_cols({
                 "x": col.x.astype(np.float32),
                 "y": col.y.astype(np.float32),
                 "tbin": binned.bin.astype(np.int32),
                 "toff": binned.offset.astype(np.int32),
-            },
+            }),
         )
+
+    def _pack_cols(self, device_cols: dict) -> dict:
+        """(tbin, toff) -> one packed tw column when packed-time is on."""
+        if self.packed_time is None:
+            return device_cols
+        tw = pack_tw(
+            device_cols.pop("tbin"), device_cols.pop("toff"), self.packed_time
+        )
+        device_cols["tw"] = tw
+        return device_cols
 
     # -- read side -------------------------------------------------------
     def scan_config(self, f: Filter) -> Optional[ScanConfig]:

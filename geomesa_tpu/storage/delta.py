@@ -51,10 +51,13 @@ def _concat_sub(parts: list[WriteKeys]) -> "np.ndarray | None":
     return np.concatenate(out)
 
 
-def delta_wide_mask(config: ScanConfig, keys: WriteKeys) -> np.ndarray:
+def delta_wide_mask(
+    config: ScanConfig, keys: WriteKeys, packed_shift: "int | None" = None
+) -> np.ndarray:
     """Wide-predicate mask over delta rows (bit-compatible with the kernel's
     wide plane: f32 widened boxes, per-bin windows, bbox-intersects for
-    extents; value-range check for predicate-free attribute scans)."""
+    extents; value-range check for predicate-free attribute scans).
+    ``packed_shift``: the keyspace's packed-time tick shift (tw column)."""
     cols = keys.device_cols
     n = len(keys.zs)
     m = np.ones(n, dtype=bool)
@@ -75,9 +78,19 @@ def delta_wide_mask(config: ScanConfig, keys: WriteKeys) -> np.ndarray:
                 hit |= (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
         m &= hit
     if config.windows is not None:
-        tb, to = cols["tbin"], cols["toff"]
+        if "tw" in cols:
+            # packed-time delta rows: wide tick semantics (floor), same
+            # as the kernel — refinement stays exact (delta hits are
+            # always uncertain)
+            from geomesa_tpu.index.z3 import unpack_tw, windows_to_ticks
+
+            tb, to = unpack_tw(cols["tw"])
+            wins = windows_to_ticks(config.windows, packed_shift, inner=False)
+        else:
+            tb, to = cols["tbin"], cols["toff"]
+            wins = config.windows
         hit = np.zeros(n, dtype=bool)
-        for b, lo, hi in np.asarray(config.windows, np.int64):
+        for b, lo, hi in np.asarray(wins, np.int64):
             hit |= (tb == b) & (to >= lo) & (to <= hi)
         m &= hit
     if config.boxes is None and config.windows is None:
@@ -123,7 +136,12 @@ class TieredTable:
     def _delta_hits(self, config: ScanConfig) -> np.ndarray:
         if config.disjoint or len(self.delta.zs) == 0:
             return np.zeros(0, np.int64)
-        return self.base + np.flatnonzero(delta_wide_mask(config, self.delta))
+        return self.base + np.flatnonzero(
+            delta_wide_mask(
+                config, self.delta,
+                packed_shift=getattr(self.keyspace, "packed_time", None),
+            )
+        )
 
     def scan(self, config: ScanConfig, deadline=None):
         return self.scan_submit(config, deadline=deadline)()

@@ -44,6 +44,7 @@ _SENTINELS = {
     "gymax": np.float32(-np.inf),
     "tbin": np.int32(-1),
     "toff": np.int32(0),
+    "tw": np.int32(-1),  # packed-time: bin -1 never matches
 }
 
 
@@ -422,8 +423,27 @@ class IndexTable(SortedKeys):
     # -- device hooks ----------------------------------------------------
     def _params(self, config: ScanConfig):
         """(boxes, windows) packed [8, 128] kernel param blocks (wide +
-        inner planes)."""
+        inner planes). Packed-time tables (the 1B layout) convert window
+        offsets to device ticks first — floor-wide / shrink-inner, so
+        tick-boundary rows refine on host like f32 box edges."""
         boxes = bk.pack_boxes(config.boxes, config.boxes_inner)
+        shift = getattr(self.keyspace, "packed_time", None)
+        if shift is not None and config.windows is not None:
+            from geomesa_tpu.index.z3 import windows_to_ticks
+
+            wide = bk.merge_window_slots(
+                windows_to_ticks(config.windows, shift, inner=False),
+                overflow="widen",
+            )
+            wi = config.windows_inner
+            if wi is not None:
+                wi = np.asarray(windows_to_ticks(wi, shift, inner=True))
+                wi = wi[wi[:, 1] <= wi[:, 2]] if len(wi) else wi
+            inner = (
+                bk.merge_window_slots(wi, overflow="drop")
+                if wi is not None and len(wi) else None
+            )
+            return boxes, bk.pack_windows(wide, inner)
         wins = bk.pack_windows(
             bk.merge_window_slots_wide(config), bk.merge_window_slots_inner(config)
         )
@@ -450,10 +470,10 @@ class IndexTable(SortedKeys):
         if config.boxes is not None:
             names |= self._coord_cols()
         if config.windows is not None:
-            names |= {"tbin", "toff"} & set(self.col_names)
+            names |= {"tbin", "toff", "tw"} & set(self.col_names)
         if not names:
             # no predicate: one validity column (sentinel test in _masks)
-            for v in ("x", "gxmin", "tbin"):
+            for v in ("x", "gxmin", "tw", "tbin"):
                 if v in self.col_names:
                     names = {v}
                     break
@@ -674,7 +694,7 @@ class IndexTable(SortedKeys):
         })
         if self.n_blocks > bk.M_BUCKETS[-1]:
             sizes.append(bk.M_BUCKETS[-1] + 1)  # triggers the full-scan shape
-        has_windows = "tbin" in self.col_names
+        has_windows = bool({"tbin", "tw"} & set(self.col_names))
         # (False, False) is the attribute-only / no-predicate variant
         # (validity-column projection) — real queries hit it too
         flag_combos = [(True, False), (False, False)]

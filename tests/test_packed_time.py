@@ -1,0 +1,168 @@
+"""Packed-time z3 device layout (the 1B-row single-chip budget): one i32
+tw = bin << 16 | (offset >> shift) column instead of (tbin, toff) —
+12 B/row. Differential: packed stores answer EXACTLY like unpacked ones
+(tick-boundary rows refine on host via the wide/inner certainty tiers).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.index.z3 import PACKED_KEY, PACKED_SHIFT, pack_tw, windows_to_ticks
+from geomesa_tpu.sft import FeatureType
+
+DAY = 86400_000
+N = 5000
+
+
+def _store(packed: bool, n=N, seed=17, interval="week"):
+    rng = np.random.default_rng(seed)
+    sft = FeatureType.from_spec("pt", "dtg:Date,*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z3"
+    sft.user_data["geomesa.z3.interval"] = interval
+    if packed:
+        sft.user_data[PACKED_KEY] = "true"
+    ds = DataStore(tile=64)
+    ds.create_schema(sft)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    t = t0 + rng.integers(0, 45 * DAY, n)
+    ds.write("pt", FeatureCollection.from_columns(
+        sft, [str(i) for i in range(n)], {"dtg": t, "geom": (x, y)}))
+    return ds, x, y, t, int(t0)
+
+
+class TestPacking:
+    def test_pack_roundtrip_bins(self):
+        tb = np.array([0, 100, 2900, 32767], np.int32)
+        to = np.array([0, 604799, 12345, 604800 - 1], np.int32)
+        from geomesa_tpu.curve.binnedtime import TimePeriod
+
+        tw = pack_tw(tb, to, PACKED_SHIFT[TimePeriod.WEEK])
+        assert (tw >> 16 == tb).all()
+        assert (tw >= 0).all()
+
+    def test_bin_overflow_raises(self):
+        with pytest.raises(ValueError, match="15 bits"):
+            pack_tw(np.array([40000], np.int32), np.array([0], np.int32), 5)
+
+    def test_tick_overflow_raises(self):
+        # a month's max offset (2,678,399 s) >> 5 would bleed into the
+        # bin bits (the review-caught MONTH shift bug); pack_tw refuses
+        with pytest.raises(ValueError, match="tick overflow"):
+            pack_tw(np.array([1], np.int32), np.array([2_678_399], np.int32), 5)
+        # the correct month shift fits
+        pack_tw(np.array([1], np.int32), np.array([2_678_399], np.int32), 6)
+
+    def test_all_period_shifts_fit(self):
+        from geomesa_tpu.curve.binnedtime import MAX_OFFSET, TimePeriod
+        from geomesa_tpu.scan.block_kernels import TW_MASK
+
+        for period, shift in PACKED_SHIFT.items():
+            assert MAX_OFFSET[period] >> shift <= TW_MASK, period
+
+    def test_window_tick_conversion_conservative(self):
+        # wide floors; inner shrinks to fully-covered ticks
+        w = np.array([[5, 63, 200]], np.int64)
+        wide = windows_to_ticks(w, 5, inner=False)
+        inner = windows_to_ticks(w, 5, inner=True)
+        assert wide[0, 1] == 63 >> 5 and wide[0, 2] == 200 >> 5
+        assert inner[0, 1] == (63 + 31) >> 5  # ceil
+        assert inner[0, 2] == (200 - 31) >> 5
+
+    def test_device_bytes_12_per_row(self):
+        ds, *_ = _store(packed=True, n=3000)
+        table = ds.table("pt", "z3")
+        t = getattr(table, "main", table)
+        assert set(t.col_names) == {"x", "y", "tw"}
+        ds2, *_ = _store(packed=False, n=3000, seed=18)
+        t2 = ds2.table("pt", "z3")
+        t2 = getattr(t2, "main", t2)
+        assert set(t2.col_names) == {"x", "y", "tbin", "toff"}
+
+
+class TestPackedDifferential:
+    @pytest.mark.parametrize("interval", ["week", "day", "month", "year"])
+    @pytest.mark.parametrize("seed", range(10))
+    def test_packed_equals_unpacked(self, seed, interval):
+        ds_p, x, y, t, t0 = _store(packed=True, seed=29, interval=interval)
+        ds_u, *_ = _store(packed=False, seed=29, interval=interval)
+        rng = np.random.default_rng(6200 + seed)
+        w = float(rng.choice([2.0, 20.0, 120.0]))
+        qx = float(f"{rng.uniform(-175, 175 - w):.3f}")
+        qy = float(f"{rng.uniform(-85, 85 - w / 2):.3f}")
+        # window endpoints at arbitrary ms (NOT tick-aligned)
+        lo = int(t0 + rng.integers(0, 40 * DAY))
+        hi = lo + int(rng.integers(1, 10 * DAY))
+        q = (f"bbox(geom, {qx}, {qy}, {qx + w}, {qy + w / 2}) AND dtg DURING "
+             f"{np.datetime64(lo, 'ms')}Z/{np.datetime64(hi, 'ms')}Z")
+        a = sorted(np.asarray(ds_p.query("pt", q).ids).tolist())
+        b = sorted(np.asarray(ds_u.query("pt", q).ids).tolist())
+        assert a == b, q
+        mask = (x >= qx) & (x <= qx + w) & (y >= qy) & (y <= qy + w / 2) \
+            & (t >= lo) & (t <= hi)
+        assert a == sorted(str(i) for i in np.flatnonzero(mask))
+
+    def test_tick_boundary_rows_exact(self):
+        """Rows whose offset sits exactly at a tick edge, queried with
+        windows cutting through the same tick."""
+        sft = FeatureType.from_spec("tb", "dtg:Date,*geom:Point:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "z3"
+        sft.user_data[PACKED_KEY] = "true"
+        ds = DataStore(tile=64)
+        ds.create_schema(sft)
+        t0 = int(np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64))
+        # a week-period tick is 32 s: place rows 1 ms apart around an edge
+        base = t0 + 7 * 32000
+        ts = np.array([base - 1, base, base + 1, base + 31999, base + 32000])
+        ds.write("tb", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(5)],
+            {"dtg": ts, "geom": (np.zeros(5), np.zeros(5))}))
+        lo, hi = base, base + 31999  # exactly one tick, ms endpoints
+        q = (f"bbox(geom, -1, -1, 1, 1) AND dtg DURING "
+             f"{np.datetime64(lo, 'ms')}Z/{np.datetime64(hi, 'ms')}Z")
+        got = sorted(np.asarray(ds.query("tb", q).ids).tolist())
+        # DURING is half-open [lo, hi)
+        want = sorted(str(i) for i in np.flatnonzero((ts >= lo) & (ts < hi)))
+        assert got == want
+
+    def test_delta_tier_and_compaction(self):
+        ds, x, y, t, t0 = _store(packed=True, n=2000)
+        sft = ds.get_schema("pt")
+        rng = np.random.default_rng(8)
+        t2 = t0 + rng.integers(0, 45 * DAY, 300)
+        ds.write("pt", FeatureCollection.from_columns(
+            sft, [f"d{i}" for i in range(300)],
+            {"dtg": t2, "geom": (rng.uniform(-180, 180, 300), rng.uniform(-90, 90, 300))}))
+        lo = t0 + 5 * DAY
+        hi = t0 + 25 * DAY
+        q = (f"bbox(geom, -90, -45, 90, 45) AND dtg DURING "
+             f"{np.datetime64(lo, 'ms')}Z/{np.datetime64(hi, 'ms')}Z")
+        got = set(np.asarray(ds.query("pt", q).ids).tolist())
+        m1 = (x >= -90) & (x <= 90) & (y >= -45) & (y <= 45) & (t >= lo) & (t <= hi)
+        xs2 = None
+        fc2 = ds.features("pt")
+        want = {str(i) for i in np.flatnonzero(m1)}
+        gx = np.asarray(fc2.geom_column.x)[2000:]
+        gy = np.asarray(fc2.geom_column.y)[2000:]
+        m2 = (gx >= -90) & (gx <= 90) & (gy >= -45) & (gy <= 45) & (t2 >= lo) & (t2 <= hi)
+        want |= {f"d{i}" for i in np.flatnonzero(m2)}
+        assert got == want
+        ds.compact("pt")
+        got2 = set(np.asarray(ds.query("pt", q).ids).tolist())
+        assert got2 == want
+
+    def test_count_and_density_on_packed(self):
+        ds, x, y, t, t0 = _store(packed=True)
+        lo, hi = t0 + 3 * DAY, t0 + 30 * DAY
+        q = (f"bbox(geom, -120, -60, 120, 60) AND dtg DURING "
+             f"{np.datetime64(lo, 'ms')}Z/{np.datetime64(hi, 'ms')}Z")
+        mask = (x >= -120) & (x <= 120) & (y >= -60) & (y <= 60) \
+            & (t >= lo) & (t <= hi)
+        assert ds.count("pt", q) == int(mask.sum())
+        grid = ds.density("pt", q, envelope=(-180, -90, 180, 90), width=32, height=16)
+        # device estimate path is tick-loose; exact host fallback isn't —
+        # allow the documented wide margin only at tick edges
+        assert abs(int(grid.sum()) - int(mask.sum())) <= int(0.02 * mask.sum()) + 64
