@@ -316,6 +316,30 @@ def cmd_convert(args) -> int:
     return 0
 
 
+def _write_payload(payload, output, n_rows: int, verb: str) -> None:
+    """Shared exporter tail: file (binary-aware) or stdout (hex for
+    binary formats)."""
+    if output:
+        mode = "wb" if isinstance(payload, bytes) else "w"
+        with open(output, mode) as fh:
+            fh.write(payload)
+        print(f"{verb} {n_rows} features to {output}")
+    else:
+        sys.stdout.write(payload if isinstance(payload, str) else payload.hex())
+
+
+def cmd_sql(args) -> int:
+    """Run one SELECT (sql.query front-end: ST_ predicates push down
+    into the planner; reference Spark SQL relation tier)."""
+    from geomesa_tpu.io.exporters import export
+    from geomesa_tpu.sql import sql_query
+
+    ds = _load(args)
+    out = sql_query(ds, args.query)
+    _write_payload(export(out, args.format), args.output, len(out), "wrote")
+    return 0
+
+
 def cmd_export(args) -> int:
     from geomesa_tpu.io.exporters import export
 
@@ -346,14 +370,7 @@ def cmd_export(args) -> int:
             return 1
         print(f"exported {len(out)} features to {base}.shp/.shx/.dbf")
         return 0
-    payload = export(out, args.format)
-    if args.output:
-        mode = "wb" if isinstance(payload, bytes) else "w"
-        with open(args.output, mode) as fh:
-            fh.write(payload)
-        print(f"exported {len(out)} features to {args.output}")
-    else:
-        sys.stdout.write(payload if isinstance(payload, str) else payload.hex())
+    _write_payload(export(out, args.format), args.output, len(out), "exported")
     return 0
 
 
@@ -487,6 +504,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("explain", cmd_explain, feature=True)
     sp.add_argument("-q", "--cql", required=True)
+
+    sp = add("sql", cmd_sql)
+    sp.add_argument("query", help="SELECT ... FROM <type> [WHERE st_...]")
+    sp.add_argument("--format", default="csv")
+    sp.add_argument("-o", "--output")
 
     sp = add("stats", cmd_stats, feature=True)
     sp.add_argument("--spec", default="Count()")

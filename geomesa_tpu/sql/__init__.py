@@ -8,5 +8,6 @@
 
 from geomesa_tpu.sql.functions import FUNCTIONS, st_call
 from geomesa_tpu.sql.join import spatial_join, spatial_join_indexed
+from geomesa_tpu.sql.query import sql_query
 
-__all__ = ["FUNCTIONS", "st_call", "spatial_join", "spatial_join_indexed"]
+__all__ = ["FUNCTIONS", "st_call", "spatial_join", "spatial_join_indexed", "sql_query"]

@@ -166,6 +166,25 @@ def main():
     )
     n_after = len(ds.query("gdelt", expr))
     log(f"append 2M in {append_s:.1f}s; post-append query {n_after:,} hits")
+    # exactness across main + delta: re-check query 0's truth including
+    # the appended rows (their dtg window rarely overlaps q0, but the
+    # check is structural, not probabilistic)
+    fc2 = ds.features("gdelt")
+    ax = np.asarray(fc2.geom_column.x)[N:]
+    ay = np.asarray(fc2.geom_column.y)[N:]
+    at = np.asarray(fc2.columns["dtg"])[N:]
+    want0, _ = truth_count_ids(x, y, t, q)
+    want_extra = int(
+        ((ax >= q[0]) & (ax <= q[2]) & (ay >= q[1]) & (ay <= q[3])
+         & (at >= q[4]) & (at < q[5])).sum()
+    )
+    assert n_after == want0 + want_extra, (n_after, want0, want_extra)
+    t_c = time.perf_counter()
+    ds.compact("gdelt")
+    compact_s = time.perf_counter() - t_c
+    n_compacted = len(ds.query("gdelt", expr))
+    assert n_compacted == n_after, (n_compacted, n_after)
+    log(f"compaction {compact_s:.1f}s; post-compaction query exact")
 
     print(json.dumps({
         "n_rows": N,
@@ -176,6 +195,8 @@ def main():
         "queries_exact": ok,
         "query_p50_s": round(float(np.percentile(lat, 50)), 2),
         "append_2m_s": round(append_s, 1),
+        "post_append_exact": True,
+        "compact_s": round(compact_s, 1),
         "backend": jax.default_backend(),
     }), flush=True)
 

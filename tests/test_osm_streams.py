@@ -1,0 +1,117 @@
+"""OSM converter + feature change-stream topology (VERDICT r4 missing
+#6/#7)."""
+
+import numpy as np
+
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.io.osm import read_osm
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.streaming import FeatureStream, StreamingFeatureCache
+
+OSM = """<?xml version="1.0"?>
+<osm version="0.6">
+  <node id="1" lat="48.1" lon="11.5"><tag k="name" v="Stop A"/><tag k="highway" v="bus_stop"/></node>
+  <node id="2" lat="48.2" lon="11.6"/>
+  <node id="3" lat="48.3" lon="11.7"/>
+  <node id="4" lat="48.1" lon="11.8"><tag k="amenity" v="cafe"/><tag k="name" v="Cafe B"/></node>
+  <node id="10" lat="48.0" lon="11.0"/>
+  <node id="11" lat="48.0" lon="11.1"/>
+  <node id="12" lat="48.1" lon="11.1"/>
+  <node id="13" lat="48.1" lon="11.0"/>
+  <way id="100"><nd ref="2"/><nd ref="3"/><tag k="highway" v="residential"/><tag k="name" v="Main St"/></way>
+  <way id="200"><nd ref="10"/><nd ref="11"/><nd ref="12"/><nd ref="13"/><nd ref="10"/><tag k="building" v="yes"/></way>
+</osm>
+"""
+
+
+class TestOsm:
+    def test_nodes_tagged_only(self):
+        fc = read_osm(OSM, kind="nodes")
+        assert sorted(fc.ids.tolist()) == ["1", "4"]
+        i = fc.ids.tolist().index("1")
+        assert fc.columns["highway"][i] == "bus_stop"
+        assert fc.columns["name"][i] == "Stop A"
+        assert abs(float(fc.geom_column.x[i]) - 11.5) < 1e-9
+
+    def test_nodes_all(self):
+        fc = read_osm(OSM, kind="nodes", tagged_only=False)
+        assert len(fc) == 8
+
+    def test_ways_line_and_area(self):
+        fc = read_osm(OSM, kind="ways")
+        assert sorted(fc.ids.tolist()) == ["100", "200"]
+        geoms = {fid: g for fid, g in zip(fc.ids.tolist(), fc.geometries())}
+        assert isinstance(geoms["100"], geo.LineString)
+        assert isinstance(geoms["200"], geo.Polygon)  # closed + building
+        assert fc.columns["name"][fc.ids.tolist().index("100")] == "Main St"
+
+    def test_ingest_roundtrip(self):
+        from geomesa_tpu.datastore import DataStore
+
+        fc = read_osm(OSM, kind="nodes", type_name="stops")
+        ds = DataStore()
+        ds.create_schema(fc.sft)
+        ds.write("stops", fc)
+        out = ds.query("stops", "highway = 'bus_stop'")
+        assert out.ids.tolist() == ["1"]
+
+
+class TestFeatureStream:
+    def _row(self, x, y, kind):
+        return {"kind": kind, "geom": geo.Point(x, y)}
+
+    def test_filter_map_to_cache(self):
+        sft = FeatureType.from_spec("ev", "kind:String,*geom:Point:srid=4326")
+        src = StreamingFeatureCache(sft)
+        src.upsert([self._row(1, 1, "ship"), self._row(2, 2, "plane")],
+                   ids=["a", "b"])
+        derived = StreamingFeatureCache(sft)
+        FeatureStream.wrap(src).filter(
+            lambda r: r["kind"] == "ship"
+        ).map(lambda r: {**r, "kind": r["kind"].upper()}).to(derived)
+        # replay of existing state
+        assert len(derived) == 1
+        assert derived.snapshot(["a"]).columns["kind"][0] == "SHIP"
+        # future events flow through
+        src.upsert([self._row(3, 3, "ship")], ids=["c"])
+        src.upsert([self._row(4, 4, "buoy")], ids=["d"])
+        assert len(derived) == 2 and len(src) == 4
+        # an update that stops matching drops the derived row
+        src.upsert([self._row(3, 3, "wreck")], ids=["c"])
+        assert len(derived) == 1
+        # deletes and expiry propagate
+        src.delete(["a"])
+        assert len(derived) == 0
+
+    def test_to_callable_sink(self):
+        sft = FeatureType.from_spec("ev", "kind:String,*geom:Point:srid=4326")
+        src = StreamingFeatureCache(sft)
+        events = []
+        FeatureStream.wrap(src).to(lambda a, fid, row: events.append((a, fid)))
+        src.upsert([self._row(0, 0, "x")], ids=["k"])
+        src.delete(["k"])
+        assert events == [("upsert", "k"), ("delete", "k")]
+
+    def test_to_lambda_store_sink(self):
+        from geomesa_tpu.datastore import DataStore
+        from geomesa_tpu.streaming import LambdaStore
+
+        sft = FeatureType.from_spec("ev", "kind:String,*geom:Point:srid=4326")
+        cold = DataStore()
+        cold.create_schema(sft)
+        lam = LambdaStore(cold, "ev")
+        src = StreamingFeatureCache(sft)
+        FeatureStream.wrap(src).filter(lambda r: r["kind"] == "ship").to(lam)
+        src.upsert([self._row(1, 1, "ship"), self._row(2, 2, "plane")],
+                   ids=["a", "b"])
+        assert lam.count() == 1
+        src.delete(["a"])  # drops the hot copy
+        assert lam.count() == 0
+
+    def test_bad_sink_raises(self):
+        import pytest
+
+        sft = FeatureType.from_spec("ev", "kind:String,*geom:Point:srid=4326")
+        src = StreamingFeatureCache(sft)
+        with pytest.raises(TypeError, match="sink"):
+            FeatureStream.wrap(src).to(object())

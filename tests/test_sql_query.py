@@ -1,0 +1,167 @@
+"""SELECT front-end with ST_ predicate push-down (VERDICT r4 missing #5).
+
+Reference: GeoMesaRelation + SQLRules — ST_ predicates rewrite into
+GeoTools filters pushed into the relation scan; everything else evaluates
+above it. Differential: sql_query == hand-built query + numpy truth.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.sql import sql_query
+from geomesa_tpu.sql.query import parse_select
+
+N = 4000
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(77)
+    sft = FeatureType.from_spec(
+        "pts", "name:String:index=true,score:Double,dtg:Date,*geom:Point:srid=4326"
+    )
+    store = DataStore(tile=64)
+    store.create_schema(sft)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    x = rng.uniform(-90, 90, N)
+    y = rng.uniform(-45, 45, N)
+    store.write("pts", FeatureCollection.from_columns(
+        sft, [str(i) for i in range(N)],
+        {"name": np.array(["a", "b", "c", "d"])[rng.integers(0, 4, N)],
+         "score": rng.uniform(0, 100, N),
+         "dtg": t0 + rng.integers(0, 30 * 86400_000, N),
+         "geom": (x, y)},
+    ))
+    return store, x, y
+
+
+class TestPushdown:
+    def test_intersects_pushdown(self, ds):
+        store, x, y = ds
+        out = sql_query(store, (
+            "SELECT * FROM pts WHERE st_intersects(geom, "
+            "st_geomfromwkt('POLYGON((0 0, 40 0, 40 20, 0 20, 0 0))'))"
+        ))
+        want = (x >= 0) & (x <= 40) & (y >= 0) & (y <= 20)
+        assert len(out) == int(want.sum())
+        # the spatial predicate became an index plan, not a full scan
+        plan = store.planner.plan(
+            "pts", parse_select(
+                "SELECT * FROM pts WHERE st_intersects(geom, "
+                "st_geomfromwkt('POLYGON((0 0, 40 0, 40 20, 0 20, 0 0))'))",
+                store.get_schema("pts"),
+            ).filter,
+        )
+        assert plan.index is not None
+
+    def test_contains_and_attribute(self, ds):
+        store, x, y = ds
+        out = sql_query(store, (
+            "SELECT name FROM pts WHERE st_contains("
+            "st_makebbox(-50, -30, 10, 10), geom) AND name = 'a'"
+        ))
+        names = np.asarray(store.features("pts").columns["name"])
+        want = (x > -50) & (x < 10) & (y > -30) & (y < 10) & (names == "a")
+        assert len(out) == int(want.sum())
+        assert list(out.columns) == ["name"]
+
+    def test_comparison_between_in_like(self, ds):
+        store, x, y = ds
+        fc = store.features("pts")
+        score = np.asarray(fc.columns["score"])
+        names = np.asarray(fc.columns["name"])
+        out = sql_query(store, "SELECT * FROM pts WHERE score BETWEEN 20 AND 30")
+        assert len(out) == int(((score >= 20) & (score <= 30)).sum())
+        out = sql_query(store, "SELECT * FROM pts WHERE name IN ('a', 'c')")
+        assert len(out) == int(np.isin(names, ["a", "c"]).sum())
+        out = sql_query(store, "SELECT * FROM pts WHERE 50 < score")
+        assert len(out) == int((score > 50).sum())
+
+    def test_order_limit_offset(self, ds):
+        store, *_ = ds
+        out = sql_query(
+            store, "SELECT name, score FROM pts ORDER BY score DESC LIMIT 5"
+        )
+        s = np.asarray(out.columns["score"])
+        assert len(out) == 5 and (np.diff(s) <= 0).all()
+        out2 = sql_query(
+            store, "SELECT score FROM pts ORDER BY score DESC LIMIT 5 OFFSET 2"
+        )
+        assert len(out2) == 5
+        np.testing.assert_allclose(
+            np.asarray(out2.columns["score"])[:3], s[2:5], rtol=0
+        )
+
+
+class TestResiduals:
+    def test_non_pushable_st_call(self, ds):
+        store, x, y = ds
+        out = sql_query(store, (
+            "SELECT * FROM pts WHERE st_bbox(geom, -20, -20, 20, 20) "
+            "AND st_x(geom) > 5"
+        ))
+        want = (x >= -20) & (x <= 20) & (y >= -20) & (y <= 20) & (x > 5)
+        assert len(out) == int(want.sum())
+
+    def test_residual_with_limit_exact(self, ds):
+        store, x, y = ds
+        out = sql_query(store, (
+            "SELECT * FROM pts WHERE st_bbox(geom, -90, -45, 90, 45) "
+            "AND st_x(geom) > 0 ORDER BY score LIMIT 7"
+        ))
+        assert len(out) == 7
+        assert (np.asarray(out.geom_column.x) > 0).all()
+        s = np.asarray(out.columns["score"])
+        assert (np.diff(s) >= 0).all()
+
+    def test_select_expressions(self, ds):
+        store, x, y = ds
+        out = sql_query(
+            store, "SELECT st_x(geom) AS lon, name FROM pts LIMIT 10"
+        )
+        assert list(out.columns) == ["lon", "name"]
+        assert len(out) == 10
+
+    def test_mixed_or_falls_residual(self, ds):
+        store, x, y = ds
+        fc = store.features("pts")
+        score = np.asarray(fc.columns["score"])
+        out = sql_query(store, (
+            "SELECT * FROM pts WHERE score > 90 OR st_x(geom) > 85"
+        ))
+        want = (score > 90) | (x > 85)
+        assert len(out) == int(want.sum())
+
+    def test_bad_sql_raises(self, ds):
+        store, *_ = ds
+        with pytest.raises(ValueError):
+            sql_query(store, "SELECT * WHERE x = 1")
+        with pytest.raises(ValueError):
+            sql_query(store, "SELECT * FROM pts WHERE")
+
+
+class TestOrderByAlias:
+    def test_order_by_select_alias(self, ds):
+        store, x, y = ds
+        out = sql_query(store, (
+            "SELECT st_x(geom) AS lon FROM pts "
+            "WHERE st_bbox(geom, -20, -20, 20, 20) ORDER BY lon DESC LIMIT 6"
+        ))
+        lons = np.asarray(out.columns["lon"])
+        assert len(out) == 6 and (np.diff(lons) <= 0).all()
+        want = np.sort(x[(x >= -20) & (x <= 20) & (y >= -20) & (y <= 20)])[::-1][:6]
+        np.testing.assert_allclose(lons, want)
+
+    def test_order_by_alias_with_residual(self, ds):
+        store, x, y = ds
+        out = sql_query(store, (
+            "SELECT st_x(geom) AS lon FROM pts WHERE "
+            "st_bbox(geom, -20, -20, 20, 20) AND st_y(geom) > 0 "
+            "ORDER BY lon LIMIT 4"
+        ))
+        lons = np.asarray(out.columns["lon"])
+        sel = (x >= -20) & (x <= 20) & (y >= -20) & (y <= 20) & (y > 0)
+        np.testing.assert_allclose(lons, np.sort(x[sel])[:4])
