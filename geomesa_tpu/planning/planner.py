@@ -475,13 +475,29 @@ class QueryPlanner:
         # auths cannot satisfy (reference VisibilityEvaluator tier)
         auths = None if skip_visibility else getattr(self.store, "auths", None)
         if auths is not None:
-            from geomesa_tpu.security import VIS_FIELD_KEY, visibility_mask
+            from geomesa_tpu.security import (
+                VIS_FIELD_KEY, visibility_mask, visible,
+            )
 
             sft = self.store.get_schema(plan.type_name)
             vis_field = sft.user_data.get(VIS_FIELD_KEY)
             if vis_field and len(out):
                 out = out.mask(visibility_mask(out.columns[vis_field], auths))
                 exp(f"Visibility filter: {len(out)} visible")
+            # attribute-level security (reference geomesa-security
+            # SecurityUtils per-attribute labels): an attribute whose
+            # ``vis=<label>`` option the auths cannot satisfy is PROJECTED
+            # OUT of the result — rows stay visible, the value does not
+            hidden = [
+                a.name
+                for a in out.sft.attributes
+                if a.options.get("vis")
+                and not visible(str(a.options["vis"]), frozenset(auths))
+            ]
+            if hidden:
+                keep = [a.name for a in out.sft.attributes if a.name not in hidden]
+                out = out.project(keep)
+                exp(f"Attribute visibility: hid {hidden}")
         exp(f"Hits: {len(out)}")
         if hints is not None:  # validated at _execute entry
             if hints.sample is not None:

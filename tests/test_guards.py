@@ -241,3 +241,45 @@ class TestPersistedAudit:
         ds_open = _store()
         ds_open.density("g", Q_OK, explain=exp4)
         assert "visibility" not in exp4.render().lower()
+
+
+class TestAttributeVisibility:
+    """Per-attribute labels (VERDICT r4 missing #3; reference
+    geomesa-security SecurityUtils attribute-level visibility): an
+    attribute with vis=<label> is projected out for auths that cannot
+    satisfy the label; rows stay visible."""
+
+    def _store(self, auths):
+        sft = FeatureType.from_spec(
+            "av", "name:String,ssn:String:vis=admin,dtg:Date,*geom:Point:srid=4326"
+        )
+        ds = DataStore(tile=64, auths=auths)
+        ds.create_schema(sft)
+        n = 50
+        rng = np.random.default_rng(2)
+        t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+        ds.write("av", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"name": np.array(["x"] * n),
+             "ssn": np.array([f"s{i}" for i in range(n)]),
+             "dtg": t0 + rng.integers(0, 30 * DAY, n),
+             "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n))},
+        ))
+        return ds
+
+    def test_unauthorized_loses_attribute(self):
+        ds = self._store(auths=("user",))
+        out = ds.query("av", Q_WIDE_LONG)
+        assert len(out) > 0
+        assert "ssn" not in out.columns
+        assert "name" in out.columns
+
+    def test_authorized_sees_attribute(self):
+        ds = self._store(auths=("admin",))
+        out = ds.query("av", Q_WIDE_LONG)
+        assert len(out) > 0 and "ssn" in out.columns
+
+    def test_no_auths_configured_sees_all(self):
+        ds = self._store(auths=None)
+        out = ds.query("av", Q_WIDE_LONG)
+        assert "ssn" in out.columns
