@@ -581,18 +581,27 @@ def _probe_link():
         jax.device_get(small)
         t0 = time.perf_counter()
         jax.device_get(small)
-        rtt_ms = (time.perf_counter() - t0) * 1e3
-        big = jnp.zeros((1024, 1024), jnp.float32) + 1  # 4 MB
+        t_small = time.perf_counter() - t0
+        big = jnp.zeros((1024, 1024), jnp.float32) + 1  # 4 MiB
         jax.device_get(big)
         t0 = time.perf_counter()
         jax.device_get(big)
-        dt = time.perf_counter() - t0
-        mbps = 4.0 / max(dt - rtt_ms / 1e3, 1e-6)
-        LINK_PROFILE.update(
-            link_rtt_ms=round(rtt_ms, 1), link_pull_mb_s=round(mbps, 1)
+        t_big = time.perf_counter() - t0
+        rtt_ms = t_small * 1e3
+        LINK_PROFILE.update(link_rtt_ms=round(rtt_ms, 1))
+        # bandwidth from the SIZE DELTA of the two pulls; on a fast link
+        # the delta drowns in noise (t_big <= t_small) — omit rather than
+        # record an absurd number in the artifact of record
+        d_bytes = big.nbytes - small.nbytes
+        mbps = None
+        if t_big > t_small * 1.2:
+            mbps = d_bytes / 1e6 / (t_big - t_small)
+            LINK_PROFILE.update(link_pull_mb_s=round(mbps, 1))
+        log(
+            f"link probe: pull floor ~{rtt_ms:.1f} ms, "
+            + (f"~{mbps:.0f} MB/s" if mbps else "bandwidth not resolvable")
         )
-        log(f"link probe: pull floor ~{rtt_ms:.0f} ms, ~{mbps:.0f} MB/s")
-        if rtt_ms > 200 or mbps < 10:
+        if rtt_ms > 200 or (mbps is not None and mbps < 10):
             log(
                 "WARNING: link profile far from the PERF.md §1 constants "
                 "the M-bucket ladder / one-pull design are tuned for"

@@ -115,6 +115,24 @@ def _filter_leaf_kinds(
     return walk(f)
 
 
+def _referenced_props(f: Filter) -> set:
+    """Every attribute name a filter tree references (``prop`` fields of
+    leaf predicates, recursing into And/Or/Not)."""
+    from geomesa_tpu.filter.predicates import And, Not, Or
+
+    out: set = set()
+    if isinstance(f, (And, Or)):
+        for c in f.filters:
+            out |= _referenced_props(c)
+    elif isinstance(f, Not):
+        out |= _referenced_props(f.filter)
+    else:
+        prop = getattr(f, "prop", None)
+        if prop is not None:
+            out.add(prop)
+    return out
+
+
 def mask_decides_filter(
     f: Filter, config: Optional[ScanConfig], sft, for_aggregation: bool = False
 ) -> bool:
@@ -177,6 +195,12 @@ class QueryPlanner:
         f = normalize_antimeridian(f)
         if intercept:
             f = self.store.apply_interceptors(type_name, f)
+            # attribute-level visibility closes at PLAN depth: a predicate
+            # over a hidden attribute would evaluate against the hidden
+            # values during scan/refinement, letting unauthorized auths
+            # reconstruct them by probing (the reference's cell-level
+            # visibility makes the cell unreadable to the scan itself)
+            self._check_attr_visibility(type_name, f)
         exp(f"Planning query on '{type_name}': {type(f).__name__}")
 
         plan = self._select(type_name, f, limit, exp)
@@ -184,6 +208,29 @@ class QueryPlanner:
             self.store.apply_guards(plan)
         plan.planning_s = time.perf_counter() - t0
         return plan
+
+    def _check_attr_visibility(self, type_name: str, f: Filter) -> None:
+        auths = getattr(self.store, "auths", None)
+        if auths is None:
+            return
+        sft = self.store.get_schema(type_name)
+        from geomesa_tpu.security import visible
+
+        hidden = {
+            a.name
+            for a in sft.attributes
+            if a.options.get("vis")
+            and not visible(str(a.options["vis"]), frozenset(auths))
+        }
+        if not hidden:
+            return
+        used = _referenced_props(f)
+        blocked = sorted(hidden & used)
+        if blocked:
+            raise QueryGuardError(
+                f"filter references attribute(s) {blocked} whose "
+                "visibility the configured auths do not satisfy"
+            )
 
     def _select(
         self, type_name: str, f: Filter, limit: Optional[int], exp

@@ -283,3 +283,19 @@ class TestAttributeVisibility:
         ds = self._store(auths=None)
         out = ds.query("av", Q_WIDE_LONG)
         assert "ssn" in out.columns
+
+    def test_filter_on_hidden_attribute_rejected(self):
+        """Predicate probing must not recover hidden values (review
+        finding): a filter referencing a vis-protected attribute is
+        rejected at plan time for unauthorized auths."""
+        from geomesa_tpu.planning.errors import QueryGuardError
+
+        ds = self._store(auths=("user",))
+        with pytest.raises(QueryGuardError, match="ssn"):
+            ds.query("av", "ssn = 's5'")
+        # authorized auths may filter on it
+        ds2 = self._store(auths=("admin",))
+        out = ds2.query("av", "ssn = 's5'")
+        assert len(out) == 1
+        # unrelated predicates still work for unauthorized auths
+        assert len(ds.query("av", "name = 'x'")) == 50
