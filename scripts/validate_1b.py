@@ -125,7 +125,14 @@ def main():
         qy = float(r.uniform(-85, 85 - w / 2))
         lo = int(t0 + r.integers(0, span - 7 * DAY))
         hi = lo + int(r.choice([1, 7, 21])) * DAY
-        qs.append((qx, qy, qx + w, qy + w / 2, lo, hi))
+        # round THROUGH the expr's %.4f formatting so the brute-force
+        # truth tests the exact values the parser will see (an unrounded
+        # bound differs by up to 5e-5 deg — at 1e9 rows that sliver holds
+        # a point every few million hits)
+        qs.append((
+            float(f"{qx:.4f}"), float(f"{qy:.4f}"),
+            float(f"{qx + w:.4f}"), float(f"{qy + w / 2:.4f}"), lo, hi,
+        ))
 
     lat = []
     ok = 0
