@@ -71,3 +71,127 @@ class InProcessAdapter:
 
     def delete_table(self, table) -> None:
         pass  # device arrays free with the last reference
+
+
+class HostTable(object):
+    """Pure-host scan surface: the same IndexTable contract with NO jax
+    anywhere — sorted keys + numpy predicate masks (the reference's
+    in-memory CQEngine backend tier). The second IndexAdapter
+    implementation, proving the SPI seam: DataStore/planner code runs
+    unmodified against it."""
+
+    def __init__(self, keyspace, keys: WriteKeys, tile=None):
+        from geomesa_tpu.storage.table import SortedKeys
+
+        self._sk = SortedKeys(keyspace, keys, tile or 0)
+        self.keyspace = keyspace
+        # sorted host copies of the predicate columns
+        self._cols = {
+            k: v[self._sk.perm] for k, v in keys.device_cols.items()
+        }
+        self.extent = "gxmin" in self._cols
+        self.nbytes_device = 0  # nothing lives on a device
+
+    # -- SortedKeys passthroughs ----------------------------------------
+    @property
+    def n(self):
+        return self._sk.n
+
+    @property
+    def perm(self):
+        return self._sk.perm
+
+    def candidate_spans(self, config):
+        return self._sk.candidate_spans(config)
+
+    def candidate_spans_split(self, config):
+        return self._sk.candidate_spans_split(config)
+
+    # -- scan surface ----------------------------------------------------
+    def _wide_rows(self, config) -> "np.ndarray":
+        """Sorted-table row ids passing the WIDE predicate within the
+        candidate spans (numpy; bit-compatible with the kernel's wide
+        plane via delta_wide_mask)."""
+        import numpy as np
+
+        from geomesa_tpu.storage.delta import delta_wide_mask
+        from geomesa_tpu.storage.table import _span_rows
+
+        spans = self.candidate_spans(config)
+        rows = _span_rows(spans)
+        if len(rows) == 0:
+            return rows
+        sub = WriteKeys(
+            bins=self._sk.bins[rows],
+            zs=self._sk.zs[rows],
+            device_cols={k: v[rows] for k, v in self._cols.items()},
+        )
+        m = delta_wide_mask(
+            config, sub,
+            packed_shift=getattr(self.keyspace, "packed_time", None),
+        )
+        return rows[m]
+
+    def scan(self, config, deadline=None):
+        return self.scan_submit(config, deadline=deadline)()
+
+    def scan_submit(self, config, deadline=None):
+        import numpy as np
+
+        if config.disjoint or self.n == 0:
+            return lambda: (np.zeros(0, np.int64), np.zeros(0, bool))
+        rows = self._wide_rows(config)
+        out = (
+            self._sk.perm[rows].astype(np.int64),
+            np.zeros(len(rows), bool),  # wide-only: always refine
+        )
+        return lambda: out
+
+    def count(self, config) -> int:
+        return int(len(self._wide_rows(config)))
+
+    # -- aggregation surface (wide semantics, like the device kernels;
+    # the representative-xy and grid-scatter rules are SHARED with the
+    # delta tier — one implementation, storage.delta) ------------------
+    def density(self, config, envelope, width, height):
+        return self.density_submit(config, envelope, width, height)()
+
+    def density_submit(self, config, envelope, width, height):
+        from geomesa_tpu.storage.delta import rep_xy, scatter_density
+
+        rows = self._wide_rows(config)
+        x, y = rep_xy(self._cols, rows)
+        grid = scatter_density(x, y, envelope, width, height)
+        return lambda: grid
+
+    def bounds_stats(self, config):
+        from geomesa_tpu.storage.delta import rep_xy
+
+        rows = self._wide_rows(config)
+        if len(rows) == 0:
+            return 0, None
+        x, y = rep_xy(self._cols, rows)
+        return len(rows), (
+            float(x.min()), float(y.min()), float(x.max()), float(y.max())
+        )
+
+    def warmup(self) -> int:
+        return 0  # nothing to compile
+
+
+class HostAdapter:
+    """IndexAdapter producing HostTable scan surfaces (no device, no
+    jax): the drop-in backend for environments without an accelerator or
+    for tiny reference stores in tests. Compactions rebuild the sort
+    outright (no merged_table fast path) — acceptable at this tier's
+    scale; thread ``old``'s sort state through if it ever fronts big
+    data."""
+
+    def __init__(self, tile=None):
+        self.tile = tile
+
+    def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
+        return HostTable(keyspace, keys, tile=self.tile)
+
+    def delete_table(self, table) -> None:
+        pass

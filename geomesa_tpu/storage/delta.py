@@ -119,6 +119,31 @@ def delta_wide_mask(
     return m
 
 
+def rep_xy(cols: dict, rows) -> tuple:
+    """Representative coordinate per row: the point itself, or the bbox
+    midpoint for extent columns — the ONE rule shared by the delta tier,
+    the host adapter and (semantically) the device aggregation kernels."""
+    if "x" in cols:
+        return cols["x"][rows], cols["y"][rows]
+    x = (cols["gxmin"][rows] + cols["gxmax"][rows]) * 0.5
+    y = (cols["gymin"][rows] + cols["gymax"][rows]) * 0.5
+    return x, y
+
+
+def scatter_density(x, y, envelope, width: int, height: int, grid=None):
+    """Clip + scatter-add points into a [height, width] f32 grid (wide
+    density semantics; shared by the delta tier and the host adapter)."""
+    x0, y0, x1, y1 = (float(v) for v in envelope)
+    inb = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+    px = np.clip(((x - x0) / max(x1 - x0, 1e-12) * width).astype(np.int64), 0, width - 1)
+    py = np.clip(((y - y0) / max(y1 - y0, 1e-12) * height).astype(np.int64), 0, height - 1)
+    if grid is None:
+        grid = np.zeros((height, width), np.float32)
+    flat = grid.reshape(-1)
+    np.add.at(flat, (py * width + px)[inb], np.float32(1))
+    return flat.reshape(height, width)
+
+
 class TieredTable:
     """Main device table + host delta, presenting the IndexTable scan
     surface. Delta hits are uncertain (always refined)."""
@@ -179,13 +204,7 @@ class TieredTable:
         d = self._delta_hits(config)
         if len(d) == 0:
             return cnt, env
-        local = d - self.base
-        cols = self.delta.device_cols
-        if "x" in cols:
-            x, y = cols["x"][local], cols["y"][local]
-        else:
-            x = (cols["gxmin"][local] + cols["gxmax"][local]) * 0.5
-            y = (cols["gymin"][local] + cols["gymax"][local]) * 0.5
+        x, y = rep_xy(self.delta.device_cols, d - self.base)
         denv = (float(x.min()), float(y.min()), float(x.max()), float(y.max()))
         if env is None:
             return cnt + len(d), denv
@@ -208,20 +227,8 @@ class TieredTable:
     def _density_apply_delta(self, grid, config: ScanConfig, bounds, width, height):
         d = self._delta_hits(config)
         if len(d):
-            local = d - self.base
-            cols = self.delta.device_cols
-            if "x" in cols:
-                x, y = cols["x"][local], cols["y"][local]
-            else:
-                x = (cols["gxmin"][local] + cols["gxmax"][local]) * 0.5
-                y = (cols["gymin"][local] + cols["gymax"][local]) * 0.5
-            x0, y0, x1, y1 = (float(v) for v in bounds)
-            inb = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
-            px = np.clip(((x - x0) / (x1 - x0) * width).astype(np.int64), 0, width - 1)
-            py = np.clip(((y - y0) / (y1 - y0) * height).astype(np.int64), 0, height - 1)
-            flat = grid.reshape(-1)
-            np.add.at(flat, (py * width + px)[inb], np.float32(1))
-            grid = flat.reshape(height, width)
+            x, y = rep_xy(self.delta.device_cols, d - self.base)
+            grid = scatter_density(x, y, bounds, width, height, grid=grid)
         return grid
 
     @property

@@ -1,0 +1,78 @@
+"""Second IndexAdapter implementation (SURVEY §2.2 'partial' row: the
+SPI seam untested by a second impl): the pure-host backend must answer
+every query exactly like the device-backed default."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.storage.adapter import HostAdapter, IndexAdapter
+
+DAY = 86400_000
+
+
+@pytest.fixture(scope="module")
+def pair():
+    rng = np.random.default_rng(12)
+    n = 4000
+    sft_spec = "name:String:index=true,dtg:Date,*geom:Point:srid=4326"
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    cols = {
+        "name": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+        "dtg": t0 + rng.integers(0, 30 * DAY, n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+    }
+    stores = []
+    for adapter in (None, HostAdapter()):
+        ds = DataStore(adapter=adapter, tile=64)
+        ds.create_schema(FeatureType.from_spec("t", sft_spec))
+        ds.write("t", FeatureCollection.from_columns(
+            ds.get_schema("t"), [str(i) for i in range(n)], dict(cols)))
+        stores.append(ds)
+    return stores
+
+
+QUERIES = [
+    "bbox(geom, -40, -20, 40, 20)",
+    "bbox(geom, 0, 0, 90, 45) AND dtg DURING 2024-01-03T00:00:00Z/2024-01-12T00:00:00Z",
+    "name = 'b'",
+    "name = 'a' AND bbox(geom, -90, -45, 90, 45)",
+    "INTERSECTS(geom, POLYGON((0 0, 60 0, 30 40, 0 0)))",
+]
+
+
+class TestHostAdapter:
+    def test_protocol_conformance(self):
+        assert isinstance(HostAdapter(), IndexAdapter)
+
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_queries_match_device_backend(self, pair, q):
+        dev, host = pair
+        a = sorted(dev.query("t", q).ids.tolist())
+        b = sorted(host.query("t", q).ids.tolist())
+        assert a == b and len(a) > 0
+
+    def test_aggregations_match(self, pair):
+        dev, host = pair
+        q = "bbox(geom, -60, -30, 60, 30)"
+        assert dev.count("t", q) == host.count("t", q)
+        ga = dev.density("t", q, envelope=(-60, -30, 60, 30), width=16, height=8)
+        gb = host.density("t", q, envelope=(-60, -30, 60, 30), width=16, height=8)
+        np.testing.assert_array_equal(ga, gb)
+        assert dev.bounds("t", q) == host.bounds("t", q)
+
+    def test_mutations_through_host_adapter(self, pair):
+        _, host = pair
+        from geomesa_tpu import geometry as geo
+
+        n0 = len(host.features("t"))
+        host.upsert("t", FeatureCollection.from_columns(
+            host.get_schema("t"), ["0"],
+            {"name": np.array(["z"]),
+             "dtg": np.array([1704067200000]),
+             "geom": (np.array([1.0]), np.array([1.0]))}))
+        out = host.query("t", "name = 'z'")
+        assert out.ids.tolist() == ["0"]
+        assert len(host.features("t")) == n0
