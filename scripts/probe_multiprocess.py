@@ -4,6 +4,13 @@ mesh spans all 8, and a shard_map psum crosses the process boundary —
 the DCN-analogue path executed for real (single machine, TCP transport).
 
 Usage: python scripts/probe_multiprocess.py  (spawns its two workers)
+
+Status note (round 5): in THIS build environment the axon TPU plugin
+hangs jax.distributed.initialize before the CPU backend comes up, so
+the live two-process run cannot complete here; on a stock JAX install
+(no tunnel plugin) it runs as written. The host-major layout logic this
+would exercise is pinned by tests/test_multihost_mesh.py, including a
+full query path over the (hosts x devices_per_host)-shaped mesh.
 """
 
 import os
