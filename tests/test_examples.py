@@ -11,7 +11,7 @@ ROOT = Path(__file__).resolve().parent.parent
 
 @pytest.mark.parametrize(
     "script",
-    ["quickstart", "distributed_mesh", "streaming_hot_tier", "batch_and_update"],
+    ["quickstart", "distributed_mesh", "streaming_hot_tier", "batch_and_update", "sql_and_joins"],
 )
 def test_example_runs(script, monkeypatch):
     monkeypatch.syspath_prepend(str(ROOT))  # import geomesa_tpu from any cwd
