@@ -547,6 +547,135 @@ def block_scan(
     )
 
 
+# ------------------------------------------------ fused multi-query scan
+
+
+def _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, pack):
+    n = len(col_names)
+    skip = skip_inner_plane(has_boxes, extent)
+
+    def kernel(bids_ref, qids_ref, boxes_ref, wins_ref, *refs):
+        del bids_ref, qids_ref  # consumed by the index maps
+        cols = {name: refs[k][0] for k, name in enumerate(col_names)}
+        w, i = _masks(cols, boxes_ref[0], wins_ref[0], has_boxes, has_windows, extent)
+        refs[n][0] = _pack_bits(w, pack)
+        if not skip:
+            refs[n + 1][0] = _pack_bits(i, pack)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "interpret"),
+)
+def _pallas_block_scan_multi(
+    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows,
+    extent, interpret,
+):
+    """Fused form of _pallas_block_scan: slot i scans block bids[i] against
+    query qids[i]'s packed params (boxes/wins are [Q, 8, 128]). Two
+    scalar-prefetch operands drive the index maps; everything else is the
+    single-query kernel per slot."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M = bids.shape[0]
+    SUB = cols3[0].shape[1]
+    PACK = SUB // 32
+    n_out = 1 if skip_inner_plane(has_boxes, extent) else 2
+    kernel = _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, PACK)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(M,),
+        in_specs=[
+            pl.BlockSpec((1, 8, LANES), lambda i, bids, qids: (qids[i], 0, 0)),
+            pl.BlockSpec((1, 8, LANES), lambda i, bids, qids: (qids[i], 0, 0)),
+        ]
+        + [
+            pl.BlockSpec((1, SUB, LANES), lambda i, bids, qids: (bids[i], 0, 0))
+            for _ in col_names
+        ],
+        out_specs=[
+            pl.BlockSpec((1, PACK, LANES), lambda i, bids, qids: (i, 0, 0))
+        ] * n_out,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32)] * n_out,
+        interpret=interpret,
+    )(bids, qids, boxes, wins, *cols3)
+    return (out[0], None) if n_out == 1 else (out[0], out[1])
+
+
+@partial(jax.jit, static_argnames=("col_names", "has_boxes", "has_windows", "extent"))
+def _xla_block_scan_multi(
+    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows, extent,
+):
+    """XLA fallback for the fused multi-query scan: gather each slot's
+    column block and params, vmap the single-block mask over slots."""
+    PACK = cols3[0].shape[1] // 32
+    gathered = tuple(c[bids] for c in cols3)
+    bq, wq = boxes[qids], wins[qids]
+    skip = skip_inner_plane(has_boxes, extent)
+
+    if skip:
+
+        def per_block_w(box, win, *colblk):
+            cols = dict(zip(col_names, colblk))
+            w, _ = _masks(cols, box, win, has_boxes, has_windows, extent)
+            return _pack_bits(w, PACK)
+
+        return jax.vmap(per_block_w)(bq, wq, *gathered), None
+
+    def per_block(box, win, *colblk):
+        cols = dict(zip(col_names, colblk))
+        w, i = _masks(cols, box, win, has_boxes, has_windows, extent)
+        return _pack_bits(w, PACK), _pack_bits(i, PACK)
+
+    return jax.vmap(per_block)(bq, wq, *gathered)
+
+
+def block_scan_multi(
+    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows, extent,
+):
+    """Fused multi-query scan (round 5): ONE kernel dispatch scans many
+    queries' candidate blocks — slot i reads block ``bids[i]`` with query
+    ``qids[i]``'s params from ``boxes``/``wins`` [Q, 8, 128] stacks. Output
+    planes are per-slot exactly like :func:`block_scan`; each query's rows
+    decode from its contiguous slot segment. Amortizes the per-dispatch
+    overhead that serialized many-small-query workloads (the indexed
+    spatial join's 256 per-polygon scans — BENCH_ALL_r05 config 4).
+    No PIP-edges support: polygon queries keep per-query dispatches.
+
+    Static compile key: (M bucket, Q bucket, col_names, flags). Callers
+    bucket Q with :func:`bucket_q` and M with :func:`pad_bids`.
+    """
+    if use_pallas():
+        interpret = jax.default_backend() != "tpu"
+        return _pallas_block_scan_multi(
+            cols3, bids, qids, boxes, wins,
+            col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=extent, interpret=interpret,
+        )
+    return _xla_block_scan_multi(
+        cols3, bids, qids, boxes, wins,
+        col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
+        extent=extent,
+    )
+
+
+def bucket_q(q: int) -> int:
+    """Static Q bucket (query-count dimension of the packed param stacks):
+    power of two >= q, floor 8. Pad query rows are all-zero params no slot
+    references (pad slots carry qid 0 and are ignored at decode)."""
+    m = 8
+    while m < q:
+        m *= 2
+    return m
+
+
 # --------------------------------------------------------------- decode
 
 

@@ -320,14 +320,18 @@ def spatial_join_indexed(
         raise TypeError("indexed join requires a point store")
 
     lgeoms = left.geometries()
-    # dispatch EVERY left geometry's scan before pulling any result
-    finishes = []
+    # ONE fused dispatch for all left geometries' scans (scan_submit_many
+    # groups box scans into shared kernel calls; PIP-edge polygon scans
+    # stay per-query but still all dispatch before any pull)
+    cfgs: list = []
+    exacts: list[bool] = []
     for g in lgeoms:
         rect = geo.is_rectangle(g)
         f = BBox(gf, *g.bounds()) if rect else Intersects(gf, g)
         cfg = idx.scan_config(f)
         if cfg is None or cfg.disjoint:
-            finishes.append(None)
+            cfgs.append(None)
+            exacts.append(False)
         else:
             # certainty is only trustworthy when the device evaluated the
             # TRUE predicate: the shrunk box for rectangles, the PIP tier
@@ -335,16 +339,17 @@ def spatial_join_indexed(
             # (cfg.poly None) gets bbox certainty only — every row must
             # host-refine or bbox-inside-but-outside-polygon points would
             # join as false pairs
-            exact_on_device = rect or cfg.poly is not None
-            finishes.append((table.scan_submit(cfg), exact_on_device))
+            cfgs.append(cfg)
+            exacts.append(rect or cfg.poly is not None)
+    live_idx = [k for k, c in enumerate(cfgs) if c is not None]
+    finish_all = table.scan_submit_many([cfgs[k] for k in live_idx])
+    results = dict(zip(live_idx, finish_all()))
 
     lo_parts: list[np.ndarray] = []
     ro_parts: list[np.ndarray] = []
-    for k, fin in enumerate(finishes):
-        if fin is None:
-            continue
-        fin, exact_on_device = fin
-        ordinals, certain = fin()
+    for k in live_idx:
+        ordinals, certain = results[k]
+        exact_on_device = exacts[k]
         if not exact_on_device:
             certain = np.zeros(len(ordinals), dtype=bool)
         if len(ordinals) == 0:
@@ -372,7 +377,10 @@ def spatial_join_indexed(
             keep[unc] = ok
             ordinals = ordinals[keep]
         lo_parts.append(np.full(len(ordinals), k, dtype=np.int64))
-        ro_parts.append(ordinals)
+        # decode yields TABLE-row order; perm makes that non-monotonic in
+        # feature ordinals — sort so the documented (left, right) pair
+        # order actually holds
+        ro_parts.append(np.sort(ordinals))
     if not lo_parts:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
     return np.concatenate(lo_parts), np.concatenate(ro_parts)

@@ -188,6 +188,24 @@ class TieredTable:
 
         return finish
 
+    def scan_submit_many(self, configs, deadline=None):
+        """Fused multi-query scan over the main table (one kernel dispatch
+        per variant group — IndexTable.scan_submit_many), each query's
+        host delta hits appended at finish like scan_submit."""
+        finish_main = self.main.scan_submit_many(configs, deadline=deadline)
+
+        def finish():
+            out = []
+            for config, (ordinals, certain) in zip(configs, finish_main()):
+                d = self._delta_hits(config)
+                if len(d):
+                    ordinals = np.concatenate([ordinals, d])
+                    certain = np.concatenate([certain, np.zeros(len(d), bool)])
+                out.append((ordinals, certain))
+            return out
+
+        return finish
+
     def count(self, config: ScanConfig) -> int:
         return self.main.count(config) + len(self._delta_hits(config))
 

@@ -394,31 +394,169 @@ class IndexTable(SortedKeys):
             return lambda: out
 
         check_deadline(deadline, "device scan dispatch")
-        finish_device = self._device_scan_submit(blocks, config)
+        return self._make_finish(
+            self._device_scan_submit(blocks, config), config, overlap, contained, deadline
+        )
+
+    def _make_finish(self, finish_device, config, overlap, contained, deadline):
+        """finish() closure over a dispatched device scan: decode +
+        _post_decode. Shared by scan_submit and scan_submit_many's
+        single-member groups so the two can never drift."""
 
         def finish() -> tuple[np.ndarray, np.ndarray]:
             rows, certain = finish_device()
             check_deadline(deadline, "bitmask decode")
-            if config.clip_rows:
-                keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
-                rows, certain = rows[keep], certain[keep]
-            if contained:
-                # union with contained-span rows (all certain), dedup
-                # kernel rows inside a span — one native two-pointer pass
-                # when available, numpy fallback otherwise
-                from geomesa_tpu import native
-
-                merged = native.merge_rows_spans(contained, rows, certain)
-                if merged is not None:
-                    rows, certain = merged
-                else:
-                    dup = _rows_in_spans(rows, contained)
-                    rows, certain = _merge_sorted_rows(
-                        _span_rows(contained), rows[~dup], certain[~dup]
-                    )
-            return self.perm[rows].astype(np.int64), certain
+            return self._post_decode(rows, certain, config, overlap, contained)
 
         return finish
+
+    def _post_decode(self, rows, certain, config, overlap, contained):
+        """Decoded kernel rows -> (feature ordinals, certain): span
+        clipping, contained-span union (all certain; native two-pointer
+        dedup when available), permutation to feature ordinals. Shared by
+        the per-query and fused scan paths."""
+        if config.clip_rows:
+            keep = _rows_in_spans(rows, _merge_spans(overlap + contained))
+            rows, certain = rows[keep], certain[keep]
+        if contained:
+            from geomesa_tpu import native
+
+            merged = native.merge_rows_spans(contained, rows, certain)
+            if merged is not None:
+                rows, certain = merged
+            else:
+                dup = _rows_in_spans(rows, contained)
+                rows, certain = _merge_sorted_rows(
+                    _span_rows(contained), rows[~dup], certain[~dup]
+                )
+        return self.perm[rows].astype(np.int64), certain
+
+    def scan_submit_many(self, configs: list, deadline=None):
+        """Fused form of :meth:`scan_submit` for MANY queries (round 5):
+        groups eligible configs by kernel variant and dispatches ONE fused
+        kernel per group (`bk.block_scan_multi`) instead of one dispatch
+        per query — slot i of the fused grid scans block bids[i] with
+        query qids[i]'s params. Returns ``finish() -> [(ordinals,
+        certain), ...]`` in input order.
+
+        Per-query dispatch overhead (~2 ms submit + serialized kernel
+        launches) dominated many-small-query workloads: the indexed
+        spatial join's 256 per-polygon scans spent ~2.1 s of which <10 ms
+        was host refinement (BENCH_ALL_r05 config 4). Ineligible configs
+        (PIP-edge polygons, pure range scans, empty/disjoint) fall back to
+        :meth:`scan_submit` per query, still dispatched before any pull.
+        """
+        import jax
+
+        if type(self)._device_scan_submit is not IndexTable._device_scan_submit:
+            # subclass re-routes the device seam (DistributedIndexTable's
+            # shard_map scans): the fused kernel would bypass it — keep
+            # per-query dispatches, still pipelined
+            finishes_d = [self.scan_submit(c, deadline=deadline) for c in configs]
+            return lambda: [f() for f in finishes_d]
+
+        n_q = len(configs)
+        finishes: list = [None] * n_q
+        # groups: variant key -> [(j, config, bids_padded?, ...)]
+        groups: dict[tuple, list] = {}
+        for j, config in enumerate(configs):
+            if config.disjoint or self.n == 0:
+                out = (np.zeros(0, np.int64), np.zeros(0, bool))
+                finishes[j] = lambda out=out: out
+                continue
+            check_deadline(deadline, "range pruning")
+            has_pred = config.boxes is not None or config.windows is not None
+            if not has_pred or (config.poly is not None and not self.extent):
+                # pure range scans and PIP-edge polygon scans keep the
+                # per-query path (edges are per-query kernel constants)
+                finishes[j] = self.scan_submit(config, deadline=deadline)
+                continue
+            overlap, contained = self.candidate_spans_split(config)
+            blocks = self.candidate_blocks(overlap)
+            if len(blocks) == 0:
+                cont_rows = _span_rows(contained)
+                out = (self.perm[cont_rows].astype(np.int64), np.ones(len(cont_rows), bool))
+                finishes[j] = lambda out=out: out
+                continue
+            blocks = self._full_or(blocks)
+            names = self._scan_cols(config)
+            key = (names, config.boxes is not None, config.windows is not None)
+            groups.setdefault(key, []).append((j, config, blocks, overlap, contained))
+
+        for (names, has_boxes, has_windows), members in groups.items():
+            if len(members) == 1:
+                # one query in this variant: plain single-query dispatch,
+                # from the already-computed blocks/spans
+                j, config, blocks, overlap, contained = members[0]
+                finishes[j] = self._make_finish(
+                    self._device_scan_submit(blocks, config),
+                    config, overlap, contained, deadline,
+                )
+                continue
+            check_deadline(deadline, "device scan dispatch")
+            q_real = len(members)
+            q_pad = bk.bucket_q(q_real)
+            boxes = np.zeros((q_pad, 8, bk.LANES), np.float32)
+            wins = np.zeros((q_pad, 8, bk.LANES), np.int32)
+            bid_parts: list[np.ndarray] = []
+            qid_parts: list[np.ndarray] = []
+            segs: list[tuple[int, int]] = []  # slot segment per member
+            pos = 0
+            for q, (j, config, blocks, _, _) in enumerate(members):
+                b, w = self._params(config)
+                boxes[q] = b
+                wins[q] = w
+                bid_parts.append(blocks.astype(np.int32))
+                qid_parts.append(np.full(len(blocks), q, np.int32))
+                segs.append((pos, pos + len(blocks)))
+                pos += len(blocks)
+            bids, n_real = bk.pad_bids(np.concatenate(bid_parts), self.n_blocks)
+            self._record_scan(names, len(bids))
+            qids = np.zeros(len(bids), np.int32)
+            qids[:n_real] = np.concatenate(qid_parts)
+            wide, inner = bk.block_scan_multi(
+                self._cols_args(names), bids, qids, boxes, wins,
+                col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+                extent=self.extent,
+            )
+            for plane in (wide, inner):
+                if plane is not None and hasattr(plane, "copy_to_host_async"):
+                    plane.copy_to_host_async()
+
+            def make_group_finish(members, segs, wide, inner):
+                pulled: dict = {}
+
+                def group_pull():
+                    if "planes" not in pulled:
+                        wide_h, inner_h = jax.device_get((wide, inner))
+                        pulled["planes"] = (
+                            np.asarray(wide_h),
+                            None if inner_h is None else np.asarray(inner_h),
+                        )
+                    return pulled["planes"]
+
+                def member_finish(k):
+                    j, config, blocks, overlap, contained = members[k]
+                    s, e = segs[k]
+                    wide_h, inner_h = group_pull()
+                    check_deadline(deadline, "bitmask decode")
+                    rows, certain = bk.decode_bits_pair(
+                        np.ascontiguousarray(wide_h[s:e]),
+                        None if inner_h is None else np.ascontiguousarray(inner_h[s:e]),
+                        blocks, e - s,
+                    )
+                    return self._post_decode(rows, certain, config, overlap, contained)
+
+                return member_finish
+
+            member_finish = make_group_finish(members, segs, wide, inner)
+            for k, (j, *_rest) in enumerate(members):
+                finishes[j] = lambda k=k, f=member_finish: f(k)
+
+        def finish_all():
+            return [f() for f in finishes]
+
+        return finish_all
 
     # -- device hooks ----------------------------------------------------
     def _params(self, config: ScanConfig):

@@ -1,0 +1,124 @@
+"""Profile config-4 spatial_join_indexed on the live device.
+
+Rebuilds the exact bench config-4 store (env-scalable) and times the
+join phases: scan_config (host z-ranges), submit (dispatch), pull+decode
+(finish callbacks), host refine, concat. Run: python scripts/profile_join.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N = int(os.environ.get("N", 20_000_000))
+N_POLY = int(os.environ.get("N_POLY", 256))
+SEED = 42
+
+
+def gdelt_points(n, rng):
+    n_clustered = n // 2
+    n_uniform = n - n_clustered
+    cx = rng.uniform(-160, 160, 64)
+    cy = rng.uniform(-55, 65, 64)
+    which = rng.integers(0, 64, n_clustered)
+    x = np.concatenate([
+        rng.uniform(-180, 180, n_uniform),
+        np.clip(cx[which] + rng.normal(0, 3.0, n_clustered), -180, 180),
+    ])
+    y = np.concatenate([
+        rng.uniform(-90, 90, n_uniform),
+        np.clip(cy[which] + rng.normal(0, 2.0, n_clustered), -90, 90),
+    ])
+    return x, y
+
+
+def main():
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.filter.predicates import BBox
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.sql.join import spatial_join_indexed
+
+    rng = np.random.default_rng(SEED + 30)
+    x, y = gdelt_points(N, rng)
+    px0 = rng.uniform(-170, 150, N_POLY)
+    py0 = rng.uniform(-80, 60, N_POLY)
+    pw = rng.uniform(1, 12, N_POLY)
+    ph = rng.uniform(1, 8, N_POLY)
+    polys = geo.PackedGeometryColumn.from_boxes(px0, py0, px0 + pw, py0 + ph)
+
+    psft = FeatureType.from_spec("pts", "*geom:Point:srid=4326")
+    psft.user_data["geomesa.indices.enabled"] = "z2"
+    gsft = FeatureType.from_spec("adm", "*geom:Polygon:srid=4326")
+    poly_fc = FeatureCollection.from_columns(gsft, np.arange(N_POLY), {"geom": polys})
+    ds = DataStore()
+    ds.create_schema(psft)
+    print(f"building {N:,} point store ...", file=sys.stderr)
+    ds.write("pts", FeatureCollection.from_columns(
+        psft, np.arange(N), {"geom": (x, y)}), check_ids=False)
+
+    spatial_join_indexed(ds, "pts", poly_fc, "contains")  # warmup
+
+    # phase timing: replicate the join loop with instrumentation
+    idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+    table = ds.table("pts", "z2")
+    pts = ds.features("pts").geom_column
+    lgeoms = poly_fc.geometries()
+
+    for trial in range(3):
+        t0 = time.perf_counter()
+        t_cfg = t_submit = 0.0
+        finishes = []
+        for g in lgeoms:
+            a = time.perf_counter()
+            f = BBox("geom", *g.bounds())
+            cfg = idx.scan_config(f)
+            b = time.perf_counter()
+            t_cfg += b - a
+            finishes.append(table.scan_submit(cfg) if cfg and not cfg.disjoint else None)
+            t_submit += time.perf_counter() - b
+        t_disp = time.perf_counter() - t0
+
+        t_pull = t_refine = 0.0
+        n_pairs = 0
+        n_unc = 0
+        for k, fin in enumerate(finishes):
+            if fin is None:
+                continue
+            a = time.perf_counter()
+            ordinals, certain = fin()
+            b = time.perf_counter()
+            t_pull += b - a
+            unc = np.flatnonzero(~certain)
+            n_unc += len(unc)
+            if len(unc):
+                g = lgeoms[k]
+                x0, y0, x1, y1 = g.bounds()
+                ux, uy = pts.x[ordinals[unc]], pts.y[ordinals[unc]]
+                ok = (ux > x0) & (ux < x1) & (uy > y0) & (uy < y1)
+                keep = certain.copy()
+                keep[unc] = ok
+                ordinals = ordinals[keep]
+            n_pairs += len(ordinals)
+            t_refine += time.perf_counter() - b
+        total = time.perf_counter() - t0
+        print(
+            f"trial {trial}: total {total*1e3:.0f} ms | dispatch {t_disp*1e3:.0f} "
+            f"(scan_config {t_cfg*1e3:.0f}, submit {t_submit*1e3:.0f}) | "
+            f"pull+decode {t_pull*1e3:.0f} | refine {t_refine*1e3:.0f} | "
+            f"pairs {n_pairs:,} unc {n_unc:,}"
+        )
+
+    # the real entry point, for reference
+    for trial in range(2):
+        t0 = time.perf_counter()
+        li, ri = spatial_join_indexed(ds, "pts", poly_fc, "contains")
+        print(f"spatial_join_indexed: {(time.perf_counter()-t0)*1e3:.0f} ms, {len(li):,} pairs")
+
+
+if __name__ == "__main__":
+    main()

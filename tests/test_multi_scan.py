@@ -1,0 +1,217 @@
+"""Fused multi-query scan (round 5): scan_submit_many == per-query scans.
+
+One kernel dispatch covers many queries' candidate blocks — slot i scans
+block bids[i] under query qids[i]'s packed params (block_kernels.
+block_scan_multi). The contract under test: for EVERY config mix, the
+fused path returns exactly what per-query IndexTable.scan would."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStore, FeatureCollection, FeatureType
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.filter.predicates import BBox, During, Intersects
+from geomesa_tpu.scan import block_kernels as bk
+
+
+def make_store(n=60_000, seed=11, index="z3"):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-45, 45, n)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    t = t0 + rng.integers(0, 28 * 86400_000, n)
+    sft = FeatureType.from_spec("pts", "dtg:Date,*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = index
+    ds = DataStore()
+    ds.create_schema(sft)
+    fc = FeatureCollection.from_columns(sft, np.arange(n), {"dtg": t, "geom": (x, y)})
+    ds.write("pts", fc, check_ids=False)
+    return ds, t0
+
+
+def rand_bbox(rng, span=25.0):
+    x0 = rng.uniform(-60, 35)
+    y0 = rng.uniform(-45, 20)
+    return BBox("geom", x0, y0, x0 + rng.uniform(0.5, span), y0 + rng.uniform(0.5, span))
+
+
+def assert_matches(table, cfgs):
+    got = table.scan_submit_many(list(cfgs))()
+    assert len(got) == len(cfgs)
+    for cfg, (rows, certain) in zip(cfgs, got):
+        er, ec = table.scan(cfg)
+        assert np.array_equal(rows, er)
+        assert np.array_equal(certain, ec)
+
+
+class TestFusedScan:
+    def test_z3_boxes_and_windows(self):
+        ds, t0 = make_store()
+        idx = next(i for i in ds.indexes("pts") if i.name == "z3")
+        table = ds.table("pts", "z3")
+        rng = np.random.default_rng(5)
+        cfgs = []
+        for _ in range(23):
+            f = rand_bbox(rng)
+            if rng.random() < 0.7:
+                lo = t0 + rng.integers(0, 20 * 86400_000)
+                f = f & During("dtg", lo, lo + rng.integers(3600_000, 7 * 86400_000))
+            else:
+                # whole-period window (z3 needs a time constraint at all)
+                f = f & During("dtg", t0 - 86400_000, t0 + 40 * 86400_000)
+            cfgs.append(idx.scan_config(f))
+        assert_matches(table, cfgs)
+
+    def test_z2_boxes(self):
+        ds, _ = make_store(index="z2")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+        rng = np.random.default_rng(6)
+        assert_matches(ds.table("pts", "z2"), [idx.scan_config(rand_bbox(rng)) for _ in range(17)])
+
+    def test_mixed_eligibility(self):
+        """Disjoint, empty-candidate, PIP-edge polygon and plain box
+        configs in one batch: each routes correctly and results stay in
+        input order."""
+        ds, _ = make_store(index="z2")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+        table = ds.table("pts", "z2")
+        rng = np.random.default_rng(7)
+        tri = geo.from_wkt("POLYGON ((0 0, 24 4, 6 21, 0 0))")
+        cfgs = [
+            idx.scan_config(rand_bbox(rng)),
+            idx.scan_config(BBox("geom", 120.0, 60.0, 130.0, 70.0)),  # empty region: no blocks
+            idx.scan_config(Intersects("geom", tri)),  # PIP tier: per-query path
+            idx.scan_config(rand_bbox(rng)),
+            idx.scan_config(rand_bbox(rng)),
+        ]
+        assert_matches(table, [c for c in cfgs if c is not None])
+
+    def test_single_member_group_falls_back(self):
+        ds, _ = make_store(n=20_000, index="z2")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+        rng = np.random.default_rng(8)
+        assert_matches(ds.table("pts", "z2"), [idx.scan_config(rand_bbox(rng))])
+
+    def test_delta_tier(self):
+        """Un-compacted writes wrap the table in TieredTable: fused main
+        scan + per-query host delta hits."""
+        ds, t0 = make_store(n=30_000, index="z3")
+        rng = np.random.default_rng(9)
+        sft = ds.get_schema("pts")
+        m = 4_000
+        t = t0 + rng.integers(0, 28 * 86400_000, m)
+        fc = FeatureCollection.from_columns(
+            sft, 30_000 + np.arange(m),
+            {"dtg": t, "geom": (rng.uniform(-60, 60, m), rng.uniform(-45, 45, m))},
+        )
+        ds.write("pts", fc, check_ids=False)
+        idx = next(i for i in ds.indexes("pts") if i.name == "z3")
+        table = ds.table("pts", "z3")
+        from geomesa_tpu.storage.delta import TieredTable
+
+        assert isinstance(table, TieredTable)
+        cfgs = []
+        for _ in range(9):
+            lo = int(t0 + rng.integers(0, 20 * 86400_000))
+            cfgs.append(idx.scan_config(
+                rand_bbox(rng) & During("dtg", lo, lo + 3 * 86400_000)
+            ))
+        assert_matches(table, cfgs)
+
+    def test_packed_time_store(self):
+        from geomesa_tpu.index.z3 import PACKED_KEY
+
+        t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+        ds2 = DataStore()
+        sft2 = FeatureType.from_spec("pts", "dtg:Date,*geom:Point:srid=4326")
+        sft2.user_data["geomesa.indices.enabled"] = "z3"
+        sft2.user_data[PACKED_KEY] = "true"
+        ds2.create_schema(sft2)
+        rng = np.random.default_rng(10)
+        n = 30_000
+        t = t0 + rng.integers(0, 28 * 86400_000, n)
+        ds2.write("pts", FeatureCollection.from_columns(
+            sft2, np.arange(n),
+            {"dtg": t, "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n))},
+        ), check_ids=False)
+        idx = next(i for i in ds2.indexes("pts") if i.name == "z3")
+        cfgs = []
+        for _ in range(11):
+            f = rand_bbox(rng)
+            lo = int(t0 + rng.integers(0, 20 * 86400_000))
+            cfgs.append(idx.scan_config(f & During("dtg", lo, lo + 2 * 86400_000)))
+        assert_matches(ds2.table("pts", "z3"), cfgs)
+
+    def test_host_adapter_passthrough(self):
+        from geomesa_tpu.storage.adapter import HostAdapter
+
+        ds, _ = make_store(n=20_000, index="z2")
+        hs = DataStore(adapter=HostAdapter())
+        sft = FeatureType.from_spec("pts", "dtg:Date,*geom:Point:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "z2"
+        hs.create_schema(sft)
+        hs.write("pts", ds.features("pts"), check_ids=False)
+        idx = next(i for i in hs.indexes("pts") if i.name == "z2")
+        rng = np.random.default_rng(12)
+        assert_matches(hs.table("pts", "z2"), [idx.scan_config(rand_bbox(rng)) for _ in range(7)])
+
+
+class TestMultiKernelParity:
+    """Pallas-interpret vs XLA parity for the fused kernel itself."""
+
+    SUB = 256
+
+    def _cols(self, nb=4, seed=13):
+        rng = np.random.default_rng(seed)
+        import jax.numpy as jnp
+
+        x = rng.uniform(-50, 50, (nb, self.SUB, 128)).astype(np.float32)
+        y = rng.uniform(-50, 50, (nb, self.SUB, 128)).astype(np.float32)
+        return tuple(jnp.asarray(a) for a in (x, y))
+
+    def test_interpret_parity_boxes(self):
+        cols3 = self._cols()
+        q = 3
+        boxes = np.zeros((bk.bucket_q(q), 8, bk.LANES), np.float32)
+        wins = np.zeros((bk.bucket_q(q), 8, bk.LANES), np.int32)
+        rng = np.random.default_rng(14)
+        for k in range(q):
+            x0, y0 = rng.uniform(-40, 20, 2)
+            wide = np.array([[x0, y0, x0 + 25, y0 + 25]])
+            inner = wide + np.array([[1.0, 1.0, -1.0, -1.0]])
+            boxes[k] = bk.pack_boxes(wide, inner)
+            wins[k] = bk.pack_windows(None, None)
+        bids = np.array([0, 1, 2, 3, 0, 2, 1, 3], np.int32)
+        qids = np.array([0, 0, 0, 1, 1, 2, 2, 2], np.int32)
+        kw = dict(col_names=("x", "y"), has_boxes=True, has_windows=False, extent=False)
+        w_ref, i_ref = bk._xla_block_scan_multi(cols3, bids, qids, boxes, wins, **kw)
+        w_got, i_got = bk._pallas_block_scan_multi(
+            cols3, bids, qids, boxes, wins, interpret=True, **kw
+        )
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_got))
+        assert np.array_equal(np.asarray(i_ref), np.asarray(i_got))
+
+    def test_slotwise_equals_single_kernel(self):
+        """Each fused slot must equal the single-query kernel run with that
+        slot's params — the fused grid is just a re-indexed batch."""
+        cols3 = self._cols()
+        rng = np.random.default_rng(15)
+        x0, y0 = -10.0, -5.0
+        b0 = bk.pack_boxes(np.array([[x0, y0, x0 + 30, y0 + 20]]), None)
+        b1 = bk.pack_boxes(np.array([[-40.0, -40.0, 0.0, 0.0]]), None)
+        wins = bk.pack_windows(None, None)
+        boxes_m = np.zeros((8, 8, bk.LANES), np.float32)
+        wins_m = np.zeros((8, 8, bk.LANES), np.int32)
+        boxes_m[0], boxes_m[1] = b0, b1
+        wins_m[0] = wins_m[1] = wins
+        bids = np.array([0, 1, 2, 3, 1, 2], np.int32)
+        qids = np.array([0, 0, 0, 1, 1, 1], np.int32)
+        kw = dict(col_names=("x", "y"), has_boxes=True, has_windows=False, extent=False)
+        w_m, i_m = bk._xla_block_scan_multi(cols3, bids, qids, boxes_m, wins_m, **kw)
+        for q, params in ((0, b0), (1, b1)):
+            sl = qids == q
+            w_s, i_s = bk._xla_block_scan(
+                cols3, bids[sl], params, wins, **kw
+            )
+            assert np.array_equal(np.asarray(w_m)[sl], np.asarray(w_s))
+            assert np.array_equal(np.asarray(i_m)[sl], np.asarray(i_s))
