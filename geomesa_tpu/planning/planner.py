@@ -381,15 +381,19 @@ class QueryPlanner:
             plan, candidates, certain, hints, exp, deadline, skip_visibility
         )
 
-    def _submit_simple(self, plan, fc, exp, hints, skip_visibility=False):
+    def _submit_simple(self, plan, fc, exp, hints, skip_visibility=False, finish_scan=None):
         """Dispatch a simple index-scan plan's device work now; return
         ``finish()`` -> FeatureCollection. ONE implementation serves both
         the synchronous path (_execute calls finish immediately) and the
         pipelined path (execute_many defers it). The deadline clock starts
         when finish() runs — matching sequential semantics, so a late
-        pull in a long batch doesn't spuriously time out."""
-        table = self.store.table(plan.type_name, plan.index)
-        finish_scan = table.scan_submit(plan.config, deadline=None)
+        pull in a long batch doesn't spuriously time out.
+
+        ``finish_scan``: an already-dispatched scan's finish (submit_many's
+        fused group scans); default dispatches this plan's own scan."""
+        if finish_scan is None:
+            table = self.store.table(plan.type_name, plan.index)
+            finish_scan = table.scan_submit(plan.config, deadline=None)
 
         def finish() -> FeatureCollection:
             deadline = self._deadline(hints)
@@ -446,24 +450,36 @@ class QueryPlanner:
         return self._post(candidates, plan, hints, exp, skip_visibility)
 
     # -- pipelined multi-query execution ---------------------------------
+    def _is_simple(self, plan: QueryPlan) -> bool:
+        """True when the plan is a plain index scan whose device work can
+        dispatch ahead of finish() (no union/id/full-scan special-casing).
+        ONE predicate shared by submit and submit_many so their routing
+        can never drift."""
+        return (
+            plan.union is None
+            and plan.ids is None
+            and plan.index is not None
+            and plan.config is not None
+            and len(self.store.features(plan.type_name)) > 0
+        )
+
     def submit(self, plan: QueryPlan, explain: Explainer | None = None, hints=None):
         """Stage one query: dispatch its device scan NOW, return a zero-arg
         ``finish()`` producing the FeatureCollection. Plans without a
         simple index scan (unions, id lookups, full scans) fall back to
         synchronous execution inside finish()."""
         exp = explain or ExplainNull()
-        simple = (
-            plan.union is None
-            and plan.ids is None
-            and plan.index is not None
-            and plan.config is not None
-        )
-        if not simple or len(self.store.features(plan.type_name)) == 0:
+        if not self._is_simple(plan):
             return lambda: self.execute(plan, explain=exp, hints=hints)
         fc = self.store.features(plan.type_name)
         if hints is not None:
             hints.validate()
-        inner = self._submit_simple(plan, fc, exp, hints)
+        return self._record_wrap(plan, self._submit_simple(plan, fc, exp, hints))
+
+    def _record_wrap(self, plan, inner):
+        """finish() wrapper adding query auditing (record_query timing) —
+        ONE implementation for submit and submit_many's fused finishes, so
+        batched and single queries are always audited identically."""
 
         def finish() -> FeatureCollection:
             t0 = time.perf_counter()
@@ -473,13 +489,49 @@ class QueryPlanner:
 
         return finish
 
+    def submit_many(self, plans, hints=None) -> list:
+        """Stage MANY queries: like per-plan :meth:`submit`, but simple
+        index-scan plans sharing a (type, index) table route through the
+        table's fused multi-query kernel (``scan_submit_many`` — one
+        device dispatch per kernel-variant group instead of one per
+        query). Returns one ``finish()`` per plan, in input order.
+        Non-simple plans (unions, id lookups, full scans) fall back to
+        :meth:`submit`, which executes them synchronously inside their
+        finish() — only simple index scans dispatch ahead of the pulls."""
+        finishes: list = [None] * len(plans)
+        groups: dict[tuple, list[int]] = {}
+        for j, plan in enumerate(plans):
+            if not self._is_simple(plan):
+                finishes[j] = self.submit(plan, hints=hints)
+            else:
+                groups.setdefault((plan.type_name, plan.index), []).append(j)
+        if hints is not None and groups:
+            hints.validate()
+        for (tname, iname), idxs in groups.items():
+            table = self.store.table(tname, iname)
+            fc = self.store.features(tname)
+            many = getattr(table, "scan_submit_many", None)
+            if many is None or len(idxs) == 1:
+                for j in idxs:
+                    finishes[j] = self.submit(plans[j], hints=hints)
+                continue
+            scan_fins = many([plans[j].config for j in idxs])
+            for j, scan_fin in zip(idxs, scan_fins):
+                plan = plans[j]
+                finishes[j] = self._record_wrap(plan, self._submit_simple(
+                    plan, fc, ExplainNull(), hints, finish_scan=scan_fin
+                ))
+        return finishes
+
     def execute_many(self, plans, hints=None) -> list:
         """Execute several plans with overlapped device work: every scan
         dispatches before any result is pulled, so per-query round-trip
         latency pipelines instead of serializing (a throughput API — the
         reference gets the same effect from server-side thread pools,
-        utils/AbstractBatchScan; here jax async dispatch provides it)."""
-        finishes = [self.submit(p, hints=hints) for p in plans]
+        utils/AbstractBatchScan; here jax async dispatch provides it).
+        Scans sharing a table additionally fuse into one kernel dispatch
+        per variant group (submit_many)."""
+        finishes = self.submit_many(plans, hints=hints)
         return [f() for f in finishes]
 
     def _execute_union(self, plan: QueryPlan, exp, hints, deadline) -> FeatureCollection:

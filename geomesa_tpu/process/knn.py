@@ -119,13 +119,12 @@ def knn_many(
     # rounds (both windows missed => the estimate was far off).
     SPEC = 4.0
 
-    def _submit(i: int, r: float):
+    def _plan(i: int, r: float):
         x, y = pts[i]
         deg = _meters_to_degrees(r, float(y))
         box = _window_filter(geom, float(x), float(y), deg)
         f = box if isinstance(filter, Include) else And((box, filter))
-        plan = store.planner.plan(type_name, f)
-        return store.planner.submit(plan)
+        return store.planner.plan(type_name, f)
 
     def _resolve(i: int, res, r: float):
         """k-or-more within r -> the k nearest, else None (miss)."""
@@ -144,15 +143,23 @@ def knn_many(
 
     pending = list(range(len(pts)))
     while pending:
-        finishes = []
+        # both windows of every pending query go through ONE submit_many:
+        # scans sharing the index fuse into a single kernel dispatch per
+        # variant group (planner.submit_many -> table.scan_submit_many)
+        plans, owner = [], []
         for i in pending:
             r = float(radii[i])
             wide_r = min(r * SPEC, max_distance_m)
-            finishes.append((
-                i,
-                _submit(i, r),
-                _submit(i, wide_r) if wide_r > r else None,
-            ))
+            plans.append(_plan(i, r))
+            owner.append((i, False))
+            if wide_r > r:
+                plans.append(_plan(i, wide_r))
+                owner.append((i, True))
+        fins = store.planner.submit_many(plans, hints=None)
+        per: dict[int, list] = {i: [None, None] for i in pending}
+        for (i, is_wide), f in zip(owner, fins):
+            per[i][1 if is_wide else 0] = f
+        finishes = [(i, per[i][0], per[i][1]) for i in pending]
         nxt = []
         for i, fin, fin_wide in finishes:
             r = float(radii[i])

@@ -342,13 +342,12 @@ def spatial_join_indexed(
             cfgs.append(cfg)
             exacts.append(rect or cfg.poly is not None)
     live_idx = [k for k, c in enumerate(cfgs) if c is not None]
-    finish_all = table.scan_submit_many([cfgs[k] for k in live_idx])
-    results = dict(zip(live_idx, finish_all()))
+    fins = table.scan_submit_many([cfgs[k] for k in live_idx])
 
     lo_parts: list[np.ndarray] = []
     ro_parts: list[np.ndarray] = []
-    for k in live_idx:
-        ordinals, certain = results[k]
+    for k, fin in zip(live_idx, fins):
+        ordinals, certain = fin()
         exact_on_device = exacts[k]
         if not exact_on_device:
             certain = np.zeros(len(ordinals), dtype=bool)

@@ -148,10 +148,10 @@ class HostTable(object):
         return lambda: out
 
     def scan_submit_many(self, configs, deadline=None):
-        """Same contract as IndexTable.scan_submit_many; a host table has
-        no dispatch overhead to amortize, so this is the per-query loop."""
-        finishes = [self.scan_submit(c, deadline=deadline) for c in configs]
-        return lambda: [f() for f in finishes]
+        """Same contract as IndexTable.scan_submit_many (one finish per
+        config); a host table has no dispatch overhead to amortize, so
+        this is the per-query loop."""
+        return [self.scan_submit(c, deadline=deadline) for c in configs]
 
     def count(self, config) -> int:
         return int(len(self._wide_rows(config)))

@@ -190,21 +190,23 @@ class TieredTable:
 
     def scan_submit_many(self, configs, deadline=None):
         """Fused multi-query scan over the main table (one kernel dispatch
-        per variant group — IndexTable.scan_submit_many), each query's
-        host delta hits appended at finish like scan_submit."""
-        finish_main = self.main.scan_submit_many(configs, deadline=deadline)
+        per variant chunk — IndexTable.scan_submit_many), each query's
+        host delta hits appended at its finish like scan_submit. Returns
+        one finish() per config (lazy per-member decode preserved)."""
+        fins_main = self.main.scan_submit_many(configs, deadline=deadline)
 
-        def finish():
-            out = []
-            for config, (ordinals, certain) in zip(configs, finish_main()):
+        def make_finish(config, fin):
+            def finish():
+                ordinals, certain = fin()
                 d = self._delta_hits(config)
                 if len(d):
                     ordinals = np.concatenate([ordinals, d])
                     certain = np.concatenate([certain, np.zeros(len(d), bool)])
-                out.append((ordinals, certain))
-            return out
+                return ordinals, certain
 
-        return finish
+            return finish
+
+        return [make_finish(c, f) for c, f in zip(configs, fins_main)]
 
     def count(self, config: ScanConfig) -> int:
         return self.main.count(config) + len(self._delta_hits(config))

@@ -47,6 +47,14 @@ _SENTINELS = {
     "tw": np.int32(-1),  # packed-time: bin -1 never matches
 }
 
+# Upper bound on one fused multi-query dispatch's slot count
+# (scan_submit_many): plane bytes — and, on the XLA fallback, the
+# column gathers — scale with the SUM of member block counts, so the
+# batch must chunk rather than grow without bound. 16384 slots keeps
+# planes ~= 2 x 16k x block/8 bytes (tens of MB) while the 256-polygon
+# join still fits in 1-2 dispatches.
+FUSED_M_CAP = 16384
+
 
 class SortedKeys:
     """Host-side sorted key structure shared by the single-device and
@@ -434,10 +442,13 @@ class IndexTable(SortedKeys):
     def scan_submit_many(self, configs: list, deadline=None):
         """Fused form of :meth:`scan_submit` for MANY queries (round 5):
         groups eligible configs by kernel variant and dispatches ONE fused
-        kernel per group (`bk.block_scan_multi`) instead of one dispatch
-        per query — slot i of the fused grid scans block bids[i] with
-        query qids[i]'s params. Returns ``finish() -> [(ordinals,
-        certain), ...]`` in input order.
+        kernel per chunk (`bk.block_scan_multi`, at most FUSED_M_CAP slots
+        each) instead of one dispatch per query — slot i of the fused grid
+        scans block bids[i] with query qids[i]'s params. Returns one
+        ``finish() -> (ordinals, certain)`` PER config, in input order;
+        a chunk's planes pull once (on its first member's finish) but each
+        member decodes lazily, so callers that discard some results (kNN's
+        speculative wide windows) never pay their decode.
 
         Per-query dispatch overhead (~2 ms submit + serialized kernel
         launches) dominated many-small-query workloads: the indexed
@@ -446,14 +457,11 @@ class IndexTable(SortedKeys):
         (PIP-edge polygons, pure range scans, empty/disjoint) fall back to
         :meth:`scan_submit` per query, still dispatched before any pull.
         """
-        import jax
-
         if type(self)._device_scan_submit is not IndexTable._device_scan_submit:
             # subclass re-routes the device seam (DistributedIndexTable's
             # shard_map scans): the fused kernel would bypass it — keep
             # per-query dispatches, still pipelined
-            finishes_d = [self.scan_submit(c, deadline=deadline) for c in configs]
-            return lambda: [f() for f in finishes_d]
+            return [self.scan_submit(c, deadline=deadline) for c in configs]
 
         n_q = len(configs)
         finishes: list = [None] * n_q
@@ -483,80 +491,105 @@ class IndexTable(SortedKeys):
             key = (names, config.boxes is not None, config.windows is not None)
             groups.setdefault(key, []).append((j, config, blocks, overlap, contained))
 
-        for (names, has_boxes, has_windows), members in groups.items():
-            if len(members) == 1:
-                # one query in this variant: plain single-query dispatch,
-                # from the already-computed blocks/spans
-                j, config, blocks, overlap, contained = members[0]
-                finishes[j] = self._make_finish(
-                    self._device_scan_submit(blocks, config),
-                    config, overlap, contained, deadline,
+        for (names, has_boxes, has_windows), group_members in groups.items():
+            # bound each fused dispatch: plane bytes and (on the XLA
+            # fallback) column gathers scale with the SUM of member block
+            # counts, so an uncapped batch of broad queries could demand
+            # many GB where per-query scans peaked at one query's worth.
+            # Broad members (> cap/2 blocks — e.g. _full_or expansions)
+            # dispatch alone; the rest pack greedily in input order.
+            chunks: list[list] = []
+            cur: list = []
+            cur_blocks = 0
+            for m in group_members:
+                nb = len(m[2])
+                if nb > FUSED_M_CAP // 2:
+                    chunks.append([m])
+                    continue
+                if cur and cur_blocks + nb > FUSED_M_CAP:
+                    chunks.append(cur)
+                    cur, cur_blocks = [], 0
+                cur.append(m)
+                cur_blocks += nb
+            if cur:
+                chunks.append(cur)
+            for members in chunks:
+                self._submit_fused_chunk(
+                    members, names, has_boxes, has_windows, finishes, deadline
                 )
-                continue
-            check_deadline(deadline, "device scan dispatch")
-            q_real = len(members)
-            q_pad = bk.bucket_q(q_real)
-            boxes = np.zeros((q_pad, 8, bk.LANES), np.float32)
-            wins = np.zeros((q_pad, 8, bk.LANES), np.int32)
-            bid_parts: list[np.ndarray] = []
-            qid_parts: list[np.ndarray] = []
-            segs: list[tuple[int, int]] = []  # slot segment per member
-            pos = 0
-            for q, (j, config, blocks, _, _) in enumerate(members):
-                b, w = self._params(config)
-                boxes[q] = b
-                wins[q] = w
-                bid_parts.append(blocks.astype(np.int32))
-                qid_parts.append(np.full(len(blocks), q, np.int32))
-                segs.append((pos, pos + len(blocks)))
-                pos += len(blocks)
-            bids, n_real = bk.pad_bids(np.concatenate(bid_parts), self.n_blocks)
-            self._record_scan(names, len(bids))
-            qids = np.zeros(len(bids), np.int32)
-            qids[:n_real] = np.concatenate(qid_parts)
-            wide, inner = bk.block_scan_multi(
-                self._cols_args(names), bids, qids, boxes, wins,
-                col_names=names, has_boxes=has_boxes, has_windows=has_windows,
-                extent=self.extent,
+
+        return finishes
+
+    def _submit_fused_chunk(
+        self, members, names, has_boxes, has_windows, finishes, deadline
+    ):
+        """Dispatch one fused chunk (scan_submit_many): a single-member
+        chunk takes the plain single-query kernel; larger chunks share one
+        block_scan_multi call and decode per-member slot segments."""
+        import jax
+
+        if len(members) == 1:
+            j, config, blocks, overlap, contained = members[0]
+            finishes[j] = self._make_finish(
+                self._device_scan_submit(blocks, config),
+                config, overlap, contained, deadline,
             )
-            for plane in (wide, inner):
-                if plane is not None and hasattr(plane, "copy_to_host_async"):
-                    plane.copy_to_host_async()
+            return
+        check_deadline(deadline, "device scan dispatch")
+        q_real = len(members)
+        q_pad = bk.bucket_q(q_real)
+        boxes = np.zeros((q_pad, 8, bk.LANES), np.float32)
+        wins = np.zeros((q_pad, 8, bk.LANES), np.int32)
+        bid_parts: list[np.ndarray] = []
+        qid_parts: list[np.ndarray] = []
+        segs: list[tuple[int, int]] = []  # slot segment per member
+        pos = 0
+        for q, (j, config, blocks, _, _) in enumerate(members):
+            b, w = self._params(config)
+            boxes[q] = b
+            wins[q] = w
+            bid_parts.append(blocks.astype(np.int32))
+            qid_parts.append(np.full(len(blocks), q, np.int32))
+            segs.append((pos, pos + len(blocks)))
+            pos += len(blocks)
+        bids, n_real = bk.pad_bids(np.concatenate(bid_parts), self.n_blocks)
+        self._record_scan(names, len(bids))
+        qids = np.zeros(len(bids), np.int32)
+        qids[:n_real] = np.concatenate(qid_parts)
+        wide, inner = bk.block_scan_multi(
+            self._cols_args(names), bids, qids, boxes, wins,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=self.extent,
+        )
+        for plane in (wide, inner):
+            if plane is not None and hasattr(plane, "copy_to_host_async"):
+                plane.copy_to_host_async()
 
-            def make_group_finish(members, segs, wide, inner):
-                pulled: dict = {}
+        pulled: dict = {}
 
-                def group_pull():
-                    if "planes" not in pulled:
-                        wide_h, inner_h = jax.device_get((wide, inner))
-                        pulled["planes"] = (
-                            np.asarray(wide_h),
-                            None if inner_h is None else np.asarray(inner_h),
-                        )
-                    return pulled["planes"]
+        def group_pull():
+            if "planes" not in pulled:
+                wide_h, inner_h = jax.device_get((wide, inner))
+                pulled["planes"] = (
+                    np.asarray(wide_h),
+                    None if inner_h is None else np.asarray(inner_h),
+                )
+            return pulled["planes"]
 
-                def member_finish(k):
-                    j, config, blocks, overlap, contained = members[k]
-                    s, e = segs[k]
-                    wide_h, inner_h = group_pull()
-                    check_deadline(deadline, "bitmask decode")
-                    rows, certain = bk.decode_bits_pair(
-                        np.ascontiguousarray(wide_h[s:e]),
-                        None if inner_h is None else np.ascontiguousarray(inner_h[s:e]),
-                        blocks, e - s,
-                    )
-                    return self._post_decode(rows, certain, config, overlap, contained)
+        def member_finish(k):
+            j, config, blocks, overlap, contained = members[k]
+            s, e = segs[k]
+            wide_h, inner_h = group_pull()
+            check_deadline(deadline, "bitmask decode")
+            rows, certain = bk.decode_bits_pair(
+                np.ascontiguousarray(wide_h[s:e]),
+                None if inner_h is None else np.ascontiguousarray(inner_h[s:e]),
+                blocks, e - s,
+            )
+            return self._post_decode(rows, certain, config, overlap, contained)
 
-                return member_finish
-
-            member_finish = make_group_finish(members, segs, wide, inner)
-            for k, (j, *_rest) in enumerate(members):
-                finishes[j] = lambda k=k, f=member_finish: f(k)
-
-        def finish_all():
-            return [f() for f in finishes]
-
-        return finish_all
+        for k, (j, *_rest) in enumerate(members):
+            finishes[j] = lambda k=k, f=member_finish: f(k)
 
     # -- device hooks ----------------------------------------------------
     def _params(self, config: ScanConfig):

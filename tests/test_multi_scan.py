@@ -36,7 +36,7 @@ def rand_bbox(rng, span=25.0):
 
 
 def assert_matches(table, cfgs):
-    got = table.scan_submit_many(list(cfgs))()
+    got = [f() for f in table.scan_submit_many(list(cfgs))]
     assert len(got) == len(cfgs)
     for cfg, (rows, certain) in zip(cfgs, got):
         er, ec = table.scan(cfg)
@@ -142,6 +142,20 @@ class TestFusedScan:
             cfgs.append(idx.scan_config(f & During("dtg", lo, lo + 2 * 86400_000)))
         assert_matches(ds2.table("pts", "z3"), cfgs)
 
+    def test_chunking_cap(self, monkeypatch):
+        """With a tiny FUSED_M_CAP the batch must split into many fused
+        chunks (and broad members dispatch alone) — results unchanged."""
+        from geomesa_tpu.storage import table as tbl
+
+        monkeypatch.setattr(tbl, "FUSED_M_CAP", 8)
+        ds, _ = make_store(n=40_000, index="z2")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+        rng = np.random.default_rng(31)
+        cfgs = [idx.scan_config(rand_bbox(rng)) for _ in range(13)]
+        # a broad query: nearly the whole extent -> blocks > cap/2
+        cfgs.append(idx.scan_config(BBox("geom", -59.0, -44.0, 59.0, 44.0)))
+        assert_matches(ds.table("pts", "z2"), cfgs)
+
     def test_host_adapter_passthrough(self):
         from geomesa_tpu.storage.adapter import HostAdapter
 
@@ -154,6 +168,39 @@ class TestFusedScan:
         idx = next(i for i in hs.indexes("pts") if i.name == "z2")
         rng = np.random.default_rng(12)
         assert_matches(hs.table("pts", "z2"), [idx.scan_config(rand_bbox(rng)) for _ in range(7)])
+
+
+class TestPlannerSubmitMany:
+    def test_mixed_types_and_indexes(self):
+        """submit_many groups per (type, index) and falls back for
+        non-simple plans; results equal sequential execution."""
+        ds, t0 = make_store(n=25_000, index="z3,z2")
+        sft2 = FeatureType.from_spec("aux", "dtg:Date,*geom:Point:srid=4326")
+        sft2.user_data["geomesa.indices.enabled"] = "z2"
+        ds.create_schema(sft2)
+        rng = np.random.default_rng(21)
+        m = 8_000
+        ds.write("aux", FeatureCollection.from_columns(
+            sft2, np.arange(m),
+            {"dtg": t0 + rng.integers(0, 86400_000, m),
+             "geom": (rng.uniform(-60, 60, m), rng.uniform(-45, 45, m))},
+        ), check_ids=False)
+        queries = [
+            ("pts", "bbox(geom, -20, -20, 10, 10)"),
+            ("aux", "bbox(geom, -10, -30, 30, 0)"),
+            ("pts", "bbox(geom, 0, 0, 25, 25) AND dtg DURING 2024-01-02T00:00:00Z/2024-01-06T00:00:00Z"),
+            ("aux", "bbox(geom, -50, -40, -20, -10)"),
+            ("pts", "IN ('3', '99')"),
+            ("pts", "bbox(geom, 5, -40, 45, 5)"),
+        ]
+        plans = [ds.planner.plan(t, q) for t, q in queries]
+        batched = [f() for f in ds.planner.submit_many(plans)]
+        for (t, q), got in zip(queries, batched):
+            want = ds.query(t, q)
+            assert np.array_equal(
+                np.sort(np.asarray(want.ids)), np.sort(np.asarray(got.ids))
+            )
+        assert sum(len(b) for b in batched) > 0
 
 
 class TestMultiKernelParity:
