@@ -110,6 +110,22 @@ def test_random_filter_matches_brute_force(world, seed):
     )
 
 
+@pytest.mark.parametrize("batch", range(6))
+def test_random_filter_batches_fuse_exactly(world, batch):
+    """The fused batch path (query_many -> submit_many -> fused kernel
+    chunks) must answer random filter MIXES exactly like brute force —
+    same sweep as above, ten filters per batch so box/window scans
+    actually share fused dispatches."""
+    ds, cols = world
+    rng = np.random.default_rng(7000 + batch)
+    exprs, masks = zip(*(_random_filter(rng, cols) for _ in range(10)))
+    outs = ds.query_many("w", list(exprs))
+    for expr, mask, out in zip(exprs, masks, outs):
+        got = np.sort(np.asarray(out.ids, dtype=np.int64))
+        want = np.flatnonzero(mask)
+        assert np.array_equal(got, want), (expr, len(got), len(want))
+
+
 class TestExtentFuzz:
     """Same differential sweep over an XZ2 extent store: random rectangle
     footprints, random INTERSECTS/bbox/NOT combinations vs brute-force
