@@ -5,27 +5,31 @@ the DCN-analogue path executed for real (single machine, TCP transport).
 
 Usage: python scripts/probe_multiprocess.py  (spawns its two workers)
 
-Status note (round 5): in THIS build environment the axon TPU plugin
-hangs jax.distributed.initialize before the CPU backend comes up, so
-the live two-process run cannot complete here; on a stock JAX install
-(no tunnel plugin) it runs as written. The host-major layout logic this
-would exercise is pinned by tests/test_multihost_mesh.py, including a
-full query path over the (hosts x devices_per_host)-shaped mesh.
+Environment note (late round 5): the TPU tunnel plugin used to hang the
+workers — its sitecustomize.py (on PYTHONPATH) monkeypatches
+jax.get_backend to initialize EVERY backend, so jax.devices() blocked
+on the tunnel claim whenever another process held or wedged the TPU
+lease, even under JAX_PLATFORMS=cpu. The launcher now strips that site
+dir from the workers' PYTHONPATH and shadows sitecustomize/jax_plugins
+with empty modules; the probe then PASSES here reliably (~7 s wall,
+verified while a wedged TPU claim was in flight in another process).
+Run via the suite: tests/test_multihost_mesh.py::test_two_process_probe.
 """
 
 import os
+import shutil
 import subprocess
 import sys
-import time
+import tempfile
 
 
-def worker(pid: int):
+def worker(pid: int, port: int):
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
 
     jax.distributed.initialize(
-        coordinator_address="127.0.0.1:23417", num_processes=2, process_id=pid
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
     )
     import numpy as np
     from jax.sharding import PartitionSpec as P
@@ -70,17 +74,63 @@ def worker(pid: int):
 
 
 def main():
-    if len(sys.argv) > 1:
-        worker(int(sys.argv[1]))
+    if len(sys.argv) > 2:
+        worker(int(sys.argv[1]), int(sys.argv[2]))
         return
-    procs = [
-        subprocess.Popen([sys.executable, os.path.abspath(__file__), str(i)])
-        for i in range(2)
+    # isolate the CPU-only workers from the TPU tunnel plugin: it
+    # injects via a sitecustomize.py on PYTHONPATH that monkeypatches
+    # jax.get_backend to initialize EVERY backend — jax.devices() then
+    # blocks on the tunnel claim whenever another process holds (or
+    # wedges) the TPU lease, regardless of JAX_PLATFORMS=cpu. Strip its
+    # site dir from the workers' PYTHONPATH and shadow sitecustomize +
+    # the jax_plugins namespace with empty modules.
+    shadow = tempfile.mkdtemp(prefix="noplug_")
+    os.makedirs(os.path.join(shadow, "jax_plugins"), exist_ok=True)
+    open(os.path.join(shadow, "jax_plugins", "__init__.py"), "w").close()
+    open(os.path.join(shadow, "sitecustomize.py"), "w").close()
+    env = dict(os.environ)
+    kept = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p
     ]
-    rc = [p.wait(timeout=300) for p in procs]
-    if any(rc):
-        raise SystemExit(f"worker rcs: {rc}")
-    print("two-process distributed probe: OK", flush=True)
+    env["PYTHONPATH"] = os.pathsep.join([shadow] + kept)
+    import socket
+
+    try:
+        for attempt in range(2):
+            # fresh coordinator port per run: a fixed one collides with
+            # an earlier run's TIME_WAIT/stale workers. bind-then-close
+            # is racy (another process can grab the port before worker 0
+            # binds it), hence the one retry with a new port.
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), str(i), str(port)],
+                    env=env,
+                )
+                for i in range(2)
+            ]
+            try:
+                # shorter than the suite wrapper's 240 s cap, so OUR
+                # finally-kill reaps the workers rather than the test
+                # runner orphaning them with the launcher
+                rc = [p.wait(timeout=180) for p in procs]
+            except subprocess.TimeoutExpired:
+                rc = [1, 1]
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            if not any(rc):
+                print("two-process distributed probe: OK", flush=True)
+                return
+            if attempt == 0:
+                print(f"worker rcs: {rc}; retrying on a fresh port", flush=True)
+    finally:
+        shutil.rmtree(shadow, ignore_errors=True)
+    raise SystemExit(f"worker rcs: {rc}")
 
 
 if __name__ == "__main__":

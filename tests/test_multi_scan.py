@@ -142,6 +142,33 @@ class TestFusedScan:
             cfgs.append(idx.scan_config(f & During("dtg", lo, lo + 2 * 86400_000)))
         assert_matches(ds2.table("pts", "z3"), cfgs)
 
+    def test_xz2_extent_store(self):
+        """Fused scans on an EXTENT table: the inner plane is skipped
+        (bbox-intersects can never certify), so the multi kernel's
+        single-output variant must match per-query scans — including
+        polygon INTERSECTS configs (extent kernels ignore poly edges in
+        both paths)."""
+        rng = np.random.default_rng(41)
+        n = 20_000
+        x0 = rng.uniform(-60, 59, n)
+        y0 = rng.uniform(-45, 44, n)
+        polys = geo.PackedGeometryColumn.from_boxes(
+            x0, y0, x0 + rng.uniform(0.01, 0.8, n), y0 + rng.uniform(0.01, 0.6, n)
+        )
+        sft = FeatureType.from_spec("bld", "*geom:Polygon:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "xz2"
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write("bld", FeatureCollection.from_columns(
+            sft, np.arange(n), {"geom": polys}), check_ids=False)
+        idx = next(i for i in ds.indexes("bld") if i.name == "xz2")
+        tri = geo.from_wkt("POLYGON ((-20 -15, 25 -10, 0 30, -20 -15))")
+        cfgs = [idx.scan_config(rand_bbox(rng)) for _ in range(9)]
+        cfgs.append(idx.scan_config(Intersects("geom", tri)))
+        cfgs.extend(idx.scan_config(rand_bbox(rng)) for _ in range(4))
+        assert all(c is not None for c in cfgs)  # esp. the INTERSECTS case
+        assert_matches(ds.table("bld", "xz2"), cfgs)
+
     def test_chunking_cap(self, monkeypatch):
         """With a tiny FUSED_M_CAP the batch must split into many fused
         chunks (and broad members dispatch alone) — results unchanged."""
@@ -237,6 +264,37 @@ class TestMultiKernelParity:
         )
         assert np.array_equal(np.asarray(w_ref), np.asarray(w_got))
         assert np.array_equal(np.asarray(i_ref), np.asarray(i_got))
+
+    def test_interpret_parity_extent_skip_inner(self):
+        """Extent mode: the fused kernel emits ONE plane (skip_inner);
+        Pallas-interpret must match the vmapped XLA fallback."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(16)
+        nb = 3
+        cols3 = tuple(
+            jnp.asarray(rng.uniform(-50, 50, (nb, self.SUB, 128)).astype(np.float32))
+            for _ in range(4)
+        )
+        q = 2
+        boxes = np.zeros((bk.bucket_q(q), 8, bk.LANES), np.float32)
+        wins = np.zeros((bk.bucket_q(q), 8, bk.LANES), np.int32)
+        for k in range(q):
+            xx, yy = rng.uniform(-40, 10, 2)
+            boxes[k] = bk.pack_boxes(np.array([[xx, yy, xx + 30, yy + 25]]), None)
+            wins[k] = bk.pack_windows(None, None)
+        bids = np.array([0, 1, 2, 2, 1], np.int32)
+        qids = np.array([0, 0, 1, 0, 1], np.int32)
+        kw = dict(
+            col_names=("gxmax", "gxmin", "gymax", "gymin"),
+            has_boxes=True, has_windows=False, extent=True,
+        )
+        w_ref, i_ref = bk._xla_block_scan_multi(cols3, bids, qids, boxes, wins, **kw)
+        w_got, i_got = bk._pallas_block_scan_multi(
+            cols3, bids, qids, boxes, wins, interpret=True, **kw
+        )
+        assert i_ref is None and i_got is None
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_got))
 
     def test_slotwise_equals_single_kernel(self):
         """Each fused slot must equal the single-query kernel run with that
