@@ -47,13 +47,18 @@ _SENTINELS = {
     "tw": np.int32(-1),  # packed-time: bin -1 never matches
 }
 
-# Upper bound on one fused multi-query dispatch's slot count
-# (scan_submit_many): plane bytes — and, on the XLA fallback, the
-# column gathers — scale with the SUM of member block counts, so the
-# batch must chunk rather than grow without bound. 16384 slots keeps
-# planes ~= 2 x 16k x block/8 bytes (tens of MB) while the 256-polygon
-# join still fits in 1-2 dispatches.
-FUSED_M_CAP = 16384
+# Canonical fused-dispatch shape (scan_submit_many): every multi-member
+# chunk pads its slot list to EXACTLY FUSED_CHUNK_SLOTS and its param
+# stacks to FUSED_CHUNK_Q, so there is ONE fused kernel variant per
+# (projected columns, predicate flags) — compiled at warmup, zero
+# query-time recompiles (the same doctrine as the single-query M-bucket
+# ladder). The fixed size also bounds device memory: plane bytes — and,
+# on the XLA fallback, the column gathers — scale with the chunk's slot
+# count, not the whole batch. 2048 slots = 4.2M rows per dispatch;
+# greedy packing keeps pad waste small, and members broader than half a
+# chunk take the single-query ladder instead.
+FUSED_CHUNK_SLOTS = 2048
+FUSED_CHUNK_Q = 128
 
 
 class SortedKeys:
@@ -442,9 +447,10 @@ class IndexTable(SortedKeys):
     def scan_submit_many(self, configs: list, deadline=None):
         """Fused form of :meth:`scan_submit` for MANY queries (round 5):
         groups eligible configs by kernel variant and dispatches ONE fused
-        kernel per chunk (`bk.block_scan_multi`, at most FUSED_M_CAP slots
-        each) instead of one dispatch per query — slot i of the fused grid
-        scans block bids[i] with query qids[i]'s params. Returns one
+        kernel per chunk (`bk.block_scan_multi`, every chunk padded to the
+        canonical FUSED_CHUNK_SLOTS x FUSED_CHUNK_Q shape) instead of one
+        dispatch per query — slot i of the fused grid scans block bids[i]
+        with query qids[i]'s params. Returns one
         ``finish() -> (ordinals, certain)`` PER config, in input order;
         a chunk's planes pull once (on its first member's finish) but each
         member decodes lazily, so callers that discard some results (kNN's
@@ -492,21 +498,23 @@ class IndexTable(SortedKeys):
             groups.setdefault(key, []).append((j, config, blocks, overlap, contained))
 
         for (names, has_boxes, has_windows), group_members in groups.items():
-            # bound each fused dispatch: plane bytes and (on the XLA
-            # fallback) column gathers scale with the SUM of member block
-            # counts, so an uncapped batch of broad queries could demand
-            # many GB where per-query scans peaked at one query's worth.
-            # Broad members (> cap/2 blocks — e.g. _full_or expansions)
-            # dispatch alone; the rest pack greedily in input order.
+            # pack members into fixed-shape chunks (FUSED_CHUNK_SLOTS /
+            # FUSED_CHUNK_Q — see the constants' doctrine note). Broad
+            # members (> half a chunk, e.g. _full_or expansions) dispatch
+            # alone on the single-query bucket ladder; the rest pack
+            # greedily in input order.
             chunks: list[list] = []
             cur: list = []
             cur_blocks = 0
             for m in group_members:
                 nb = len(m[2])
-                if nb > FUSED_M_CAP // 2:
+                if nb > FUSED_CHUNK_SLOTS // 2:
                     chunks.append([m])
                     continue
-                if cur and cur_blocks + nb > FUSED_M_CAP:
+                if cur and (
+                    cur_blocks + nb > FUSED_CHUNK_SLOTS
+                    or len(cur) == FUSED_CHUNK_Q
+                ):
                     chunks.append(cur)
                     cur, cur_blocks = [], 0
                 cur.append(m)
@@ -523,23 +531,26 @@ class IndexTable(SortedKeys):
     def _submit_fused_chunk(
         self, members, names, has_boxes, has_windows, finishes, deadline
     ):
-        """Dispatch one fused chunk (scan_submit_many): a single-member
-        chunk takes the plain single-query kernel; larger chunks share one
-        block_scan_multi call and decode per-member slot segments."""
+        """Dispatch one fused chunk (scan_submit_many): single-member or
+        near-empty chunks take the plain single-query kernel (the fixed
+        2048-slot fused shape would waste most of its scan work on pads);
+        real batches share one block_scan_multi call and decode
+        per-member slot segments."""
         import jax
 
-        if len(members) == 1:
-            j, config, blocks, overlap, contained = members[0]
-            finishes[j] = self._make_finish(
-                self._device_scan_submit(blocks, config),
-                config, overlap, contained, deadline,
-            )
+        if (
+            len(members) == 1
+            or sum(len(m[2]) for m in members) < FUSED_CHUNK_SLOTS // 8
+        ):
+            for j, config, blocks, overlap, contained in members:
+                finishes[j] = self._make_finish(
+                    self._device_scan_submit(blocks, config),
+                    config, overlap, contained, deadline,
+                )
             return
         check_deadline(deadline, "device scan dispatch")
-        q_real = len(members)
-        q_pad = bk.bucket_q(q_real)
-        boxes = np.zeros((q_pad, 8, bk.LANES), np.float32)
-        wins = np.zeros((q_pad, 8, bk.LANES), np.int32)
+        boxes = np.zeros((FUSED_CHUNK_Q, 8, bk.LANES), np.float32)
+        wins = np.zeros((FUSED_CHUNK_Q, 8, bk.LANES), np.int32)
         bid_parts: list[np.ndarray] = []
         qid_parts: list[np.ndarray] = []
         segs: list[tuple[int, int]] = []  # slot segment per member
@@ -552,7 +563,9 @@ class IndexTable(SortedKeys):
             qid_parts.append(np.full(len(blocks), q, np.int32))
             segs.append((pos, pos + len(blocks)))
             pos += len(blocks)
-        bids, n_real = bk.pad_bids(np.concatenate(bid_parts), self.n_blocks)
+        bids, n_real = bk.pad_bids(
+            np.concatenate(bid_parts), self.n_blocks, bucket=FUSED_CHUNK_SLOTS
+        )
         self._record_scan(names, len(bids))
         qids = np.zeros(len(bids), np.int32)
         qids[:n_real] = np.concatenate(qid_parts)
@@ -851,8 +864,10 @@ class IndexTable(SortedKeys):
         predicate flags); this drives the shared device hook
         (``_device_scan_submit`` — so the distributed table warms its
         shard_map variants too) once per ladder bucket up to the table
-        size, for the table's natural flag combinations. Returns the
-        number of kernel calls issued."""
+        size, for the table's natural flag combinations — plus the one
+        canonical fused multi-query shape per flag combo
+        (scan_submit_many's fixed FUSED_CHUNK_SLOTS/FUSED_CHUNK_Q chunk).
+        Returns the number of kernel calls issued."""
         if self.n == 0:
             return 0
         # every ladder bucket at or below n_blocks, PLUS the bucket that
@@ -871,20 +886,43 @@ class IndexTable(SortedKeys):
         flag_combos = [(True, False), (False, False)]
         if has_windows:
             flag_combos = [(True, True), (True, False), (False, True), (False, False)]
+        def make_cfg(has_boxes: bool, has_w: bool) -> ScanConfig:
+            return ScanConfig(
+                index="warmup",
+                range_bins=np.zeros(1, np.int32),
+                range_lo=np.zeros(1, np.uint64),
+                range_hi=np.zeros(1, np.uint64),
+                boxes=np.array([[0.0, 0.0, 1e-6, 1e-6]], np.float32)
+                if has_boxes else None,
+                windows=np.array([[0, 0, 0]], np.int32) if has_w else None,
+            )
+
         calls = 0
         for m in sizes:
             blocks = np.arange(min(m, self.n_blocks), dtype=np.int64)
             for has_boxes, has_w in flag_combos:
-                cfg = ScanConfig(
-                    index="warmup",
-                    range_bins=np.zeros(1, np.int32),
-                    range_lo=np.zeros(1, np.uint64),
-                    range_hi=np.zeros(1, np.uint64),
-                    boxes=np.array([[0.0, 0.0, 1e-6, 1e-6]], np.float32)
-                    if has_boxes else None,
-                    windows=np.array([[0, 0, 0]], np.int32) if has_w else None,
+                self._device_scan_submit(blocks, make_cfg(has_boxes, has_w))()
+                calls += 1
+        # the canonical fused multi-query variant (scan_submit_many):
+        # fixed (FUSED_CHUNK_SLOTS, FUSED_CHUNK_Q) shape means ONE compile
+        # per predicate-flag combo covers every future batch
+        if type(self)._device_scan_submit is IndexTable._device_scan_submit:
+            for has_boxes, has_w in flag_combos:
+                if not (has_boxes or has_w):
+                    continue  # fused path requires a predicate
+                cfg = make_cfg(has_boxes, has_w)
+                names = self._scan_cols(cfg)
+                # half a chunk of repeated block 0 per member: enough real
+                # slots to clear the small-batch routing threshold, same
+                # compile key as any future fused dispatch
+                blk = np.zeros(FUSED_CHUNK_SLOTS // 4, np.int64)
+                fused_fins: list = [None, None]
+                self._submit_fused_chunk(
+                    [(0, cfg, blk, [], []), (1, cfg, blk, [], [])],
+                    names, has_boxes, has_w, fused_fins, None,
                 )
-                self._device_scan_submit(blocks, cfg)()
+                for f in fused_fins:
+                    f()
                 calls += 1
         return calls
 

@@ -21,6 +21,7 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import time
 
 
 def worker(pid: int, port: int):
@@ -113,10 +114,16 @@ def main():
                 for i in range(2)
             ]
             try:
-                # shorter than the suite wrapper's 240 s cap, so OUR
-                # finally-kill reaps the workers rather than the test
-                # runner orphaning them with the launcher
-                rc = [p.wait(timeout=180) for p in procs]
+                # one shared 90 s deadline per attempt (not per worker):
+                # two attempts total ~185 s, safely under the suite
+                # wrapper's 240 s cap, so OUR finally-kill reaps the
+                # workers rather than the test runner orphaning them
+                # with the launcher
+                attempt_deadline = time.monotonic() + 90
+                rc = [
+                    p.wait(timeout=max(attempt_deadline - time.monotonic(), 1))
+                    for p in procs
+                ]
             except subprocess.TimeoutExpired:
                 rc = [1, 1]
             finally:

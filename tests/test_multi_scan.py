@@ -170,11 +170,12 @@ class TestFusedScan:
         assert_matches(ds.table("bld", "xz2"), cfgs)
 
     def test_chunking_cap(self, monkeypatch):
-        """With a tiny FUSED_M_CAP the batch must split into many fused
+        """With a tiny chunk shape the batch must split into many fused
         chunks (and broad members dispatch alone) — results unchanged."""
         from geomesa_tpu.storage import table as tbl
 
-        monkeypatch.setattr(tbl, "FUSED_M_CAP", 8)
+        monkeypatch.setattr(tbl, "FUSED_CHUNK_SLOTS", 8)
+        monkeypatch.setattr(tbl, "FUSED_CHUNK_Q", 4)
         ds, _ = make_store(n=40_000, index="z2")
         idx = next(i for i in ds.indexes("pts") if i.name == "z2")
         rng = np.random.default_rng(31)

@@ -129,6 +129,13 @@ def test_warmup_compiles_all_variants():
         # spatial, spatio-temporal, attribute-only (False/False flags)
         for q in QUERIES[:3] + ["bbox(geom, 3, 3, 9, 9)"]:
             ds.query("ev", q)
+        # the fused batch path: canonical chunk shape must already be
+        # compiled (warmup's _submit_fused_chunk pass)
+        ds.query_many("ev", QUERIES[:2] + [
+            "bbox(geom, 3, 3, 9, 9)", "bbox(geom, -20, -20, -5, -5)",
+            "bbox(geom, 10, -30, 30, -10) AND dtg DURING "
+            "2024-01-02T00:00:00Z/2024-01-08T00:00:00Z",
+        ])
     finally:
         jax.config.update("jax_log_compiles", False)
         for lg in loggers:
