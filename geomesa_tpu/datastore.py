@@ -16,6 +16,7 @@ lookup is pointer-chasing, not a scan).
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Iterable, Mapping, Optional, Sequence
 
@@ -29,6 +30,38 @@ from geomesa_tpu.planning.explain import Explainer
 from geomesa_tpu.planning.planner import QueryPlanner
 from geomesa_tpu.sft import FeatureType
 from geomesa_tpu.storage.table import IndexTable
+
+
+_EXPIRY_UNITS_MS = {
+    "millisecond": 1, "second": 1000, "minute": 60_000, "hour": 3_600_000,
+    "day": 86_400_000, "week": 7 * 86_400_000,
+}
+
+
+def parse_expiry_ms(spec: str, dtg_field: str | None = None) -> int:
+    """``geomesa.feature.expiry``-style duration -> milliseconds: a
+    plain integer (ms) or ``"<n> <unit>"`` with the reference's units
+    (``"7 days"``, ``"24 hours"``, ``"30 minutes"``, ...). An attribute
+    prefix like ``"dtg(7 days)"`` is accepted only when it names the
+    store's default time attribute (pass ``dtg_field`` to enforce):
+    age-off always sweeps by that attribute, so silently honoring a
+    DIFFERENT attribute's expiry would delete the wrong rows."""
+    s = spec.strip()
+    m = re.fullmatch(r"(\w+)\(([^)]+)\)", s)
+    if m:
+        if dtg_field is not None and m.group(1) != dtg_field:
+            raise ValueError(
+                f"expiry attribute {m.group(1)!r} is not the time attribute "
+                f"{dtg_field!r}; attribute-based expiry on other attributes "
+                "is not supported"
+            )
+        s = m.group(2).strip()
+    if re.fullmatch(r"\d+", s):
+        return int(s)
+    m = re.fullmatch(r"(\d+)\s*([a-zA-Z]+?)s?", s)
+    if m and m.group(2).lower() in _EXPIRY_UNITS_MS:
+        return int(m.group(1)) * _EXPIRY_UNITS_MS[m.group(2).lower()]
+    raise ValueError(f"unparseable expiry spec: {spec!r}")
 
 
 def _slice_keys(keys, start: int):
@@ -457,13 +490,28 @@ class DataStore:
                 raise
             return n
 
-    def age_off(self, type_name: str, ttl_ms: int, now_ms: int | None = None) -> int:
+    def age_off(
+        self, type_name: str, ttl_ms: int | None = None, now_ms: int | None = None
+    ) -> int:
         """Physically remove features older than ``ttl_ms`` (reference
         AgeOffIterator compaction semantics; pair with AgeOffInterceptor
-        for query-time hiding between sweeps). Returns rows removed."""
+        for query-time hiding between sweeps). Returns rows removed.
+
+        ``ttl_ms=None`` reads the schema's ``geomesa.feature.expiry``
+        user-data key (the reference's age-off configuration key:
+        ``"7 days"``, ``"24 hours"``, ``"30 minutes"``, ``"90 seconds"``
+        or a plain millisecond count)."""
         import time as _time
 
         sft = self._schemas[type_name]
+        if ttl_ms is None:
+            spec = sft.user_data.get("geomesa.feature.expiry")
+            if spec is None:
+                raise ValueError(
+                    f"{type_name!r}: no ttl_ms given and no "
+                    "geomesa.feature.expiry user-data key on the schema"
+                )
+            ttl_ms = parse_expiry_ms(str(spec), dtg_field=sft.dtg_field)
         if sft.dtg_field is None:
             raise ValueError(f"{type_name!r} has no time attribute to age off")
         now = now_ms if now_ms is not None else int(_time.time() * 1000)

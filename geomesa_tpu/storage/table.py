@@ -538,9 +538,13 @@ class IndexTable(SortedKeys):
         per-member slot segments."""
         import jax
 
-        if (
-            len(members) == 1
-            or sum(len(m[2]) for m in members) < FUSED_CHUNK_SLOTS // 8
+        if len(members) == 1 or (
+            # near-empty AND few members: past a handful of queries the
+            # per-dispatch overhead (~2 ms each) outweighs scanning the
+            # canonical shape's pad slots (~ms), so larger chunks always
+            # fuse even when sparse
+            len(members) <= 8
+            and sum(len(m[2]) for m in members) < FUSED_CHUNK_SLOTS // 8
         ):
             for j, config, blocks, overlap, contained in members:
                 finishes[j] = self._make_finish(
