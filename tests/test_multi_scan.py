@@ -14,7 +14,7 @@ from geomesa_tpu.filter.predicates import BBox, During, Intersects
 from geomesa_tpu.scan import block_kernels as bk
 
 
-def make_store(n=60_000, seed=11, index="z3"):
+def make_store(n=60_000, seed=11, index="z3", mesh=None):
     rng = np.random.default_rng(seed)
     x = rng.uniform(-60, 60, n)
     y = rng.uniform(-45, 45, n)
@@ -22,11 +22,21 @@ def make_store(n=60_000, seed=11, index="z3"):
     t = t0 + rng.integers(0, 28 * 86400_000, n)
     sft = FeatureType.from_spec("pts", "dtg:Date,*geom:Point:srid=4326")
     sft.user_data["geomesa.indices.enabled"] = index
-    ds = DataStore()
+    ds = DataStore(mesh=mesh)
     ds.create_schema(sft)
     fc = FeatureCollection.from_columns(sft, np.arange(n), {"dtg": t, "geom": (x, y)})
     ds.write("pts", fc, check_ids=False)
     return ds, t0
+
+
+def assert_batched_equals_sequential(ds, type_name, queries):
+    batched = ds.query_many(type_name, queries)
+    for q, got in zip(queries, batched):
+        want = ds.query(type_name, q)
+        assert np.array_equal(
+            np.sort(np.asarray(want.ids)), np.sort(np.asarray(got.ids))
+        ), q
+    assert sum(len(b) for b in batched) > 0
 
 
 def rand_bbox(rng, span=25.0):
@@ -229,6 +239,23 @@ class TestPlannerSubmitMany:
                 np.sort(np.asarray(want.ids)), np.sort(np.asarray(got.ids))
             )
         assert sum(len(b) for b in batched) > 0
+
+
+class TestMeshFallback:
+    def test_query_many_on_mesh_store(self):
+        """A mesh-sharded store's table overrides the device-scan seam,
+        so scan_submit_many must fall back to per-query shard_map scans
+        — batched results still equal sequential ones."""
+        from geomesa_tpu.parallel import make_mesh
+
+        ds, _ = make_store(n=30_000, seed=51, index="z2", mesh=make_mesh(8))
+        rng = np.random.default_rng(52)
+        qs = []
+        for _ in range(12):
+            qx, qy = rng.uniform(-55, 30), rng.uniform(-40, 15)
+            w, h = rng.uniform(1, 15), rng.uniform(1, 10)
+            qs.append(f"bbox(geom, {qx}, {qy}, {qx + w}, {qy + h})")
+        assert_batched_equals_sequential(ds, "pts", qs)
 
 
 class TestMultiKernelParity:
