@@ -257,6 +257,28 @@ class TestMeshFallback:
             qs.append(f"bbox(geom, {qx}, {qy}, {qx + w}, {qy + h})")
         assert_batched_equals_sequential(ds, "pts", qs)
 
+    def test_indexed_join_on_mesh_store(self):
+        """spatial_join_indexed against a mesh-sharded point store (the
+        shard_map scan fallback) must produce exactly the host grid
+        join's pairs."""
+        from geomesa_tpu.parallel import make_mesh
+        from geomesa_tpu.sql import spatial_join, spatial_join_indexed
+
+        ds, _ = make_store(n=25_000, seed=53, index="z2", mesh=make_mesh(8))
+        rng = np.random.default_rng(54)
+        npoly = 24
+        px0 = rng.uniform(-55, 35, npoly)
+        py0 = rng.uniform(-40, 25, npoly)
+        pw = rng.uniform(1, 14, npoly)
+        ph = rng.uniform(1, 9, npoly)
+        polys = geo.PackedGeometryColumn.from_boxes(px0, py0, px0 + pw, py0 + ph)
+        gsft = FeatureType.from_spec("adm", "*geom:Polygon:srid=4326")
+        pfc = FeatureCollection.from_columns(gsft, np.arange(npoly), {"geom": polys})
+        li, ri = spatial_join_indexed(ds, "pts", pfc, "contains")
+        hl, hr = spatial_join(pfc, ds.features("pts"), "contains")
+        assert set(zip(li.tolist(), ri.tolist())) == set(zip(hl.tolist(), hr.tolist()))
+        assert len(li) > 0
+
 
 class TestMultiKernelParity:
     """Pallas-interpret vs XLA parity for the fused kernel itself."""
