@@ -26,8 +26,16 @@ while true; do
   sleep 780
 done
 
-# full five-config driver-grade run (no overrides -> updates last-good)
-timeout 7200 python bench.py > /root/repo/bench_r5_refresh.log 2> /root/repo/bench_r5_refresh.err
+# full five-config driver-grade run (no overrides -> updates last-good);
+# needs ~75 min — if recovery came too late, leave the device for the
+# driver's own end-of-round run instead of colliding with it
+now=$(date +%s)
+if [ $((deadline_epoch - now)) -lt 5400 ]; then
+  echo "recovered too late for a full bench ($(date)); leaving TPU idle" >> "$LOG"
+  exit 0
+fi
+timeout $((deadline_epoch - now)) python bench.py \
+  > /root/repo/bench_r5_refresh.log 2> /root/repo/bench_r5_refresh.err
 echo "full bench rc=$? at $(date)" >> "$LOG"
 
 now=$(date +%s)
