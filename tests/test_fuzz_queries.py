@@ -126,6 +126,22 @@ def test_random_filter_batches_fuse_exactly(world, batch):
         assert np.array_equal(got, want), (expr, len(got), len(want))
 
 
+def test_fused_batch_stress_sweep(world):
+    """100 further batches in one test (seeds disjoint from the
+    parametrized sweep): ~2 s of pure fused-path stress, post-warmup, so
+    chunk-packing edge cases (member counts, sparse fallbacks, mixed
+    variant groups) see a wide input distribution every run."""
+    ds, cols = world
+    for batch in range(100):
+        rng = np.random.default_rng(50_000 + batch)
+        exprs, masks = zip(*(_random_filter(rng, cols) for _ in range(10)))
+        outs = ds.query_many("w", list(exprs))
+        for expr, mask, out in zip(exprs, masks, outs):
+            got = np.sort(np.asarray(out.ids, dtype=np.int64))
+            want = np.flatnonzero(mask)
+            assert np.array_equal(got, want), (batch, expr, len(got), len(want))
+
+
 class TestExtentFuzz:
     """Same differential sweep over an XZ2 extent store: random rectangle
     footprints, random INTERSECTS/bbox/NOT combinations vs brute-force
