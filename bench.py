@@ -530,14 +530,11 @@ def child_main():
     """One bench attempt in THIS process (device init + all configs)."""
     import threading
 
-    import jax
-
-    platform = os.environ.get("GEOMESA_BENCH_PLATFORM")
-    if platform:  # e.g. "cpu" for off-TPU verification runs
-        jax.config.update("jax_platforms", platform)
-
-    # device-claim watchdog: a wedged TPU lease makes jax.devices() block
-    # forever inside PJRT init; fail loudly instead of hanging the driver
+    # device-claim watchdog, armed BEFORE the jax import: a wedged TPU
+    # lease can block either jax.devices() (PJRT init) or — in the
+    # import-time variant observed late round 5, PERF.md §10 — the
+    # tunnel plugin's import itself; fail loudly either way instead of
+    # hanging until the supervisor's 2.5 h attempt timeout
     init_timeout = float(os.environ.get("GEOMESA_BENCH_INIT_TIMEOUT", 600))
     ready = threading.Event()
 
@@ -550,6 +547,12 @@ def child_main():
             os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
+
+    import jax
+
+    platform = os.environ.get("GEOMESA_BENCH_PLATFORM")
+    if platform:  # e.g. "cpu" for off-TPU verification runs
+        jax.config.update("jax_platforms", platform)
     log(f"devices: {jax.devices()}")
     ready.set()
     _probe_link()

@@ -110,6 +110,18 @@ def test_random_filter_matches_brute_force(world, seed):
     )
 
 
+def _check_fused_batch(ds, cols, seed, n_filters=10):
+    """One query_many batch of random filters vs brute-force truth —
+    shared by the parametrized sweep and the stress sweep."""
+    rng = np.random.default_rng(seed)
+    exprs, masks = zip(*(_random_filter(rng, cols) for _ in range(n_filters)))
+    outs = ds.query_many("w", list(exprs))
+    for expr, mask, out in zip(exprs, masks, outs):
+        got = np.sort(np.asarray(out.ids, dtype=np.int64))
+        want = np.flatnonzero(mask)
+        assert np.array_equal(got, want), (seed, expr, len(got), len(want))
+
+
 @pytest.mark.parametrize("batch", range(6))
 def test_random_filter_batches_fuse_exactly(world, batch):
     """The fused batch path (query_many -> submit_many -> fused kernel
@@ -117,13 +129,7 @@ def test_random_filter_batches_fuse_exactly(world, batch):
     same sweep as above, ten filters per batch so box/window scans
     actually share fused dispatches."""
     ds, cols = world
-    rng = np.random.default_rng(7000 + batch)
-    exprs, masks = zip(*(_random_filter(rng, cols) for _ in range(10)))
-    outs = ds.query_many("w", list(exprs))
-    for expr, mask, out in zip(exprs, masks, outs):
-        got = np.sort(np.asarray(out.ids, dtype=np.int64))
-        want = np.flatnonzero(mask)
-        assert np.array_equal(got, want), (expr, len(got), len(want))
+    _check_fused_batch(ds, cols, 7000 + batch)
 
 
 def test_fused_batch_stress_sweep(world):
@@ -133,13 +139,7 @@ def test_fused_batch_stress_sweep(world):
     variant groups) see a wide input distribution every run."""
     ds, cols = world
     for batch in range(100):
-        rng = np.random.default_rng(50_000 + batch)
-        exprs, masks = zip(*(_random_filter(rng, cols) for _ in range(10)))
-        outs = ds.query_many("w", list(exprs))
-        for expr, mask, out in zip(exprs, masks, outs):
-            got = np.sort(np.asarray(out.ids, dtype=np.int64))
-            want = np.flatnonzero(mask)
-            assert np.array_equal(got, want), (batch, expr, len(got), len(want))
+        _check_fused_batch(ds, cols, 50_000 + batch)
 
 
 class TestExtentFuzz:
