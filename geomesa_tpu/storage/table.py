@@ -462,6 +462,13 @@ class IndexTable(SortedKeys):
         was host refinement (BENCH_ALL_r05 config 4). Ineligible configs
         (PIP-edge polygons, pure range scans, empty/disjoint) fall back to
         :meth:`scan_submit` per query, still dispatched before any pull.
+
+        This is the TPU shape of the reference's server-side batch scans
+        (geomesa-utils/.../utils/AbstractBatchScan.scala threads one
+        range per pooled scanner; geomesa-hbase/.../HBaseQueryPlan.scala:
+        43-54 fans ranges over CachedThreadPool): instead of threads
+        hiding per-range latency, one kernel grid scans every (query,
+        block) slot and the host decodes per-query segments.
         """
         if type(self)._device_scan_submit is not IndexTable._device_scan_submit:
             # subclass re-routes the device seam (DistributedIndexTable's
