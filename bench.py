@@ -660,6 +660,14 @@ def main():
             log(f"bench attempt {attempt} failed (rc={last_rc}); retrying in {backoff:.0f}s")
             time.sleep(backoff)
         env = dict(os.environ, GEOMESA_BENCH_CHILD="1")
+        if attempt and last_rc == 3 and "GEOMESA_BENCH_INIT_TIMEOUT" not in os.environ:
+            # the first attempt already proved the lease wedged after the
+            # full default init window; a HEALTHY init takes <10 s
+            # (PERF.md §10), so retries fail fast and the driver gets the
+            # degraded rows in ~19 min total instead of ~35. An
+            # operator-set init timeout is honored as-is on every attempt
+            # (deployments where init legitimately takes minutes).
+            env["GEOMESA_BENCH_INIT_TIMEOUT"] = "180"
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             stdout=subprocess.PIPE, env=env, text=True,
