@@ -158,6 +158,12 @@ class DataStore:
         import threading
 
         self._write_lock = threading.RLock()
+        # damage accounting: persist.load replaces this with the real
+        # verification outcome; a store with quarantined partitions
+        # answers queries DEGRADED (per-plan warnings + metrics counter)
+        from geomesa_tpu.storage.persist import StoreHealth
+
+        self.health = StoreHealth()
         self.planner = QueryPlanner(self)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
@@ -220,6 +226,13 @@ class DataStore:
             if not indexes:
                 raise ValueError(f"no supported index in {enabled!r}")
         return indexes
+
+    @property
+    def store_health(self):
+        """This store's :class:`~geomesa_tpu.storage.persist.StoreHealth`:
+        ``status`` is ``"ok"`` or ``"degraded"`` (partitions quarantined
+        at load); ``damage`` lists the quarantine records."""
+        return self.health
 
     def get_schema(self, type_name: str) -> FeatureType:
         return self._schemas[type_name]
@@ -795,6 +808,9 @@ class DataStore:
         if self.metrics is not None:
             self.metrics.counter("geomesa.query.count")
             self.metrics.counter("geomesa.query.hits", max(hits, 0))
+            if plan.warnings:
+                # degraded-mode answer: results excluded quarantined data
+                self.metrics.counter("geomesa.query.degraded")
             self.metrics.timers["geomesa.query.plan"].update(plan.planning_s)
             self.metrics.timers["geomesa.query.scan"].update(scan_s)
         if self.audit is not None:
@@ -820,6 +836,20 @@ class DataStore:
         from geomesa_tpu.planning.errors import deadline_from
 
         return deadline_from(self.query_timeout)
+
+    def _agg_check_deadline(self, deadline, stage: str) -> None:
+        """check_deadline for the aggregation fast paths, with the same
+        timeout accounting the planner gives row scans — an overdue
+        density/count/bounds scan must bump geomesa.query.timeout, not
+        vanish with the exception."""
+        from geomesa_tpu.planning.errors import QueryTimeout
+
+        try:
+            check_deadline(deadline, stage)
+        except QueryTimeout:
+            if self.metrics is not None:
+                self.metrics.counter("geomesa.query.timeout")
+            raise
 
     def _note_vis_fallback(self, explain, what: str) -> None:
         """Signal that row-level visibility disabled an aggregation device
@@ -924,7 +954,7 @@ class DataStore:
                 deadline = self._agg_deadline()
                 t0 = time.perf_counter()
                 grid = finish()
-                check_deadline(deadline, "density scan")
+                self._agg_check_deadline(deadline, "density scan")
                 self.record_query(plan, int(grid.sum()), time.perf_counter() - t0)
                 out.append(grid)
             else:
@@ -972,7 +1002,7 @@ class DataStore:
                     if plan.config.disjoint
                     else self.table(type_name, plan.index).count(plan.config)
                 )
-                check_deadline(deadline, "count scan")
+                self._agg_check_deadline(deadline, "count scan")
                 self.record_query(plan, n, time.perf_counter() - t0)
                 out = []
                 for _ in terms:
@@ -1020,7 +1050,7 @@ class DataStore:
                 deadline = self._agg_deadline()
                 t0 = time.perf_counter()
                 cnt, env = table.bounds_stats(plan.config)
-                check_deadline(deadline, "bounds scan")
+                self._agg_check_deadline(deadline, "bounds scan")
                 self.record_query(plan, cnt, time.perf_counter() - t0)
                 return env
         out = self.planner.execute(plan)

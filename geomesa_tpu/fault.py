@@ -1,0 +1,285 @@
+"""Fault injection and bounded retry for the persistence boundary.
+
+The reference survives region-server death and partial writes because its
+storage tier is exercised under real failures (HBase WAL replay, fs-storage
+manifest rebuilds). This in-process redesign gets the same confidence from
+*deterministic fault injection*: named fault points at every IO step of the
+persist/load path (and the streaming flush) where tests — or an operator,
+via environment variable — can inject IO errors, simulated crashes,
+partial writes, bit flips, or artificial latency.
+
+Fault kinds:
+
+- ``io_error``  — raise :class:`InjectedIOError` (an ``OSError``;
+  *transient*, eaten by :func:`with_retries`);
+- ``crash``     — raise :class:`InjectedCrash` (a ``BaseException``:
+  no retry or ``except Exception`` handler can survive it, exactly like a
+  real ``kill -9`` mid-save);
+- ``partial_write`` — truncate the file at the fault point to half its
+  bytes, then crash (a torn write);
+- ``bit_flip``  — flip one bit of the file at the fault point and
+  *continue* (silent media corruption, detected later by checksums);
+- ``latency``   — sleep ``delay_s`` and continue.
+
+Usage (tests)::
+
+    with fault.inject("persist.manifest.rename", kind="crash"):
+        persist.save(store, root)   # raises InjectedCrash at that point
+
+Usage (environment, e.g. a chaos CI job)::
+
+    GEOMESA_TPU_FAULTS="persist.partition.write:io_error:0:1"
+
+comma-separated ``point:kind[:after[:times[:delay_s]]]`` entries
+(``times`` ``-1`` = every hit; ``delay_s`` is the sleep for ``latency``
+faults; empty fields take their defaults, e.g.
+``persist.*:latency::-1:0.05``); ``point`` is an ``fnmatch`` pattern
+(``persist.*``). Retry
+tuning: ``GEOMESA_TPU_IO_RETRIES`` (attempts, default 3) and
+``GEOMESA_TPU_IO_BACKOFF_S`` (initial backoff, default 0.01, doubled per
+attempt).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+DEFAULT_RETRIES = 3
+DEFAULT_BACKOFF_S = 0.01
+
+KINDS = ("io_error", "crash", "partial_write", "bit_flip", "latency")
+
+
+class InjectedIOError(OSError):
+    """A transient injected IO failure — retryable (an OSError)."""
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at a fault point. Derives from
+    ``BaseException`` so neither :func:`with_retries` nor a blanket
+    ``except Exception`` can ride over it — the operation aborts exactly
+    where a real kill would leave it."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: fires at fault points matching ``point``."""
+
+    point: str                    # fnmatch pattern over fault-point names
+    kind: str = "io_error"
+    after: int = 0                # skip the first ``after`` matching hits
+    times: Optional[int] = 1     # fire at most this many times (None = every hit)
+    delay_s: float = 0.0          # latency kind
+    hits: int = field(default=0, repr=False)
+    fired: int = field(default=0, repr=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (one of {KINDS})")
+
+
+def _corrupt_file(path: Optional[str], kind: str) -> None:
+    """Apply on-disk damage for partial_write/bit_flip kinds; a fault
+    point without a file path degrades to the no-damage behavior."""
+    if path is None or not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    if kind == "partial_write":
+        with open(path, "rb+") as fh:
+            fh.truncate(size // 2)
+    else:  # bit_flip
+        with open(path, "rb+") as fh:
+            fh.seek(size // 2)
+            b = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([b[0] ^ 0x40]))
+
+
+class FaultInjector:
+    """Registry of armed :class:`FaultSpec`s, consulted at every
+    :func:`fault_point`. Process-global; deterministic (specs fire by hit
+    count, nothing random)."""
+
+    def __init__(self):
+        self.specs: list[FaultSpec] = []
+
+    def install(self, spec: FaultSpec) -> FaultSpec:
+        self.specs.append(spec)
+        return spec
+
+    def remove(self, spec: FaultSpec) -> None:
+        if spec in self.specs:
+            self.specs.remove(spec)
+
+    def reset(self) -> None:
+        self.specs.clear()
+
+    def load_env(self, env: Optional[dict] = None, strict: bool = True) -> list[FaultSpec]:
+        """Arm faults from ``GEOMESA_TPU_FAULTS`` (see module docstring);
+        returns the installed specs so callers can remove them.
+        ``strict=False`` (the import-time mode): a malformed entry is
+        logged and skipped instead of raised — a chaos-config typo must
+        not turn into an import failure of the whole library."""
+        raw = (env if env is not None else os.environ).get("GEOMESA_TPU_FAULTS", "")
+        out: list[FaultSpec] = []
+        for entry in filter(None, (e.strip() for e in raw.split(","))):
+            try:
+                parts = entry.split(":")
+                if len(parts) < 2:
+                    raise ValueError("need point:kind")
+
+                def _field(i: int, default, conv):
+                    return conv(parts[i]) if len(parts) > i and parts[i] else default
+
+                times = _field(3, 1, int)
+                spec = FaultSpec(
+                    point=parts[0],
+                    kind=parts[1],
+                    after=_field(2, 0, int),
+                    times=None if times < 0 else times,
+                    delay_s=_field(4, 0.0, float),
+                )
+            except ValueError as e:
+                if strict:
+                    raise ValueError(
+                        f"bad GEOMESA_TPU_FAULTS entry {entry!r}: {e}"
+                    ) from e
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring bad GEOMESA_TPU_FAULTS entry %r: %s", entry, e
+                )
+                continue
+            out.append(self.install(spec))
+        return out
+
+    def on(self, point: str, path: Optional[str] = None) -> None:
+        """Fire any armed spec matching this fault point."""
+        for spec in list(self.specs):
+            if not fnmatch.fnmatch(point, spec.point):
+                continue
+            spec.hits += 1
+            if spec.hits <= spec.after:
+                continue
+            if spec.times is not None and spec.fired >= spec.times:
+                continue
+            spec.fired += 1
+            if spec.kind == "latency":
+                time.sleep(spec.delay_s)
+            elif spec.kind == "io_error":
+                raise InjectedIOError(f"injected IO error at {point}")
+            elif spec.kind == "bit_flip":
+                _corrupt_file(path, "bit_flip")
+            elif spec.kind == "partial_write":
+                _corrupt_file(path, "partial_write")
+                raise InjectedCrash(f"injected crash (partial write) at {point}")
+            else:  # crash
+                raise InjectedCrash(f"injected crash at {point}")
+
+
+_GLOBAL = FaultInjector()
+_GLOBAL.load_env(strict=False)
+
+
+def fsync_dir(path: str) -> None:
+    """Durably record a rename in its directory — the second half of the
+    tmp+``os.replace`` discipline every durable writer here uses
+    (best-effort: not every platform/filesystem supports directory
+    fsync)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes, point: Optional[str] = None) -> None:
+    """The durable-write ritual every writer here shares: ``<path>.tmp``
+    + flush + fsync + ``os.replace`` + directory fsync — no reader ever
+    sees a torn file under the final name. ``point`` names the two
+    fault-injectable steps, both targeting the TMP file: ``<point>.write``
+    fires before any bytes land (damage kinds no-op on the not-yet-written
+    tmp), ``<point>.rename`` fires after the full write, just before the
+    replace — a damage kind there simulates corruption in flight, which
+    commits and is caught later only where a checksum covers the file."""
+    tmp = path + ".tmp"
+    if point is not None:
+        fault_point(f"{point}.write", tmp)
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    if point is not None:
+        fault_point(f"{point}.rename", tmp)
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
+
+
+def injector() -> FaultInjector:
+    """The process-global injector (env-armed at import)."""
+    return _GLOBAL
+
+
+def fault_point(name: str, path: Optional[str] = None) -> None:
+    """Mark an injectable point; no-op unless a matching fault is armed.
+    ``path``: the file the point is about to (or just did) touch — the
+    target for partial_write/bit_flip damage."""
+    if _GLOBAL.specs:
+        _GLOBAL.on(name, path)
+
+
+@contextmanager
+def inject(
+    point: str,
+    kind: str = "io_error",
+    after: int = 0,
+    times: Optional[int] = 1,
+    delay_s: float = 0.0,
+) -> Iterator[FaultSpec]:
+    """Arm one fault for the duration of a ``with`` block."""
+    spec = _GLOBAL.install(
+        FaultSpec(point=point, kind=kind, after=after, times=times, delay_s=delay_s)
+    )
+    try:
+        yield spec
+    finally:
+        _GLOBAL.remove(spec)
+
+
+def with_retries(
+    fn: Callable,
+    attempts: Optional[int] = None,
+    backoff_s: Optional[float] = None,
+    retry_on: tuple = (OSError,),
+    sleep: Callable = time.sleep,
+):
+    """Run ``fn()`` with bounded exponential-backoff retries on transient
+    IO errors (the reference's client retry policies around region-server
+    blips). :class:`InjectedCrash` is a BaseException and always
+    propagates — a crash is not a transient fault."""
+    if attempts is None:
+        attempts = int(os.environ.get("GEOMESA_TPU_IO_RETRIES", DEFAULT_RETRIES))
+    if backoff_s is None:
+        backoff_s = float(
+            os.environ.get("GEOMESA_TPU_IO_BACKOFF_S", DEFAULT_BACKOFF_S)
+        )
+    attempts = max(1, attempts)
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on:
+            if attempt == attempts - 1:
+                raise
+            sleep(backoff_s * (2 ** attempt))

@@ -83,3 +83,19 @@ class MetricsRegistry:
 
 def _prom(name: str) -> str:
     return name.replace(".", "_").replace("-", "_")
+
+
+# process-global fallback registry: components that run without a
+# configured store registry (streaming listener sweeps, quarantine events
+# during load) still record their error counters somewhere scrapeable
+_GLOBAL = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    """The process-global fallback MetricsRegistry."""
+    return _GLOBAL
+
+
+def resolve(metrics: MetricsRegistry | None) -> MetricsRegistry:
+    """The given registry, or the process-global fallback when None."""
+    return _GLOBAL if metrics is None else metrics

@@ -5,6 +5,7 @@ storage layer can enforce deadlines without importing the planner
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 
 
 class QueryGuardError(Exception):
@@ -16,15 +17,52 @@ class QueryTimeout(Exception):
     ThreadManagement.scala: scans are registered with a timeout and killed
     when overdue; here the single-controller design checks wall-clock at
     every stage boundary — before/after each device call and around host
-    refinement — and aborts the query)."""
+    refinement — and aborts the query). Carries ``elapsed_s``/``budget_s``
+    so callers and audit sinks can report how far over budget the scan
+    ran (None when the deadline was a bare monotonic cutoff)."""
+
+    def __init__(self, msg: str, elapsed_s: float | None = None,
+                 budget_s: float | None = None):
+        super().__init__(msg)
+        self.elapsed_s = elapsed_s
+        self.budget_s = budget_s
 
 
-def check_deadline(deadline: float | None, stage: str) -> None:
+@dataclass(frozen=True)
+class Deadline:
+    """A query's wall-clock budget: monotonic start + cutoff. Floats
+    (bare cutoffs) are still accepted by :func:`check_deadline` for
+    back-compat; the object form lets QueryTimeout report elapsed vs
+    budget."""
+
+    start: float    # time.monotonic() at plan/execute entry
+    budget_s: float
+    cutoff: float   # start + budget_s
+
+    def remaining(self) -> float:
+        return self.cutoff - time.monotonic()
+
+
+def check_deadline(deadline: "Deadline | float | None", stage: str) -> None:
     """Raise QueryTimeout when a monotonic deadline has passed."""
-    if deadline is not None and time.monotonic() > deadline:
+    if deadline is None:
+        return
+    now = time.monotonic()
+    if isinstance(deadline, Deadline):
+        if now > deadline.cutoff:
+            elapsed = now - deadline.start
+            raise QueryTimeout(
+                f"query deadline exceeded during {stage} "
+                f"(elapsed {elapsed:.3f}s > budget {deadline.budget_s:.3f}s)",
+                elapsed_s=elapsed, budget_s=deadline.budget_s,
+            )
+    elif now > deadline:
         raise QueryTimeout(f"query deadline exceeded during {stage}")
 
 
-def deadline_from(timeout: float | None) -> float | None:
-    """Monotonic cutoff for a timeout in seconds, or None."""
-    return None if timeout is None else time.monotonic() + timeout
+def deadline_from(timeout: float | None) -> Deadline | None:
+    """A Deadline for a timeout in seconds, or None."""
+    if timeout is None:
+        return None
+    now = time.monotonic()
+    return Deadline(start=now, budget_s=timeout, cutoff=now + timeout)

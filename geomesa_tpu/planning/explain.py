@@ -18,11 +18,23 @@ class Explainer:
 
     def __init__(self):
         self._lines: list[str] = []
+        self._warnings: list[str] = []
         self._depth = 0
 
     def __call__(self, msg: str) -> "Explainer":
         self._lines.append("  " * self._depth + str(msg))
         return self
+
+    def warn(self, msg: str) -> "Explainer":
+        """Record a query warning (degraded-mode results, disabled fast
+        paths): shows in the trace AND collects separately so callers can
+        surface warnings without parsing the trail."""
+        self._warnings.append(str(msg))
+        return self(f"WARNING: {msg}")
+
+    @property
+    def warnings(self) -> list[str]:
+        return list(self._warnings)
 
     @contextmanager
     def span(self, msg: str):
@@ -49,6 +61,9 @@ class ExplainNull(Explainer):
     """No-op explainer for the hot path."""
 
     def __call__(self, msg: str) -> "Explainer":
+        return self
+
+    def warn(self, msg: str) -> "Explainer":
         return self
 
     @contextmanager

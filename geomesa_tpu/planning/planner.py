@@ -57,6 +57,9 @@ class QueryPlan:
     # multi-index union plan (reference FilterSplitter OR options): each
     # sub-plan scans one DNF disjunct on its own index; results dedup-union
     union: Optional[list["QueryPlan"]] = None
+    # degraded-mode notices (quarantined partitions excluded from results);
+    # populated at plan time from the store's health, counted at execute
+    warnings: Optional[list] = None
 
     @property
     def strategy(self) -> str:
@@ -206,6 +209,14 @@ class QueryPlanner:
         plan = self._select(type_name, f, limit, exp)
         if guard:
             self.store.apply_guards(plan)
+        # degraded mode: a store that quarantined damaged partitions at
+        # load answers from the survivors and WARNS instead of raising
+        health = getattr(self.store, "health", None)
+        if health is not None:
+            w = health.warning_for(type_name)
+            if w is not None:
+                plan.warnings = [w]
+                exp.warn(w)
         plan.planning_s = time.perf_counter() - t0
         return plan
 
@@ -332,11 +343,23 @@ class QueryPlanner:
         hints=None,
     ) -> FeatureCollection:
         t0 = time.perf_counter()
-        out = self._execute(plan, explain, hints)
+        try:
+            out = self._execute(plan, explain, hints)
+        except QueryTimeout:
+            self._record_timeout(plan)
+            raise
         self.store.record_query(plan, len(out), time.perf_counter() - t0)
         return out
 
-    def _deadline(self, hints) -> float | None:
+    def _record_timeout(self, plan) -> None:
+        """A timed-out scan must still be recorded (reference audit writes
+        failed scans too): bump the timeout counter so overdue queries are
+        visible in metrics instead of vanishing with the exception."""
+        metrics = getattr(self.store, "metrics", None)
+        if metrics is not None:
+            metrics.counter("geomesa.query.timeout")
+
+    def _deadline(self, hints):
         """Monotonic cutoff from the hint timeout or the store default."""
         timeout = getattr(hints, "timeout", None) if hints is not None else None
         if timeout is None:
@@ -483,7 +506,11 @@ class QueryPlanner:
 
         def finish() -> FeatureCollection:
             t0 = time.perf_counter()
-            out = inner()
+            try:
+                out = inner()
+            except QueryTimeout:
+                self._record_timeout(plan)
+                raise
             self.store.record_query(plan, len(out), time.perf_counter() - t0)
             return out
 
@@ -549,7 +576,7 @@ class QueryPlanner:
             sub_hints = None
             if deadline is not None:
                 check_deadline(deadline, f"union branch [{sp.strategy}]")
-                sub_hints = QueryHints(timeout=max(deadline - time.monotonic(), 1e-9))
+                sub_hints = QueryHints(timeout=max(deadline.remaining(), 1e-9))
             with exp.span(f"Union branch [{sp.strategy}]"):
                 parts.append(
                     self._execute(sp, explain=exp, hints=sub_hints, skip_visibility=True)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Optional, Protocol, runtime_checkable
 
+from geomesa_tpu.fault import fault_point, with_retries
 from geomesa_tpu.index.api import IndexKeySpace, WriteKeys
 
 
@@ -54,20 +55,31 @@ class InProcessAdapter:
     def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
         from geomesa_tpu.storage.table import IndexTable, merged_table
 
-        kwargs: dict = {"tile": self.tile} if self.tile else {}
-        if self.mesh is not None:
-            from geomesa_tpu.parallel import DistributedIndexTable
+        # table builds are pure functions of (keyspace, keys), so a
+        # transient IO fault (OSError; fault-injectable) is safely
+        # retried. Device/runtime errors are NOT retried — an XLA
+        # failure is not known-transient and masking it would hide
+        # real bugs.
+        def attempt():
+            fault_point("adapter.create_table")
+            kwargs: dict = {"tile": self.tile} if self.tile else {}
+            if self.mesh is not None:
+                from geomesa_tpu.parallel import DistributedIndexTable
 
-            return DistributedIndexTable(keyspace, keys, self.mesh, **kwargs)
-        if (
-            isinstance(old, IndexTable)
-            and old.n == main_rows
-            and 0 < main_rows < len(keys.zs)
-        ):
-            from geomesa_tpu.datastore import _slice_keys
+                return DistributedIndexTable(keyspace, keys, self.mesh, **kwargs)
+            if (
+                isinstance(old, IndexTable)
+                and old.n == main_rows
+                and 0 < main_rows < len(keys.zs)
+            ):
+                from geomesa_tpu.datastore import _slice_keys
 
-            return merged_table(old, keys, _slice_keys(keys, main_rows), **kwargs)
-        return IndexTable(keyspace, keys, **kwargs)
+                return merged_table(
+                    old, keys, _slice_keys(keys, main_rows), **kwargs
+                )
+            return IndexTable(keyspace, keys, **kwargs)
+
+        return with_retries(attempt)
 
     def delete_table(self, table) -> None:
         pass  # device arrays free with the last reference
@@ -197,7 +209,11 @@ class HostAdapter:
         self.tile = tile
 
     def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
-        return HostTable(keyspace, keys, tile=self.tile)
+        def attempt():
+            fault_point("adapter.create_table")
+            return HostTable(keyspace, keys, tile=self.tile)
+
+        return with_retries(attempt)
 
     def delete_table(self, table) -> None:
         pass

@@ -17,6 +17,8 @@ import os
 import re
 from typing import Iterator, Optional, Protocol, runtime_checkable
 
+from geomesa_tpu.fault import atomic_write, with_retries
+
 _SAFE_KEY = re.compile(r"^[A-Za-z0-9_.~/-]+$")
 
 
@@ -85,10 +87,15 @@ class FileMetadata:
     def insert(self, key: str, value: str) -> None:
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            fh.write(str(value))
-        os.replace(tmp, path)
+
+        # same durability discipline as the persist tier (fault.
+        # atomic_write), retried on transient IO faults — a crashed
+        # insert leaves the old value, never a torn file
+        with_retries(
+            lambda: atomic_write(
+                path, str(value).encode("utf-8"), point="metadata"
+            )
+        )
 
     def remove(self, key: str) -> None:
         try:

@@ -41,7 +41,7 @@ class StreamingFeatureCache:
     """
 
     def __init__(self, sft: FeatureType, expiry_ms: Optional[int] = None,
-                 grid: tuple[int, int] = (360, 180)):
+                 grid: tuple[int, int] = (360, 180), metrics=None):
         self.sft = sft
         self.expiry_ms = expiry_ms
         self.index = BucketIndex(*grid)
@@ -49,13 +49,31 @@ class StreamingFeatureCache:
         self._ingest_ms: dict[str, int] = {}
         self._next_id = 0  # monotonic: survives deletes without colliding
         self.listeners: list[Callable] = []
+        self.metrics = metrics  # MetricsRegistry (default: global fallback)
 
     def __len__(self) -> int:
         return len(self._rows)
 
-    def _notify(self, event: str, fid: str, row) -> None:
+    def _notify(self, event: str, fid: str, row, guard: bool = False) -> None:
+        """``guard=True``: a raising listener is logged + counted instead
+        of propagating — maintenance sweeps (expire) must finish even when
+        a derived view misbehaves, or expired rows stay resident."""
         for fn in self.listeners:
-            fn(event, fid, row)
+            if not guard:
+                fn(event, fid, row)
+                continue
+            try:
+                fn(event, fid, row)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "stream listener %r raised on %s(%s); sweep continues",
+                    fn, event, fid, exc_info=True,
+                )
+                from geomesa_tpu.metrics import resolve
+
+                resolve(self.metrics).counter("geomesa.stream.listener_errors")
 
     def _bbox(self, row: Mapping) -> tuple:
         # upsert has already converted WKT strings to Geometry objects
@@ -110,7 +128,7 @@ class StreamingFeatureCache:
             row = self._rows.pop(fid)
             self._ingest_ms.pop(fid)
             self.index.remove(fid)
-            self._notify("expired", fid, row)
+            self._notify("expired", fid, row, guard=True)
         return len(stale)
 
     # -- queries ---------------------------------------------------------
@@ -156,7 +174,10 @@ class LambdaStore:
     def __init__(self, cold, type_name: str, expiry_ms: Optional[int] = None):
         self.cold = cold
         self.type_name = type_name
-        self.hot = StreamingFeatureCache(cold.get_schema(type_name), expiry_ms)
+        self.hot = StreamingFeatureCache(
+            cold.get_schema(type_name), expiry_ms,
+            metrics=getattr(cold, "metrics", None),
+        )
 
     def write(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
         return self.hot.upsert(rows, ids)
@@ -164,23 +185,40 @@ class LambdaStore:
     def persist_hot(self) -> int:
         """Flush hot state into the cold store; returns rows persisted.
 
-        Ids already persisted are *updates*: the stale cold rows are
-        removed and re-written from the hot copy (the reference
-        LambdaDataStore persists updates as its primary loop — raising on
-        them, as before round 3, both wedged the flush and silently lost
-        updates under expiry)."""
+        Ids already persisted are *updates*: the flush routes through
+        ``cold.upsert`` (validate-then-replace with rollback — the
+        reference LambdaDataStore persists updates as its primary loop)
+        under bounded retry for transient IO faults, and the hot copies
+        are dropped only AFTER the cold write commits: a failed flush
+        leaves the cold tier intact and every hot row resident for the
+        next attempt — never a corrupted cold store or a dropped cache."""
+        from geomesa_tpu import fault
+
         fc = self.hot.snapshot()
         if len(fc) == 0:
             return 0
         ids = [str(i) for i in fc.ids.tolist()]
-        existing = set(str(i) for i in self.cold.features(self.type_name).ids.tolist())
-        updated = [i for i in ids if i in existing]
-        if updated:
-            quoted = ", ".join(f"'{i}'" for i in updated)
-            self.cold.delete_features(self.type_name, f"IN ({quoted})")
-        self.cold.write(self.type_name, fc)
+
+        def attempt():
+            fault.fault_point("streaming.persist")
+            return self.cold.upsert(self.type_name, fc)
+
+        fault.with_retries(attempt)
         self.hot.delete(ids)
         return len(fc)
+
+    def checkpoint(self, root: str) -> int:
+        """Periodic persistence (the reference Lambda store's scheduled
+        persist): flush the hot tier, then write the cold store to disk
+        through the crash-safe v3 path (storage.persist.save — atomic
+        renames, checksums, per-step retry). A failure at any point
+        leaves the previous on-disk store and the hot/cold state
+        consistent. Returns rows flushed from the hot tier."""
+        from geomesa_tpu.storage import persist
+
+        n = self.persist_hot()
+        persist.save(self.cold, root)
+        return n
 
     def query(self, f: "Filter | str" = INCLUDE) -> FeatureCollection:
         hot = self.hot.query(f)

@@ -18,3 +18,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'` (ROADMAP): long randomized suites
+    # (crash matrices, fuzzers) carry the slow marker
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run"
+    )

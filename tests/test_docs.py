@@ -49,6 +49,29 @@ def test_migration_guide_apis_exist():
     assert "limit" in inspect.signature(DataStore.query).parameters
 
 
+def test_durability_doc_apis_exist():
+    """docs/durability.md stays honest the same way: every durability/
+    fault API it names is real."""
+    from geomesa_tpu import fault
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.storage import persist
+    from geomesa_tpu.streaming import LambdaStore
+
+    for name in ("save", "load", "damage_report", "StoreCorruptionError",
+                 "StoreHealth", "DamageRecord"):
+        assert hasattr(persist, name), name
+    for name in ("inject", "with_retries", "fault_point", "injector",
+                 "InjectedCrash", "InjectedIOError"):
+        assert hasattr(fault, name), name
+    assert set(fault.KINDS) == {
+        "io_error", "crash", "partial_write", "bit_flip", "latency",
+    }
+    assert isinstance(DataStore.store_health, property)
+    for m in ("persist_hot", "checkpoint"):
+        assert hasattr(LambdaStore, m), m
+    assert "on_damage" in inspect.signature(persist.load).parameters
+
+
 def test_migration_guide_dotted_names_resolve():
     """Every `process.X` / `streaming.X` / `sql.X` / `ds.X(...)` name the
     guide mentions in backticks resolves against the real modules."""

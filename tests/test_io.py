@@ -277,17 +277,20 @@ class TestPartitionedPersistence:
         persist.save(ds, root)
         tdir = os.path.join(root, "pp")
         mtimes = {f: os.path.getmtime(os.path.join(tdir, f)) for f in os.listdir(tdir)}
-        # append rows only to the LAST partition, then re-save
+        # append rows only to the LAST partition, then re-save: the v3
+        # content-addressed layout REPLACES the touched partition's file
+        # (new name, old one garbage-collected) and leaves every other
+        # file byte-identical in place
         ds2 = self._store(tmp_path, extra=300)
         import time as _time
 
         _time.sleep(0.02)
         persist.save(ds2, root)
-        changed = [
-            f for f in mtimes
-            if os.path.getmtime(os.path.join(tdir, f)) != mtimes[f]
-        ]
-        assert len(changed) == 1  # only the touched partition rewrote
+        after = {f: os.path.getmtime(os.path.join(tdir, f)) for f in os.listdir(tdir)}
+        kept = set(mtimes) & set(after)
+        assert len(set(mtimes) - kept) == 1  # one old version dropped
+        assert len(set(after) - kept) == 1   # one new version written
+        assert all(after[f] == mtimes[f] for f in kept)  # rest untouched
         back = persist.load(root)
         assert back.count("pp") == ds2.count("pp")
 

@@ -80,6 +80,46 @@ class TestStreamingCache:
         out = cache.query("name = 'b'")
         assert out.ids.tolist() == ["2"]
 
+    def test_expire_survives_raising_listener(self):
+        """One raising listener must not abort the sweep and leave
+        expired rows resident; the error is counted in metrics."""
+        from geomesa_tpu.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        sft = FeatureType.from_spec("s", SPEC)
+        cache = StreamingFeatureCache(sft, expiry_ms=1000, metrics=reg)
+        seen = []
+
+        def bad(ev, fid, row):
+            raise RuntimeError("listener boom")
+
+        import time
+
+        cache.upsert([_row(n, 0, 0) for n in "abc"], ids=["1", "2", "3"])
+        # wire the listeners after ingest: upsert's write path does not
+        # guard (see test below) — the sweep is what must survive
+        cache.listeners.append(bad)
+        cache.listeners.append(lambda ev, fid, row: seen.append((ev, fid)))
+        future = int(time.time() * 1000) + 10_000
+        assert cache.expire(now_ms=future) == 3  # sweep completed
+        assert len(cache) == 0                   # nothing left resident
+        assert reg.counters["geomesa.stream.listener_errors"] == 3
+        # the well-behaved listener still saw every expiry
+        assert [e for e in seen if e[0] == "expired"] == [
+            ("expired", "1"), ("expired", "2"), ("expired", "3")
+        ]
+
+    def test_upsert_listener_errors_still_propagate(self):
+        """Only maintenance sweeps guard: a write-path listener failure is
+        the caller's to see (unchanged contract)."""
+        sft = FeatureType.from_spec("s", SPEC)
+        cache = StreamingFeatureCache(sft)
+        cache.listeners.append(
+            lambda ev, fid, row: (_ for _ in ()).throw(RuntimeError("boom"))
+        )
+        with pytest.raises(RuntimeError):
+            cache.upsert([_row("a", 0, 0)], ids=["1"])
+
 
 class TestLambdaStore:
     def _cold(self):

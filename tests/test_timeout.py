@@ -59,3 +59,67 @@ class TestQueryTimeout:
     def test_invalid_timeout_rejected(self, ds):
         with pytest.raises(ValueError):
             ds.query("t", Q, hints=QueryHints(timeout=-1))
+
+    def test_timeout_carries_elapsed_and_budget(self, ds):
+        with pytest.raises(QueryTimeout) as ei:
+            ds.query("t", Q, hints=QueryHints(timeout=1e-9))
+        assert ei.value.budget_s == pytest.approx(1e-9)
+        assert ei.value.elapsed_s is not None
+        assert ei.value.elapsed_s > ei.value.budget_s
+        assert "budget" in str(ei.value)
+
+
+class TestTimeoutMetrics:
+    def _metered_store(self):
+        from geomesa_tpu.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        sft = FeatureType.from_spec("m", "dtg:Date,*geom:Point:srid=4326")
+        store = DataStore(metrics=reg)
+        store.create_schema(sft)
+        t0 = np.datetime64("2024-01-01", "ms").astype(np.int64)
+        n = 500
+        rng = np.random.default_rng(7)
+        store.write("m", FeatureCollection.from_columns(
+            sft, [str(i) for i in range(n)],
+            {"dtg": t0 + rng.integers(0, 86400_000 * 10, n),
+             "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+        ))
+        return store, reg
+
+    Q = "bbox(geom, -10, -10, 10, 10) AND dtg DURING 2024-01-02T00:00:00Z/2024-01-05T00:00:00Z"
+
+    def test_timed_out_scan_increments_counter(self):
+        store, reg = self._metered_store()
+        with pytest.raises(QueryTimeout):
+            store.query("m", self.Q, hints=QueryHints(timeout=1e-9))
+        assert reg.counters["geomesa.query.timeout"] == 1
+        # a timed-out query is NOT recorded as a completed one
+        assert reg.counters.get("geomesa.query.count", 0) == 0
+
+    def test_pipelined_timeout_also_counted(self):
+        store, reg = self._metered_store()
+        plans = [store.planner.plan("m", self.Q) for _ in range(2)]
+        finishes = store.planner.submit_many(
+            plans, hints=QueryHints(timeout=1e-9)
+        )
+        for fin in finishes:
+            with pytest.raises(QueryTimeout):
+                fin()
+        assert reg.counters["geomesa.query.timeout"] == 2
+
+    def test_aggregation_timeout_also_counted(self):
+        store, reg = self._metered_store()
+        store.query_timeout = 1e-9
+        try:
+            with pytest.raises(QueryTimeout):
+                store.stats_query("m", "Count()", self.Q, estimate=True)
+        finally:
+            store.query_timeout = None
+        assert reg.counters["geomesa.query.timeout"] >= 1
+
+    def test_successful_query_leaves_counter_untouched(self):
+        store, reg = self._metered_store()
+        store.query("m", self.Q, hints=QueryHints(timeout=60.0))
+        assert reg.counters.get("geomesa.query.timeout", 0) == 0
+        assert reg.counters["geomesa.query.count"] == 1
