@@ -384,6 +384,171 @@ def config3_xz2():
     return rec
 
 
+# ------------------------------------------------------- cache scenario
+
+
+def config_cache(out_path: "str | None" = None):
+    """Query/aggregation cache tier scenario (docs/caching.md): repeat-
+    query and shifted-bbox workloads on a cache-enabled store, reporting
+    hit rate and warm-cache speedup. Emits BENCH_CACHE.json next to this
+    file (or at ``out_path``). Env knobs: GEOMESA_BENCH_CACHE_N (points),
+    GEOMESA_BENCH_CACHE_QUERIES (distinct queries per workload)."""
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.planning.hints import QueryHints
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_CACHE_N", 5_000_000))
+    n_q = int(os.environ.get("GEOMESA_BENCH_CACHE_QUERIES", 24))
+    rng = np.random.default_rng(SEED + 60)
+    log(f"[cache] building {n:,} point store ...")
+    x, y = gdelt_points(n, rng)
+    sft = FeatureType.from_spec("dash", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    reg = MetricsRegistry()
+    ds = DataStore(metrics=reg, cache=True)
+    ds.create_schema(sft)
+    ds.write("dash", FeatureCollection.from_columns(
+        sft, np.arange(n), {"geom": (x, y)}), check_ids=False)
+
+    boxes = box_queries(np.random.default_rng(SEED + 61), n_q)
+    queries = [
+        f"bbox(geom, {x0:.4f}, {y0:.4f}, {x1:.4f}, {y1:.4f})"
+        for x0, y0, x1, y1 in boxes
+    ]
+    bypass = QueryHints(cache="bypass")
+
+    # -- repeat-query workload (the dashboard refresh) -------------------
+    for q in queries:  # compile kernels; no cache interaction
+        ds.query("dash", q, hints=bypass)
+    def _timed_pass(run):
+        """Two passes, per-query min: the noise floor under scheduler
+        jitter (a 3x run-to-run swing on identical scans is common on a
+        contended host; noise only ever ADDS time)."""
+        a = []
+        for q in queries:
+            s = time.perf_counter()
+            run(q)
+            a.append(time.perf_counter() - s)
+        b = []
+        for q in queries:
+            s = time.perf_counter()
+            run(q)
+            b.append(time.perf_counter() - s)
+        return np.minimum(a, b)
+
+    cold = _timed_pass(  # honest uncached latency, cache bypassed
+        lambda q: ds.query("dash", q, hints=bypass)
+    )
+    for q in queries:  # populate
+        ds.query("dash", q)
+    h0, m0 = reg.counters["geomesa.cache.hit"], reg.counters["geomesa.cache.miss"]
+    hits_total = 0
+
+    def _warm(q):
+        nonlocal hits_total
+        hits_total += len(ds.query("dash", q))
+
+    warm = _timed_pass(_warm)  # the repeat passes: served warm
+    h1, m1 = reg.counters["geomesa.cache.hit"], reg.counters["geomesa.cache.miss"]
+    hit_rate = (h1 - h0) / max((h1 - h0) + (m1 - m0), 1)
+    # speedup over the WORKLOAD (total cold / total warm): the dashboard
+    # refresh is the whole query set, and totals weight the expensive
+    # queries the cache exists for — per-query medians flip on boxes whose
+    # uncached scan is already sub-ms
+    speedup = float(np.sum(cold)) / max(float(np.sum(warm)), 1e-9)
+    repeat = {
+        "n_queries": n_q,
+        "hit_rate": round(hit_rate, 4),
+        "speedup": round(speedup, 2),
+        "uncached_total_ms": round(float(np.sum(cold)) * 1e3, 3),
+        "warm_total_ms": round(float(np.sum(warm)) * 1e3, 3),
+        "uncached_median_ms": round(float(np.median(cold)) * 1e3, 3),
+        "warm_median_ms": round(float(np.median(warm)) * 1e3, 3),
+        "warm_p99_ms": round(float(np.percentile(np.array(warm) * 1e3, 99)), 3),
+    }
+    log(f"[cache] repeat-query: hit rate {hit_rate:.2%}, speedup {speedup:.1f}x")
+
+    # -- shifted-bbox workload (the dashboard pan) -----------------------
+    # count() composes per-tile aggregates: a pan re-scans only the edge
+    # strips, the interior comes from the tile cache
+    shift_cold = []
+    for (x0, y0, x1, y1), q in zip(boxes, queries):
+        s = time.perf_counter()
+        n_plain = len(ds.query("dash", q, hints=bypass))
+        shift_cold.append(time.perf_counter() - s)
+        assert ds.count("dash", q) == n_plain  # fills tiles + exactness
+    r0 = reg.counters.get("geomesa.cache.tile.reused", 0)
+    f0 = reg.counters.get("geomesa.cache.tile.filled", 0)
+    g0 = reg.counters.get("geomesa.cache.tile.gated", 0)
+    panned = []  # pan each box by ~10% of its width
+    for x0, y0, x1, y1 in boxes:
+        dx = (x1 - x0) * 0.1
+        panned.append(
+            f"bbox(geom, {x0 + dx:.4f}, {y0:.4f}, "
+            f"{min(x1 + dx, 180.0):.4f}, {y1:.4f})"
+        )
+    for q in panned:  # compile + plan-memo warmup, same as the cold loop
+        ds.query("dash", q, hints=bypass)
+    shift_warm = []
+    for q in panned:
+        s = time.perf_counter()
+        ds.count("dash", q)
+        shift_warm.append(time.perf_counter() - s)
+    r1 = reg.counters.get("geomesa.cache.tile.reused", 0)
+    f1 = reg.counters.get("geomesa.cache.tile.filled", 0)
+    g1 = reg.counters.get("geomesa.cache.tile.gated", 0)
+    reused_frac = (r1 - r0) / max((r1 - r0) + (f1 - f0), 1)
+    shifted = {
+        "n_queries": n_q,
+        "tiles_reused_frac": round(reused_frac, 4),
+        # compositions the adaptive cost gate skipped: on backends where
+        # fragmented edge scans price near a full scan, the gate keeps
+        # the pan workload at plain-scan parity instead of composing at
+        # a loss — 0 reuse + high gated is the gate doing its job
+        "gated": g1 - g0,
+        "uncached_scan_median_ms": round(float(np.median(shift_cold)) * 1e3, 3),
+        "shifted_count_median_ms": round(float(np.median(shift_warm)) * 1e3, 3),
+        "speedup": round(
+            float(np.median(shift_cold)) / max(float(np.median(shift_warm)), 1e-9), 2
+        ),
+    }
+    log(f"[cache] shifted-bbox: {reused_frac:.2%} tiles reused, "
+        f"{shifted['speedup']}x vs plain scan")
+
+    import jax
+
+    payload = {
+        "n_points": n,
+        "platform": jax.default_backend(),
+        "repeat_query": repeat,
+        "shifted_bbox": shifted,
+        "cache_stats": ds.cache.stats(),
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_CACHE.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "cache_repeat_query_speedup",
+        "value": repeat["speedup"],
+        "unit": "x",
+        "hit_rate": repeat["hit_rate"],
+        "tiles_reused_frac": shifted["tiles_reused_frac"],
+        "n_points": n,
+        "hits_total": hits_total,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ------------------------------------------------------------- config 4
 
 
@@ -558,7 +723,7 @@ def child_main():
     _probe_link()
     runners = {
         "1": config1_z3, "2": config2_z2, "3": config3_xz2,
-        "4": config4_join, "5": config5_knn,
+        "4": config4_join, "5": config5_knn, "cache": config_cache,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

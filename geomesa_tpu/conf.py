@@ -85,6 +85,37 @@ PALLAS_MODE = SystemProperty(
     "force the kernel backend: '1' = Pallas (interpret off-TPU), '0' = XLA",
 )
 
+# -- query/aggregation cache tier (geomesa_tpu.cache; docs/caching.md) ----
+
+CACHE_MAX_BYTES = SystemProperty(
+    "geomesa.cache.result.max.bytes", 256 << 20, int,
+    "LRU byte budget for cached query results (0 disables the result cache)",
+)
+CACHE_TTL = SystemProperty(
+    "geomesa.cache.ttl", None, float,
+    "seconds a cached entry stays servable (None = until invalidated)",
+)
+CACHE_MIN_COST = SystemProperty(
+    "geomesa.cache.min.cost", 0.0, float,
+    "cost-aware admission: cache only results whose measured scan took at "
+    "least this many seconds (0 = admit everything)",
+)
+CACHE_TILE_BITS = SystemProperty(
+    "geomesa.cache.tile.bits", 6, int,
+    "tile-aggregate cache resolution: the world splits into 2^bits x "
+    "2^bits SFC-aligned tiles whose partial aggregates are memoized",
+)
+CACHE_TILE_MAX = SystemProperty(
+    "geomesa.cache.tile.max.entries", 65_536, int,
+    "max resident tile aggregates before LRU eviction (0 disables the "
+    "tile cache)",
+)
+CACHE_TILES_PER_QUERY = SystemProperty(
+    "geomesa.cache.tile.max.per.query", 1024, int,
+    "bbox queries spanning more interior tiles than this skip tile "
+    "composition (the per-tile bookkeeping would beat the scan)",
+)
+
 
 def describe() -> str:
     """One line per registered property with its current value (CLI env)."""

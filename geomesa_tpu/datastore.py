@@ -95,6 +95,7 @@ class DataStore:
         query_timeout: float | None = None,
         adapter=None,
         metadata=None,
+        cache=None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh``; when given, index
         tables shard over it and scans run as shard_map collectives
@@ -105,7 +106,13 @@ class DataStore:
         QueryHints.timeout overrides it per query). ``adapter``: a
         storage.adapter.IndexAdapter backend (default: the in-process
         HBM-resident adapter over ``mesh``/``tile``). ``metadata``: a
-        storage.metadata.Metadata catalog backend (default in-memory)."""
+        storage.metadata.Metadata catalog backend (default in-memory).
+        ``cache``: the query/aggregation cache tier (docs/caching.md) —
+        ``True`` builds a geomesa_tpu.cache.QueryCache from the conf.py
+        knobs; a geomesa_tpu.cache.CacheConfig builds one from that
+        config; a QueryCache instance is used directly (e.g. shared
+        across a reload via ``persist.load(root, cache=...)``). Default
+        None = no caching."""
         self._schemas: dict[str, FeatureType] = {}
         # features live as a list of write-batch chunks (LSM memtable
         # pattern): writes append O(batch); the concatenated view is built
@@ -165,6 +172,44 @@ class DataStore:
 
         self.health = StoreHealth()
         self.planner = QueryPlanner(self)
+        # query/aggregation cache tier (docs/caching.md)
+        self.cache = None
+        if cache is not None and cache is not False:
+            self.attach_cache(cache)
+
+    def attach_cache(self, cache) -> None:
+        """Install (or replace) the cache tier: ``True``/CacheConfig build
+        a fresh QueryCache; an existing QueryCache attaches directly.
+        Wires the adapter's generation hook so table rebuilds
+        (compactions) bump generations too. ``None`` detaches."""
+        from geomesa_tpu.cache import CacheConfig, QueryCache
+
+        if cache is True:
+            cache = QueryCache(metrics=self.metrics)
+        elif isinstance(cache, CacheConfig):
+            cache = QueryCache(cache, metrics=self.metrics)
+        self.cache = cache
+        generations = cache.generations if cache is not None else None
+        try:
+            self.adapter.generations = generations
+        except AttributeError:  # adapters without the hook still work
+            pass
+
+    def _bump_cache(self, type_name: str, fc=None) -> None:
+        """Generation bump for one committed mutation (invalidates
+        overlapping cached entries; cache.generations). Runs AFTER the
+        mutation is reader-visible, so a racing fill that read the old
+        state lands with an older tick and is dropped, never served.
+        Every mutation path (write/upsert/modify/delete/age_off — the
+        latter all route through write + the delete rewrite) lands here,
+        so this is also where the planner's scan-config memo drops:
+        scan_config clamps time bins to the index's bin_range, which
+        GROWS with writes, so a memoized decomposition can silently
+        exclude freshly-written bins (cached or not — the memo serves
+        bypass queries too)."""
+        self.planner.invalidate_config_memo()
+        if self.cache is not None:
+            self.cache.on_mutation(type_name, fc)
 
     # -- schema lifecycle (reference MetadataBackedDataStore) ------------
     def create_schema(self, sft: "FeatureType | str", spec: str | None = None) -> FeatureType:
@@ -256,6 +301,9 @@ class DataStore:
                 self._key_chunks.pop((type_name, idx.name), None)
             for key in (f"{type_name}~schema", f"{type_name}~user_data", f"{type_name}~indices"):
                 self.metadata.remove(key)
+            self.planner.invalidate_config_memo()
+            if self.cache is not None:
+                self.cache.on_schema_dropped(type_name)
 
     # -- ingest ----------------------------------------------------------
     # delta tier compaction threshold: rebuild the device table when the
@@ -352,6 +400,7 @@ class DataStore:
                 self.COMPACT_MIN_ROWS, total // 8
             ):
                 self.compact(type_name)
+            self._bump_cache(type_name, features)
         return len(features)
 
     def delete_features(self, type_name: str, f: "Filter | str") -> int:
@@ -540,9 +589,14 @@ class DataStore:
         the count."""
         # maintenance scan: the RAW filter decides what is removed — an
         # interceptor (age-off TTL, say) must not rewrite a deletion of
-        # expired rows into a contradiction
+        # expired rows into a contradiction. Bypass the result cache:
+        # admitting a scan the very next line's bump invalidates would be
+        # pure churn (and upsert's IdFilter would fingerprint whole id
+        # batches)
+        from geomesa_tpu.planning.hints import QueryHints
+
         plan = self.planner.plan(type_name, f, intercept=False)
-        out = self.planner.execute(plan)
+        out = self.planner.execute(plan, hints=QueryHints(cache="bypass"))
         if len(out) == 0:
             return out if return_removed else 0
         ordinals = self.id_lookup(type_name, out.ids)
@@ -575,6 +629,7 @@ class DataStore:
         )
         self._main_rows[type_name] = 0  # force table rebuild
         self.compact(type_name)
+        self._bump_cache(type_name, out)  # removed rows' key range
         return out if return_removed else int((~keep).sum())
 
     def _build_stats_fresh(self, type_name: str, fc: FeatureCollection):
@@ -813,6 +868,18 @@ class DataStore:
                 self.metrics.counter("geomesa.query.degraded")
             self.metrics.timers["geomesa.query.plan"].update(plan.planning_s)
             self.metrics.timers["geomesa.query.scan"].update(scan_s)
+            if self.cache is not None and plan.cache_status in (None, "miss"):
+                # an actually-scanned query: feeds the tile tier's
+                # adaptive cost gate (hits/coalesced measure the cache,
+                # not the scan being replaced)
+                self.cache.tiles.note_scan(plan.type_name, scan_s)
+            if plan.cache_status is not None:
+                # probe time attributes cache overhead separately from
+                # scan time (the scan timer above still covers the whole
+                # execute, so a hit shows scan ~= probe)
+                self.metrics.timers["geomesa.query.cache_probe"].update(
+                    plan.cache_probe_s
+                )
         if self.audit is not None:
             from geomesa_tpu.audit import AuditedEvent
 
@@ -829,6 +896,43 @@ class DataStore:
             )
 
     # -- aggregation push-down (reference iterators/ + coprocessor tier) --
+    def _tile_compose(self, type_name: str, f, explain=None):
+        """Tile-aggregate cache composition for a pure-bbox aggregation
+        (docs/caching.md): cached interior tiles + fresh edge scans, or
+        None when ineligible — the tile tier serves point schemas with no
+        row-level visibility and no interceptors (both change per-row
+        membership in ways a cached tile cannot represent), for a single
+        in-world BBox on the geometry field."""
+        cache = self.cache
+        if cache is None or not cache.tiles.enabled:
+            return None
+        from geomesa_tpu.filter.predicates import BBox
+
+        if not isinstance(f, BBox):
+            return None
+        sft = self._schemas[type_name]
+        if (
+            f.prop != sft.geom_field
+            or not sft.is_points
+            or self._vis_active(type_name)
+            or self.interceptors
+            or not (-180.0 <= f.xmin <= f.xmax <= 180.0)
+            or not (-90.0 <= f.ymin <= f.ymax <= 90.0)
+        ):
+            return None
+        if not cache.tiles.worth_composing(type_name):
+            # adaptive cost gate: measured compositions for this type are
+            # losing to the plain scan — fall back until a re-probe
+            return None
+        comp = cache.tiles.compose(self, type_name, f)
+        if comp is not None and explain is not None:
+            status = "hit" if comp.tiles_filled == 0 else "partial"
+            explain(
+                f"cache: {status} ({comp.tiles_reused}/{comp.tiles_total} "
+                f"tiles reused, probe {comp.probe_s * 1e3:.3f}ms)"
+            )
+        return comp
+
     def _agg_deadline(self):
         """Deadline for a device aggregation call from the store default
         (aggregation entry points take no hints; the device call itself is
@@ -987,6 +1091,24 @@ class DataStore:
             f = ecql.parse(f)
         terms = stat_spec.parse(spec)
         plan = self.planner.plan(type_name, f)
+        if all(t.kind == "count" for t in terms):
+            # tile-aggregate composition (exact; cached interior tiles +
+            # fresh edge scans) serves Count() regardless of `estimate`
+            t0 = time.perf_counter()
+            comp = self._tile_compose(type_name, plan.filter, explain=explain)
+            if comp is not None:
+                # mark the plan as cache-served so record_query attributes
+                # this to the cache (and does NOT feed the composition's
+                # own duration into the tile tier's plain-scan baseline)
+                plan.cache_status = "hit" if comp.tiles_filled == 0 else "partial"
+                plan.cache_probe_s = comp.probe_s
+                self.record_query(plan, comp.count, time.perf_counter() - t0)
+                out = []
+                for _ in terms:
+                    c = CountStat()
+                    c.count = comp.count
+                    out.append(c)
+                return out
         if estimate and all(t.kind == "count" for t in terms):
             fast_eligible = plan.index is not None and mask_decides_filter(
                 plan.filter, plan.config, self._schemas[type_name],
@@ -1031,6 +1153,16 @@ class DataStore:
             out = self.query(type_name, f)
             return _exact_bounds(out)
         plan = self.planner.plan(type_name, f)
+        t0 = time.perf_counter()
+        comp = self._tile_compose(type_name, plan.filter, explain=explain)
+        if comp is not None:
+            # exact envelope composed from cached tile aggregates + fresh
+            # edge rows (at least as tight as the loose device estimate);
+            # cache-served: keep it out of the plain-scan baseline EWMA
+            plan.cache_status = "hit" if comp.tiles_filled == 0 else "partial"
+            plan.cache_probe_s = comp.probe_s
+            self.record_query(plan, comp.count, time.perf_counter() - t0)
+            return comp.bounds
         bounds_eligible = (
             estimate
             and plan.index is not None
@@ -1082,13 +1214,32 @@ class DataStore:
         return bin_format.encode(lon, lat, dtg, track_col, label_col, sort=sort)
 
     def count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
-        """Exact hit count (scan + refine)."""
+        """Exact hit count (scan + refine; pure-bbox counts on a cached
+        store compose from the tile-aggregate cache, still exact)."""
         if (
             isinstance(f, Include)
             and not self._vis_active(type_name)
             and not self.interceptors  # an interceptor may hide rows
         ):
             return len(self.features(type_name))
+        if self.cache is not None:
+            from geomesa_tpu.filter import ecql
+
+            if isinstance(f, str):
+                f = ecql.parse(f)
+            plan = self.planner.plan(type_name, f)
+            t0 = time.perf_counter()
+            comp = self._tile_compose(type_name, plan.filter)
+            if comp is not None:
+                # audited + attributed like the stats/bounds composed
+                # paths (record_query's contract: aggregation fast paths
+                # are audited like row queries)
+                plan.cache_status = "hit" if comp.tiles_filled == 0 else "partial"
+                plan.cache_probe_s = comp.probe_s
+                self.record_query(plan, comp.count, time.perf_counter() - t0)
+                return comp.count
+            # reuse the plan rather than re-planning inside query()
+            return len(self.planner.execute(plan))
         return len(self.query(type_name, f))
 
     def estimate_count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:

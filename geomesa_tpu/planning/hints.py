@@ -37,6 +37,13 @@ class QueryHints:
       stage boundaries, raises QueryTimeout when exceeded (reference
       per-plan timeouts + ThreadManagement scan registration). Overrides
       the store-level ``query_timeout`` default.
+    - ``cache``: per-query result-cache control (stores configured with a
+      cache tier; docs/caching.md). ``None`` = normal probe/populate;
+      ``"bypass"`` = skip the cache entirely (probe AND populate — for
+      one-off queries that must not pollute it); ``"pin"`` = cache this
+      result regardless of the cost-admission threshold and exempt it
+      from LRU eviction (dashboards' hottest queries). Pinned entries are
+      still invalidated by mutations and TTL.
     """
 
     transforms: Optional[Sequence[str]] = None
@@ -50,8 +57,13 @@ class QueryHints:
     # CRS (reference QueryPlanner.scala:292 reprojection hints); applied
     # after refinement, before transforms. Unsupported CRSs raise.
     reproject: Optional[str] = None
+    cache: Optional[str] = None  # None | "bypass" | "pin"
 
     def validate(self) -> None:
+        if self.cache not in (None, "bypass", "pin"):
+            raise ValueError(
+                f"cache hint must be None, 'bypass' or 'pin', got {self.cache!r}"
+            )
         if self.reproject is not None:
             from geomesa_tpu.crs import normalize_crs
 

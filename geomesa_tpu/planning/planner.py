@@ -14,6 +14,7 @@ f64) -> limit.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional
 
@@ -60,6 +61,12 @@ class QueryPlan:
     # degraded-mode notices (quarantined partitions excluded from results);
     # populated at plan time from the store's health, counted at execute
     warnings: Optional[list] = None
+    # result-cache outcome for this execution ("hit"|"miss"|"coalesced"|
+    # None = cache not consulted) + time spent probing the cache, kept
+    # SEPARATE from scan time so cache regressions are attributable in
+    # explain traces and the geomesa.query.cache_probe timer
+    cache_status: Optional[str] = None
+    cache_probe_s: float = 0.0
 
     @property
     def strategy(self) -> str:
@@ -166,11 +173,47 @@ def mask_decides_filter(
     return True
 
 
+# scan-config memo bound: repeated dashboard queries re-plan constantly;
+# the z/xz range decomposition is the dominant planning cost and a PURE
+# function of (index instance, filter), so memoizing it is always safe
+_CONFIG_MEMO_MAX = 4096
+
+
 class QueryPlanner:
     """Plans and runs queries for one DataStore."""
 
     def __init__(self, store):
         self.store = store
+        # (index instance, canonical filter key) -> ScanConfig | None.
+        # Keyed by the index OBJECT, so a dropped-and-recreated schema
+        # (fresh index instances, possibly different resolution) can never
+        # serve a stale decomposition; LRU-bounded.
+        self._config_memo: "OrderedDict" = OrderedDict()
+
+    def invalidate_config_memo(self) -> None:
+        """Drop every memoized scan config. The store calls this after
+        EVERY committed mutation: scan_config is pure only between
+        mutations (bin_range clamping in z3/xz3/s2/attribute indexes
+        depends on the data), so a memo entry may not outlive a write."""
+        self._config_memo.clear()
+
+    def _scan_config(self, idx, f: Filter):
+        """``idx.scan_config(f)`` through the memo (planner half of the
+        cache tier's "probe before scan": a warm repeat query skips the
+        range decomposition entirely). Only valid between mutations —
+        see invalidate_config_memo."""
+        from geomesa_tpu.filter.predicates import canonical_key
+
+        key = (idx, canonical_key(f))
+        memo = self._config_memo
+        if key in memo:
+            memo.move_to_end(key)
+            return memo[key]
+        cfg = idx.scan_config(f)
+        memo[key] = cfg
+        while len(memo) > _CONFIG_MEMO_MAX:
+            memo.popitem(last=False)
+        return cfg
 
     # -- planning --------------------------------------------------------
     def plan(
@@ -301,7 +344,7 @@ class QueryPlanner:
         indexes = self.store.indexes(type_name)
         options: list[tuple[float, str, ScanConfig]] = []
         for idx in indexes:
-            cfg = idx.scan_config(f)
+            cfg = self._scan_config(idx, f)
             if cfg is None:
                 continue
             if cfg.disjoint:
@@ -344,11 +387,46 @@ class QueryPlanner:
     ) -> FeatureCollection:
         t0 = time.perf_counter()
         try:
-            out = self._execute(plan, explain, hints)
+            out = self._execute_or_cached(plan, explain, hints)
         except QueryTimeout:
             self._record_timeout(plan)
             raise
         self.store.record_query(plan, len(out), time.perf_counter() - t0)
+        return out
+
+    def _execute_or_cached(
+        self,
+        plan: QueryPlan,
+        explain: Explainer | None = None,
+        hints=None,
+    ) -> FeatureCollection:
+        """The result-cache tier around :meth:`_execute` (docs/caching.md):
+        probe by canonical fingerprint, single-flight the scan on a miss,
+        populate under cost-aware admission. Generation validation inside
+        the cache guarantees a served entry reflects every committed
+        mutation; the ``cache`` hint bypasses or pins per query."""
+        cache = getattr(self.store, "cache", None)
+        mode = getattr(hints, "cache", None) if hints is not None else None
+        if cache is None or not cache.result.enabled or mode == "bypass":
+            return self._execute(plan, explain, hints)
+        exp = explain or ExplainNull()
+        sft = self.store.get_schema(plan.type_name)
+        key = cache.fingerprint_plan(
+            plan, hints, sft, getattr(self.store, "auths", None)
+        )
+        key_range = cache.key_range(plan.filter, sft)
+
+        def compute():
+            s0 = time.perf_counter()
+            value = self._execute(plan, explain, hints)
+            return value, time.perf_counter() - s0
+
+        out, status, probe_s = cache.result.get_or_compute(
+            key, plan.type_name, key_range, compute, pinned=(mode == "pin")
+        )
+        plan.cache_status = status
+        plan.cache_probe_s = probe_s
+        exp(f"cache: {status} (probe {probe_s * 1e3:.3f}ms, key {key[:12]})")
         return out
 
     def _record_timeout(self, plan) -> None:

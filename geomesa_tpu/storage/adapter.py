@@ -43,6 +43,18 @@ class IndexAdapter(Protocol):
         ...
 
 
+def _bump_generations(adapter, keyspace) -> None:
+    """Generation hook for table rebuilds: a DataStore with a cache tier
+    sets ``adapter.generations`` (cache.GenerationTracker) and every
+    create_table bumps the owning type — compaction is a mutation path in
+    the invalidation contract (docs/caching.md), conservatively scoped to
+    the whole type since the adapter sees sort keys, not filters."""
+    generations = getattr(adapter, "generations", None)
+    sft = getattr(keyspace, "sft", None)
+    if generations is not None and sft is not None:
+        generations.bump(sft.name)
+
+
 class InProcessAdapter:
     """The built-in backend: HBM-resident sorted columnar tables, mesh-
     sharded when a mesh is configured. Single-chip updates take the
@@ -51,6 +63,7 @@ class InProcessAdapter:
     def __init__(self, mesh=None, tile: Optional[int] = None):
         self.mesh = mesh
         self.tile = tile
+        self.generations = None  # set by DataStore.attach_cache
 
     def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
         from geomesa_tpu.storage.table import IndexTable, merged_table
@@ -79,7 +92,9 @@ class InProcessAdapter:
                 )
             return IndexTable(keyspace, keys, **kwargs)
 
-        return with_retries(attempt)
+        table = with_retries(attempt)
+        _bump_generations(self, keyspace)
+        return table
 
     def delete_table(self, table) -> None:
         pass  # device arrays free with the last reference

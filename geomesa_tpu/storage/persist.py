@@ -481,6 +481,21 @@ def load(root: str, on_damage: str = "quarantine", **store_kwargs):
             fc = pieces[0] if len(pieces) == 1 else FeatureCollection.concat(pieces)
             store.write(name, fc, check_ids=False)
     store.health = health
+    cache = getattr(store, "cache", None)
+    if cache is not None:
+        # a reload is a mutation over EVERY loaded type — including one
+        # that loads zero rows: the on-disk state may be older than what
+        # warm entries saw (unsaved writes roll back across a crash), and
+        # the write-path bumps above only fire when rows actually loaded
+        for name in meta["types"]:
+            cache.on_mutation(name)
+    if cache is not None and health.damage:
+        # degraded-mode contract (docs/caching.md): a warm cache passed
+        # through ``load(root, cache=...)`` must not keep entries over a
+        # quarantined partition's key range — bump + eagerly drop them,
+        # don't just warn
+        for d in health.damage:
+            cache.on_quarantine(d.type_name, _partition_interval(d.file))
     fresh = sum(1 for d in health.damage if d.fresh)
     if fresh:
         from geomesa_tpu.metrics import resolve
@@ -489,6 +504,21 @@ def load(root: str, on_damage: str = "quarantine", **store_kwargs):
             "geomesa.store.quarantined", fresh
         )
     return store
+
+
+_PART_FILE = re.compile(r"^p(-?\d+)")
+
+
+def _partition_interval(fname) -> "tuple[int, int] | None":
+    """The [lo_ms, hi_ms) time interval a partition file covers, parsed
+    from its ``p<NNNN>[-sig]`` name (partition = dtg // PARTITION_MS, so
+    the cache tier's generation buckets align 1:1). None when the name is
+    unparsable — the quarantine bump then covers the whole time axis."""
+    m = _PART_FILE.match(str(fname))
+    if m is None:
+        return None
+    p = int(m.group(1))
+    return (p * PARTITION_MS, (p + 1) * PARTITION_MS)
 
 
 def _load_v3_type(root: str, name: str, sft: FeatureType, info: dict,

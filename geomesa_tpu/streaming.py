@@ -50,6 +50,31 @@ class StreamingFeatureCache:
         self._next_id = 0  # monotonic: survives deletes without colliding
         self.listeners: list[Callable] = []
         self.metrics = metrics  # MetricsRegistry (default: global fallback)
+        # generation hook (docs/caching.md): a LambdaStore over a
+        # cache-enabled cold store points these at the cold cache's
+        # GenerationTracker so hot-tier mutations invalidate overlapping
+        # cached results too. Conservative: the merge shadows cold rows by
+        # live hot ids, so a hot write can change a merged answer even
+        # before any flush — bumping here keeps every cache tier honest.
+        self.generations = None
+        self.gen_type: Optional[str] = None
+
+    def _bump_gen(self, rows: Sequence[Mapping] = ()) -> None:
+        """Bump the wired generation tracker over the mutated rows' bbox
+        union (falls back to a whole-type bump when bounds are unknown)."""
+        if self.generations is None or self.gen_type is None:
+            return
+        bounds = None
+        try:
+            boxes = [self._bbox(r) for r in rows if r is not None]
+            if boxes:
+                bounds = (
+                    min(b[0] for b in boxes), min(b[1] for b in boxes),
+                    max(b[2] for b in boxes), max(b[3] for b in boxes),
+                )
+        except Exception:
+            bounds = None
+        self.generations.bump(self.gen_type, bounds=bounds, time_range=None)
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -82,6 +107,7 @@ class StreamingFeatureCache:
     def upsert(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
         """Apply a batch of messages; returns the number applied."""
         now = int(_time.time() * 1000)
+        applied = []
         for i, row in enumerate(rows):
             if ids is not None:
                 fid = str(ids[i])
@@ -99,10 +125,14 @@ class StreamingFeatureCache:
             self._ingest_ms[fid] = now
             self.index.insert(fid, self._bbox(row))
             self._notify(event, fid, row)
+            applied.append(row)
+        if applied:
+            self._bump_gen(applied)
         return len(rows)
 
     def delete(self, ids: Sequence[str]) -> int:
         n = 0
+        removed = []
         for fid in ids:
             fid = str(fid)
             row = self._rows.pop(fid, None)
@@ -110,7 +140,10 @@ class StreamingFeatureCache:
                 self._ingest_ms.pop(fid, None)
                 self.index.remove(fid)
                 self._notify("removed", fid, row)
+                removed.append(row)
                 n += 1
+        if removed:
+            self._bump_gen(removed)
         return n
 
     def clear(self) -> None:
@@ -124,11 +157,15 @@ class StreamingFeatureCache:
         now = int(_time.time() * 1000) if now_ms is None else now_ms
         cutoff = now - self.expiry_ms
         stale = [fid for fid, t in self._ingest_ms.items() if t <= cutoff]
+        expired = []
         for fid in stale:
             row = self._rows.pop(fid)
             self._ingest_ms.pop(fid)
             self.index.remove(fid)
             self._notify("expired", fid, row, guard=True)
+            expired.append(row)
+        if expired:
+            self._bump_gen(expired)
         return len(stale)
 
     # -- queries ---------------------------------------------------------
@@ -178,6 +215,13 @@ class LambdaStore:
             cold.get_schema(type_name), expiry_ms,
             metrics=getattr(cold, "metrics", None),
         )
+        # a cache-enabled cold store: hot-tier upsert/delete/expiry bump
+        # the shared generations, so merged answers over a mutated hot
+        # tier never compose against stale cold cache entries
+        cache = getattr(cold, "cache", None)
+        if cache is not None:
+            self.hot.generations = cache.generations
+            self.hot.gen_type = type_name
 
     def write(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
         return self.hot.upsert(rows, ids)

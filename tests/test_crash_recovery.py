@@ -583,3 +583,97 @@ class TestSignature:
         persist.save(ds, tmp_path / "s")
         back = persist.load(tmp_path / "s")
         assert len(back.features("m")) == 3
+
+
+class TestCacheQuarantineInterplay:
+    """Degraded-mode x cache tier (ISSUE 2 satellite): a quarantined
+    partition INVALIDATES overlapping cached entries — a warm cache
+    carried across a reload must never serve rows from the hole."""
+
+    def test_quarantine_invalidates_overlapping_cache_entries(self, tmp_path):
+        from geomesa_tpu.cache import QueryCache
+        from geomesa_tpu.planning.hints import QueryHints
+
+        ds = _store()
+        cache = QueryCache()
+        ds.attach_cache(cache)
+        root = tmp_path / "s"
+        persist.save(ds, root)
+        # warm the cache AFTER the save: entries reflect the on-disk rows
+        q = "bbox(geom, -60, -60, 60, 60)"  # covers the whole store
+        n_full = len(ds.query("t", q))
+        assert len(cache.result) >= 1
+        # damage one durable partition -> quarantined on the next load
+        fname = sorted(os.listdir(root / "t"))[0]
+        _flip_byte(root / "t" / fname)
+        back = persist.load(root, cache=cache)
+        assert back.store_health.status == "degraded"
+        [rec] = back.store_health.damage
+        assert rec.rows_lost > 0
+        # the warm cache was INVALIDATED (eagerly dropped), not warned
+        # about: nothing overlapping the quarantined range is resident
+        assert len(cache.result) == 0
+        assert len(cache.tiles) == 0
+        # degraded queries answer from survivors only, cached and
+        # uncached paths byte-identical (no stale full-store entry)
+        got = back.query("t", q)
+        raw = back.query("t", q, hints=QueryHints(cache="bypass"))
+        assert sorted(np.asarray(got.ids).tolist()) == sorted(
+            np.asarray(raw.ids).tolist()
+        )
+        assert len(got) == n_full - rec.rows_lost
+        # counts compose from fresh tiles, never the pre-damage ones
+        assert back.count("t", q) == len(got)
+
+    def test_reload_invalidates_warm_entries_even_for_empty_types(
+        self, tmp_path
+    ):
+        """A type saved EMPTY, then written and queried (warming the
+        cache), then reloaded: the reload rolls the unsaved rows back, no
+        write-path bump fires (zero rows load), yet the warm entry must
+        NOT be served — load bumps every loaded type unconditionally."""
+        from geomesa_tpu.cache import QueryCache
+
+        sft = FeatureType.from_spec("t", SPEC)
+        ds = DataStore()
+        ds.create_schema(sft)
+        cache = QueryCache()
+        ds.attach_cache(cache)
+        root = tmp_path / "s"
+        persist.save(ds, root)  # the type is durable but EMPTY
+        ds.write("t", FeatureCollection.from_columns(
+            sft, ["u0", "u1"],
+            {"name": np.array(["a", "b"]),
+             "dtg": np.full(2, int(T0)),
+             "geom": (np.zeros(2), np.zeros(2))},
+        ))
+        q = "bbox(geom, -10, -10, 10, 10)"
+        assert len(ds.query("t", q)) == 2  # warms the cache post-save
+        back = persist.load(root, cache=cache)
+        assert len(back.query("t", q)) == 0  # rolled back, never stale
+
+    def test_quarantine_generation_bump_scopes_to_partition_bucket(
+        self, tmp_path
+    ):
+        """on_quarantine bumps the damaged partition's TIME bucket: a
+        warm entry over a disjoint time window on another type survives
+        the reload untouched."""
+        from geomesa_tpu.cache import KeyRange, QueryCache
+
+        ds = _store()
+        root = tmp_path / "s"
+        persist.save(ds, root)
+        cache = QueryCache()
+        # a synthetic warm entry for an UNRELATED type: quarantine bumps
+        # must be per-type, so this entry survives every load below
+        tick = cache.generations.tick()
+        fname = sorted(os.listdir(root / "t"))[0]
+        _flip_byte(root / "t" / fname)
+        back = persist.load(root, cache=cache)
+        assert back.store_health.status == "degraded"
+        assert not cache.generations.stale(
+            "other_type", KeyRange.everything(), tick
+        )
+        assert cache.generations.stale(
+            "t", KeyRange.everything(), tick
+        )

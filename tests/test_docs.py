@@ -131,3 +131,50 @@ def test_feature_expiry_user_data_key():
         s2 = FeatureType.from_spec("e2", "dtg:Date,*geom:Point:srid=4326")
         ds2.create_schema(s2)
         ds2.age_off("e2")
+
+
+def test_caching_doc_apis_exist():
+    """docs/caching.md stays honest the same way: every cache API,
+    knob, and metric name it documents is real."""
+    from geomesa_tpu import conf
+    from geomesa_tpu.cache import (  # noqa: F401
+        BUCKET_MS,
+        CacheConfig,
+        GenerationTracker,
+        KeyRange,
+        QueryCache,
+        ResultCache,
+        TileAggregateCache,
+        fingerprint,
+        key_range_of,
+        mutation_range,
+    )
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.filter.predicates import canonical_key  # noqa: F401
+    from geomesa_tpu.planning.hints import QueryHints
+    from geomesa_tpu.storage import persist
+
+    import inspect
+
+    assert "cache" in inspect.signature(DataStore.__init__).parameters
+    assert hasattr(DataStore, "attach_cache")
+    # persist.load forwards store kwargs (including cache=) to DataStore
+    assert any(
+        p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in inspect.signature(persist.load).parameters.values()
+    )
+    QueryHints(cache="bypass")
+    QueryHints(cache="pin")
+    for m in ("fingerprint_plan", "key_range", "on_mutation",
+              "on_schema_dropped", "on_quarantine", "stats"):
+        assert hasattr(QueryCache, m), m
+    # every conf knob the doc names resolves through the property tier
+    for prop, name in [
+        (conf.CACHE_MAX_BYTES, "geomesa.cache.result.max.bytes"),
+        (conf.CACHE_TTL, "geomesa.cache.ttl"),
+        (conf.CACHE_MIN_COST, "geomesa.cache.min.cost"),
+        (conf.CACHE_TILE_BITS, "geomesa.cache.tile.bits"),
+        (conf.CACHE_TILE_MAX, "geomesa.cache.tile.max.entries"),
+        (conf.CACHE_TILES_PER_QUERY, "geomesa.cache.tile.max.per.query"),
+    ]:
+        assert prop.name == name

@@ -202,3 +202,97 @@ def test_extent_mutation_sequences(seed, indices):
             for fid in want:
                 del model[fid]
         check()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_cached_store_matches_uncached_oracle(seed):
+    """Cache-tier invalidation fuzz (ISSUE 2 satellite): a cache-enabled
+    store and an uncached oracle receive IDENTICAL random mutation
+    sequences; every query runs twice on the cached store (the second
+    answer may come from cache) and must match the oracle row-for-row —
+    zero stale results across write/query interleavings."""
+    from geomesa_tpu.metrics import MetricsRegistry
+
+    rng = np.random.default_rng(300 + seed)
+    reg = MetricsRegistry()
+    stores = []
+    for cache in (True, False):
+        sft = FeatureType.from_spec("m", SPEC)
+        ds = DataStore(metrics=reg if cache else None, cache=cache)
+        ds.create_schema(sft)
+        stores.append(ds)
+    cached, oracle = stores
+    next_id = 0
+
+    def check_queries():
+        nonlocal rng
+        for _ in range(3):
+            x0 = float(rng.uniform(-180, 100))
+            y0 = float(rng.uniform(-90, 50))
+            w = float(rng.uniform(5, min(80.0, 180.0 - x0)))
+            t_lo = T0 + int(rng.integers(0, 40 * DAY))
+            t_hi = t_lo + int(rng.integers(DAY, 30 * DAY))
+            qs = [
+                f"bbox(geom, {x0}, {y0}, {x0 + w}, {y0 + w})",
+                (f"bbox(geom, {x0}, {y0}, {x0 + w}, {y0 + w}) AND dtg "
+                 f"DURING {np.datetime64(t_lo, 'ms')}Z/"
+                 f"{np.datetime64(t_hi, 'ms')}Z"),
+            ]
+            for q in qs:
+                want = oracle.query("m", q)
+                wi = np.argsort(np.asarray(want.ids).astype(str))
+                for _ in range(2):  # second pass may serve from cache
+                    got = cached.query("m", q)
+                    gi = np.argsort(np.asarray(got.ids).astype(str))
+                    assert np.array_equal(
+                        np.asarray(got.ids)[gi], np.asarray(want.ids)[wi]
+                    ), f"stale ids after mutation: {q}"
+                    # column BYTES too, not just membership (a stale
+                    # cached entry can differ in values under same ids)
+                    for col in ("name", "age", "dtg"):
+                        assert np.array_equal(
+                            np.asarray(got.columns[col])[gi],
+                            np.asarray(want.columns[col])[wi],
+                        ), f"stale column {col}: {q}"
+            # the tile-aggregate path: exact count vs the oracle
+            assert cached.count("m", qs[0]) == len(oracle.query("m", qs[0]))
+
+    model_ids: list = []
+    for step in range(10):
+        op = rng.choice(["write", "upsert", "modify", "delete"])
+        if op == "write" or not model_ids:
+            n = int(rng.integers(50, 300))
+            ids = [str(next_id + i) for i in range(n)]
+            next_id += n
+            sft = cached.get_schema("m")
+            fc = _batch(sft, rng, ids)
+            cached.write("m", fc)
+            oracle.write("m", fc)
+            model_ids.extend(ids)
+        elif op == "upsert":
+            k = int(rng.integers(1, min(60, len(model_ids)) + 1))
+            chosen = list(rng.choice(model_ids, k, replace=False))
+            fc = _batch(cached.get_schema("m"), rng, chosen)
+            cached.upsert("m", fc)
+            oracle.upsert("m", fc)
+        elif op == "modify":
+            name = f"n{rng.integers(0, 6)}"
+            new_age = int(rng.integers(200, 300))
+            px = float(rng.uniform(-170, 170))
+            py = float(rng.uniform(-85, 85))
+            updates = {"age": new_age, "geom": geo.Point(px, py)}
+            a = cached.modify_features("m", updates, f"name = '{name}'")
+            b = oracle.modify_features("m", updates, f"name = '{name}'")
+            assert a == b
+        else:
+            cutoff = int(rng.integers(150, 250))
+            a = cached.delete_features("m", f"age > {cutoff}")
+            b = oracle.delete_features("m", f"age > {cutoff}")
+            assert a == b
+            if a:
+                model_ids = sorted(
+                    np.asarray(cached.features("m").ids).astype(str).tolist()
+                )
+        check_queries()
+    # the fuzz exercised the cache, not an always-miss degenerate path
+    assert reg.counters["geomesa.cache.hit"] > 0
