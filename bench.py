@@ -549,6 +549,165 @@ def config_cache(out_path: "str | None" = None):
     return rec
 
 
+# ----------------------------------------------------- serving scenario
+
+
+def config_serving(out_path: "str | None" = None):
+    """Concurrent query serving scenario (docs/serving.md): QPS and
+    p50/p99 latency at 8/32/128 concurrent clients, the micro-batch
+    scheduler vs the naive per-thread ``execute()`` baseline, reporting
+    the mean fused batch size. Emits BENCH_SERVING.json next to this
+    file (or at ``out_path``). CPU-runnable. Env knobs:
+    GEOMESA_BENCH_SERVING_N (points), GEOMESA_BENCH_SERVING_CLIENTS
+    (comma list), GEOMESA_BENCH_SERVING_Q (target total queries per
+    client count)."""
+    import threading
+
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_SERVING_N", 2_000_000))
+    clients_list = [
+        int(c) for c in os.environ.get(
+            "GEOMESA_BENCH_SERVING_CLIENTS", "8,32,128"
+        ).split(",")
+    ]
+    total_q = int(os.environ.get("GEOMESA_BENCH_SERVING_Q", 384))
+    rng = np.random.default_rng(SEED + 70)
+    log(f"[serving] building {n:,} point store ...")
+    x, y = gdelt_points(n, rng)
+    sft = FeatureType.from_spec("srv", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    reg = MetricsRegistry()
+    ds = DataStore(metrics=reg)
+    ds.create_schema(sft)
+    ds.write("srv", FeatureCollection.from_columns(
+        sft, np.arange(n), {"geom": (x, y)}), check_ids=False)
+
+    # distinct city-scale boxes (small results: per-query overhead is
+    # what serving amortizes), one disjoint slice per client
+    qrng = np.random.default_rng(SEED + 71)
+    def qbox():
+        w = float(qrng.choice([0.5, 1.0, 2.0]))
+        qx = qrng.uniform(-175, 175 - w)
+        qy = qrng.uniform(-85, 85 - w / 2)
+        return f"bbox(geom, {qx:.4f}, {qy:.4f}, {qx + w:.4f}, {qy + w / 2:.4f})"
+
+    pool = [qbox() for _ in range(total_q)]
+    for q in pool[:8]:  # compile the single-query variants
+        ds.query("srv", q)
+    ds.query_many("srv", pool[:8])  # compile the fused chunk variant
+    for q in pool:  # warm the scan-config memo for BOTH runs (fairness)
+        ds.planner.plan("srv", q)
+
+    def run(clients, body):
+        """``clients`` threads, each running ``body`` over its slice;
+        returns (per-query latencies, total hits, wall seconds)."""
+        per = max(1, total_q // clients)
+        lat: list[float] = []
+        hits = [0]
+        lock = threading.Lock()
+        start = threading.Barrier(clients + 1)
+
+        def worker(qs):
+            loc, h = [], 0
+            start.wait()
+            for q in qs:
+                s = time.perf_counter()
+                h += body(q)
+                loc.append(time.perf_counter() - s)
+            with lock:
+                lat.extend(loc)
+                hits[0] += h
+
+        threads = [
+            threading.Thread(target=worker, args=(pool[i * per:(i + 1) * per],))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return np.array(lat), hits[0], time.perf_counter() - t0
+
+    rows = []
+    for clients in clients_list:
+        nl, nh, nw = run(clients, lambda q: len(ds.query("srv", q)))
+        sched = ds.serve()
+        b0 = reg.counters.get("geomesa.serving.batches", 0)
+        q0 = reg.counters.get("geomesa.serving.batched_queries", 0)
+        c0 = reg.counters.get("geomesa.serving.coalesced", 0)
+        sl, sh, sw = run(clients, lambda q: len(sched.query("srv", q)))
+        sched.close()
+        b1 = reg.counters.get("geomesa.serving.batches", 0)
+        q1 = reg.counters.get("geomesa.serving.batched_queries", 0)
+        c1 = reg.counters.get("geomesa.serving.coalesced", 0)
+        assert nh == sh, (nh, sh)  # scheduler results == naive results
+        mean_batch = (q1 - q0) / max(b1 - b0, 1)
+        row = {
+            "clients": clients,
+            "queries": len(nl),
+            "hits_total": int(nh),
+            "naive": {
+                "qps": round(len(nl) / nw, 1),
+                "p50_ms": round(float(np.percentile(nl * 1e3, 50)), 3),
+                "p99_ms": round(float(np.percentile(nl * 1e3, 99)), 3),
+            },
+            "scheduler": {
+                "qps": round(len(sl) / sw, 1),
+                "p50_ms": round(float(np.percentile(sl * 1e3, 50)), 3),
+                "p99_ms": round(float(np.percentile(sl * 1e3, 99)), 3),
+                "mean_fused_batch": round(mean_batch, 2),
+                "coalesced": c1 - c0,
+            },
+        }
+        row["speedup"] = round(
+            row["scheduler"]["qps"] / max(row["naive"]["qps"], 1e-9), 2
+        )
+        rows.append(row)
+        log(
+            f"[serving] {clients} clients: scheduler {row['scheduler']['qps']}"
+            f" qps vs naive {row['naive']['qps']} qps "
+            f"({row['speedup']}x), mean fused batch {mean_batch:.1f}"
+        )
+
+    import jax
+
+    payload = {
+        "n_points": n,
+        "platform": jax.default_backend(),
+        "rows": rows,
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    headline = min(rows, key=lambda r: abs(r["clients"] - 32))
+    rec = {
+        "metric": "serving_scheduler_qps_32_clients",
+        "value": headline["scheduler"]["qps"],
+        "unit": "queries/s",
+        "vs_baseline": headline["speedup"],
+        "naive_qps": headline["naive"]["qps"],
+        "mean_fused_batch": headline["scheduler"]["mean_fused_batch"],
+        "latency_p50_ms": headline["scheduler"]["p50_ms"],
+        "latency_p99_ms": headline["scheduler"]["p99_ms"],
+        "n_points": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ------------------------------------------------------------- config 4
 
 
@@ -724,6 +883,7 @@ def child_main():
     runners = {
         "1": config1_z3, "2": config2_z2, "3": config3_xz2,
         "4": config4_join, "5": config5_knn, "cache": config_cache,
+        "serving": config_serving,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

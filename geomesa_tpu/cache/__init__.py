@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from geomesa_tpu.cache.fingerprint import (
-    fingerprint, hints_token, schema_signature,
+    fingerprint, fingerprint_plan, hints_token, schema_signature,
 )
 from geomesa_tpu.cache.generations import (
     BUCKET_MS, GenerationTracker, KeyRange, key_range_of, mutation_range,
@@ -34,7 +34,8 @@ from geomesa_tpu.cache.tiles import (
 __all__ = [
     "CacheConfig", "QueryCache", "ResultCache", "TileAggregateCache",
     "GenerationTracker", "KeyRange", "TileComposition",
-    "fingerprint", "schema_signature", "key_range_of", "mutation_range",
+    "fingerprint", "fingerprint_plan", "schema_signature", "key_range_of",
+    "mutation_range",
     "collection_nbytes", "BUCKET_MS",
 ]
 
@@ -103,15 +104,9 @@ class QueryCache:
 
     # -- planner hooks ---------------------------------------------------
     def fingerprint_plan(self, plan, hints, sft, auths) -> str:
-        return fingerprint(
-            plan.type_name,
-            schema_signature(sft),
+        return fingerprint_plan(
+            plan, hints, sft, auths,
             self.generations.schema_gen(plan.type_name),
-            plan.strategy,
-            plan.filter,
-            plan.limit,
-            hints,
-            auths,
         )
 
     def key_range(self, f, sft) -> KeyRange:

@@ -83,3 +83,14 @@ def fingerprint(
     ))
     h.update(payload.encode())
     return h.hexdigest()
+
+
+def fingerprint_plan(plan, hints, sft, auths, schema_gen: int = 0) -> str:
+    """Assemble the canonical fingerprint for one QueryPlan — ONE
+    argument assembly shared by QueryCache.fingerprint_plan and the
+    serving tier's cache-less coalescing path (where the schema
+    generation is fixed at 0), so the two keys can never drift."""
+    return fingerprint(
+        plan.type_name, schema_signature(sft), schema_gen,
+        plan.strategy, plan.filter, plan.limit, hints, auths,
+    )

@@ -235,6 +235,38 @@ class ResultCache:
         )
         return value, "miss", probe_s
 
+    def peek(self, key: str):
+        """Counter-free lookup: the valid cached value for ``key`` or
+        None. The serving tier's admission check — a peek hit is
+        immediately re-probed (and counted) by the normal get_or_compute
+        path, so peek itself must not touch the hit/miss counters or
+        drop entries (read-only; the counted paths clean up stale
+        entries)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            if e.expires_at is not None and time.monotonic() >= e.expires_at:
+                return None
+            if self.generations.stale(e.type_name, e.key_range, e.tick):
+                return None
+            return e.value
+
+    def admit(
+        self, key: str, type_name: str, key_range: KeyRange,
+        value, cost_s: float, tick: int, pinned: bool = False,
+    ) -> None:
+        """Populate one externally-computed result (the serving tier's
+        fused scans run outside :meth:`get_or_compute`). The normal
+        admission policy applies: cost threshold, byte budget, and a
+        staleness re-check against ``tick`` (the generation tick captured
+        BEFORE the scan read store state) — a mutation landing mid-scan
+        rejects the entry. Does not touch hit/miss counters; those
+        belong to the probing paths."""
+        if not self.enabled:
+            return
+        self._admit(key, type_name, key_range, value, cost_s, tick, pinned)
+
     def probe(self, key: str):
         """Non-computing lookup (tests/tools): the value or None."""
         with self._lock:

@@ -82,6 +82,13 @@ def _slice_keys(keys, start: int):
 class DataStore:
     """In-process TPU-backed feature store."""
 
+    # serving tier (docs/serving.md): the attached QueryScheduler, or
+    # None. The CLASS-level default (alongside the instance assignment
+    # in __init__) makes `ds.scheduler` resolvable via
+    # hasattr(DataStore, ...) — the doc-honesty check in test_docs.py
+    # verifies every documented `ds.X` against the class
+    scheduler = None
+
     def __init__(
         self,
         block_full_table_scans: bool = False,
@@ -176,6 +183,30 @@ class DataStore:
         self.cache = None
         if cache is not None and cache is not False:
             self.attach_cache(cache)
+        # concurrent-serving tier (docs/serving.md): attached by serve()
+        self.scheduler = None
+
+    def serve(self, config=None):
+        """Attach (or return) the micro-batch serving tier
+        (geomesa_tpu.serving; docs/serving.md): concurrent callers
+        ``submit()`` through the returned QueryScheduler and compatible
+        index scans coalesce into fused device dispatches. ``config``:
+        None builds a ServingConfig from the conf.py knobs; a
+        ServingConfig is used directly. Idempotent while the attached
+        scheduler is open; a closed one is replaced. Thread-safe: lazy
+        attachment from concurrent request handlers must not race two
+        schedulers into existence (the loser's dispatcher thread would
+        leak and split traffic across two queues, defeating fusion)."""
+        from geomesa_tpu.serving import QueryScheduler, ServingConfig
+
+        with self._write_lock:
+            sched = self.scheduler
+            if sched is not None and not sched.closed:
+                return sched
+            if config is None or config is True:
+                config = ServingConfig.from_properties()
+            self.scheduler = QueryScheduler(self, config).start()
+            return self.scheduler
 
     def attach_cache(self, cache) -> None:
         """Install (or replace) the cache tier: ``True``/CacheConfig build
@@ -866,8 +897,14 @@ class DataStore:
             if plan.warnings:
                 # degraded-mode answer: results excluded quarantined data
                 self.metrics.counter("geomesa.query.degraded")
-            self.metrics.timers["geomesa.query.plan"].update(plan.planning_s)
-            self.metrics.timers["geomesa.query.scan"].update(scan_s)
+            self.metrics.timer_update("geomesa.query.plan", plan.planning_s)
+            self.metrics.timer_update("geomesa.query.scan", scan_s)
+            if getattr(plan, "queue_wait_s", 0.0):
+                # serving-tier attribution: time queued behind the
+                # micro-batch window, SEPARATE from scan time
+                self.metrics.timer_update(
+                    "geomesa.serving.queue_wait", plan.queue_wait_s
+                )
             if self.cache is not None and plan.cache_status in (None, "miss"):
                 # an actually-scanned query: feeds the tile tier's
                 # adaptive cost gate (hits/coalesced measure the cache,
@@ -877,8 +914,8 @@ class DataStore:
                 # probe time attributes cache overhead separately from
                 # scan time (the scan timer above still covers the whole
                 # execute, so a hit shows scan ~= probe)
-                self.metrics.timers["geomesa.query.cache_probe"].update(
-                    plan.cache_probe_s
+                self.metrics.timer_update(
+                    "geomesa.query.cache_probe", plan.cache_probe_s
                 )
         if self.audit is not None:
             from geomesa_tpu.audit import AuditedEvent

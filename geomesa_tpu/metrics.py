@@ -10,6 +10,7 @@ reference's default registry).
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -33,18 +34,35 @@ class Timer:
 
 
 class MetricsRegistry:
-    """Process-local metrics: counter / gauge / timer by dotted name."""
+    """Process-local metrics: counter / gauge / timer by dotted name.
+
+    Thread-safe: one lock covers counters, gauges and timers — a bare
+    ``defaultdict`` ``+=`` is a read-modify-write that loses increments
+    under concurrent callers, and ``snapshot()``/``render_prometheus()``
+    iterate dicts that can resize mid-update. The ``time()``
+    contextmanager stays lock-free around the timed body; only the final
+    :meth:`timer_update` takes the lock."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.counters: dict[str, int] = defaultdict(int)
         self.gauges: dict[str, float] = {}
         self.timers: dict[str, Timer] = defaultdict(Timer)
 
     def counter(self, name: str, inc: int = 1) -> None:
-        self.counters[name] += inc
+        with self._lock:
+            self.counters[name] += inc
 
     def gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = float(value)
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def timer_update(self, name: str, seconds: float) -> None:
+        """Record one timed duration (the locked half of :meth:`time`;
+        also the entry point for callers that measured the span
+        themselves, e.g. DataStore.record_query)."""
+        with self._lock:
+            self.timers[name].update(seconds)
 
     @contextmanager
     def time(self, name: str):
@@ -52,32 +70,45 @@ class MetricsRegistry:
         try:
             yield
         finally:
-            self.timers[name].update(time.perf_counter() - t0)
+            self.timer_update(name, time.perf_counter() - t0)
 
     def snapshot(self) -> dict:
-        return {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "timers": {
-                k: {"count": t.count, "mean_s": t.mean_s, "max_s": t.max_s}
-                for k, t in self.timers.items()
-            },
-        }
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timers": {
+                    k: {"count": t.count, "mean_s": t.mean_s, "max_s": t.max_s}
+                    for k, t in self.timers.items()
+                },
+            }
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition of the registry."""
+        """Prometheus text exposition of the registry. Timers emit
+        ``_seconds_count`` / ``_seconds_sum`` / ``_seconds_max`` so both
+        mean latency and the p-worst observation are scrapeable."""
+        with self._lock:
+            counters = sorted(self.counters.items())
+            gauges = sorted(self.gauges.items())
+            timers = sorted(
+                (k, t.count, t.total_s, t.max_s) for k, t in self.timers.items()
+            )
         lines = []
-        for k, v in sorted(self.counters.items()):
+        for k, v in counters:
             lines.append(f"# TYPE {_prom(k)} counter")
             lines.append(f"{_prom(k)} {v}")
-        for k, v in sorted(self.gauges.items()):
+        for k, v in gauges:
             lines.append(f"# TYPE {_prom(k)} gauge")
             lines.append(f"{_prom(k)} {v}")
-        for k, t in sorted(self.timers.items()):
+        for k, count, total_s, max_s in timers:
             base = _prom(k)
             lines.append(f"# TYPE {base}_seconds summary")
-            lines.append(f"{base}_seconds_count {t.count}")
-            lines.append(f"{base}_seconds_sum {t.total_s}")
+            lines.append(f"{base}_seconds_count {count}")
+            lines.append(f"{base}_seconds_sum {total_s}")
+            # the max is its OWN gauge family: strict OpenMetrics parsers
+            # allow only _sum/_count/quantile samples inside a summary
+            lines.append(f"# TYPE {base}_seconds_max gauge")
+            lines.append(f"{base}_seconds_max {max_s}")
         return "\n".join(lines) + "\n"
 
 

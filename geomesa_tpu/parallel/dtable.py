@@ -38,6 +38,25 @@ from geomesa_tpu.scan import block_kernels as bk
 from geomesa_tpu.storage.table import IndexTable
 
 
+def _shard_map(body, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the graduated API (jax.shard_map,
+    ``check_vma``) when present, else the pre-0.6 experimental home
+    (``check_rep``). Replication checking is off either way — the scan
+    bodies index shard-local blocks, which the checker cannot see
+    through."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 @lru_cache(maxsize=256)
 def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0):
     """jit(shard_map): per-device block-bitmask scan -> (wide, inner)
@@ -63,13 +82,9 @@ def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0):
         + ((P(),) if n_edges else ())
         + (P(axis),) * len(names)
     )
-    return jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs,
-            out_specs=P(axis) if skip else (P(axis), P(axis)),
-            check_vma=False,
-        )
-    )
+    return jax.jit(_shard_map(
+        body, mesh, in_specs, P(axis) if skip else (P(axis), P(axis))
+    ))
 
 
 @lru_cache(maxsize=256)
@@ -87,11 +102,7 @@ def _dist_pops(mesh, names, has_boxes, has_windows, extent):
         return pops[None]
 
     in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
-    return jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=P(axis), check_vma=False
-        )
-    )
+    return jax.jit(_shard_map(body, mesh, in_specs, P(axis)))
 
 
 @lru_cache(maxsize=256)
@@ -108,11 +119,7 @@ def _dist_density(mesh, names, has_boxes, has_windows, extent, width, height):
         return lax.psum(grid, axis)
 
     in_specs = (P(axis), P(), P(), P()) + (P(axis),) * len(names)
-    return jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
-        )
-    )
+    return jax.jit(_shard_map(body, mesh, in_specs, P()))
 
 
 @lru_cache(maxsize=256)
@@ -129,11 +136,7 @@ def _dist_bounds(mesh, names, has_boxes, has_windows, extent):
         return stats[None]
 
     in_specs = (P(axis), P(), P()) + (P(axis),) * len(names)
-    return jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=P(axis), check_vma=False
-        )
-    )
+    return jax.jit(_shard_map(body, mesh, in_specs, P(axis)))
 
 
 class DistributedIndexTable(IndexTable):

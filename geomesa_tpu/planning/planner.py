@@ -67,6 +67,11 @@ class QueryPlan:
     # explain traces and the geomesa.query.cache_probe timer
     cache_status: Optional[str] = None
     cache_probe_s: float = 0.0
+    # serving-tier attribution (geomesa_tpu.serving): wall-clock this plan
+    # spent queued behind the micro-batch window before its fused dispatch
+    # — kept SEPARATE from scan time so queue wait is attributable in
+    # explain traces and the geomesa.serving.queue_wait timer
+    queue_wait_s: float = 0.0
 
     @property
     def strategy(self) -> str:
@@ -183,36 +188,65 @@ class QueryPlanner:
     """Plans and runs queries for one DataStore."""
 
     def __init__(self, store):
+        import threading
+
         self.store = store
         # (index instance, canonical filter key) -> ScanConfig | None.
         # Keyed by the index OBJECT, so a dropped-and-recreated schema
         # (fresh index instances, possibly different resolution) can never
-        # serve a stale decomposition; LRU-bounded.
+        # serve a stale decomposition; LRU-bounded. The lock makes
+        # concurrent plan() calls safe (the serving tier plans in caller
+        # threads): an OrderedDict mutating under two threads corrupts.
         self._config_memo: "OrderedDict" = OrderedDict()
+        self._memo_lock = threading.Lock()
+        self._memo_epoch = 0  # bumped by every invalidation (see below)
+
+    @property
+    def mutation_epoch(self) -> int:
+        """Monotonic count of committed mutations (config-memo
+        invalidations). The serving tier scopes in-window coalescing to
+        one epoch so a query admitted after a write never shares a
+        pre-write leader's result."""
+        return self._memo_epoch
 
     def invalidate_config_memo(self) -> None:
         """Drop every memoized scan config. The store calls this after
         EVERY committed mutation: scan_config is pure only between
         mutations (bin_range clamping in z3/xz3/s2/attribute indexes
-        depends on the data), so a memo entry may not outlive a write."""
-        self._config_memo.clear()
+        depends on the data), so a memo entry may not outlive a write.
+        Bumping the epoch stops a decomposition computed BEFORE the
+        mutation (outside the lock) from being inserted after it."""
+        with self._memo_lock:
+            self._memo_epoch += 1
+            self._config_memo.clear()
 
     def _scan_config(self, idx, f: Filter):
         """``idx.scan_config(f)`` through the memo (planner half of the
         cache tier's "probe before scan": a warm repeat query skips the
         range decomposition entirely). Only valid between mutations —
-        see invalidate_config_memo."""
+        see invalidate_config_memo. The decomposition itself runs outside
+        the lock: two racing planners may both compute (benign — the
+        result is pure), but never block each other on it."""
         from geomesa_tpu.filter.predicates import canonical_key
 
         key = (idx, canonical_key(f))
-        memo = self._config_memo
-        if key in memo:
-            memo.move_to_end(key)
-            return memo[key]
+        with self._memo_lock:
+            memo = self._config_memo
+            if key in memo:
+                memo.move_to_end(key)
+                return memo[key]
+            epoch = self._memo_epoch
         cfg = idx.scan_config(f)
-        memo[key] = cfg
-        while len(memo) > _CONFIG_MEMO_MAX:
-            memo.popitem(last=False)
+        with self._memo_lock:
+            if self._memo_epoch != epoch:
+                # a mutation invalidated mid-compute: this decomposition
+                # reflects pre-write data — usable for THIS query (the
+                # inherent plan/execute race) but never memoizable
+                return cfg
+            memo = self._config_memo
+            memo[key] = cfg
+            while len(memo) > _CONFIG_MEMO_MAX:
+                memo.popitem(last=False)
         return cfg
 
     # -- planning --------------------------------------------------------
@@ -384,10 +418,14 @@ class QueryPlanner:
         plan: QueryPlan,
         explain: Explainer | None = None,
         hints=None,
+        deadline=None,
     ) -> FeatureCollection:
+        """``deadline``: an optional pre-anchored Deadline (the serving
+        tier charges queue wait against the caller's budget); default
+        starts the clock here, from the hint/store timeout."""
         t0 = time.perf_counter()
         try:
-            out = self._execute_or_cached(plan, explain, hints)
+            out = self._execute_or_cached(plan, explain, hints, deadline)
         except QueryTimeout:
             self._record_timeout(plan)
             raise
@@ -399,6 +437,7 @@ class QueryPlanner:
         plan: QueryPlan,
         explain: Explainer | None = None,
         hints=None,
+        deadline=None,
     ) -> FeatureCollection:
         """The result-cache tier around :meth:`_execute` (docs/caching.md):
         probe by canonical fingerprint, single-flight the scan on a miss,
@@ -408,7 +447,7 @@ class QueryPlanner:
         cache = getattr(self.store, "cache", None)
         mode = getattr(hints, "cache", None) if hints is not None else None
         if cache is None or not cache.result.enabled or mode == "bypass":
-            return self._execute(plan, explain, hints)
+            return self._execute(plan, explain, hints, deadline=deadline)
         exp = explain or ExplainNull()
         sft = self.store.get_schema(plan.type_name)
         key = cache.fingerprint_plan(
@@ -418,7 +457,7 @@ class QueryPlanner:
 
         def compute():
             s0 = time.perf_counter()
-            value = self._execute(plan, explain, hints)
+            value = self._execute(plan, explain, hints, deadline=deadline)
             return value, time.perf_counter() - s0
 
         out, status, probe_s = cache.result.get_or_compute(
@@ -450,12 +489,14 @@ class QueryPlanner:
         explain: Explainer | None = None,
         hints=None,
         skip_visibility: bool = False,
+        deadline=None,
     ) -> FeatureCollection:
         exp = explain or ExplainNull()
         fc = self.store.features(plan.type_name)
         if hints is not None:
             hints.validate()
-        deadline = self._deadline(hints)
+        if deadline is None:
+            deadline = self._deadline(hints)
 
         if plan.union is not None:
             return self._execute_union(plan, exp, hints, deadline)
@@ -476,19 +517,25 @@ class QueryPlanner:
         else:
             # simple index scan: the shared dispatch/finish implementation
             # (finish runs immediately here; query_many defers it)
-            return self._submit_simple(plan, fc, exp, hints, skip_visibility)()
+            return self._submit_simple(
+                plan, fc, exp, hints, skip_visibility, deadline=deadline
+            )()
 
         return self._refine_and_post(
             plan, candidates, certain, hints, exp, deadline, skip_visibility
         )
 
-    def _submit_simple(self, plan, fc, exp, hints, skip_visibility=False, finish_scan=None):
+    def _submit_simple(self, plan, fc, exp, hints, skip_visibility=False,
+                       finish_scan=None, deadline=None):
         """Dispatch a simple index-scan plan's device work now; return
         ``finish()`` -> FeatureCollection. ONE implementation serves both
         the synchronous path (_execute calls finish immediately) and the
-        pipelined path (execute_many defers it). The deadline clock starts
-        when finish() runs — matching sequential semantics, so a late
-        pull in a long batch doesn't spuriously time out.
+        pipelined path (execute_many defers it). By default the deadline
+        clock starts when finish() runs — matching sequential semantics,
+        so a late pull in a long batch doesn't spuriously time out; an
+        explicit ``deadline`` (a Deadline) overrides that — the serving
+        tier anchors it at ADMISSION so queue wait is charged against
+        the caller's budget instead of restarting it at dispatch.
 
         ``finish_scan``: an already-dispatched scan's finish (submit_many's
         fused group scans); default dispatches this plan's own scan."""
@@ -496,8 +543,9 @@ class QueryPlanner:
             table = self.store.table(plan.type_name, plan.index)
             finish_scan = table.scan_submit(plan.config, deadline=None)
 
-        def finish() -> FeatureCollection:
-            deadline = self._deadline(hints)
+        def finish(deadline=deadline) -> FeatureCollection:
+            if deadline is None:
+                deadline = self._deadline(hints)
             with exp.span(f"Device scan [{plan.index}]"):
                 # single-chip and distributed tables share one engine and
                 # one contract: (ordinals, certainty vector)
@@ -564,18 +612,25 @@ class QueryPlanner:
             and len(self.store.features(plan.type_name)) > 0
         )
 
-    def submit(self, plan: QueryPlan, explain: Explainer | None = None, hints=None):
+    def submit(self, plan: QueryPlan, explain: Explainer | None = None,
+               hints=None, deadline=None):
         """Stage one query: dispatch its device scan NOW, return a zero-arg
         ``finish()`` producing the FeatureCollection. Plans without a
         simple index scan (unions, id lookups, full scans) fall back to
-        synchronous execution inside finish()."""
+        synchronous execution inside finish(); an explicit ``deadline``
+        (a pre-anchored Deadline — the serving tier's admission time)
+        bounds both paths, default starts each budget at finish()."""
         exp = explain or ExplainNull()
         if not self._is_simple(plan):
-            return lambda: self.execute(plan, explain=exp, hints=hints)
+            return lambda: self.execute(
+                plan, explain=exp, hints=hints, deadline=deadline
+            )
         fc = self.store.features(plan.type_name)
         if hints is not None:
             hints.validate()
-        return self._record_wrap(plan, self._submit_simple(plan, fc, exp, hints))
+        return self._record_wrap(plan, self._submit_simple(
+            plan, fc, exp, hints, deadline=deadline
+        ))
 
     def _record_wrap(self, plan, inner):
         """finish() wrapper adding query auditing (record_query timing) —
@@ -594,7 +649,7 @@ class QueryPlanner:
 
         return finish
 
-    def submit_many(self, plans, hints=None) -> list:
+    def submit_many(self, plans, hints=None, explains=None, deadlines=None) -> list:
         """Stage MANY queries: like per-plan :meth:`submit`, but simple
         index-scan plans sharing a (type, index) table route through the
         table's fused multi-query kernel (``scan_submit_many`` — one
@@ -602,29 +657,67 @@ class QueryPlanner:
         query). Returns one ``finish()`` per plan, in input order.
         Non-simple plans (unions, id lookups, full scans) fall back to
         :meth:`submit`, which executes them synchronously inside their
-        finish() — only simple index scans dispatch ahead of the pulls."""
+        finish() — only simple index scans dispatch ahead of the pulls.
+
+        ``hints``: one QueryHints applied to every plan, or a sequence
+        aligned with ``plans`` — the serving tier (geomesa_tpu.serving)
+        batches independent callers carrying DIFFERENT hints into one
+        fused dispatch; hints shape only post-processing and deadlines,
+        never the device scan, so mixed-hints plans still fuse.
+        ``explains``: optional per-plan Explainer sequence — fused
+        members trace their device scan/refinement like sequential
+        execution. ``deadlines``: optional per-plan Deadline sequence
+        anchoring each plan's budget (fused scans AND non-simple
+        fallbacks) at an earlier instant — the serving tier's admission
+        time — instead of at its finish()."""
+        def aligned(seq, what):
+            if seq is None:
+                return [None] * len(plans)
+            if len(seq) != len(plans):
+                raise ValueError(
+                    f"{what} sequence length {len(seq)} != plans {len(plans)}"
+                )
+            return list(seq)
+
+        if isinstance(hints, (list, tuple)):
+            per = aligned(hints, "hints")
+        else:
+            per = [hints] * len(plans)
+        exps = aligned(explains, "explains")
+        dls = aligned(deadlines, "deadlines")
         finishes: list = [None] * len(plans)
         groups: dict[tuple, list[int]] = {}
         for j, plan in enumerate(plans):
             if not self._is_simple(plan):
-                finishes[j] = self.submit(plan, hints=hints)
+                finishes[j] = self.submit(
+                    plan, explain=exps[j], hints=per[j], deadline=dls[j]
+                )
             else:
                 groups.setdefault((plan.type_name, plan.index), []).append(j)
-        if hints is not None and groups:
-            hints.validate()
+        seen: set = set()  # validate each distinct hints object once
+        for idxs in groups.values():
+            for j in idxs:
+                h = per[j]
+                if h is not None and id(h) not in seen:
+                    seen.add(id(h))
+                    h.validate()
         for (tname, iname), idxs in groups.items():
             table = self.store.table(tname, iname)
             fc = self.store.features(tname)
             many = getattr(table, "scan_submit_many", None)
             if many is None or len(idxs) == 1:
                 for j in idxs:
-                    finishes[j] = self.submit(plans[j], hints=hints)
+                    finishes[j] = self.submit(
+                        plans[j], explain=exps[j], hints=per[j],
+                        deadline=dls[j],
+                    )
                 continue
             scan_fins = many([plans[j].config for j in idxs])
             for j, scan_fin in zip(idxs, scan_fins):
                 plan = plans[j]
                 finishes[j] = self._record_wrap(plan, self._submit_simple(
-                    plan, fc, ExplainNull(), hints, finish_scan=scan_fin
+                    plan, fc, exps[j] or ExplainNull(), per[j],
+                    finish_scan=scan_fin, deadline=dls[j],
                 ))
         return finishes
 

@@ -1,0 +1,21 @@
+"""geomesa_tpu.serving: concurrent query serving (docs/serving.md).
+
+The micro-batch admission tier in front of the device (ISSUE 3): N
+independent threads each calling ``DataStore.query()`` pay N serialized
+single-query dispatches; a :class:`QueryScheduler` coalesces them into
+fused multi-query device dispatches through the planner's ``submit_many``
+path instead — the same admission-layer shape GeoBlocks uses for
+aggregation throughput, and the PR shape that transfers directly to
+continuous batching in an inference-serving stack.
+
+- :class:`QueryScheduler` — bounded admission queue + adaptive
+  micro-batch window + dispatcher thread; callers get futures;
+- :class:`ServingConfig` — the knobs (conf.py property tier defaults);
+- :class:`ServingRejected` — a full queue shed a non-blocking submit.
+"""
+
+from geomesa_tpu.serving.scheduler import (
+    QueryScheduler, ServingConfig, ServingRejected,
+)
+
+__all__ = ["QueryScheduler", "ServingConfig", "ServingRejected"]

@@ -691,18 +691,54 @@ def _line_is_simple(c: np.ndarray) -> bool:
     if n < 2:
         return True
     closed = bool((c[0] == c[-1]).all())
-    for i in range(n - 2):
-        # vectorized against all non-adjacent later segments (adjacent
-        # segments share a vertex by design; ring closure shares the
-        # first/last vertex)
-        j0 = i + 2
-        j1 = n - 1 if (closed and i == 0) else n
-        if j0 >= j1:
+    a, b = c[:-1], c[1:]
+    lo = np.minimum(a, b)  # [n, 2] per-segment bounding boxes
+    hi = np.maximum(a, b)
+    # axis-sweep prune: in min order along one axis, position p can only
+    # intersect later positions whose min <= p's max — a contiguous
+    # sorted run, found with one searchsorted — so the exact test touches
+    # bbox-overlapping pairs only (intersecting segments always have
+    # overlapping bboxes). Sweep whichever axis yields fewer candidate
+    # pairs: a long north-south track overlaps everything in x but
+    # almost nothing in y. Inputs degenerate in BOTH axes fall back to
+    # the full O(n^2) pair set, same as testing every pair directly.
+    def sweep(ax):
+        order = np.argsort(lo[:, ax], kind="stable")
+        stop = np.searchsorted(lo[order, ax], hi[order, ax], side="right")
+        lens = np.maximum(stop - np.arange(1, n + 1), 0)
+        return order, lens, int(lens.sum())
+
+    sx, sy = sweep(0), sweep(1)
+    (order, lens_all, _), other = (sx, 1) if sx[2] <= sy[2] else (sy, 0)
+    # block by PAIR count, not position count: a position in a heavily
+    # overlapping stretch can have ~n candidates, so a fixed position
+    # block would materialize O(block * n) pair indices at once —
+    # capping pairs keeps peak memory flat
+    csum = np.concatenate([[0], np.cumsum(lens_all)])
+    cap = 1_000_000  # pairs per iteration (~8 MB per index array)
+    p0 = 0
+    while p0 < n:
+        p1 = max(int(np.searchsorted(csum, csum[p0] + cap)), p0 + 1)
+        pp = np.arange(p0, min(p1, n))
+        p0 = min(p1, n)
+        lens = lens_all[pp]
+        total = int(lens.sum())
+        if total == 0:
             continue
-        hits = geo.segments_intersect(
-            c[i], c[i + 1], c[j0:j1], c[j0 + 1 : j1 + 1]
+        pi = np.repeat(pp, lens)
+        qi = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens) + pi + 1
+        i, j = order[pi], order[qi]
+        # non-adjacent pairs only (adjacent segments share a vertex by
+        # design; ring closure shares the first/last vertex)
+        keep = np.abs(i - j) >= 2
+        if closed:
+            keep &= (np.minimum(i, j) != 0) | (np.maximum(i, j) != n - 1)
+        i, j = i[keep], j[keep]
+        keep = (  # bbox overlap on the non-swept axis
+            (lo[i, other] <= hi[j, other]) & (lo[j, other] <= hi[i, other])
         )
-        if bool(np.any(hits)):
+        i, j = i[keep], j[keep]
+        if len(i) and bool(np.any(geo.segments_intersect(a[i], b[i], a[j], b[j]))):
             return False
     return True
 

@@ -48,15 +48,24 @@ _SENTINELS = {
 }
 
 # Canonical fused-dispatch shape (scan_submit_many): every multi-member
-# chunk pads its slot list to EXACTLY FUSED_CHUNK_SLOTS and its param
-# stacks to FUSED_CHUNK_Q, so there is ONE fused kernel variant per
+# chunk pads its slot list to EXACTLY the table's ``fused_slots`` and its
+# param stacks to FUSED_CHUNK_Q, so there is ONE fused kernel variant per
 # (projected columns, predicate flags) — compiled at warmup, zero
 # query-time recompiles (the same doctrine as the single-query M-bucket
-# ladder). The fixed size also bounds device memory: plane bytes — and,
-# on the XLA fallback, the column gathers — scale with the chunk's slot
-# count, not the whole batch. 2048 slots = 4.2M rows per dispatch;
-# greedy packing keeps pad waste small, and members broader than half a
-# chunk take the single-query ladder instead.
+# ladder). ``fused_slots`` is FUSED_CHUNK_SLOTS clamped down to the
+# table's own block-count bucket: the kernel's scan cost is proportional
+# to slots whether they are real or pads, so a 123-block table padding to
+# 2048 slots would scan 16x its own size per dispatch (the serving
+# bench's CPU regression at 32 clients). The fixed size also bounds
+# device memory: plane bytes — and, on the XLA fallback, the column
+# gathers — scale with the chunk's slot count, not the whole batch.
+# 2048 slots = 4.2M rows per dispatch at the default tile; greedy packing
+# keeps pad waste small, and members broader than half a chunk take the
+# single-query ladder instead. A table growing past its block-count
+# bucket compiles the next fused shape on first use — the same
+# growth-triggered compile the single-query ladder already has (new
+# buckets past warmup's table size), softened by the persistent compile
+# cache; re-run warmup() after major growth to take it off the hot path.
 FUSED_CHUNK_SLOTS = 2048
 FUSED_CHUNK_Q = 128
 
@@ -328,6 +337,15 @@ class IndexTable(SortedKeys):
         multiple of the mesh size)."""
         return n_blocks
 
+    @property
+    def fused_slots(self) -> int:
+        """Slot count of THIS table's canonical fused-dispatch shape:
+        FUSED_CHUNK_SLOTS clamped down to the table's own block-count
+        bucket (see the constants' doctrine note) — still one static
+        shape per (columns, flags), but a small table never scans a
+        multiple of its own size in pad slots."""
+        return min(FUSED_CHUNK_SLOTS, bk.bucket_of(self.n_blocks))
+
     def _place_cols(self, cols: dict, device) -> None:
         """Put the padded columns on device in the [n_blocks, SUB, 128]
         scan layout. With ``self._reuse`` set, device blocks before the
@@ -504,8 +522,9 @@ class IndexTable(SortedKeys):
             key = (names, config.boxes is not None, config.windows is not None)
             groups.setdefault(key, []).append((j, config, blocks, overlap, contained))
 
+        slots = self.fused_slots
         for (names, has_boxes, has_windows), group_members in groups.items():
-            # pack members into fixed-shape chunks (FUSED_CHUNK_SLOTS /
+            # pack members into fixed-shape chunks (fused_slots /
             # FUSED_CHUNK_Q — see the constants' doctrine note). Broad
             # members (> half a chunk, e.g. _full_or expansions) dispatch
             # alone on the single-query bucket ladder; the rest pack
@@ -515,11 +534,11 @@ class IndexTable(SortedKeys):
             cur_blocks = 0
             for m in group_members:
                 nb = len(m[2])
-                if nb > FUSED_CHUNK_SLOTS // 2:
+                if nb > slots // 2:
                     chunks.append([m])
                     continue
                 if cur and (
-                    cur_blocks + nb > FUSED_CHUNK_SLOTS
+                    cur_blocks + nb > slots
                     or len(cur) == FUSED_CHUNK_Q
                 ):
                     chunks.append(cur)
@@ -545,13 +564,14 @@ class IndexTable(SortedKeys):
         per-member slot segments."""
         import jax
 
+        slots = self.fused_slots
         if len(members) == 1 or (
             # near-empty AND few members: past a handful of queries the
             # per-dispatch overhead (~2 ms each) outweighs scanning the
             # canonical shape's pad slots (~ms), so larger chunks always
             # fuse even when sparse
             len(members) <= 8
-            and sum(len(m[2]) for m in members) < FUSED_CHUNK_SLOTS // 8
+            and sum(len(m[2]) for m in members) < slots // 8
         ):
             for j, config, blocks, overlap, contained in members:
                 finishes[j] = self._make_finish(
@@ -575,7 +595,7 @@ class IndexTable(SortedKeys):
             segs.append((pos, pos + len(blocks)))
             pos += len(blocks)
         bids, n_real = bk.pad_bids(
-            np.concatenate(bid_parts), self.n_blocks, bucket=FUSED_CHUNK_SLOTS
+            np.concatenate(bid_parts), self.n_blocks, bucket=slots
         )
         self._record_scan(names, len(bids))
         qids = np.zeros(len(bids), np.int32)
@@ -926,7 +946,7 @@ class IndexTable(SortedKeys):
                 # half a chunk of repeated block 0 per member: enough real
                 # slots to clear the small-batch routing threshold, same
                 # compile key as any future fused dispatch
-                blk = np.zeros(FUSED_CHUNK_SLOTS // 4, np.int64)
+                blk = np.zeros(max(self.fused_slots // 4, 1), np.int64)
                 fused_fins: list = [None, None]
                 self._submit_fused_chunk(
                     [(0, cfg, blk, [], []), (1, cfg, blk, [], [])],

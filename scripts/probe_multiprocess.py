@@ -36,6 +36,7 @@ def worker(pid: int, port: int):
     from jax.sharding import PartitionSpec as P
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from geomesa_tpu.parallel.dtable import _shard_map
     from geomesa_tpu.parallel.mesh import make_multihost_mesh
 
     mesh = make_multihost_mesh()  # 2 hosts x 4 devices, host-major
@@ -46,12 +47,7 @@ def worker(pid: int, port: int):
     def body(x):
         return jax.lax.psum(x.sum(), "shard")
 
-    fn = jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=P("shard"), out_specs=P(),
-            check_vma=False,
-        )
-    )
+    fn = jax.jit(_shard_map(body, mesh, P("shard"), P()))
     import jax.numpy as jnp
 
     # each device holds one row; global array is process-sharded
@@ -66,7 +62,15 @@ def worker(pid: int, port: int):
     x = jax.make_array_from_single_device_arrays(
         global_shape, NamedSharding(mesh, P("shard")), arrs
     )
-    out = fn(x)
+    try:
+        out = fn(x)
+    except RuntimeError as e:
+        if "aren't implemented on the CPU backend" in str(e):
+            # this jax build's CPU client has no cross-process collective
+            # transport: the probe is unsupported here, not failing
+            print("UNSUPPORTED: no CPU multiprocess computations", flush=True)
+            sys.exit(3)
+        raise
     got = float(np.asarray(out)[()] if np.asarray(out).shape == () else np.asarray(out).ravel()[0])
     want = 128 * 4 * (1.0 + 2.0)  # both processes' rows in one psum
     assert abs(got - want) < 1e-3, (got, want)
@@ -133,6 +137,10 @@ def main():
             if not any(rc):
                 print("two-process distributed probe: OK", flush=True)
                 return
+            if 3 in rc:
+                # a worker reported UNSUPPORTED (see worker()): propagate
+                # the distinct code so the suite can skip, not fail
+                raise SystemExit(3)
             if attempt == 0:
                 print(f"worker rcs: {rc}; retrying on a fresh port", flush=True)
     finally:

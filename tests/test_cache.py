@@ -306,10 +306,10 @@ class TestSingleFlight:
         scans = []
         orig = ds.planner._execute
 
-        def counting_execute(plan, explain=None, hints=None):
+        def counting_execute(plan, explain=None, hints=None, **kw):
             scans.append(1)
             time.sleep(0.15)  # hold the flight open so waiters pile up
-            return orig(plan, explain, hints)
+            return orig(plan, explain, hints, **kw)
 
         ds.planner._execute = counting_execute
         barrier = threading.Barrier(n_threads)
@@ -349,10 +349,10 @@ class TestSingleFlight:
         orig = ds.planner._execute
         started = threading.Event()  # leader is inside its scan
 
-        def slow_execute(plan, explain=None, hints=None):
+        def slow_execute(plan, explain=None, hints=None, **kw):
             first = not started.is_set()
             started.set()
-            out = orig(plan, explain, hints)
+            out = orig(plan, explain, hints, **kw)
             if first:
                 # a mutation lands AFTER the leader's snapshot but before
                 # its flight completes

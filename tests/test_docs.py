@@ -133,6 +133,52 @@ def test_feature_expiry_user_data_key():
         ds2.age_off("e2")
 
 
+def test_serving_doc_apis_exist():
+    """docs/serving.md stays honest the same way: every serving API,
+    knob, metric, and dotted name it documents is real."""
+    import inspect
+
+    from geomesa_tpu import conf
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.serving import (
+        QueryScheduler, ServingConfig, ServingRejected,  # noqa: F401
+    )
+
+    assert hasattr(DataStore, "serve")
+    for m in ("submit", "query", "start", "close", "closed", "window_s"):
+        assert hasattr(QueryScheduler, m), m
+    for f in ("window_ms", "queue_max", "batch_max"):
+        assert f in ServingConfig.__dataclass_fields__, f
+    assert "block" in inspect.signature(QueryScheduler.submit).parameters
+    # every conf knob the doc names resolves through the property tier
+    for prop, name in [
+        (conf.SERVING_WINDOW_MS, "geomesa.serving.window_ms"),
+        (conf.SERVING_QUEUE_MAX, "geomesa.serving.queue.max"),
+        (conf.SERVING_BATCH_MAX, "geomesa.serving.batch.max"),
+    ]:
+        assert prop.name == name
+    # the documented metric names render through the registry, including
+    # the _seconds_max exposition the doc points operators at
+    reg = MetricsRegistry()
+    for c in ("geomesa.serving.submitted", "geomesa.serving.shed",
+              "geomesa.serving.coalesced", "geomesa.serving.batches",
+              "geomesa.serving.batched_queries"):
+        reg.counter(c)
+    reg.gauge("geomesa.serving.window_ms", 0.0)
+    reg.timer_update("geomesa.serving.queue_wait", 0.01)
+    text = reg.render_prometheus()
+    assert "geomesa_serving_shed 1" in text
+    assert "geomesa_serving_queue_wait_seconds_max" in text
+    # every `ds.X` / `sched.X` the guide mentions in backticks resolves
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "serving.md")
+    text = open(path).read()
+    for name in re.findall(r"`ds\.(\w+)", text):
+        assert hasattr(DataStore, name), f"ds.{name}"
+    for name in re.findall(r"`sched\.(\w+)", text):
+        assert hasattr(QueryScheduler, name), f"sched.{name}"
+
+
 def test_caching_doc_apis_exist():
     """docs/caching.md stays honest the same way: every cache API,
     knob, and metric name it documents is real."""

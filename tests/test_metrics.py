@@ -1,0 +1,117 @@
+"""MetricsRegistry: thread-safety under concurrent callers and the
+Prometheus exposition format (counters, gauges, timer
+_seconds_count/_sum/_max)."""
+
+import threading
+
+import pytest
+
+from geomesa_tpu.metrics import MetricsRegistry, global_registry, resolve
+
+
+def test_concurrent_counters_lose_no_increments():
+    reg = MetricsRegistry()
+    n_threads, per = 8, 10_000
+
+    def worker():
+        for _ in range(per):
+            reg.counter("hits")
+            reg.counter("weighted", 3)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counters["hits"] == n_threads * per
+    assert reg.counters["weighted"] == 3 * n_threads * per
+
+
+def test_snapshot_and_render_under_concurrent_updates():
+    """snapshot()/render_prometheus() iterate while writers insert NEW
+    names (dict resize): must never raise and the final state is exact."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def writer(k):
+        i = 0
+        while not stop.is_set():
+            reg.counter(f"c.{k}.{i % 50}")
+            reg.gauge(f"g.{k}.{i % 50}", i)
+            reg.timer_update(f"t.{k}.{i % 50}", 0.001)
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                reg.snapshot()
+                reg.render_prometheus()
+            except BaseException as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == []
+    snap = reg.snapshot()
+    assert len(snap["counters"]) == 4 * 50
+    assert all(t["count"] > 0 for t in snap["timers"].values())
+
+
+def test_timer_context_manager_records():
+    reg = MetricsRegistry()
+    with reg.time("op"):
+        pass
+    with reg.time("op"):
+        pass
+    t = reg.timers["op"]
+    assert t.count == 2
+    assert t.max_s >= t.mean_s > 0
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("geomesa.query.count", 3)
+    reg.gauge("geomesa.cache.bytes", 1024.0)
+    reg.timer_update("geomesa.query.scan", 0.25)
+    reg.timer_update("geomesa.query.scan", 0.75)
+    text = reg.render_prometheus()
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    assert "# TYPE geomesa_query_count counter" in lines
+    assert "geomesa_query_count 3" in lines
+    assert "# TYPE geomesa_cache_bytes gauge" in lines
+    assert "geomesa_cache_bytes 1024.0" in lines
+    # timers: count + sum under the summary family; the max is its OWN
+    # gauge family (strict OpenMetrics parsers allow only _sum/_count/
+    # quantile samples inside a summary)
+    i = lines.index("# TYPE geomesa_query_scan_seconds summary")
+    assert lines[i + 1] == "geomesa_query_scan_seconds_count 2"
+    assert lines[i + 2] == "geomesa_query_scan_seconds_sum 1.0"
+    assert lines[i + 3] == "# TYPE geomesa_query_scan_seconds_max gauge"
+    assert lines[i + 4] == "geomesa_query_scan_seconds_max 0.75"
+    # p-worst latency is scrapeable for EVERY timer
+    assert sum(l == "geomesa_query_scan_seconds_max 0.75" for l in lines) == 1
+
+
+def test_snapshot_reports_max():
+    reg = MetricsRegistry()
+    reg.timer_update("t", 0.1)
+    reg.timer_update("t", 0.9)
+    snap = reg.snapshot()["timers"]["t"]
+    assert snap == {"count": 2, "mean_s": pytest.approx(0.5), "max_s": 0.9}
+
+
+def test_resolve_falls_back_to_global():
+    assert resolve(None) is global_registry()
+    reg = MetricsRegistry()
+    assert resolve(reg) is reg

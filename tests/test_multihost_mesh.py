@@ -102,5 +102,9 @@ def test_two_process_probe():
         [sys.executable, os.path.abspath(script)],
         capture_output=True, text=True, timeout=240, start_new_session=True,
     )
+    if out.returncode == 3:
+        # the probe's distinct "unsupported here" code: this jax build's
+        # CPU client has no cross-process collective transport
+        pytest.skip("jax CPU backend lacks multiprocess computations")
     assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
     assert "cross-process psum" in out.stdout

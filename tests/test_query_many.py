@@ -87,6 +87,35 @@ def test_query_many_respects_delta_tier(store):
     assert after == before + 50  # no rows lost or double-counted
 
 
+def test_scheduler_threaded_equals_sequential(store):
+    """The serving tier's core contract (docs/serving.md): M threads
+    submitting the QUERIES matrix through the micro-batch scheduler get
+    results identical to sequential query() — per plan kind (simple
+    scans, attribute index, union, id lookup, empty, full scan), on
+    single-device and mesh4 stores."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    ds = store
+    seq = [ds.query("ev", q) for q in QUERIES]
+    sched = ds.serve()
+    try:
+        def worker(_):
+            futs = [sched.submit("ev", q) for q in QUERIES]
+            return [f.result(120) for f in futs]
+
+        with ThreadPoolExecutor(4) as ex:
+            all_outs = list(ex.map(worker, range(4)))
+    finally:
+        sched.close()
+    for outs in all_outs:
+        assert len(outs) == len(seq)
+        for a, b in zip(seq, outs):
+            np.testing.assert_array_equal(
+                np.sort(np.asarray(a.ids)), np.sort(np.asarray(b.ids))
+            )
+    assert sum(len(a) for a in seq) > 0
+
+
 def test_warmup_compiles_all_variants():
     """After DataStore.warmup, a fresh mixed query batch triggers NO new
     XLA compiles. A UNIQUE block size (tile) gives this store distinct

@@ -510,6 +510,25 @@ class TestUdfReviewFixes:
         assert F.st_issimple(spiral)
         assert time.monotonic() - t0 < 10.0
 
+    def test_simple_degenerate_axis_lines_fast(self):
+        """Axis-degenerate tracks (every x-span — or every y-span —
+        overlapping) must not blow up the sweep prune's time or memory:
+        the sweep picks the axis with fewer candidate pairs."""
+        import time
+
+        from geomesa_tpu.sql import functions as F
+
+        yy = np.linspace(0.0, 1000.0, 100_000)
+        zz = np.zeros_like(yy)
+        for coords in (np.stack([zz, yy], 1), np.stack([yy, zz], 1)):
+            t0 = time.monotonic()
+            assert F._line_is_simple(coords)
+            assert time.monotonic() - t0 < 10.0
+        # ... and a crossing is still caught on such a track
+        bad = np.stack([zz[:100], yy[:100]], 1).copy()
+        bad[-1] = (0.0, yy[50])  # doubles back over the middle
+        assert not F._line_is_simple(bad)
+
 
 class TestUdfReviewFixes2:
     """Second review pass: boundary-identical interiors, on-meridian
