@@ -119,8 +119,13 @@ def cmd_ingest(args) -> int:
         return _ingest_direct(ds, args)
 
     if not args.infer and args.workers and args.workers > 1:
-        # distributed-ingest mode: process-pool converters, single writer
-        from geomesa_tpu.io.ingest import ingest_files
+        # distributed-ingest mode: process-pool converters feeding the
+        # staged pipeline (docs/ingest.md); --no-pipeline falls back to
+        # the sequential-commit driver (per-split incremental visibility)
+        if getattr(args, "no_pipeline", False):
+            from geomesa_tpu.io.ingest import ingest_files
+        else:
+            from geomesa_tpu.ingest import ingest_files
 
         sft = ds.get_schema(args.feature_name)
         conv = _converter_from_file(sft, args.converter)
@@ -132,6 +137,14 @@ def cmd_ingest(args) -> int:
             f"ingested {res.written} features into '{args.feature_name}' "
             f"({res.splits} splits, {args.workers} workers)"
         )
+        if res.stage_seconds:
+            # per-stage wall attribution: where the ingest time lives
+            print(
+                "stages: " + "  ".join(
+                    f"{k}={v:.2f}s" for k, v in res.stage_seconds.items() if v
+                ),
+                file=sys.stderr,
+            )
         return 0
 
     conv0 = None
@@ -483,6 +496,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--workers", type=int, default=0,
         help="parallel converter processes (0 = in-process; reference "
         "distributed MapReduce ingest)",
+    )
+    sp.add_argument(
+        "--no-pipeline", action="store_true",
+        help="with --workers > 1: use the sequential-commit driver "
+        "(per-split incremental visibility) instead of the staged "
+        "bulk-load pipeline (docs/ingest.md)",
     )
     sp.add_argument("files", nargs="+")
 

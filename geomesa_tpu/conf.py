@@ -72,6 +72,12 @@ COMPACT_MIN_ROWS = SystemProperty(
     "geomesa.tpu.compact.min.rows", 262_144, int,
     "delta rows before a minor compaction merges into the device table",
 )
+COMPACT_SPAN_ROWS = SystemProperty(
+    "geomesa.tpu.compact.span.rows", 4_194_304, int,
+    "bounded-buffer rows per gather span when a compaction streams sorted "
+    "columns to the device (block-aligned; caps host scratch instead of "
+    "materializing the whole sorted column set)",
+)
 DENSITY_VMEM_BUDGET = SystemProperty(
     "geomesa.tpu.density.vmem.budget", 10 << 20, int,
     "VMEM byte budget for the Pallas density histogram kernel",
@@ -114,6 +120,32 @@ CACHE_TILES_PER_QUERY = SystemProperty(
     "geomesa.cache.tile.max.per.query", 1024, int,
     "bbox queries spanning more interior tiles than this skip tile "
     "composition (the per-tile bookkeeping would beat the scan)",
+)
+
+
+# -- pipelined multi-core ingest (geomesa_tpu.ingest; docs/ingest.md) -----
+
+INGEST_WORKERS = SystemProperty(
+    "geomesa.ingest.workers", 0, int,
+    "worker count for the pipelined ingest's parse/key/sort stages "
+    "(0 = one per host core)",
+)
+INGEST_QUEUE_DEPTH = SystemProperty(
+    "geomesa.ingest.queue.depth", 4, int,
+    "bounded admission window: chunks a producer may stage ahead of the "
+    "ordered writer before put() blocks (backpressure, counted by "
+    "geomesa.ingest.queue_full)",
+)
+INGEST_CHUNK_ROWS = SystemProperty(
+    "geomesa.ingest.chunk.rows", 1 << 20, int,
+    "fixed-size sort shard rows: each chunk's (bin, z) keys radix-sort in "
+    "shards of this many rows, in parallel, merged spanwise at finalize",
+)
+INGEST_MERGE_MIN_BINS = SystemProperty(
+    "geomesa.ingest.merge.min.bins", 2, int,
+    "distinct sort bins below which the ingest finalize falls back to the "
+    "whole-table LSD radix sort (the PERF.md 4f negative result: spanwise "
+    "merging has nothing to parallelize over few bins)",
 )
 
 

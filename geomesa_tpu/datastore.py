@@ -361,16 +361,25 @@ class DataStore:
         to the batch, not the table. ``check_ids=False`` skips the
         duplicate id check for large bulk loads with known-unique ids.
         """
+        features, new_keys, batch_stats = self._encode_batch(type_name, features)
+        if len(features) == 0:
+            return 0
+        return self._commit_batch(
+            type_name, features, new_keys, batch_stats, check_ids=check_ids
+        )
+
+    def _encode_batch(self, type_name: str, features):
+        """The PURE half of a write: per-batch stats sketch + every
+        index's write keys, built BEFORE any store state mutates — a
+        failing encoder (bad dates, unsupported geometry) must leave the
+        store untouched, not half-written. No lock: the pipelined ingest
+        (geomesa_tpu.ingest) runs this stage concurrently across chunks.
+        Returns (features, {index name -> WriteKeys}, StatsStore | None)."""
         sft = self._schemas[type_name]
         if not isinstance(features, FeatureCollection):
             features = FeatureCollection.from_rows(sft, features)
         if len(features) == 0:
-            return 0
-
-        # build everything BEFORE mutating store state: a failing encoder
-        # (bad dates, unsupported geometry) must leave the store untouched,
-        # not half-written (features visible but index tables stale). This
-        # stage is pure per-batch work, so it runs outside the write lock.
+            return features, {}, None
         from geomesa_tpu.stats.store import StatsStore
 
         batch_stats = StatsStore.build(sft, features)
@@ -384,36 +393,53 @@ class DataStore:
                 # accumulates); cell width is codec-defined (dims x per-dim
                 # precision), NOT data-dependent, so cells stay aligned
                 _observe_sketch(batch_stats, idx, keys)
+        return features, new_keys, batch_stats
 
-        # serialized section: id check, stats merge and commit must be
-        # atomic — two racing writers would otherwise both pass the id
-        # check or both merge onto the same prior sketch (losing one batch)
+    def _widen_bin_ranges(self, type_name: str, new_keys: Mapping) -> None:
+        """Widen each index's known time-bin range (open-ended temporal
+        predicates clamp to it; see index.z3.clamp_bins) — a
+        read-modify-write, so callers hold the write lock: a lost widen
+        would make committed rows invisible to clamped queries. Attribute
+        indexes key by value bucket; the time bins come from the tbin
+        device column, not the sort bins."""
+        for idx in self._indexes[type_name]:
+            keys = new_keys.get(idx.name)
+            if keys is None:
+                continue
+            tb = keys.device_cols.get("tbin")
+            if tb is None:
+                tw = keys.device_cols.get("tw")
+                if tw is not None:
+                    from geomesa_tpu.index.z3 import unpack_tw
+
+                    tb = unpack_tw(tw)[0]
+            if tb is not None and len(tb):
+                lo, hi = int(tb.min()), int(tb.max())
+                p = idx.bin_range
+                idx.bin_range = (
+                    (lo, hi) if p is None else (min(p[0], lo), max(p[1], hi))
+                )
+
+    def _commit_batch(
+        self,
+        type_name: str,
+        features: FeatureCollection,
+        new_keys: Mapping,
+        batch_stats,
+        check_ids: bool = True,
+        compact: bool = True,
+    ) -> int:
+        """The serialized half of a write: id check, stats merge and
+        commit are atomic — two racing writers would otherwise both pass
+        the id check or both merge onto the same prior sketch (losing one
+        batch). ``compact=False`` defers the delta-threshold compaction
+        (the pipelined bulk path compacts ONCE at publish)."""
         with self._write_lock:
             if check_ids:
-                self._check_ids(type_name, features)
+                self._check_ids(type_name, np.asarray(features.ids))
             prev = self._stats.get(type_name)
             stats = prev.merge(batch_stats) if prev is not None else batch_stats
-
-            # widen each index's known time-bin range (open-ended temporal
-            # predicates clamp to it; see index.z3.clamp_bins) — a
-            # read-modify-write, so it lives under the lock: a lost widen
-            # would make committed rows invisible to clamped queries.
-            # Attribute indexes key by value bucket; the time bins come
-            # from the tbin device column, not the sort bins.
-            for idx in self._indexes[type_name]:
-                tb = new_keys[idx.name].device_cols.get("tbin")
-                if tb is None:
-                    tw = new_keys[idx.name].device_cols.get("tw")
-                    if tw is not None:
-                        from geomesa_tpu.index.z3 import unpack_tw
-
-                        tb = unpack_tw(tw)[0]
-                if tb is not None and len(tb):
-                    lo, hi = int(tb.min()), int(tb.max())
-                    p = idx.bin_range
-                    idx.bin_range = (
-                        (lo, hi) if p is None else (min(p[0], lo), max(p[1], hi))
-                    )
+            self._widen_bin_ranges(type_name, new_keys)
 
             self._chunks[type_name].append(features)
             self._full[type_name] = None
@@ -427,12 +453,62 @@ class DataStore:
             # mesh stores use the same delta tier as single-chip stores
             # (round 3 force-compacted every mesh write; the shared engine
             # removed that)
-            if self._main_rows[type_name] == 0 or delta_rows > max(
-                self.COMPACT_MIN_ROWS, total // 8
+            if compact and (
+                self._main_rows[type_name] == 0
+                or delta_rows > max(self.COMPACT_MIN_ROWS, total // 8)
             ):
                 self.compact(type_name)
             self._bump_cache(type_name, features)
         return len(features)
+
+    def _bulk_commit(
+        self,
+        type_name: str,
+        fcs: Sequence[FeatureCollection],
+        keys_by_index: Mapping,
+        stats_list: Sequence,
+        check_ids: bool = True,
+        presorted: "Mapping | None" = None,
+    ) -> int:
+        """Atomic multi-chunk publish for the pipelined bulk ingest
+        (geomesa_tpu.ingest.BulkLoader): ONE write-lock section appends
+        every staged chunk, folds the per-chunk stats in chunk order (the
+        same left-fold association the sequential write path produces, so
+        histograms bin identically), and compacts ONCE. ``keys_by_index``
+        holds one pre-concatenated WriteKeys per index covering all
+        chunks; ``presorted`` optionally maps index names to the full
+        stable (bin, z) argsort of those keys so the compaction can skip
+        its radix sort. Until this returns, nothing is visible — a failed
+        pipeline never shows a partial table."""
+        fcs = [fc for fc in fcs if len(fc)]
+        total_new = sum(len(fc) for fc in fcs)
+        if total_new == 0:
+            return 0
+        with self._write_lock:
+            if check_ids:
+                ids = np.concatenate([np.asarray(fc.ids) for fc in fcs])
+                self._check_ids(type_name, ids)
+            stats = self._stats.get(type_name)
+            for st in stats_list:
+                if st is None:
+                    continue
+                stats = st if stats is None else stats.merge(st)
+            self._widen_bin_ranges(type_name, keys_by_index)
+            total_before = sum(len(c) for c in self._chunks[type_name])
+            self._chunks[type_name].extend(fcs)
+            self._full[type_name] = None
+            self._id_sorted[type_name] = None
+            self._stats[type_name] = stats
+            for name, keys in keys_by_index.items():
+                self._key_chunks.setdefault((type_name, name), []).append(keys)
+            # a presorted perm is ordinal-aligned only when the new rows
+            # ARE the whole table (a bulk load into an empty type)
+            self.compact(
+                type_name,
+                presorted=presorted if total_before == 0 else None,
+            )
+            self._bump_cache(type_name)
+        return total_new
 
     def delete_features(self, type_name: str, f: "Filter | str") -> int:
         """Remove features matching a filter; returns the count removed
@@ -699,7 +775,7 @@ class DataStore:
             self._stats[type_name] = stats
         return stats
 
-    def compact(self, type_name: str) -> None:
+    def compact(self, type_name: str, presorted: "Mapping | None" = None) -> None:
         """Merge the delta tier into the sorted device tables (LSM minor
         compaction; the reference's backends compact SSTables server-side).
         Also collapses the feature chunks into one collection.
@@ -709,7 +785,16 @@ class DataStore:
         mesh-shards when configured and takes the partition-preserving
         merge path for single-chip updates (only the delta is sorted, only
         device blocks past the first insertion point re-upload — the
-        TimePartition analogue)."""
+        TimePartition analogue). Sorted columns stream to the device in
+        block-aligned bounded spans (geomesa.tpu.compact.span.rows), so a
+        compaction's host peak is ~one column, not a second full copy of
+        the column set (the 1B-row OOM; docs/ingest.md memory model).
+
+        ``presorted`` optionally maps index names to the full stable
+        (bin, z) argsort of that index's concatenated keys (the pipelined
+        ingest's pre-merged runs) — the table build then skips its radix
+        sort. Adapters that don't understand ``sorted_state`` are detected
+        by signature and get the plain call."""
         from geomesa_tpu.storage.delta import concat_keys
 
         with self._write_lock:
@@ -722,24 +807,73 @@ class DataStore:
                     continue
                 keys = concat_keys(parts)
                 self._key_chunks[(type_name, idx.name)] = [keys]
+                # drop the pre-concat chunk refs NOW: holding them through
+                # the table build would keep a second copy of this index's
+                # key columns resident for the whole upload (the bounded-
+                # memory model; docs/ingest.md)
+                del parts
                 old = self._tables.get((type_name, idx.name))
                 if old is not None and old.n == len(keys.zs) == main_rows:
                     continue  # empty delta: the resident table is current
-                table = self.adapter.create_table(
-                    idx, keys, old=old, main_rows=main_rows
-                )
+                sorted_state = None
+                if presorted is not None:
+                    sp = presorted.get(idx.name)
+                    if sp is not None and len(sp) == len(keys.zs):
+                        sorted_state = sp
+                if sorted_state is not None and self._adapter_takes_sorted_state():
+                    table = self.adapter.create_table(
+                        idx, keys, old=old, main_rows=main_rows,
+                        sorted_state=sorted_state,
+                    )
+                else:
+                    table = self.adapter.create_table(
+                        idx, keys, old=old, main_rows=main_rows
+                    )
                 if old is not None and old is not table:
                     self.adapter.delete_table(old)
                 self._tables[(type_name, idx.name)] = table
             self._main_rows[type_name] = len(full)
 
-    def _check_ids(self, type_name: str, batch: FeatureCollection) -> None:
-        ids = np.asarray(batch.ids)
+    def _adapter_takes_sorted_state(self) -> bool:
+        """Whether this adapter's ``create_table`` accepts the optional
+        ``sorted_state`` kwarg (older custom adapters may predate it —
+        they just lose the skip-the-sort optimization, nothing else)."""
+        cached = getattr(self, "_adapter_sorted_state_ok", None)
+        if cached is None:
+            import inspect
+
+            try:
+                params = inspect.signature(self.adapter.create_table).parameters
+                cached = "sorted_state" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()
+                )
+            except (TypeError, ValueError):
+                cached = False
+            self._adapter_sorted_state_ok = cached
+        return cached
+
+    def _check_ids(self, type_name: str, ids: np.ndarray) -> None:
+        """Reject duplicate ids within the batch or against the store.
+        Takes the raw id array so the bulk path can validate ALL staged
+        chunks with one sort instead of one re-index per chunk."""
         if len(np.unique(ids)) != len(ids):
             raise ValueError("duplicate feature ids in write batch")
         existing = self._id_index(type_name)
         if existing is not None and len(existing[0]):
             sorted_ids = existing[0]
+            if ids.dtype.kind != sorted_ids.dtype.kind:
+                if sorted_ids.dtype.kind in "US":
+                    # natural-width cast: astype(sorted_ids.dtype) would
+                    # TRUNCATE to the stored width ('12345' -> '123') and
+                    # spuriously report duplicates; numpy compares unicode
+                    # arrays of different widths correctly
+                    ids = ids.astype(str)
+                else:
+                    try:
+                        ids = ids.astype(sorted_ids.dtype)
+                    except (ValueError, TypeError):
+                        return  # incomparable id kinds cannot collide
             pos = np.searchsorted(sorted_ids, ids)
             pos = np.clip(pos, 0, len(sorted_ids) - 1)
             if np.any(sorted_ids[pos] == ids):

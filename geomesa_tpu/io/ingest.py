@@ -1,150 +1,81 @@
-"""Parallel converter ingest: the distributed-ingest driver.
+"""Parallel converter ingest: the sequential-commit distributed-ingest
+driver (compatibility surface).
 
 Reference: distributed MapReduce ingest (/root/reference/geomesa-jobs/src/
 main/scala/org/locationtech/geomesa/jobs/mapreduce/ —
 ``ConverterInputFormat`` splits inputs, mappers run the converter,
 ``GeoMesaOutputFormat`` writes; driven by tools/ingest/IngestCommand.scala
-which picks local vs distributed mode). The TPU-native inversion: parsing
-and conversion — the CPU-bound stage — fan out over a process pool (one
-"mapper" per input split), while the single JAX controller stays the only
-writer (SURVEY §2.6: single-controller design, no distributed lock). Large
-delimited files are split at line boundaries into byte-range tasks, so one
-big CSV parallelizes like many small files.
+which picks local vs distributed mode). Parsing fans out over a process
+pool (one "mapper" per input split) while the single JAX controller stays
+the only writer.
 
-Workers rebuild the converter from its config (compiled expressions hold
-closures and cannot pickle); results return as columnar
-FeatureCollections, and the driver writes batches in order — the LSM delta
-tier makes each write O(batch).
+The split machinery (byte-range splits, the picklable converter config,
+the guarded worker) now lives in :mod:`geomesa_tpu.ingest.splits`; this
+module keeps the original *sequential-commit* driver — each split's batch
+goes through ``store.write`` as it arrives, with the store's normal
+incremental compaction cadence. The staged multi-core pipeline
+(:mod:`geomesa_tpu.ingest.pipeline`) is the bulk-load path: deferred
+single compaction, sharded sort, atomic publish. Use this one when you
+want per-split incremental visibility; use the pipeline for throughput.
+
+Worker failures surface as :class:`~geomesa_tpu.ingest.IngestError` with
+the worker-side traceback, and per-split parse-error counts aggregate into
+``IngestResult.split_errors`` ordered by split index (deterministic across
+worker counts and completion orders).
 """
 
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
-from geomesa_tpu.features import FeatureCollection
-from geomesa_tpu.io.converters import Converter, FieldSpec
-from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.ingest.pipeline import (
+    IngestError,
+    IngestResult,
+    raise_split_failure,
+    rebase_ids,
+)
+from geomesa_tpu.ingest.splits import (  # noqa: F401 (compat re-exports)
+    ConverterConfig,
+    Split,
+    SplitFailure,
+    run_split_guarded,
+)
+from geomesa_tpu.ingest import splits as _splits
 
-# a split per ~32 MB keeps task granularity reasonable for big files
-SPLIT_BYTES = 32 << 20
-
-
-@dataclass
-class ConverterConfig:
-    """Picklable converter description (the mapper-side job config)."""
-
-    spec: str
-    type_name: str
-    fields: Sequence[tuple]  # (name, transform)
-    id_field: Optional[str]
-    fmt: str
-    delimiter: str
-    skip_lines: int
-    drop_errors: bool
-    xml_feature_tag: Optional[str]
-    user_data: dict = field(default_factory=dict)
-
-    @staticmethod
-    def of(conv: Converter) -> "ConverterConfig":
-        return ConverterConfig(
-            spec=conv.sft.to_spec(),
-            type_name=conv.sft.name,
-            fields=[(f.name, f.transform) for f in conv.fields],
-            id_field=conv.id_field,
-            fmt=conv.fmt,
-            delimiter=conv.delimiter,
-            skip_lines=conv.skip_lines,
-            drop_errors=conv.drop_errors,
-            xml_feature_tag=conv.xml_feature_tag,
-            user_data=dict(conv.sft.user_data),
-        )
-
-    def build(self) -> Converter:
-        sft = FeatureType.from_spec(self.type_name, self.spec)
-        sft.user_data.update(self.user_data)
-        return Converter(
-            sft=sft,
-            fields=[FieldSpec(n, t) for n, t in self.fields],
-            id_field=self.id_field,
-            fmt=self.fmt,
-            delimiter=self.delimiter,
-            skip_lines=self.skip_lines,
-            drop_errors=self.drop_errors,
-            xml_feature_tag=self.xml_feature_tag,
-        )
-
-
-@dataclass(frozen=True)
-class Split:
-    """One mapper task: a byte range of one input file (the
-    ConverterInputFormat split analogue). ``skip_header`` drops the
-    configured header lines (first split of a delimited file only)."""
-
-    path: str
-    start: int
-    end: int  # exclusive
-    skip_header: bool
+# a split per ~32 MB keeps task granularity reasonable for big files.
+# Kept as a module-level knob here (tests/config patch it); the canonical
+# default lives in geomesa_tpu.ingest.splits.
+SPLIT_BYTES = _splits.SPLIT_BYTES
 
 
 def plan_splits(
     paths: Sequence[str], fmt: str, split_bytes: int | None = None
 ) -> list[Split]:
-    """Input files -> mapper splits. Only delimited files split mid-file
-    (line-oriented); JSON/XML/Avro documents stay whole."""
+    """Input files -> mapper splits (see ingest.splits.plan_splits).
+    Defaults to THIS module's patchable ``SPLIT_BYTES``."""
     if split_bytes is None:
         split_bytes = SPLIT_BYTES  # read at call time so tests/config can tune
-    out: list[Split] = []
-    for path in paths:
-        size = os.path.getsize(path)
-        if fmt != "delimited" or size <= split_bytes:
-            out.append(Split(path, 0, size, True))
-            continue
-        with open(path, "rb") as fh:
-            start = 0
-            while start < size:
-                end = min(start + split_bytes, size)
-                if end < size:  # advance to the next line boundary
-                    fh.seek(end)
-                    fh.readline()
-                    end = fh.tell()
-                out.append(Split(path, start, end, start == 0))
-                start = end
-    return out
+    return _splits.plan_splits(paths, fmt, split_bytes)
 
 
 def _run_split(cfg: ConverterConfig, split: Split):
     """Mapper: parse one split -> (FeatureCollection, n_errors)."""
-    conv = cfg.build()
-    if not split.skip_header:
-        conv.skip_lines = 0
-    with open(split.path, "rb") as fh:
-        fh.seek(split.start)
-        data = fh.read(split.end - split.start)
-    fc = conv.convert(data)
-    return fc, conv.errors
-
-
-@dataclass
-class IngestResult:
-    written: int = 0
-    errors: int = 0
-    splits: int = 0
+    return _splits.run_split(cfg, split)
 
 
 def ingest_files(
     store,
-    converter: Converter,
+    converter,
     paths: Sequence[str],
     workers: Optional[int] = None,
     id_prefix_splits: bool = True,
 ) -> IngestResult:
     """Convert ``paths`` with a pool of worker processes and write the
-    results to ``store``. ``workers=0/1`` runs in-process (the reference's
-    local ingest mode). ``id_prefix_splits`` namespaces running-index
-    feature ids per split so converters without an id expression don't
-    collide across splits."""
+    results to ``store`` split by split. ``workers=0/1`` runs in-process
+    (the reference's local ingest mode). ``id_prefix_splits`` namespaces
+    running-index feature ids per split so converters without an id
+    expression don't collide across splits."""
     cfg = ConverterConfig.of(converter)
     type_name = converter.sft.name
     splits = plan_splits(paths, converter.fmt)
@@ -161,41 +92,33 @@ def ingest_files(
         else 0
     )
 
-    def commit(fc, errors):
+    def commit(res):
         nonlocal base
+        idx, fc, errors, _parse_s, failure = res
+        if failure is not None:
+            raise_split_failure(failure, splits)
+        result.split_errors.append(errors)
         result.errors += errors
         if len(fc) == 0:
             return
         if id_prefix_splits and converter.id_field is None:
-            # running-index ids restart per split AND per run: rebase onto
-            # the store's row count (same semantics as the sequential CLI
-            # path), so repeat ingests and multi-split inputs never collide
-            import numpy as np
-
-            fc = FeatureCollection(
-                fc.sft,
-                np.arange(base, base + len(fc)).astype(str),
-                fc.columns,
-            )
+            fc = rebase_ids(fc, base)
             base += len(fc)
         result.written += store.write(type_name, fc)
 
+    tasks = [(cfg, sp, i) for i, sp in enumerate(splits)]
     if workers <= 1 or len(splits) <= 1:
-        for sp in splits:
-            fc, errors = _run_split(cfg, sp)
-            commit(fc, errors)
+        for t in tasks:
+            commit(run_split_guarded(t))
         return result
 
     import multiprocessing as mp
 
     ctx = mp.get_context("fork")
     with ctx.Pool(workers) as pool:
-        # imap streams results in split order: commits overlap conversion
-        # and only ~workers results are in flight (not the whole dataset)
-        for fc, errors in pool.imap(_run_split_star, [(cfg, sp) for sp in splits]):
-            commit(fc, errors)
+        # imap streams results in SPLIT order: commits overlap conversion,
+        # only ~workers results are in flight (not the whole dataset), and
+        # error aggregation is deterministic whatever order workers finish
+        for res in pool.imap(run_split_guarded, tasks):
+            commit(res)
     return result
-
-
-def _run_split_star(args):
-    return _run_split(*args)

@@ -31,11 +31,17 @@ class IndexAdapter(Protocol):
         keys: WriteKeys,
         old=None,
         main_rows: int = 0,
+        sorted_state=None,
     ):
         """Build (or incrementally update from ``old``) the scan surface
         for one index. ``old`` is this adapter's previous table for the
         index (or None); ``main_rows`` is the row count ``old`` was built
-        from — rows past it in ``keys`` are the freshly-compacted delta."""
+        from — rows past it in ``keys`` are the freshly-compacted delta.
+        ``sorted_state``: an optional precomputed stable (bin, z) argsort
+        of ``keys`` (the pipelined ingest's merged runs) — adapters may
+        use it to skip their own sort; ignoring it is always correct.
+        DataStore only passes it to adapters whose signature accepts it,
+        so implementations predating the kwarg keep working."""
         ...
 
     def delete_table(self, table) -> None:
@@ -65,7 +71,9 @@ class InProcessAdapter:
         self.tile = tile
         self.generations = None  # set by DataStore.attach_cache
 
-    def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
+    def create_table(
+        self, keyspace, keys, old=None, main_rows: int = 0, sorted_state=None
+    ):
         from geomesa_tpu.storage.table import IndexTable, merged_table
 
         # table builds are pure functions of (keyspace, keys), so a
@@ -79,7 +87,15 @@ class InProcessAdapter:
             if self.mesh is not None:
                 from geomesa_tpu.parallel import DistributedIndexTable
 
+                # mesh tables re-sort (their deal layout derives from the
+                # sort anyway); ignoring sorted_state is correct
                 return DistributedIndexTable(keyspace, keys, self.mesh, **kwargs)
+            if sorted_state is not None and len(sorted_state) == len(keys.zs) > 0:
+                # the pipelined ingest already merged the stable (bin, z)
+                # order: build straight from it, no radix sort
+                return IndexTable(
+                    keyspace, keys, sorted_state=sorted_state, **kwargs
+                )
             if (
                 isinstance(old, IndexTable)
                 and old.n == main_rows
@@ -107,10 +123,10 @@ class HostTable(object):
     implementation, proving the SPI seam: DataStore/planner code runs
     unmodified against it."""
 
-    def __init__(self, keyspace, keys: WriteKeys, tile=None):
+    def __init__(self, keyspace, keys: WriteKeys, tile=None, sorted_state=None):
         from geomesa_tpu.storage.table import SortedKeys
 
-        self._sk = SortedKeys(keyspace, keys, tile or 0)
+        self._sk = SortedKeys(keyspace, keys, tile or 0, sorted_state=sorted_state)
         self.keyspace = keyspace
         # sorted host copies of the predicate columns
         self._cols = {
@@ -223,10 +239,16 @@ class HostAdapter:
     def __init__(self, tile=None):
         self.tile = tile
 
-    def create_table(self, keyspace, keys, old=None, main_rows: int = 0):
+    def create_table(
+        self, keyspace, keys, old=None, main_rows: int = 0, sorted_state=None
+    ):
         def attempt():
             fault_point("adapter.create_table")
-            return HostTable(keyspace, keys, tile=self.tile)
+            if sorted_state is not None and len(sorted_state) != len(keys.zs):
+                return HostTable(keyspace, keys, tile=self.tile)
+            return HostTable(
+                keyspace, keys, tile=self.tile, sorted_state=sorted_state
+            )
 
         return with_retries(attempt)
 

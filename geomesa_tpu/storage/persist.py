@@ -478,8 +478,17 @@ def load(root: str, on_damage: str = "quarantine", **store_kwargs):
             pieces = _load_legacy_type(root, name, sft, info)
         pieces = [p for p in pieces if len(p)]
         if pieces:
+            # one batch through the staged ingest pipeline: key encoding
+            # and the (bin, z) sorts for the different indexes run on
+            # worker threads in parallel, and the pre-merged sort feeds
+            # the table build directly (a single chunk keeps the stats
+            # fold identical to the old single-write path)
+            from geomesa_tpu.ingest import BulkLoader
+
             fc = pieces[0] if len(pieces) == 1 else FeatureCollection.concat(pieces)
-            store.write(name, fc, check_ids=False)
+            loader = BulkLoader(store, name, check_ids=False)
+            loader.put(fc)
+            loader.close()
     store.health = health
     cache = getattr(store, "cache", None)
     if cache is not None:

@@ -319,9 +319,8 @@ class IndexTable(SortedKeys):
         n_blocks = self._round_blocks(max(1, -(-self.n // block)))
         self.n_blocks = n_blocks
         self.n_pad = n_blocks * block
-        cols = self.pad_cols(keys, self.n_pad)
-        self.col_names = tuple(sorted(cols))
-        self.extent = "gxmin" in cols
+        self.col_names = tuple(sorted(keys.device_cols))
+        self.extent = "gxmin" in keys.device_cols
         # projection accounting for the most recent kernel call
         self.last_scan_cols: tuple = ()
         self.last_scan_bytes = 0
@@ -329,7 +328,16 @@ class IndexTable(SortedKeys):
         # compaction keeps every device block before the first insertion
         # point and uploads only the changed suffix
         self._reuse = reuse
-        self._place_cols(cols, device)
+        if type(self)._place_cols is IndexTable._place_cols:
+            # bounded-memory build: sort-gather each column in
+            # block-aligned spans and upload it before touching the next —
+            # host peak is ONE padded column, never a second full copy of
+            # the column set (the 1B compaction OOM; docs/ingest.md)
+            self._stream_cols(keys, device)
+        else:
+            # subclasses (the distributed table) own their layout via the
+            # whole-dict hook; they get the classic padded column set
+            self._place_cols(self.pad_cols(keys, self.n_pad), device)
 
     # -- layout hooks ----------------------------------------------------
     def _round_blocks(self, n_blocks: int) -> int:
@@ -346,6 +354,17 @@ class IndexTable(SortedKeys):
         multiple of its own size in pad slots."""
         return min(FUSED_CHUNK_SLOTS, bk.bucket_of(self.n_blocks))
 
+    def _reuse_prefix(self, col_names) -> tuple:
+        """(old table, first reusable block count) from ``self._reuse``,
+        or (None, 0) when nothing can be reused."""
+        if self._reuse is not None:
+            cand, first_row = self._reuse
+            if cand.block == self.block and set(cand.col_names) == set(col_names):
+                return cand, min(
+                    first_row // self.block, cand.n_blocks, self.n_blocks
+                )
+        return None, 0
+
     def _place_cols(self, cols: dict, device) -> None:
         """Put the padded columns on device in the [n_blocks, SUB, 128]
         scan layout. With ``self._reuse`` set, device blocks before the
@@ -354,13 +373,7 @@ class IndexTable(SortedKeys):
         import jax
         import jax.numpy as jnp
 
-        old = None
-        first_block = 0
-        if self._reuse is not None:
-            cand, first_row = self._reuse
-            if cand.block == self.block and set(cand.col_names) == set(cols):
-                old = cand
-                first_block = min(first_row // self.block, old.n_blocks, self.n_blocks)
+        old, first_block = self._reuse_prefix(set(cols))
         self.rows_uploaded = (self.n_blocks - first_block) * self.block
         self.cols3 = {}
         for k, v in cols.items():
@@ -370,6 +383,43 @@ class IndexTable(SortedKeys):
                 self.cols3[k] = jnp.concatenate([old.cols3[k][:first_block], suffix])
             else:
                 self.cols3[k] = jax.device_put(v3, device) if device else jax.device_put(v3)
+
+    def _stream_cols(self, keys: WriteKeys, device) -> None:
+        """Bounded-memory `_place_cols`: build and upload the sorted
+        padded columns ONE AT A TIME, gathering each through block-aligned
+        spans of ``geomesa.tpu.compact.span.rows`` rows, and release the
+        host copy before the next column starts. The classic path
+        materialized every sorted column simultaneously — at 1B rows that
+        is a second full copy of the column set next to the unsorted
+        source, which OOM'd a 125 GB host (ISSUE 4; docs/ingest.md).
+        Keeps the merge-compaction suffix reuse: with ``self._reuse`` set,
+        only rows past the first changed block are gathered/uploaded."""
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.conf import COMPACT_SPAN_ROWS
+
+        old, first_block = self._reuse_prefix(set(keys.device_cols))
+        self.rows_uploaded = (self.n_blocks - first_block) * self.block
+        lo = first_block * self.block  # first sorted row to (re)build
+        span = max(self.block, (COMPACT_SPAN_ROWS.get() // self.block) * self.block)
+        self.cols3 = {}
+        for k in self.col_names:
+            col = keys.device_cols[k]
+            out = np.empty(self.n_pad - lo, dtype=col.dtype)
+            for s in range(lo, self.n, span):
+                e = min(s + span, self.n)
+                out[s - lo : e - lo] = _take(col, self.perm[s:e])
+            out[self.n - lo :] = _SENTINELS[k]  # pad rows never match
+            v3 = out.reshape(self.n_blocks - first_block, self.sub, bk.LANES)
+            suffix = jax.device_put(v3, device) if device else jax.device_put(v3)
+            if old is not None and first_block > 0:
+                self.cols3[k] = jnp.concatenate(
+                    [old.cols3[k][:first_block], suffix]
+                )
+            else:
+                self.cols3[k] = suffix
+            del out, v3, suffix
 
     # -- scanning --------------------------------------------------------
     def candidate_blocks(self, spans: list[tuple[int, int]]) -> np.ndarray:

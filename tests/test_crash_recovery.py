@@ -677,3 +677,140 @@ class TestCacheQuarantineInterplay:
         assert cache.generations.stale(
             "t", KeyRange.everything(), tick
         )
+
+
+class TestPipelineFaultAtomicity:
+    """Fault injection in the staged ingest pipeline (docs/ingest.md):
+    an io_error/crash in ANY worker stage fails the ingest atomically —
+    no partial table visible, `_quarantine/` untouched."""
+
+    def _sft(self):
+        return FeatureType.from_spec("t", SPEC)
+
+    def _chunks(self, n_chunks=4, n=300):
+        sft = self._sft()
+        rng = np.random.default_rng(5)
+        out, base = [], 0
+        for _ in range(n_chunks):
+            out.append(FeatureCollection.from_columns(
+                sft, [f"f{base + i}" for i in range(n)],
+                {"name": np.array(["x"] * n),
+                 "dtg": T0 + rng.integers(0, 80 * 86_400_000, n),
+                 "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+            ))
+            base += n
+        return out
+
+    def _assert_untouched(self, ds, root=None):
+        assert ds.count("t") == 0
+        assert ds._chunks["t"] == []
+        assert ("t", "z3") not in ds._tables
+        assert ds.stats_for("t") is None
+        if root is not None:
+            assert not os.path.exists(os.path.join(str(root), "_quarantine"))
+
+    @pytest.mark.parametrize("point,kind", [
+        ("ingest.keys", "io_error"),
+        ("ingest.keys", "crash"),
+        ("ingest.sort", "io_error"),
+        ("ingest.sort", "crash"),
+        ("ingest.commit", "crash"),
+        ("ingest.finalize", "io_error"),
+    ])
+    def test_stage_fault_aborts_atomically(self, tmp_path, point, kind):
+        from geomesa_tpu.fault import InjectedCrash, InjectedIOError
+        from geomesa_tpu.ingest import BulkLoader, PipelineConfig
+
+        ds = DataStore()
+        ds.create_schema(self._sft())
+        loader = BulkLoader(ds, "t", config=PipelineConfig(workers=2))
+        expected = InjectedCrash if kind == "crash" else InjectedIOError
+        with fault.inject(point, kind=kind):
+            with pytest.raises((expected, RuntimeError)):
+                for fc in self._chunks():
+                    loader.put(fc)
+                loader.close()
+        self._assert_untouched(ds, tmp_path)
+
+    def test_worker_fault_then_clean_retry_succeeds(self):
+        """After an aborted ingest the store accepts a fresh bulk load of
+        the same rows (nothing half-registered blocks the retry)."""
+        from geomesa_tpu.fault import InjectedIOError
+        from geomesa_tpu.ingest import BulkLoader, PipelineConfig
+
+        ds = DataStore()
+        ds.create_schema(self._sft())
+        chunks = self._chunks()
+        loader = BulkLoader(ds, "t", config=PipelineConfig(workers=2))
+        with fault.inject("ingest.sort", kind="io_error"):
+            with pytest.raises((InjectedIOError, RuntimeError)):
+                for fc in chunks:
+                    loader.put(fc)
+                loader.close()
+        self._assert_untouched(ds)
+        loader = BulkLoader(ds, "t", config=PipelineConfig(workers=2))
+        for fc in chunks:
+            loader.put(fc)
+        res = loader.close()
+        assert res.written == sum(len(c) for c in chunks) == ds.count("t")
+
+    def test_file_ingest_split_read_fault_atomic(self, tmp_path):
+        """Exhausted split-read retries (every-hit io_error at
+        ingest.split.read) abort the PIPELINED file ingest atomically and
+        surface the worker traceback."""
+        from geomesa_tpu import ingest as ing
+        from geomesa_tpu.io.converters import Converter, FieldSpec
+
+        p = tmp_path / "d.csv"
+        p.write_text("name,lon,lat,when\n" + "".join(
+            f"r{i},{i % 60},{i % 40},2024-02-01T00:00:00Z\n" for i in range(200)
+        ))
+        sft = FeatureType.from_spec(
+            "t", "name:String,dtg:Date,*geom:Point:srid=4326"
+        )
+        conv = Converter(
+            sft=sft, fmt="delimited", skip_lines=1, id_field="$1",
+            fields=[FieldSpec("name", "$1"), FieldSpec("geom", "point($2, $3)"),
+                    FieldSpec("dtg", "datetime($4)")],
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        os.environ["GEOMESA_TPU_IO_BACKOFF_S"] = "0.001"
+        try:
+            with fault.inject("ingest.split.read", kind="io_error", times=None):
+                with pytest.raises(ing.IngestError) as ei:
+                    ing.ingest_files(ds, conv, [str(p)], workers=0)
+        finally:
+            os.environ.pop("GEOMESA_TPU_IO_BACKOFF_S", None)
+        assert "InjectedIOError" in str(ei.value)
+        assert ei.value.split_index == 0
+        assert ds.count("t") == 0
+        assert not os.path.exists(str(tmp_path / "_quarantine"))
+
+    def test_transient_split_read_fault_is_retried(self, tmp_path):
+        """ONE io_error at the split read is absorbed by with_retries:
+        the ingest completes with every row."""
+        from geomesa_tpu import ingest as ing
+        from geomesa_tpu.io.converters import Converter, FieldSpec
+
+        p = tmp_path / "d.csv"
+        p.write_text("name,lon,lat,when\n" + "".join(
+            f"r{i},{i % 60},{i % 40},2024-02-01T00:00:00Z\n" for i in range(50)
+        ))
+        sft = FeatureType.from_spec(
+            "t", "name:String,dtg:Date,*geom:Point:srid=4326"
+        )
+        conv = Converter(
+            sft=sft, fmt="delimited", skip_lines=1, id_field="$1",
+            fields=[FieldSpec("name", "$1"), FieldSpec("geom", "point($2, $3)"),
+                    FieldSpec("dtg", "datetime($4)")],
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        os.environ["GEOMESA_TPU_IO_BACKOFF_S"] = "0.001"
+        try:
+            with fault.inject("ingest.split.read", kind="io_error", times=1):
+                res = ing.ingest_files(ds, conv, [str(p)], workers=0)
+        finally:
+            os.environ.pop("GEOMESA_TPU_IO_BACKOFF_S", None)
+        assert res.written == 50 == ds.count("t")

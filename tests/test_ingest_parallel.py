@@ -135,3 +135,91 @@ class TestParallelIngest:
         finally:
             ing.SPLIT_BYTES = old
         assert res.written == 2000 == ds.count("ing")  # no id collisions
+
+
+class TestSplitErrorAggregation:
+    """The multiprocessing split path aggregates per-split errors
+    deterministically (ordered by SPLIT, not worker completion) and
+    surfaces worker tracebacks instead of swallowing them."""
+
+    def _files_with_known_errors(self, tmp_path):
+        """Three files with 0 / 1 / 2 bad rows respectively (ids unique
+        across files)."""
+        paths = []
+        for i, n_bad in enumerate((0, 1, 2)):
+            rows = [
+                f"g{i}_{j},1.0,10,10,2024-02-01T00:00:00Z\n" for j in range(20)
+            ] + [
+                f"b{i}_{j},NOT_A_NUMBER,10,10,2024-02-01T00:00:00Z\n"
+                for j in range(n_bad)
+            ]
+            p = tmp_path / f"f{i}.csv"
+            p.write_text("name,val,lon,lat,when\n" + "".join(rows))
+            paths.append(str(p))
+        return paths
+
+    def test_split_errors_ordered_by_split(self, tmp_path):
+        paths = self._files_with_known_errors(tmp_path)
+        conv = _converter()
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        res = ingest_files(ds, conv, paths, workers=3)
+        assert res.split_errors == [0, 1, 2]  # split order, always
+        assert res.errors == 3
+        assert res.written == 60 == ds.count("ing")
+
+    def test_split_errors_ordered_pipelined(self, tmp_path):
+        from geomesa_tpu.ingest import ingest_files as pipelined_ingest
+
+        paths = self._files_with_known_errors(tmp_path)
+        conv = _converter()
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        res = pipelined_ingest(ds, conv, paths, workers=3)
+        assert res.split_errors == [0, 1, 2]
+        assert res.errors == 3
+        assert res.written == 60 == ds.count("ing")
+        assert res.stage_seconds["keys"] > 0  # stage attribution exists
+
+    def test_worker_traceback_surfaced(self, tmp_path):
+        """A worker whose converter RAISES (drop_errors=False on a bad
+        record) surfaces IngestError carrying the worker's formatted
+        traceback and the failing split's index — not a bare exception
+        with the forked stack lost."""
+        from geomesa_tpu.ingest import IngestError
+
+        p1 = _write_csv(tmp_path / "ok.csv", 50, 1)
+        p2 = tmp_path / "bad.csv"
+        p2.write_text(
+            "name,val,lon,lat,when\n"
+            "z1,NOT_A_NUMBER,10,10,2024-02-01T00:00:00Z\n"
+        )
+        conv = _converter()
+        conv.drop_errors = False
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        with pytest.raises(IngestError) as ei:
+            ingest_files(ds, conv, [p1, str(p2)], workers=2)
+        assert ei.value.split_index == 1
+        assert ei.value.worker_traceback  # the worker-side stack
+        assert "Traceback" in ei.value.worker_traceback
+
+    def test_pipelined_matches_classic_rows(self, tmp_path):
+        """Both drivers over the same multi-split file ingest the same
+        row set."""
+        from geomesa_tpu.ingest import ingest_files as pipelined_ingest
+
+        p = _write_csv(tmp_path / "big.csv", 3000, 8)
+        ds1 = DataStore()
+        ds1.create_schema(_converter().sft)
+        r1 = ingest_files(ds1, _converter(), [p], workers=2)
+        ds2 = DataStore()
+        ds2.create_schema(_converter().sft)
+        r2 = pipelined_ingest(
+            ds2, _converter(), [p], workers=2, split_bytes=16 << 10
+        )
+        assert r1.written == r2.written == 3000
+        assert r2.splits > 1
+        assert sorted(ds1.features("ing").ids.tolist()) == sorted(
+            ds2.features("ing").ids.tolist()
+        )

@@ -1,0 +1,337 @@
+"""Staged multi-core ingest pipeline (geomesa_tpu.ingest): differential
+equivalence vs the sequential write path under adversarial chunk
+boundaries, the sharded sort's bit-exact stable merge, backpressure, and
+bulk loads into non-empty stores."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.ingest import BulkLoader, PipelineConfig
+from geomesa_tpu.ingest import sort as shsort
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.storage import persist
+
+SPEC = "name:String,val:Double,dtg:Date,*geom:Point:srid=4326"
+T0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+DAY = 86_400_000
+
+
+def _sft():
+    return FeatureType.from_spec("p", SPEC)
+
+
+def _fc(sft, ids, n, seed, day_lo=0, day_hi=40):
+    rng = np.random.default_rng(seed)
+    return FeatureCollection.from_columns(
+        sft, ids,
+        {
+            "name": np.array([f"n{i % 7}" for i in range(n)]),
+            "val": rng.uniform(0, 1, n),
+            "dtg": T0 + rng.integers(day_lo * DAY, day_hi * DAY, n),
+            "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n)),
+        },
+    )
+
+
+def _chunks(sizes, seed=0, **kw):
+    """One FeatureCollection per size (0 = an empty chunk), globally
+    unique ids in chunk order."""
+    sft = _sft()
+    out, base = [], 0
+    for j, n in enumerate(sizes):
+        ids = [f"f{base + i}" for i in range(n)]
+        out.append(_fc(sft, ids, n, seed + j, **kw))
+        base += n
+    return out
+
+
+def _seq_store(chunks):
+    ds = DataStore()
+    ds.create_schema(_sft())
+    for fc in chunks:
+        ds.write("p", FeatureCollection(ds.get_schema("p"), fc.ids, fc.columns))
+    ds.compact("p")
+    return ds
+
+
+def _pipe_store(chunks, workers=3, **cfg_kw):
+    ds = DataStore()
+    ds.create_schema(_sft())
+    loader = BulkLoader(
+        ds, "p", config=PipelineConfig(workers=workers, **cfg_kw)
+    )
+    for fc in chunks:
+        loader.put(FeatureCollection(ds.get_schema("p"), fc.ids, fc.columns))
+    loader.close()
+    return ds
+
+
+def _assert_tables_identical(a, b, type_name="p"):
+    names = {n for (t, n) in a._tables if t == type_name}
+    assert names == {n for (t, n) in b._tables if t == type_name}
+    for n in names:
+        ta, tb = a._tables[(type_name, n)], b._tables[(type_name, n)]
+        assert ta.n == tb.n and ta.block == tb.block
+        assert ta.n_blocks == tb.n_blocks
+        assert np.array_equal(ta.bins, tb.bins), n
+        assert np.array_equal(ta.zs, tb.zs), n
+        assert np.array_equal(np.asarray(ta.perm), np.asarray(tb.perm)), n
+        for k in ta.col_names:
+            assert np.array_equal(
+                np.asarray(ta.cols3[k]), np.asarray(tb.cols3[k])
+            ), (n, k)
+    sa, sb = a.stats_for(type_name), b.stats_for(type_name)
+    assert json.dumps(sa.to_json(), default=str, sort_keys=True) == json.dumps(
+        sb.to_json(), default=str, sort_keys=True
+    )
+
+
+def _tree_bytes(root):
+    out = {}
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+class TestDifferentialEquivalence:
+    def test_adversarial_chunk_boundaries(self, tmp_path):
+        """Chunks smaller than a device block, chunks straddling many
+        bins, and empty chunks: the pipelined load's persisted store is
+        BYTE-identical to the sequential one, and the in-memory tables
+        (keys, perm, device blocks) and stats match bit for bit."""
+        # block is >= 4096 rows: 100-row chunks are far below one block;
+        # the 40-day dtg span straddles ~6 weekly z3 bins per chunk
+        sizes = [100, 0, 3000, 1, 0, 777, 2048, 5000, 17]
+        chunks = _chunks(sizes, seed=11)
+        seq = _seq_store(chunks)
+        pipe = _pipe_store(chunks, workers=3, chunk_rows=512, queue_depth=2)
+        _assert_tables_identical(seq, pipe)
+        assert seq.count("p") == pipe.count("p") == sum(sizes)
+        d1, d2 = tmp_path / "seq", tmp_path / "pipe"
+        persist.save(seq, str(d1))
+        persist.save(pipe, str(d2))
+        t1, t2 = _tree_bytes(d1), _tree_bytes(d2)
+        assert sorted(t1) == sorted(t2)
+        for name in t1:
+            assert t1[name] == t2[name], name
+
+    def test_lsd_fallback_bins_few_still_identical(self):
+        """All rows in ONE z3 bin (and z2 is always one bin): the §4f
+        fallback path (whole-table LSD at finalize, no span merge) must
+        produce the same tables too."""
+        chunks = _chunks([500, 1200, 300], seed=3, day_lo=2, day_hi=3)
+        seq = _seq_store(chunks)
+        pipe = _pipe_store(chunks, workers=2, chunk_rows=256)
+        _assert_tables_identical(seq, pipe)
+
+    def test_span_merge_forced_still_identical(self):
+        """merge_min_bins=1 forces the spanwise k-way merge even for
+        single-bin tables — exercises the merge on z2 as well."""
+        chunks = _chunks([900, 1100, 250, 800], seed=5)
+        seq = _seq_store(chunks)
+        pipe = _pipe_store(chunks, workers=2, chunk_rows=300, merge_min_bins=1)
+        _assert_tables_identical(seq, pipe)
+
+    def test_queries_match_sequential(self):
+        chunks = _chunks([2000, 1500, 2500], seed=7)
+        seq = _seq_store(chunks)
+        pipe = _pipe_store(chunks)
+        for q in (
+            "bbox(geom, -10, -10, 10, 10)",
+            "bbox(geom, -30, -20, 40, 30) AND dtg DURING "
+            "2024-01-03T00:00:00Z/2024-01-20T00:00:00Z",
+            "name = 'n3'",
+        ):
+            a, b = seq.query("p", q), pipe.query("p", q)
+            assert sorted(map(str, a.ids)) == sorted(map(str, b.ids))
+
+
+class TestSortMerge:
+    def test_merge_matches_stable_lexsort_with_ties(self):
+        """Deliberate duplicate (bin, z) keys across shards: the spanwise
+        merge must reproduce np.lexsort's STABLE order exactly."""
+        rng = np.random.default_rng(0)
+        n = 20_000
+        bins = rng.integers(0, 12, n).astype(np.int32)
+        zs = rng.integers(0, 50, n).astype(np.uint64)  # many ties
+        runs = []
+        for s in range(0, n, 1024):
+            runs.extend(
+                shsort.shard_runs(bins[s : s + 1024], zs[s : s + 1024], s, 400)
+            )
+        perm = shsort.merge_runs(runs)
+        expect = np.lexsort((zs, bins))
+        assert np.array_equal(perm, expect)
+
+    def test_merge_parallel_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        rng = np.random.default_rng(1)
+        n = 5000
+        bins = rng.integers(0, 30, n).astype(np.int32)
+        zs = rng.integers(0, 2**40, n).astype(np.uint64)
+        runs = shsort.shard_runs(bins, zs, 0, 700)
+        with ThreadPoolExecutor(4) as pool:
+            perm = shsort.merge_runs(runs, pool=pool)
+        assert np.array_equal(perm, np.lexsort((zs, bins)))
+
+    def test_single_run_passthrough(self):
+        bins = np.zeros(100, np.int32)
+        zs = np.arange(100, dtype=np.uint64)[::-1].copy()
+        runs = shsort.shard_runs(bins, zs, 10, 1000)
+        perm = shsort.merge_runs(runs)
+        assert np.array_equal(perm, 10 + np.lexsort((zs, bins)))
+
+
+class TestBulkLoader:
+    def test_bulk_into_non_empty_store(self):
+        """A bulk load appended to an existing table goes through the
+        normal delta compaction (presorted perms only cover the new rows)
+        and still matches the sequential result."""
+        first = _chunks([1500], seed=21)[0]
+        sft = _sft()
+        more = [
+            _fc(sft, [f"x{i}" for i in range(800)], 800, 22),
+            _fc(sft, [f"y{i}" for i in range(600)], 600, 23),
+        ]
+        seq = DataStore()
+        seq.create_schema(_sft())
+        seq.write("p", FeatureCollection(seq.get_schema("p"), first.ids, first.columns))
+        for fc in more:
+            seq.write("p", FeatureCollection(seq.get_schema("p"), fc.ids, fc.columns))
+        seq.compact("p")
+
+        pipe = DataStore()
+        pipe.create_schema(_sft())
+        pipe.write("p", FeatureCollection(pipe.get_schema("p"), first.ids, first.columns))
+        loader = BulkLoader(pipe, "p", config=PipelineConfig(workers=2))
+        for fc in more:
+            loader.put(FeatureCollection(pipe.get_schema("p"), fc.ids, fc.columns))
+        loader.close()
+        pipe.compact("p")
+        seq.compact("p")
+        _assert_tables_identical(seq, pipe)
+
+    def test_duplicate_ids_abort_atomically(self):
+        ds = DataStore()
+        ds.create_schema(_sft())
+        sft = ds.get_schema("p")
+        loader = BulkLoader(ds, "p")
+        loader.put(_fc(sft, [f"a{i}" for i in range(50)], 50, 1))
+        loader.put(_fc(sft, [f"a{i}" for i in range(30)], 30, 2))  # dup ids
+        with pytest.raises(ValueError, match="duplicate feature ids"):
+            loader.close()
+        # atomic: NOTHING was published
+        assert ds.count("p") == 0
+        assert ds._chunks["p"] == []
+        assert ("p", "z3") not in ds._tables
+
+    def test_backpressure_counter_and_peak_gauge(self):
+        reg = MetricsRegistry()
+        ds = DataStore(metrics=reg)
+        ds.create_schema(_sft())
+        sft = ds.get_schema("p")
+        loader = BulkLoader(
+            ds, "p", config=PipelineConfig(workers=1, queue_depth=1)
+        )
+        for j in range(6):
+            loader.put(_fc(sft, [f"c{j}_{i}" for i in range(2000)], 2000, j))
+        res = loader.close()
+        assert res.written == 12000
+        snap = reg.snapshot()
+        assert snap["counters"]["geomesa.ingest.rows"] == 12000
+        assert snap["counters"]["geomesa.ingest.chunks"] == 6
+        assert snap["counters"].get("geomesa.ingest.queue_full", 0) >= 1
+        assert snap["gauges"]["geomesa.ingest.chunk_bytes_peak"] > 0
+        for stage in ("keys", "sort", "finalize"):
+            assert snap["timers"][f"geomesa.ingest.{stage}"]["count"] >= 1
+        assert res.stage_seconds["keys"] > 0
+
+    def test_put_after_close_rejected(self):
+        ds = DataStore()
+        ds.create_schema(_sft())
+        loader = BulkLoader(ds, "p")
+        loader.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            loader.put(_fc(ds.get_schema("p"), ["q0"], 1, 0))
+
+    def test_empty_close_is_noop(self):
+        ds = DataStore()
+        ds.create_schema(_sft())
+        res = BulkLoader(ds, "p").close()
+        assert res.written == 0
+        assert ds.count("p") == 0
+
+
+class TestLoadUsesPipeline:
+    def test_save_load_roundtrip_exact(self, tmp_path):
+        """persist.load routes through the BulkLoader: the reloaded store
+        answers exactly like the original (and its stats survive)."""
+        chunks = _chunks([1200, 900], seed=31)
+        ds = _seq_store(chunks)
+        persist.save(ds, str(tmp_path / "s"))
+        back = persist.load(str(tmp_path / "s"))
+        assert back.count("p") == ds.count("p")
+        q = "bbox(geom, -15, -15, 15, 15)"
+        assert sorted(map(str, back.query("p", q).ids)) == sorted(
+            map(str, ds.query("p", q).ids)
+        )
+        assert back.stats_for("p").total_count() == ds.stats_for("p").total_count()
+
+
+class TestReviewRegressions:
+    def test_concurrent_producers_mint_disjoint_ordinals(self):
+        """Two threads put() concurrently: chunk base offsets must never
+        overlap (the sort permutation is built from them), and the final
+        table matches a sequential load of the same rows."""
+        import threading
+
+        ds = DataStore()
+        ds.create_schema(_sft())
+        sft = ds.get_schema("p")
+        loader = BulkLoader(ds, "p", config=PipelineConfig(workers=2))
+        per, n_chunks = 400, 10
+
+        def producer(tag):
+            for j in range(n_chunks):
+                loader.put(_fc(
+                    sft, [f"{tag}{j}_{i}" for i in range(per)], per,
+                    seed=hash(tag) % 1000 + j,
+                ))
+
+        threads = [
+            threading.Thread(target=producer, args=(t,)) for t in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        res = loader.close()
+        assert res.written == 2 * n_chunks * per == ds.count("p")
+        # every row is queryable exactly once (overlapping ordinals would
+        # duplicate some ids and drop others)
+        out = ds.query("p", "INCLUDE")
+        assert len(set(map(str, out.ids))) == 2 * n_chunks * per
+
+    def test_id_check_does_not_truncate_wide_ids(self):
+        """A store with short string ids must not reject a LONGER unique
+        id because of a fixed-width astype truncation ('12345' -> '123')."""
+        ds = DataStore()
+        ds.create_schema(_sft())
+        sft = ds.get_schema("p")
+        ds.write("p", _fc(sft, ["123", "ab"], 2, 1))
+        # int ids cast through the stored '<U3' dtype would truncate
+        # 12345 to '123' and spuriously collide
+        fc = _fc(sft, ["x1", "x2"], 2, 2)
+        fc = FeatureCollection(sft, np.array([12345, 67890]), fc.columns)
+        assert ds.write("p", fc) == 2
+        assert ds.count("p") == 4

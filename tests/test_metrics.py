@@ -34,6 +34,8 @@ def test_snapshot_and_render_under_concurrent_updates():
     stop = threading.Event()
     errors = []
 
+    progress = [0, 0, 0, 0]
+
     def writer(k):
         i = 0
         while not stop.is_set():
@@ -41,6 +43,7 @@ def test_snapshot_and_render_under_concurrent_updates():
             reg.gauge(f"g.{k}.{i % 50}", i)
             reg.timer_update(f"t.{k}.{i % 50}", 0.001)
             i += 1
+            progress[k] = i
 
     def reader():
         while not stop.is_set():
@@ -57,7 +60,12 @@ def test_snapshot_and_render_under_concurrent_updates():
         t.start()
     import time
 
-    time.sleep(0.5)
+    # progress-based stop (not a fixed wall time): every writer must have
+    # cycled all 50 names, or a loaded/2-core host starves one and the
+    # exact-count assertion below flakes
+    deadline = time.monotonic() + 10.0
+    while min(progress) < 50 and time.monotonic() < deadline:
+        time.sleep(0.02)
     stop.set()
     for t in threads:
         t.join()
@@ -115,3 +123,58 @@ def test_resolve_falls_back_to_global():
     assert resolve(None) is global_registry()
     reg = MetricsRegistry()
     assert resolve(reg) is reg
+
+
+def test_ingest_metrics_family_renders():
+    """The geomesa.ingest.* family (docs/ingest.md): counters, per-stage
+    timers, and the peak-chunk-bytes gauge all render through the
+    registry and the Prometheus exposition."""
+    reg = MetricsRegistry()
+    for c in ("geomesa.ingest.rows", "geomesa.ingest.chunks",
+              "geomesa.ingest.errors", "geomesa.ingest.queue_full"):
+        reg.counter(c, 2)
+    for t in ("parse", "keys", "sort", "commit", "finalize"):
+        reg.timer_update(f"geomesa.ingest.{t}", 0.01)
+    reg.gauge("geomesa.ingest.chunk_bytes_peak", 12345.0)
+    text = reg.render_prometheus()
+    assert "geomesa_ingest_rows 2" in text
+    assert "geomesa_ingest_queue_full 2" in text
+    assert "geomesa_ingest_chunk_bytes_peak 12345.0" in text
+    for t in ("parse", "keys", "sort", "commit", "finalize"):
+        assert f"geomesa_ingest_{t}_seconds_count 1" in text
+        assert f"geomesa_ingest_{t}_seconds_max" in text
+
+
+def test_ingest_pipeline_records_real_metrics():
+    """An actual pipelined bulk load populates the family: rows/chunks
+    counters, stage timers, and the chunk-bytes gauge."""
+    import numpy as np
+
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.ingest import BulkLoader, PipelineConfig
+    from geomesa_tpu.sft import FeatureType
+
+    reg = MetricsRegistry()
+    sft = FeatureType.from_spec("m", "dtg:Date,*geom:Point:srid=4326")
+    ds = DataStore(metrics=reg)
+    ds.create_schema(sft)
+    rng = np.random.default_rng(0)
+    t0 = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    loader = BulkLoader(ds, "m", config=PipelineConfig(workers=2))
+    for j in range(3):
+        n = 500
+        loader.put(FeatureCollection.from_columns(
+            sft, [f"c{j}_{i}" for i in range(n)],
+            {"dtg": t0 + rng.integers(0, 10 * 86_400_000, n),
+             "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+        ))
+    res = loader.close()
+    assert res.written == 1500
+    snap = reg.snapshot()
+    assert snap["counters"]["geomesa.ingest.rows"] == 1500
+    assert snap["counters"]["geomesa.ingest.chunks"] == 3
+    assert snap["gauges"]["geomesa.ingest.chunk_bytes_peak"] > 0
+    for stage in ("keys", "sort", "finalize"):
+        t = snap["timers"][f"geomesa.ingest.{stage}"]
+        assert t["count"] >= 1 and t["mean_s"] >= 0
