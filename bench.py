@@ -26,7 +26,8 @@ variants are keyed by shape bucket, not query values.
 Prints one JSON line per config, config 1 first. Env knobs:
 GEOMESA_BENCH_N (config-1 points), GEOMESA_BENCH_N2, GEOMESA_BENCH_N3,
 GEOMESA_BENCH_N4, GEOMESA_BENCH_N5, GEOMESA_BENCH_QUERIES,
-GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"), GEOMESA_BENCH_PLATFORM
+GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"; named scenarios "cache",
+"serving", "ingest", "fused"), GEOMESA_BENCH_PLATFORM
 (e.g. "cpu" for off-TPU verification). Supervisor knobs (see main()):
 GEOMESA_BENCH_INIT_TIMEOUT (child device-init watchdog, s),
 GEOMESA_BENCH_INIT_RETRIES (attempts), GEOMESA_BENCH_ATTEMPT_TIMEOUT
@@ -1028,6 +1029,195 @@ def config_serving(out_path: "str | None" = None):
     return rec
 
 
+# ----------------------------------------------------- fused scenario
+
+
+def config_fused(out_path: "str | None" = None):
+    """Fused-coverage scenario (docs/serving.md "Fused coverage",
+    PERF.md §12): the round-6 fusion tiers — (a) an XZ2 extent table's
+    box batch (wide-only plane layout), (b) a z2 polygon-INTERSECTS
+    batch through the fused device-PIP edge stacks, (c) a mesh-sharded
+    z2 box+polygon batch under shard_map (skipped below 2 devices) —
+    each timed FUSED (one `scan_submit_many` dispatch set) vs PER-QUERY
+    (serialized `scan_submit` dispatch+pull, what independent callers
+    pay), with bit-identity asserted between the paths on every leg.
+    Emits BENCH_FUSED.json next to this file (or at ``out_path``).
+    CPU-runnable. Env knobs: GEOMESA_BENCH_FUSED_N (rows per table),
+    GEOMESA_BENCH_FUSED_Q (queries per batch),
+    GEOMESA_BENCH_FUSED_REPEAT (timing repeats, best-of)."""
+    import jax
+
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.filter.predicates import BBox, Intersects
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_FUSED_N", 2_000_000))
+    n_q = int(os.environ.get("GEOMESA_BENCH_FUSED_Q", 32))
+    repeat = int(os.environ.get("GEOMESA_BENCH_FUSED_REPEAT", 5))
+    rng = np.random.default_rng(SEED + 80)
+
+    def star(cx, cy, r, n_arms=5):
+        a = np.linspace(0, 2 * np.pi, 2 * n_arms + 1)[:-1]
+        rad = np.where(np.arange(2 * n_arms) % 2 == 0, r, 0.4 * r)
+        return geo.Polygon(
+            [(cx + rr * np.cos(t), cy + rr * np.sin(t)) for t, rr in zip(a, rad)]
+        )
+
+    def time_paths(table, cfgs, label):
+        """(row dict) fused vs per-query dispatch over the same configs,
+        best-of-``repeat``, bit-identity asserted. Two baselines:
+        ``per_query_ms`` serializes dispatch+pull (what independent
+        callers pay); ``pipelined_ms`` dispatches every query before any
+        pull (the pre-round-6 scan_submit_many fallback these configs
+        used to take) — the honest "before" of the fusion PR."""
+        seq = [table.scan_submit(c)() for c in cfgs]  # warm single-query
+        fus = [f() for f in table.scan_submit_many(list(cfgs))]  # warm fused
+        identical = all(
+            np.array_equal(ra, rb) and np.array_equal(ca, cb)
+            for (ra, ca), (rb, cb) in zip(seq, fus)
+        )
+        assert identical, label  # recorded either way (python -O safe)
+        t_seq = min(
+            _timed(lambda: [table.scan_submit(c)() for c in cfgs])
+            for _ in range(repeat)
+        )
+        t_pipe = min(
+            _timed(lambda: [f() for f in [table.scan_submit(c) for c in cfgs]])
+            for _ in range(repeat)
+        )
+        t_fus = min(
+            _timed(lambda: [f() for f in table.scan_submit_many(list(cfgs))])
+            for _ in range(repeat)
+        )
+        row = {
+            "scenario": label,
+            "queries": len(cfgs),
+            "per_query_ms": round(t_seq / len(cfgs) * 1e3, 3),
+            "pipelined_ms": round(t_pipe / len(cfgs) * 1e3, 3),
+            "fused_ms": round(t_fus / len(cfgs) * 1e3, 3),
+            "speedup": round(t_seq / max(t_fus, 1e-9), 2),
+            "speedup_vs_pipelined": round(t_pipe / max(t_fus, 1e-9), 2),
+            "identical": identical,
+        }
+        log(
+            f"[fused] {label}: {row['per_query_ms']} ms/q per-query / "
+            f"{row['pipelined_ms']} ms/q pipelined vs "
+            f"{row['fused_ms']} ms/q fused = {row['speedup']}x "
+            f"({row['speedup_vs_pipelined']}x vs pipelined)"
+        )
+        return row
+
+    def _timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    rows = []
+
+    # -- (a) XZ2 extent box batch ---------------------------------------
+    log(f"[fused] building {n:,}-extent xz2 store ...")
+    ex0, ey0 = gdelt_points(n, rng)
+    exts = geo.PackedGeometryColumn.from_boxes(
+        ex0, ey0,
+        ex0 + rng.uniform(0.005, 0.5, n).astype(ex0.dtype),
+        ey0 + rng.uniform(0.005, 0.4, n).astype(ey0.dtype),
+    )
+    sft_x = FeatureType.from_spec("fx", "*geom:Polygon:srid=4326")
+    sft_x.user_data["geomesa.indices.enabled"] = "xz2"
+    ds = DataStore()
+    ds.create_schema(sft_x)
+    ds.write("fx", FeatureCollection.from_columns(
+        sft_x, np.arange(n), {"geom": exts}), check_ids=False)
+    idx = next(i for i in ds.indexes("fx") if i.name == "xz2")
+    qrng = np.random.default_rng(SEED + 81)
+
+    def small_box():
+        w = float(qrng.choice([0.5, 1.0, 2.0]))
+        qx = qrng.uniform(-170, 170 - w)
+        qy = qrng.uniform(-80, 80 - w / 2)
+        return BBox("geom", qx, qy, qx + w, qy + w / 2)
+
+    cfgs = [idx.scan_config(small_box()) for _ in range(n_q)]
+    rows.append(time_paths(ds.table("fx", "xz2"), cfgs, "xz2_box_batch"))
+
+    # -- (b) z2 polygon-INTERSECTS (device PIP) batch -------------------
+    log(f"[fused] building {n:,}-point z2 store ...")
+    px, py = gdelt_points(n, rng)
+    sft_p = FeatureType.from_spec("fp", "*geom:Point:srid=4326")
+    sft_p.user_data["geomesa.indices.enabled"] = "z2"
+    ds.create_schema(sft_p)
+    ds.write("fp", FeatureCollection.from_columns(
+        sft_p, np.arange(n), {"geom": (px, py)}), check_ids=False)
+    idx_p = next(i for i in ds.indexes("fp") if i.name == "z2")
+    cfgs = [
+        idx_p.scan_config(Intersects("geom", star(
+            float(qrng.uniform(-150, 150)), float(qrng.uniform(-70, 70)),
+            float(qrng.choice([0.5, 1.0, 2.0])),
+            n_arms=int(qrng.choice([4, 5, 8])),
+        )))
+        for _ in range(n_q)
+    ]
+    assert all(c is not None and c.poly is not None for c in cfgs)
+    rows.append(time_paths(ds.table("fp", "z2"), cfgs, "z2_polygon_pip_batch"))
+
+    # -- (c) mesh-sharded box+polygon batch -----------------------------
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        from geomesa_tpu.parallel import make_mesh
+
+        log(f"[fused] building mesh{n_dev} z2 store ...")
+        ds_m = DataStore(mesh=make_mesh(n_dev))
+        ds_m.create_schema(sft_p)
+        ds_m.write("fp", FeatureCollection.from_columns(
+            sft_p, np.arange(n), {"geom": (px, py)}), check_ids=False)
+        idx_m = next(i for i in ds_m.indexes("fp") if i.name == "z2")
+        cfgs = []
+        for k in range(n_q):
+            if k % 3 == 0:
+                cfgs.append(idx_m.scan_config(Intersects("geom", star(
+                    float(qrng.uniform(-150, 150)), float(qrng.uniform(-70, 70)),
+                    1.0,
+                ))))
+            else:
+                cfgs.append(idx_m.scan_config(small_box()))
+        rows.append(time_paths(
+            ds_m.table("fp", "z2"), cfgs, f"mesh{n_dev}_mixed_batch"
+        ))
+    else:
+        log("[fused] mesh leg skipped: single device")
+        rows.append({"scenario": "mesh_mixed_batch", "skipped": "single device"})
+
+    payload = {
+        "n_rows": n,
+        "queries_per_batch": n_q,
+        "platform": jax.default_backend(),
+        "rows": rows,
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_FUSED.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    timed = [r for r in rows if "speedup" in r]
+    rec = {
+        "metric": "fused_coverage_min_speedup",
+        "value": min(r["speedup"] for r in timed),
+        "unit": "x",
+        "min_vs_pipelined": min(r["speedup_vs_pipelined"] for r in timed),
+        "rows": rows,
+        "n_rows": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ------------------------------------------------------------- config 4
 
 
@@ -1204,6 +1394,7 @@ def child_main():
         "1": config1_z3, "2": config2_z2, "3": config3_xz2,
         "4": config4_join, "5": config5_knn, "cache": config_cache,
         "serving": config_serving, "ingest": config_ingest,
+        "fused": config_fused,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

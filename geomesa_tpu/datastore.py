@@ -35,17 +35,26 @@ from geomesa_tpu.storage.table import IndexTable
 _EXPIRY_UNITS_MS = {
     "millisecond": 1, "second": 1000, "minute": 60_000, "hour": 3_600_000,
     "day": 86_400_000, "week": 7 * 86_400_000,
+    # short forms the reference accepts via scala.concurrent.duration
+    # ("7 d", "24 h", "30 min", "90 s", "500 ms"): schemas migrated
+    # verbatim from GeoMesa keep parsing (docs/migration.md). "m" means
+    # minutes, matching Duration — checked EXACTLY before the plural
+    # strip below so "ms" can never collapse onto it.
+    "ms": 1, "s": 1000, "sec": 1000, "min": 60_000, "m": 60_000,
+    "h": 3_600_000, "d": 86_400_000, "w": 7 * 86_400_000,
 }
 
 
 def parse_expiry_ms(spec: str, dtg_field: str | None = None) -> int:
     """``geomesa.feature.expiry``-style duration -> milliseconds: a
-    plain integer (ms) or ``"<n> <unit>"`` with the reference's units
-    (``"7 days"``, ``"24 hours"``, ``"30 minutes"``, ...). An attribute
-    prefix like ``"dtg(7 days)"`` is accepted only when it names the
-    store's default time attribute (pass ``dtg_field`` to enforce):
-    age-off always sweeps by that attribute, so silently honoring a
-    DIFFERENT attribute's expiry would delete the wrong rows."""
+    plain integer (ms) or ``"<n> <unit>"`` with the reference's units,
+    long (``"7 days"``, ``"24 hours"``, ``"30 minutes"``, ...) or short
+    (``"7 d"``, ``"24 h"``, ``"30 min"``, ``"90 s"``, ``"500 ms"``). An
+    attribute prefix like ``"dtg(7 days)"`` is accepted only when it
+    names the store's default time attribute (pass ``dtg_field`` to
+    enforce): age-off always sweeps by that attribute, so silently
+    honoring a DIFFERENT attribute's expiry would delete the wrong
+    rows."""
     s = spec.strip()
     m = re.fullmatch(r"(\w+)\(([^)]+)\)", s)
     if m:
@@ -58,9 +67,15 @@ def parse_expiry_ms(spec: str, dtg_field: str | None = None) -> int:
         s = m.group(2).strip()
     if re.fullmatch(r"\d+", s):
         return int(s)
-    m = re.fullmatch(r"(\d+)\s*([a-zA-Z]+?)s?", s)
-    if m and m.group(2).lower() in _EXPIRY_UNITS_MS:
-        return int(m.group(1)) * _EXPIRY_UNITS_MS[m.group(2).lower()]
+    m = re.fullmatch(r"(\d+)\s*([a-zA-Z]+)", s)
+    if m:
+        unit = m.group(2).lower()
+        # exact unit first ("ms", "min", "s"), then the plural long form
+        # ("days" -> "day") — NEVER strip the 's' of a bare "s"/"ms"
+        if unit not in _EXPIRY_UNITS_MS and unit.endswith("s"):
+            unit = unit[:-1]
+        if unit in _EXPIRY_UNITS_MS:
+            return int(m.group(1)) * _EXPIRY_UNITS_MS[unit]
     raise ValueError(f"unparseable expiry spec: {spec!r}")
 
 
@@ -668,8 +683,20 @@ class DataStore:
 
         ``ttl_ms=None`` reads the schema's ``geomesa.feature.expiry``
         user-data key (the reference's age-off configuration key:
-        ``"7 days"``, ``"24 hours"``, ``"30 minutes"``, ``"90 seconds"``
-        or a plain millisecond count)."""
+        ``"7 days"``, ``"24 hours"``, ``"30 min"``, ``"90 s"`` or a
+        plain millisecond count).
+
+        DEVIATION from the reference (docs/migration.md "Feature
+        expiry"): GeoMesa's ``FeatureExpiration`` treats a PLAIN duration
+        spec as *ingest-time* expiry (``IngestTimeExpiration`` — rows age
+        out N ms after they were WRITTEN) and the ``dtg(7 days)``
+        attribute form as *attribute-based* expiry. This store does not
+        track ingest time, so BOTH forms sweep by the schema's time
+        attribute (attribute-based semantics). For the same plain spec
+        the two systems delete different rows: a recently-ingested
+        feature whose ``dtg`` is old is removed here but retained by the
+        reference until its ingest TTL lapses. Write the attribute form
+        ``dtg(7 days)`` to make the (identical) semantics explicit."""
         import time as _time
 
         sft = self._schemas[type_name]

@@ -55,6 +55,23 @@ M_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
 E_BUCKETS = (16, 32, 64, 128, 256)
 PALLAS_MAX_EDGES = 64  # above this the unrolled kernel gets too large
 
+# fused-chunk edge ladder (round 6): a fused multi-query chunk carries ONE
+# static [Q, E, 128] edge stack sized to its largest member polygon, so
+# the compile key stays (columns, flags, E bucket) — a deliberately
+# SMALLER ladder than E_BUCKETS (each entry is one more warmup compile
+# per flag combo). Chunks with no polygon member use E = 0, the exact
+# pre-PIP variant. pack_edges caps polygons at E_BUCKETS[-1], which is
+# also FUSED_E_BUCKETS[-1]: every packed polygon fits a fused bucket.
+FUSED_E_BUCKETS = (16, 64, 256)
+
+
+def fused_e_bucket(n: int) -> int:
+    """Static fused-chunk edge bucket: the smallest FUSED_E_BUCKETS entry
+    >= n, or 0 for a chunk with no polygon member."""
+    if n <= 0:
+        return 0
+    return next(b for b in FUSED_E_BUCKETS if n <= b)
+
 # column-set signatures -> ordered device column names
 POINT_COLS = ("x", "y")
 POINT_TIME_COLS = ("x", "y", "tbin", "toff")
@@ -550,14 +567,34 @@ def block_scan(
 # ------------------------------------------------ fused multi-query scan
 
 
-def _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, pack):
+def _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, pack, n_edges=0):
     n = len(col_names)
     skip = skip_inner_plane(has_boxes, extent)
 
-    def kernel(bids_ref, qids_ref, boxes_ref, wins_ref, *refs):
+    def kernel(bids_ref, qids_ref, *refs):
+        from jax.experimental import pallas as pl
+
         del bids_ref, qids_ref  # consumed by the index maps
+        if n_edges:
+            spip_ref, boxes_ref, wins_ref, edges_ref = refs[:4]
+            refs = refs[4:]
+        else:
+            boxes_ref, wins_ref = refs[:2]
+            refs = refs[2:]
         cols = {name: refs[k][0] for k, name in enumerate(col_names)}
         w, i = _masks(cols, boxes_ref[0], wins_ref[0], has_boxes, has_windows, extent)
+        if n_edges:
+            # PIP leg: the same _masks with this slot's query edge block —
+            # selected per SLOT by the scalar-prefetched spip flag, so box
+            # and polygon queries share one fused chunk (a box query's
+            # slot keeps the box leg; its zero-edge stack row is unused)
+            wp, ip = _masks(
+                cols, boxes_ref[0], wins_ref[0], has_boxes, has_windows,
+                extent, edges=edges_ref[0], n_edges=n_edges,
+            )
+            use_pip = spip_ref[pl.program_id(0)] > 0
+            w = jnp.where(use_pip, wp, w)
+            i = jnp.where(use_pip, ip, i)
         refs[n][0] = _pack_bits(w, pack)
         if not skip:
             refs[n + 1][0] = _pack_bits(i, pack)
@@ -567,16 +604,21 @@ def _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, pack):
 
 @partial(
     jax.jit,
-    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "interpret"),
+    static_argnames=(
+        "col_names", "has_boxes", "has_windows", "extent", "interpret", "n_edges"
+    ),
 )
 def _pallas_block_scan_multi(
-    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows,
-    extent, interpret,
+    cols3, bids, qids, boxes, wins, edges=None, spip=None, *, col_names,
+    has_boxes, has_windows, extent, interpret, n_edges=0,
 ):
     """Fused form of _pallas_block_scan: slot i scans block bids[i] against
     query qids[i]'s packed params (boxes/wins are [Q, 8, 128]). Two
     scalar-prefetch operands drive the index maps; everything else is the
-    single-query kernel per slot."""
+    single-query kernel per slot. With ``n_edges`` > 0 a third
+    scalar-prefetch operand ``spip`` ([M] i32, 1 = this slot's query runs
+    the PIP tier) and a [Q, n_edges, 128] ``edges`` stack (gathered per
+    slot by qid, like boxes/wins) add the fused point-in-polygon leg."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -584,61 +626,101 @@ def _pallas_block_scan_multi(
     SUB = cols3[0].shape[1]
     PACK = SUB // 32
     n_out = 1 if skip_inner_plane(has_boxes, extent) else 2
-    kernel = _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, PACK)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(M,),
-        in_specs=[
-            pl.BlockSpec((1, 8, LANES), lambda i, bids, qids: (qids[i], 0, 0)),
-            pl.BlockSpec((1, 8, LANES), lambda i, bids, qids: (qids[i], 0, 0)),
+    kernel = _make_pallas_kernel_multi(
+        col_names, has_boxes, has_windows, extent, PACK, n_edges
+    )
+    if n_edges:
+        by_q = lambda i, bids, qids, spip: (qids[i], 0, 0)  # noqa: E731
+        by_b = lambda i, bids, qids, spip: (bids[i], 0, 0)  # noqa: E731
+        by_i = lambda i, bids, qids, spip: (i, 0, 0)        # noqa: E731
+        n_prefetch = 3
+        param_specs = [
+            pl.BlockSpec((1, 8, LANES), by_q),
+            pl.BlockSpec((1, 8, LANES), by_q),
+            pl.BlockSpec((1, n_edges, LANES), by_q),
         ]
-        + [
-            pl.BlockSpec((1, SUB, LANES), lambda i, bids, qids: (bids[i], 0, 0))
-            for _ in col_names
-        ],
-        out_specs=[
-            pl.BlockSpec((1, PACK, LANES), lambda i, bids, qids: (i, 0, 0))
-        ] * n_out,
+        args = (bids, qids, spip, boxes, wins, edges)
+    else:
+        by_b = lambda i, bids, qids: (bids[i], 0, 0)        # noqa: E731
+        by_i = lambda i, bids, qids: (i, 0, 0)              # noqa: E731
+        by_q = lambda i, bids, qids: (qids[i], 0, 0)        # noqa: E731
+        n_prefetch = 2
+        param_specs = [
+            pl.BlockSpec((1, 8, LANES), by_q),
+            pl.BlockSpec((1, 8, LANES), by_q),
+        ]
+        args = (bids, qids, boxes, wins)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(M,),
+        in_specs=param_specs
+        + [pl.BlockSpec((1, SUB, LANES), by_b) for _ in col_names],
+        out_specs=[pl.BlockSpec((1, PACK, LANES), by_i)] * n_out,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32)] * n_out,
         interpret=interpret,
-    )(bids, qids, boxes, wins, *cols3)
+    )(*args, *cols3)
     return (out[0], None) if n_out == 1 else (out[0], out[1])
 
 
-@partial(jax.jit, static_argnames=("col_names", "has_boxes", "has_windows", "extent"))
+@partial(
+    jax.jit,
+    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "n_edges"),
+)
 def _xla_block_scan_multi(
-    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows, extent,
+    cols3, bids, qids, boxes, wins, edges=None, spip=None, *, col_names,
+    has_boxes, has_windows, extent, n_edges=0,
 ):
     """XLA fallback for the fused multi-query scan: gather each slot's
-    column block and params, vmap the single-block mask over slots."""
+    column block and params, vmap the single-block mask over slots. With
+    ``n_edges`` > 0 the per-slot edge block (``edges[qids]``) and the
+    ``spip`` selector add the PIP leg — the fori_loop PIP variant keeps
+    the HLO small at large E, exactly like the single-query XLA kernel."""
     PACK = cols3[0].shape[1] // 32
     gathered = tuple(c[bids] for c in cols3)
     bq, wq = boxes[qids], wins[qids]
     skip = skip_inner_plane(has_boxes, extent)
 
-    if skip:
-
-        def per_block_w(box, win, *colblk):
-            cols = dict(zip(col_names, colblk))
-            w, _ = _masks(cols, box, win, has_boxes, has_windows, extent)
-            return _pack_bits(w, PACK)
-
-        return jax.vmap(per_block_w)(bq, wq, *gathered), None
-
-    def per_block(box, win, *colblk):
+    def slot_masks(box, win, eb, sp, *colblk):
         cols = dict(zip(col_names, colblk))
         w, i = _masks(cols, box, win, has_boxes, has_windows, extent)
+        if n_edges:
+            wp, ip = _masks(
+                cols, box, win, has_boxes, has_windows, extent,
+                edges=eb, n_edges=n_edges, pip_loop=True,
+            )
+            w = jnp.where(sp > 0, wp, w)
+            i = jnp.where(sp > 0, ip, i)
+        return w, i
+
+    if n_edges:
+        eq, sq = edges[qids], spip
+    else:
+        # dummy per-slot operands so ONE vmapped body serves both shapes
+        eq = jnp.zeros((bids.shape[0], 1), jnp.float32)
+        sq = jnp.zeros(bids.shape[0], jnp.int32)
+
+    if skip:
+
+        def per_block_w(box, win, eb, sp, *colblk):
+            w, _ = slot_masks(box, win, eb, sp, *colblk)
+            return _pack_bits(w, PACK)
+
+        return jax.vmap(per_block_w)(bq, wq, eq, sq, *gathered), None
+
+    def per_block(box, win, eb, sp, *colblk):
+        w, i = slot_masks(box, win, eb, sp, *colblk)
         return _pack_bits(w, PACK), _pack_bits(i, PACK)
 
-    return jax.vmap(per_block)(bq, wq, *gathered)
+    return jax.vmap(per_block)(bq, wq, eq, sq, *gathered)
 
 
 def block_scan_multi(
-    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows, extent,
+    cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows,
+    extent, edges=None, spip=None, n_edges=0,
 ):
     """Fused multi-query scan (round 5): ONE kernel dispatch scans many
     queries' candidate blocks — slot i reads block ``bids[i]`` with query
@@ -647,29 +729,41 @@ def block_scan_multi(
     decode from its contiguous slot segment. Amortizes the per-dispatch
     overhead that serialized many-small-query workloads (the indexed
     spatial join's 256 per-polygon scans — BENCH_ALL_r05 config 4).
-    No PIP-edges support: polygon queries keep per-query dispatches.
 
-    Static compile key: (M bucket, Q bucket, col_names, flags). Callers
-    bucket Q with :func:`bucket_q` and M with :func:`pad_bids`.
+    PIP fusion (round 6): ``n_edges`` > 0 adds a [Q, n_edges, 128]
+    ``edges`` stack (pack_edges blocks zero-padded to the chunk's
+    FUSED_E_BUCKETS bucket) and a per-slot ``spip`` i32 selector — slots
+    whose query carries a polygon run the exact device point-in-polygon
+    tier, box-query slots keep the box test, all in the same dispatch.
+    Past PALLAS_MAX_EDGES the chunk rides the XLA variant (the unrolled
+    Pallas kernel gets too large), same as the single-query ladder.
+
+    Static compile key: (M bucket, Q stack height, col_names, flags,
+    n_edges). Production callers use the canonical fixed chunk shape —
+    ``IndexTable.fused_slots`` x FUSED_CHUNK_Q (storage.table) — so ONE
+    compiled variant per (columns, flags, E bucket) serves every batch;
+    :func:`bucket_q` is a test-only helper for hand-built param stacks.
     """
-    if use_pallas():
+    if use_pallas() and n_edges <= PALLAS_MAX_EDGES:
         interpret = jax.default_backend() != "tpu"
         return _pallas_block_scan_multi(
-            cols3, bids, qids, boxes, wins,
+            cols3, bids, qids, boxes, wins, edges, spip,
             col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
-            extent=extent, interpret=interpret,
+            extent=extent, interpret=interpret, n_edges=n_edges,
         )
     return _xla_block_scan_multi(
-        cols3, bids, qids, boxes, wins,
+        cols3, bids, qids, boxes, wins, edges, spip,
         col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
-        extent=extent,
+        extent=extent, n_edges=n_edges,
     )
 
 
 def bucket_q(q: int) -> int:
-    """Static Q bucket (query-count dimension of the packed param stacks):
-    power of two >= q, floor 8. Pad query rows are all-zero params no slot
-    references (pad slots carry qid 0 and are ignored at decode)."""
+    """Static Q bucket: power of two >= q, floor 8. TEST-ONLY — production
+    fused dispatches pad their param stacks to the canonical FUSED_CHUNK_Q
+    (storage.table._submit_fused_chunk); this helper sizes hand-built
+    stacks in kernel-level tests. Pad query rows are all-zero params no
+    slot references (pad slots carry qid 0 and are ignored at decode)."""
     m = 8
     while m < q:
         m *= 2

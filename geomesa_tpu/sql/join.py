@@ -320,9 +320,10 @@ def spatial_join_indexed(
         raise TypeError("indexed join requires a point store")
 
     lgeoms = left.geometries()
-    # ONE fused dispatch for all left geometries' scans (scan_submit_many
-    # groups box scans into shared kernel calls; PIP-edge polygon scans
-    # stay per-query but still all dispatch before any pull)
+    # ONE fused dispatch for all left geometries' scans: scan_submit_many
+    # groups box AND polygon-PIP scans into shared kernel chunks (the
+    # per-query edge stacks of round 6), so a polygon-heavy join pays
+    # O(chunks) dispatches instead of O(polygons)
     cfgs: list = []
     exacts: list[bool] = []
     for g in lgeoms:

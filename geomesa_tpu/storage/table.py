@@ -351,8 +351,30 @@ class IndexTable(SortedKeys):
         FUSED_CHUNK_SLOTS clamped down to the table's own block-count
         bucket (see the constants' doctrine note) — still one static
         shape per (columns, flags), but a small table never scans a
-        multiple of its own size in pad slots."""
+        multiple of its own size in pad slots. For the distributed table
+        this is the PER-DEVICE slot bucket."""
         return min(FUSED_CHUNK_SLOTS, bk.bucket_of(self.n_blocks))
+
+    @property
+    def fused_pack_capacity(self) -> int:
+        """Candidate-block capacity the chunk packer fills per fused
+        chunk. Equal to ``fused_slots`` on a single-device table; the
+        distributed table multiplies by the mesh size (its candidates
+        split round-robin across devices, each padded to ``fused_slots``
+        local slots)."""
+        return self.fused_slots
+
+    def _fused_supported(self) -> bool:
+        """Whether scan_submit_many may dispatch fused chunks on this
+        table: true for the base engine, and for subclasses that override
+        the device seam ONLY IF they also provide their own
+        ``_submit_fused_chunk`` (DistributedIndexTable's shard_map fused
+        dispatch) — otherwise the fused kernel would silently bypass the
+        subclass's device hooks."""
+        return (
+            type(self)._device_scan_submit is IndexTable._device_scan_submit
+            or type(self)._submit_fused_chunk is not IndexTable._submit_fused_chunk
+        )
 
     def _reuse_prefix(self, col_names) -> tuple:
         """(old table, first reusable block count) from ``self._reuse``,
@@ -527,8 +549,13 @@ class IndexTable(SortedKeys):
         Per-query dispatch overhead (~2 ms submit + serialized kernel
         launches) dominated many-small-query workloads: the indexed
         spatial join's 256 per-polygon scans spent ~2.1 s of which <10 ms
-        was host refinement (BENCH_ALL_r05 config 4). Ineligible configs
-        (PIP-edge polygons, pure range scans, empty/disjoint) fall back to
+        was host refinement (BENCH_ALL_r05 config 4). Round 6 widened
+        eligibility to EVERY kernel-backed config: polygon-INTERSECTS
+        members fuse through the chunk's [Q, E, 128] edge stack (the
+        device PIP tier, selected per slot), extent/XZ members fuse on
+        their wide-only plane, and the distributed table dispatches the
+        whole chunk under shard_map. Only pure range scans (row-exact, no
+        kernel) and empty/disjoint configs fall back to
         :meth:`scan_submit` per query, still dispatched before any pull.
 
         This is the TPU shape of the reference's server-side batch scans
@@ -538,10 +565,10 @@ class IndexTable(SortedKeys):
         hiding per-range latency, one kernel grid scans every (query,
         block) slot and the host decodes per-query segments.
         """
-        if type(self)._device_scan_submit is not IndexTable._device_scan_submit:
-            # subclass re-routes the device seam (DistributedIndexTable's
-            # shard_map scans): the fused kernel would bypass it — keep
-            # per-query dispatches, still pipelined
+        if not self._fused_supported():
+            # subclass re-routes the device seam without providing its own
+            # fused chunk dispatch: the fused kernel would bypass the seam
+            # — keep per-query dispatches, still pipelined
             return [self.scan_submit(c, deadline=deadline) for c in configs]
 
         n_q = len(configs)
@@ -555,9 +582,13 @@ class IndexTable(SortedKeys):
                 continue
             check_deadline(deadline, "range pruning")
             has_pred = config.boxes is not None or config.windows is not None
-            if not has_pred or (config.poly is not None and not self.extent):
-                # pure range scans and PIP-edge polygon scans keep the
-                # per-query path (edges are per-query kernel constants)
+            if not has_pred:
+                # pure range scans (attribute-index primaries) keep the
+                # per-query path: spans are row-exact, no kernel runs.
+                # PIP-edge polygon configs FUSE (round 6): their chunks
+                # carry a [Q, E, 128] edge stack and a per-slot selector,
+                # grouped per E bucket so polygon batches share dispatches
+                # without taxing box chunks with edge work
                 finishes[j] = self.scan_submit(config, deadline=deadline)
                 continue
             overlap, contained = self.candidate_spans_split(config)
@@ -569,12 +600,26 @@ class IndexTable(SortedKeys):
                 continue
             blocks = self._full_or(blocks)
             names = self._scan_cols(config)
-            key = (names, config.boxes is not None, config.windows is not None)
+            # the E bucket is part of the variant key: box queries group
+            # at E = 0 (their slots keep the round-5 zero-edge kernel
+            # cost and the Pallas path), polygons group per fused bucket
+            # — a 256-edge member must not inflate every box slot to
+            # 256-edge PIP work, nor demote the chunk past
+            # PALLAS_MAX_EDGES to the XLA variant, just to share one
+            # dispatch
+            e_bucket = (
+                0 if self.extent
+                else bk.fused_e_bucket(bk.n_edges_of(config.poly))
+            )
+            key = (
+                names, config.boxes is not None, config.windows is not None,
+                e_bucket,
+            )
             groups.setdefault(key, []).append((j, config, blocks, overlap, contained))
 
-        slots = self.fused_slots
-        for (names, has_boxes, has_windows), group_members in groups.items():
-            # pack members into fixed-shape chunks (fused_slots /
+        slots = self.fused_pack_capacity
+        for (names, has_boxes, has_windows, _e), group_members in groups.items():
+            # pack members into fixed-shape chunks (fused_pack_capacity /
             # FUSED_CHUNK_Q — see the constants' doctrine note). Broad
             # members (> half a chunk, e.g. _full_or expansions) dispatch
             # alone on the single-query bucket ladder; the rest pack
@@ -604,61 +649,49 @@ class IndexTable(SortedKeys):
 
         return finishes
 
-    def _submit_fused_chunk(
-        self, members, names, has_boxes, has_windows, finishes, deadline
-    ):
-        """Dispatch one fused chunk (scan_submit_many): single-member or
-        near-empty chunks take the plain single-query kernel (the fixed
-        2048-slot fused shape would waste most of its scan work on pads);
-        real batches share one block_scan_multi call and decode
-        per-member slot segments."""
-        import jax
-
-        slots = self.fused_slots
+    def _fused_route_single(self, members, finishes, deadline) -> bool:
+        """Route single-member / near-empty chunks to the plain
+        single-query kernel (the fixed fused shape would waste most of
+        its scan work on pads); returns True when routed. Shared by the
+        single-device and distributed fused dispatches."""
         if len(members) == 1 or (
             # near-empty AND few members: past a handful of queries the
             # per-dispatch overhead (~2 ms each) outweighs scanning the
             # canonical shape's pad slots (~ms), so larger chunks always
             # fuse even when sparse
             len(members) <= 8
-            and sum(len(m[2]) for m in members) < slots // 8
+            and sum(len(m[2]) for m in members) < self.fused_pack_capacity // 8
         ):
             for j, config, blocks, overlap, contained in members:
                 finishes[j] = self._make_finish(
                     self._device_scan_submit(blocks, config),
                     config, overlap, contained, deadline,
                 )
-            return
-        check_deadline(deadline, "device scan dispatch")
+            return True
+        return False
+
+    def _fused_param_stacks(self, members):
+        """(boxes, wins) [FUSED_CHUNK_Q, 8, 128] per-query param stacks
+        for one fused chunk — shared by the single-device and distributed
+        dispatches so the packing can never drift."""
         boxes = np.zeros((FUSED_CHUNK_Q, 8, bk.LANES), np.float32)
         wins = np.zeros((FUSED_CHUNK_Q, 8, bk.LANES), np.int32)
-        bid_parts: list[np.ndarray] = []
-        qid_parts: list[np.ndarray] = []
-        segs: list[tuple[int, int]] = []  # slot segment per member
-        pos = 0
-        for q, (j, config, blocks, _, _) in enumerate(members):
-            b, w = self._params(config)
-            boxes[q] = b
-            wins[q] = w
-            bid_parts.append(blocks.astype(np.int32))
-            qid_parts.append(np.full(len(blocks), q, np.int32))
-            segs.append((pos, pos + len(blocks)))
-            pos += len(blocks)
-        bids, n_real = bk.pad_bids(
-            np.concatenate(bid_parts), self.n_blocks, bucket=slots
-        )
-        self._record_scan(names, len(bids))
-        qids = np.zeros(len(bids), np.int32)
-        qids[:n_real] = np.concatenate(qid_parts)
-        wide, inner = bk.block_scan_multi(
-            self._cols_args(names), bids, qids, boxes, wins,
-            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
-            extent=self.extent,
-        )
+        for q, m in enumerate(members):
+            boxes[q], wins[q] = self._params(m[1])
+        return boxes, wins
+
+    @staticmethod
+    def _fused_pull(wide, inner):
+        """Start the async device->host copies for a fused chunk's planes
+        NOW (see _device_scan_submit on why) and return a memoized
+        ``group_pull() -> (wide_h, inner_h)``: the chunk pulls ONCE, on
+        its first member's finish, and members decode lazily. Shared by
+        the single-device and distributed dispatches."""
+        import jax
+
         for plane in (wide, inner):
             if plane is not None and hasattr(plane, "copy_to_host_async"):
                 plane.copy_to_host_async()
-
         pulled: dict = {}
 
         def group_pull():
@@ -669,6 +702,71 @@ class IndexTable(SortedKeys):
                     None if inner_h is None else np.asarray(inner_h),
                 )
             return pulled["planes"]
+
+        return group_pull
+
+    def _chunk_edge_stack(self, members):
+        """(chunk_E, edges [FUSED_CHUNK_Q, chunk_E, 128] | None, pip [Q]
+        bool) for one fused chunk: the per-query PIP edge stack, sized to
+        the chunk's largest member polygon and zero-padded per query
+        (pack_edges pad rows never cross and are never near). Extent
+        tables ignore polygon edges in BOTH scan paths (bbox-intersects
+        is the device test), so their chunks always ride E = 0."""
+        pip = np.zeros(len(members), bool)
+        if self.extent:
+            return 0, None, pip
+        chunk_e = bk.fused_e_bucket(
+            max(bk.n_edges_of(m[1].poly) for m in members)
+        )
+        if chunk_e == 0:
+            return 0, None, pip
+        edges = np.zeros((FUSED_CHUNK_Q, chunk_e, bk.LANES), np.float32)
+        for q, m in enumerate(members):
+            poly = m[1].poly
+            if poly is not None:
+                edges[q, : poly.shape[0]] = poly
+                pip[q] = True
+        return chunk_e, edges, pip
+
+    def _submit_fused_chunk(
+        self, members, names, has_boxes, has_windows, finishes, deadline
+    ):
+        """Dispatch one fused chunk (scan_submit_many): single-member or
+        near-empty chunks take the plain single-query kernel; real
+        batches share one block_scan_multi call — box AND polygon-PIP
+        members together, selected per slot — and decode per-member slot
+        segments."""
+        slots = self.fused_slots
+        if self._fused_route_single(members, finishes, deadline):
+            return
+        check_deadline(deadline, "device scan dispatch")
+        boxes, wins = self._fused_param_stacks(members)
+        chunk_e, edges, pip = self._chunk_edge_stack(members)
+        bid_parts: list[np.ndarray] = []
+        qid_parts: list[np.ndarray] = []
+        segs: list[tuple[int, int]] = []  # slot segment per member
+        pos = 0
+        for q, (j, config, blocks, _, _) in enumerate(members):
+            bid_parts.append(blocks.astype(np.int32))
+            qid_parts.append(np.full(len(blocks), q, np.int32))
+            segs.append((pos, pos + len(blocks)))
+            pos += len(blocks)
+        bids, n_real = bk.pad_bids(
+            np.concatenate(bid_parts), self.n_blocks, bucket=slots
+        )
+        self._record_scan(names, len(bids))
+        qids = np.zeros(len(bids), np.int32)
+        qids[:n_real] = np.concatenate(qid_parts)
+        spip = None
+        if chunk_e:
+            spip = pip[qids].astype(np.int32)
+            spip[n_real:] = 0  # pad slots keep the (cheaper) box leg
+        wide, inner = bk.block_scan_multi(
+            self._cols_args(names), bids, qids, boxes, wins,
+            col_names=names, has_boxes=has_boxes, has_windows=has_windows,
+            extent=self.extent, edges=edges, spip=spip, n_edges=chunk_e,
+        )
+        group_pull = self._fused_pull(wide, inner)
 
         def member_finish(k):
             j, config, blocks, overlap, contained = members[k]
@@ -984,27 +1082,41 @@ class IndexTable(SortedKeys):
             for has_boxes, has_w in flag_combos:
                 self._device_scan_submit(blocks, make_cfg(has_boxes, has_w))()
                 calls += 1
-        # the canonical fused multi-query variant (scan_submit_many):
-        # fixed (FUSED_CHUNK_SLOTS, FUSED_CHUNK_Q) shape means ONE compile
-        # per predicate-flag combo covers every future batch
-        if type(self)._device_scan_submit is IndexTable._device_scan_submit:
+        # the canonical fused multi-query variants (scan_submit_many):
+        # fixed (fused_slots, FUSED_CHUNK_Q) shape means ONE compile per
+        # (predicate-flag combo, E bucket) covers every future batch.
+        # E = 0 is the box-only chunk; point tables additionally warm the
+        # PIP-fused E ladder (polygon members always carry a bbox, so
+        # only has_boxes combos can hit them)
+        if self._fused_supported():
+            pip_ok = not self.extent and {"x", "y"} <= set(self.col_names)
             for has_boxes, has_w in flag_combos:
                 if not (has_boxes or has_w):
                     continue  # fused path requires a predicate
-                cfg = make_cfg(has_boxes, has_w)
-                names = self._scan_cols(cfg)
-                # half a chunk of repeated block 0 per member: enough real
-                # slots to clear the small-batch routing threshold, same
-                # compile key as any future fused dispatch
-                blk = np.zeros(max(self.fused_slots // 4, 1), np.int64)
-                fused_fins: list = [None, None]
-                self._submit_fused_chunk(
-                    [(0, cfg, blk, [], []), (1, cfg, blk, [], [])],
-                    names, has_boxes, has_w, fused_fins, None,
+                e_ladder = (0,) + (
+                    bk.FUSED_E_BUCKETS if (pip_ok and has_boxes) else ()
                 )
-                for f in fused_fins:
-                    f()
-                calls += 1
+                for n_e in e_ladder:
+                    cfg = make_cfg(has_boxes, has_w)
+                    if n_e:
+                        cfg.poly = np.zeros((n_e, bk.LANES), np.float32)
+                    names = self._scan_cols(cfg)
+                    # half a chunk of round-robin blocks per member:
+                    # enough real slots to clear the small-batch routing
+                    # threshold (and to touch every mesh device), same
+                    # compile key as any future fused dispatch
+                    blk = (
+                        np.arange(max(self.fused_pack_capacity // 4, 1))
+                        % self.n_blocks
+                    ).astype(np.int64)
+                    fused_fins: list = [None, None]
+                    self._submit_fused_chunk(
+                        [(0, cfg, blk, [], []), (1, cfg, blk, [], [])],
+                        names, has_boxes, has_w, fused_fins, None,
+                    )
+                    for f in fused_fins:
+                        f()
+                    calls += 1
         return calls
 
     @property

@@ -294,3 +294,63 @@ def test_ingest_doc_apis_exist():
     text = open(path).read()
     for name in _re.findall(r"`ds\.(\w+)", text):
         assert hasattr(DataStore, name), f"ds.{name}"
+
+
+def test_fused_coverage_doc_honest():
+    """docs/serving.md "Fused coverage" + PERF.md §12 stay honest: every
+    constant, API and file the matrix names is real and matches the
+    code, and BENCH_FUSED.json (when present) actually shows the fused
+    path faster with bit-identical results, as both docs claim."""
+    import json
+
+    from geomesa_tpu.scan import block_kernels as bk
+    from geomesa_tpu.storage.table import IndexTable
+    from geomesa_tpu.parallel.dtable import DistributedIndexTable
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    text = open(os.path.join(root, "docs", "serving.md")).read()
+    assert "Fused coverage" in text
+
+    # the documented E ladder is the code's E ladder, and every
+    # pack_edges polygon fits a fused bucket (the matrix's 256-edge row)
+    assert f"FUSED_E_BUCKETS = {bk.FUSED_E_BUCKETS}" in text
+    assert bk.FUSED_E_BUCKETS[-1] == bk.E_BUCKETS[-1]
+    assert bk.fused_e_bucket(bk.E_BUCKETS[-1]) == bk.FUSED_E_BUCKETS[-1]
+
+    # documented APIs: the fused seam, the wide-only chunk rule, warmup,
+    # and the mesh override the matrix's shard_map row relies on
+    for name in ("scan_submit_many", "_submit_fused_chunk", "fused_slots",
+                 "warmup"):
+        assert hasattr(IndexTable, name), name
+    assert "skip_inner_plane" in text and hasattr(bk, "skip_inner_plane")
+    assert (
+        DistributedIndexTable._submit_fused_chunk
+        is not IndexTable._submit_fused_chunk
+    )
+    # kernel-level contract the matrix documents: block_scan_multi takes
+    # the edge stack + per-slot selector
+    import inspect
+
+    sig = inspect.signature(bk.block_scan_multi).parameters
+    for p in ("edges", "spip", "n_edges"):
+        assert p in sig, p
+
+    # the bench the docs point at exists and is registered (source-level
+    # contract, like the ingest fault points — bench.py is not a package)
+    bench_src = open(os.path.join(root, "bench.py")).read()
+    assert "def config_fused" in bench_src
+    assert '"fused": config_fused' in bench_src
+    assert "BENCH_FUSED.json" in bench_src
+    assert "BENCH_FUSED.json" in text
+
+    # honesty of the recorded numbers: fused faster than both baselines,
+    # results identical, on every non-skipped row
+    path = os.path.join(root, "BENCH_FUSED.json")
+    if os.path.exists(path):
+        payload = json.load(open(path))
+        timed = [r for r in payload["rows"] if "speedup" in r]
+        assert timed, "BENCH_FUSED.json has no timed rows"
+        for r in timed:
+            assert r["identical"] is True, r["scenario"]
+            assert r["fused_ms"] < r["per_query_ms"], r["scenario"]
+            assert r["speedup"] >= 2.0, r["scenario"]  # the round-6 bar

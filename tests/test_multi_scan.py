@@ -208,6 +208,175 @@ class TestFusedScan:
         assert_matches(hs.table("pts", "z2"), [idx.scan_config(rand_bbox(rng)) for _ in range(7)])
 
 
+def _poly(kind, cx, cy, r, rng=None, holes=False):
+    """Concave / convex / holed polygons (the PIP fuzz shapes of
+    test_pip_kernel, round 6: now exercised through the FUSED path)."""
+    if kind == "triangle":
+        pts = [(cx - r, cy - r), (cx + r, cy - r), (cx, cy + r)]
+    elif kind == "hex":
+        a = np.linspace(0, 2 * np.pi, 7)[:-1] + (rng.uniform(0, 1) if rng else 0.3)
+        pts = [(cx + r * np.cos(t), cy + 0.7 * r * np.sin(t)) for t in a]
+    elif kind == "lshape":
+        pts = [
+            (cx - r, cy - r), (cx + r, cy - r), (cx + r, cy),
+            (cx, cy), (cx, cy + r), (cx - r, cy + r),
+        ]
+    else:  # star-ish concave
+        a = np.linspace(0, 2 * np.pi, 11)[:-1]
+        rad = np.where(np.arange(10) % 2 == 0, r, 0.4 * r)
+        pts = [(cx + rr * np.cos(t), cy + rr * np.sin(t)) for t, rr in zip(a, rad)]
+    hh = (
+        [[(cx - 0.3 * r, cy - 0.3 * r), (cx + 0.3 * r, cy - 0.3 * r),
+          (cx, cy + 0.2 * r)]]
+        if holes else None
+    )
+    return geo.Polygon(pts, holes=hh)
+
+
+class TestFusedPip:
+    """Round 6: polygon-INTERSECTS (device PIP) members fuse — the chunk
+    carries a [Q, E, 128] edge stack and a per-slot selector. Contract:
+    fused == per-query scan, bit-identical, for every polygon shape mix,
+    and polygon batches actually take the fused dispatch."""
+
+    def _spy(self, monkeypatch):
+        calls = {"fused": 0, "edged": 0}
+        orig = bk.block_scan_multi
+
+        def spy(*a, **kw):
+            calls["fused"] += 1
+            if kw.get("n_edges", 0):
+                calls["edged"] += 1
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(bk, "block_scan_multi", spy)
+        return calls
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_z2_polygon_batches(self, seed, monkeypatch):
+        ds, _ = make_store(n=40_000, seed=60 + seed, index="z2")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+        table = ds.table("pts", "z2")
+        calls = self._spy(monkeypatch)
+        rng = np.random.default_rng(6000 + seed)
+        kinds = ["triangle", "hex", "lshape", "star"]
+        cfgs = []
+        for k in range(12):
+            cx, cy = rng.uniform(-40, 40), rng.uniform(-30, 30)
+            if k % 3 == 2:  # mixed chunk: boxes ride zero-edge slots
+                cfgs.append(idx.scan_config(rand_bbox(rng, span=10)))
+            else:
+                p = _poly(kinds[(seed + k) % 4], cx, cy, rng.uniform(3, 8),
+                          rng, holes=(k % 4 == 1))
+                cfgs.append(idx.scan_config(Intersects("geom", p)))
+        assert any(c.poly is not None for c in cfgs)
+        assert_matches(table, cfgs)
+        assert calls["edged"] >= 1, "polygon batch never took the fused PIP path"
+
+    def test_z3_polygon_time_batches(self, monkeypatch):
+        ds, t0 = make_store(n=40_000, seed=71, index="z3")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z3")
+        calls = self._spy(monkeypatch)
+        rng = np.random.default_rng(6100)
+        cfgs = []
+        for k in range(10):
+            cx, cy = rng.uniform(-40, 40), rng.uniform(-30, 30)
+            p = _poly(["star", "lshape"][k % 2], cx, cy, rng.uniform(3, 7), rng)
+            lo = t0 + rng.integers(0, 20 * 86400_000)
+            f = Intersects("geom", p) & During("dtg", lo, lo + 5 * 86400_000)
+            cfgs.append(idx.scan_config(f))
+        assert_matches(ds.table("pts", "z3"), cfgs)
+        assert calls["edged"] >= 1
+
+    def test_e_bucket_ladder(self):
+        assert bk.fused_e_bucket(0) == 0
+        assert bk.fused_e_bucket(1) == 16
+        assert bk.fused_e_bucket(16) == 16
+        assert bk.fused_e_bucket(17) == 64
+        assert bk.fused_e_bucket(200) == 256
+        # every pack_edges output fits a fused bucket
+        assert bk.FUSED_E_BUCKETS[-1] == bk.E_BUCKETS[-1]
+
+    def test_mixed_edge_sizes_and_bucket_grouping(self, monkeypatch):
+        """Polygons with different edge counts in the SAME fused bucket
+        zero-pad into one chunk; a bigger-bucket ring and the box members
+        group separately (the E bucket is part of the variant key, so box
+        slots never pay edge work) — results exact throughout."""
+        ds, _ = make_store(n=30_000, seed=75, index="z2")
+        idx = next(i for i in ds.indexes("pts") if i.name == "z2")
+        e_seen = []
+        orig = bk.block_scan_multi
+
+        def spy(*a, **kw):
+            e_seen.append(kw.get("n_edges", 0))
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(bk, "block_scan_multi", spy)
+        rng = np.random.default_rng(6200)
+        a = np.linspace(0, 2 * np.pi, 41)[:-1]
+        ring = geo.Polygon([(10 * np.cos(t), 8 * np.sin(t)) for t in a])
+        # 3-, 6- and 10-edge polygons all bucket to FUSED_E_BUCKETS[0]
+        small = [
+            _poly(k, rng.uniform(-30, 30), rng.uniform(-20, 20), 6.0, rng)
+            for k in ("triangle", "lshape", "star", "triangle", "star", "lshape")
+        ]
+        cfgs = (
+            [idx.scan_config(Intersects("geom", p)) for p in small]
+            + [idx.scan_config(Intersects("geom", ring))]
+            + [idx.scan_config(rand_bbox(rng, span=8)) for _ in range(6)]
+        )
+        assert bk.n_edges_of(cfgs[len(small)].poly) > bk.FUSED_E_BUCKETS[0]
+        assert_matches(ds.table("pts", "z2"), cfgs)
+        # the small polygons fused at the smallest bucket; no box chunk
+        # ever dispatched with edge work
+        assert bk.FUSED_E_BUCKETS[0] in e_seen
+        assert all(e in (0,) + bk.FUSED_E_BUCKETS for e in e_seen)
+
+
+class TestFusedExtentXZ3:
+    """XZ3 (extent + time) batches fuse on the wide-only plane layout
+    (skip_inner_plane): fused == per-query, including polygon-INTERSECTS
+    configs, whose edges extent kernels ignore in both paths."""
+
+    def test_xz3_box_time_batch(self, monkeypatch):
+        rng = np.random.default_rng(81)
+        n = 15_000
+        t0 = np.datetime64("2024-03-01T00:00:00", "ms").astype(np.int64)
+        sft = FeatureType.from_spec("tx", "dtg:Date,*geom:Polygon:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "xz3"
+        ds = DataStore()
+        ds.create_schema(sft)
+        x0 = rng.uniform(-60, 58, n)
+        y0 = rng.uniform(-45, 43, n)
+        col = geo.PackedGeometryColumn.from_boxes(
+            x0, y0, x0 + rng.uniform(0.01, 1.0, n), y0 + rng.uniform(0.01, 0.8, n)
+        )
+        t = t0 + rng.integers(0, 30 * 86400_000, n)
+        ds.write("tx", FeatureCollection.from_columns(
+            sft, np.arange(n), {"dtg": t, "geom": col}), check_ids=False)
+        idx = next(i for i in ds.indexes("tx") if i.name == "xz3")
+        calls = {"fused": 0}
+        orig = bk.block_scan_multi
+
+        def spy(*a, **kw):
+            calls["fused"] += 1
+            assert kw.get("n_edges", 0) == 0  # extent chunks ride E = 0
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(bk, "block_scan_multi", spy)
+        tri = geo.from_wkt("POLYGON ((-20 -15, 25 -10, 0 30, -20 -15))")
+        cfgs = []
+        for k in range(11):
+            f = rand_bbox(rng, span=15) if k % 4 else Intersects("geom", tri)
+            lo = t0 + rng.integers(0, 20 * 86400_000)
+            cfgs.append(idx.scan_config(
+                f & During("dtg", int(lo), int(lo) + 6 * 86400_000)
+            ))
+        assert all(c is not None for c in cfgs)
+        assert_matches(ds.table("tx", "xz3"), cfgs)
+        assert calls["fused"] >= 1, "xz3 batch never fused"
+
+
 class TestPlannerSubmitMany:
     def test_mixed_types_and_indexes(self):
         """submit_many groups per (type, index) and falls back for
@@ -241,21 +410,109 @@ class TestPlannerSubmitMany:
         assert sum(len(b) for b in batched) > 0
 
 
-class TestMeshFallback:
+class TestMeshFused:
     def test_query_many_on_mesh_store(self):
-        """A mesh-sharded store's table overrides the device-scan seam,
-        so scan_submit_many must fall back to per-query shard_map scans
-        — batched results still equal sequential ones."""
-        from geomesa_tpu.parallel import make_mesh
+        """A mesh-sharded store's batches dispatch through the shard_map
+        FUSED kernel (round 6: one mesh-wide dispatch per chunk, one
+        batched plane pull) — batched results equal sequential ones."""
+        from geomesa_tpu.parallel import dtable, make_mesh
 
         ds, _ = make_store(n=30_000, seed=51, index="z2", mesh=make_mesh(8))
-        rng = np.random.default_rng(52)
+        calls = {"n": 0}
+        orig = dtable._dist_scan_multi
+
+        def spy(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        dtable._dist_scan_multi = spy
+        try:
+            rng = np.random.default_rng(52)
+            qs = []
+            for _ in range(12):
+                qx, qy = rng.uniform(-55, 30), rng.uniform(-40, 15)
+                w, h = rng.uniform(1, 15), rng.uniform(1, 10)
+                qs.append(f"bbox(geom, {qx}, {qy}, {qx + w}, {qy + h})")
+            assert_batched_equals_sequential(ds, "pts", qs)
+        finally:
+            dtable._dist_scan_multi = orig
+        assert calls["n"] >= 1, "mesh batch never took the fused dispatch"
+
+    def test_mesh_fused_matches_single_device(self):
+        """mesh4 fused == single-device fused == sequential, on a batch
+        mixing boxes and polygon-PIP members (the differential the round-6
+        acceptance pins)."""
+        from geomesa_tpu.parallel import make_mesh
+
+        ds_m, _ = make_store(n=25_000, seed=55, index="z2", mesh=make_mesh(4))
+        ds_s, _ = make_store(n=25_000, seed=55, index="z2")
+        idx_m = next(i for i in ds_m.indexes("pts") if i.name == "z2")
+        idx_s = next(i for i in ds_s.indexes("pts") if i.name == "z2")
+        rng = np.random.default_rng(56)
+        filters = []
+        for k in range(10):
+            cx, cy = rng.uniform(-40, 40), rng.uniform(-30, 30)
+            if k % 3 == 0:
+                filters.append(Intersects("geom", _poly(
+                    ["star", "lshape", "hex"][k % 3], cx, cy, 6.0, rng
+                )))
+            else:
+                filters.append(rand_bbox(rng, span=10))
+        cfg_m = [idx_m.scan_config(f) for f in filters]
+        cfg_s = [idx_s.scan_config(f) for f in filters]
+        got_m = [f() for f in ds_m.table("pts", "z2").scan_submit_many(cfg_m)]
+        got_s = [f() for f in ds_s.table("pts", "z2").scan_submit_many(cfg_s)]
+        for cm, cs, (rm, km), (rs, ks) in zip(cfg_m, cfg_s, got_m, got_s):
+            er, ec = ds_m.table("pts", "z2").scan(cm)
+            assert np.array_equal(rm, er) and np.array_equal(km, ec)
+            # same seed -> same data -> identical ordinal sets and
+            # certainty across the two layouts
+            assert np.array_equal(rm, rs)
+            assert np.array_equal(km, ks)
+
+    def test_mesh_zero_recompiles_warm_fused_batch(self):
+        """After ONE fused batch (the warmup dispatch for its chunk
+        variants), re-running the same mixed batch triggers NO new XLA
+        compiles — the round-6 mesh-fusion acceptance bar (the compile
+        key is the static (slots, Q, columns, flags, E) tuple)."""
+        import logging
+
+        import jax
+
+        from geomesa_tpu.parallel import make_mesh
+
+        ds, _ = make_store(n=30_000, seed=57, index="z2", mesh=make_mesh(4))
+        rng = np.random.default_rng(58)
         qs = []
-        for _ in range(12):
-            qx, qy = rng.uniform(-55, 30), rng.uniform(-40, 15)
-            w, h = rng.uniform(1, 15), rng.uniform(1, 10)
-            qs.append(f"bbox(geom, {qx}, {qy}, {qx + w}, {qy + h})")
-        assert_batched_equals_sequential(ds, "pts", qs)
+        for k in range(10):
+            if k % 3 == 0:
+                cx, cy = rng.uniform(-40, 40), rng.uniform(-30, 30)
+                p = _poly("star", cx, cy, 6.0, rng)
+                qs.append(f"INTERSECTS(geom, {p.wkt})")
+            else:
+                qx, qy = rng.uniform(-55, 30), rng.uniform(-40, 15)
+                qs.append(f"bbox(geom, {qx}, {qy}, {qx + 9}, {qy + 7})")
+        ds.query_many("pts", qs)  # warm: compiles the fused chunk variants
+        jax.config.update("jax_log_compiles", True)
+        records: list = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        loggers = [logging.getLogger(n) for n in (
+            "jax._src.dispatch", "jax._src.interpreters.pxla", "jax._src.compiler"
+        )]
+        prior = [lg.level for lg in loggers]
+        for lg in loggers:
+            lg.addHandler(handler)
+            lg.setLevel(logging.DEBUG)
+        try:
+            ds.query_many("pts", qs)
+        finally:
+            jax.config.update("jax_log_compiles", False)
+            for lg, lvl in zip(loggers, prior):
+                lg.removeHandler(handler)
+                lg.setLevel(lvl)
+        compiles = [m for m in records if "Compiling" in m]
+        assert compiles == [], f"unexpected recompiles: {compiles}"
 
     def test_indexed_join_on_mesh_store(self):
         """spatial_join_indexed against a mesh-sharded point store (the
@@ -345,6 +602,49 @@ class TestMultiKernelParity:
         )
         assert i_ref is None and i_got is None
         assert np.array_equal(np.asarray(w_ref), np.asarray(w_got))
+
+    def test_interpret_parity_pip_fused(self):
+        """PIP-fused multi kernel: Pallas-interpret == XLA, with a mixed
+        chunk (polygon slots + box slots selected by spip)."""
+        cols3 = self._cols(seed=17)
+        q = 3
+        E = 16
+        boxes = np.zeros((bk.bucket_q(q), 8, bk.LANES), np.float32)
+        wins = np.zeros((bk.bucket_q(q), 8, bk.LANES), np.int32)
+        edges = np.zeros((bk.bucket_q(q), E, bk.LANES), np.float32)
+        rng = np.random.default_rng(18)
+        tri = geo.from_wkt("POLYGON ((-30 -20, 20 -25, 5 30, -30 -20))")
+        packed = bk.pack_edges(tri)
+        assert packed is not None and packed.shape[0] == E
+        for k in range(q):
+            x0, y0 = rng.uniform(-40, 10, 2)
+            boxes[k] = bk.pack_boxes(np.array([[x0, y0, x0 + 25, y0 + 20]]), None)
+            wins[k] = bk.pack_windows(None, None)
+        edges[1] = packed  # query 1 is the polygon; 0 and 2 stay boxes
+        bids = np.array([0, 1, 2, 3, 0, 2, 1, 3], np.int32)
+        qids = np.array([0, 0, 1, 1, 1, 2, 2, 2], np.int32)
+        spip = (qids == 1).astype(np.int32)
+        kw = dict(
+            col_names=("x", "y"), has_boxes=True, has_windows=False,
+            extent=False, n_edges=E,
+        )
+        w_ref, i_ref = bk._xla_block_scan_multi(
+            cols3, bids, qids, boxes, wins, edges, spip, **kw
+        )
+        w_got, i_got = bk._pallas_block_scan_multi(
+            cols3, bids, qids, boxes, wins, edges, spip, interpret=True, **kw
+        )
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_got))
+        assert np.array_equal(np.asarray(i_ref), np.asarray(i_got))
+        # and the polygon slots equal the single-query PIP kernel
+        sl = qids == 1
+        w_s, i_s = bk._xla_block_scan(
+            cols3, bids[sl], boxes[1], wins[1], edges[1],
+            col_names=("x", "y"), has_boxes=True, has_windows=False,
+            extent=False, n_edges=E,
+        )
+        assert np.array_equal(np.asarray(w_ref)[sl], np.asarray(w_s))
+        assert np.array_equal(np.asarray(i_ref)[sl], np.asarray(i_s))
 
     def test_slotwise_equals_single_kernel(self):
         """Each fused slot must equal the single-query kernel run with that
