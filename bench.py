@@ -1159,7 +1159,12 @@ def config_fused(out_path: "str | None" = None):
         )))
         for _ in range(n_q)
     ]
-    assert all(c is not None and c.poly is not None for c in cfgs)
+    # the polygon tier: PIP edges pre-round-7, raster intervals (with
+    # host residue) by default since — either way a device polygon leg
+    assert all(
+        c is not None and (c.poly is not None or c.rast is not None)
+        for c in cfgs
+    )
     rows.append(time_paths(ds.table("fp", "z2"), cfgs, "z2_polygon_pip_batch"))
 
     # -- (c) mesh-sharded box+polygon batch -----------------------------
@@ -1211,6 +1216,245 @@ def config_fused(out_path: "str | None" = None):
         "value": min(r["speedup"] for r in timed),
         "unit": "x",
         "min_vs_pipelined": min(r["speedup_vs_pipelined"] for r in timed),
+        "rows": rows,
+        "n_rows": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ------------------------------------------- raster PIP + join scenario
+
+
+def config_pip_join(out_path: "str | None" = None):
+    """Raster-interval polygon approximations + adaptive joins (round 7,
+    docs/joins.md, PERF.md §13): the polygon-heavy workloads the raster
+    tier targets, each measured with rasters OFF (the round-6 exact
+    device-PIP path) vs ON, end-to-end (fused kernel batch + host
+    residue refinement), with bit-identity of the refined hit sets
+    computed in-bench —
+
+    - ``z2_polygon_pip_batch``: 32 concave polygon-INTERSECTS queries
+      (16..256-edge jagged stars) over an n-point z2 store, one fused
+      scan_submit_many dispatch set per batch;
+    - ``z2_polygon_join``: spatial_join_indexed over 128 concave
+      polygons (the broadcast-join shape with a non-rectangular left
+      side);
+    - ``host_grid_join``: the storeless grid join, exact vs adaptive
+      (sampled-selectivity raster strategy).
+
+    Emits BENCH_PIP_JOIN.json next to this file (or at ``out_path`` /
+    env GEOMESA_BENCH_PIP_OUT — use a SCRATCH path when producing the
+    fresh side of a gate comparison, so the committed baseline is not
+    clobbered); ``scripts/bench_gate.py`` compares a fresh run against
+    the recorded baseline and fails on >20% fused-PIP regression. Env
+    knobs: GEOMESA_BENCH_PIP_N (rows), GEOMESA_BENCH_PIP_Q
+    (queries/batch), GEOMESA_BENCH_PIP_REPEAT (best-of)."""
+    import jax
+
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.conf import RASTER_ENABLED
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.filter import raster as fr
+    from geomesa_tpu.filter.predicates import Intersects
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.sql.join import spatial_join, spatial_join_indexed
+
+    n = int(os.environ.get("GEOMESA_BENCH_PIP_N", 2_000_000))
+    n_q = int(os.environ.get("GEOMESA_BENCH_PIP_Q", 32))
+    repeat = int(os.environ.get("GEOMESA_BENCH_PIP_REPEAT", 3))
+    rng = np.random.default_rng(SEED + 90)
+
+    def jagged(cx, cy, r, n_arms, seed):
+        srng = np.random.default_rng(seed)
+        a = np.linspace(0, 2 * np.pi, 2 * n_arms + 1)[:-1]
+        rad = np.where(
+            np.arange(2 * n_arms) % 2 == 0, r,
+            r * srng.uniform(0.3, 0.7, 2 * n_arms),
+        )
+        return geo.Polygon(
+            [(cx + rr * np.cos(t), cy + rr * np.sin(t)) for t, rr in zip(a, rad)]
+        )
+
+    log(f"[pip_join] building {n:,}-point z2 store ...")
+    px, py = gdelt_points(n, rng)
+    sft = FeatureType.from_spec("fp", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    ds = DataStore()
+    ds.create_schema(sft)
+    ds.write("fp", FeatureCollection.from_columns(
+        sft, np.arange(n), {"geom": (px, py)}), check_ids=False)
+    idx = next(i for i in ds.indexes("fp") if i.name == "z2")
+    table = ds.table("fp", "z2")
+    qrng = np.random.default_rng(SEED + 91)
+    # the issue's workload: up-to-256-edge polygon stacks (arms 8..127
+    # -> 16..254 edges, every fused E bucket incl. the XLA ladder top)
+    polys = [
+        jagged(
+            float(qrng.uniform(-150, 150)), float(qrng.uniform(-60, 60)),
+            float(qrng.choice([0.5, 1.0, 2.0])),
+            int(qrng.choice([8, 16, 50, 127])), seed=k,
+        )
+        for k in range(n_q)
+    ]
+
+    def _timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return time.perf_counter() - t0, out
+
+    def resolve_batch(cfgs):
+        """Fused batch + exact host residue refinement -> per-query
+        sorted true-hit ordinal arrays (what the planner produces)."""
+        outs = [f() for f in table.scan_submit_many(list(cfgs))]
+        final = []
+        for p, (rows, cert) in zip(polys, outs):
+            unc = np.flatnonzero(~cert)
+            keep = cert.copy()
+            if len(unc):
+                ux, uy = px[rows[unc]], py[rows[unc]]
+                ok = geo.points_in_polygon(ux, uy, p)
+                nb = np.flatnonzero(~ok)  # intersects: boundary counts
+                if len(nb):
+                    ok[nb] = geo.points_on_boundary(ux[nb], uy[nb], p)
+                keep[unc] = ok
+            final.append(np.sort(rows[keep]))
+        return final
+
+    def run_batch(label):
+        ds.planner.invalidate_config_memo()
+        fr.clear_cache()
+        cfgs = [idx.scan_config(Intersects("geom", p)) for p in polys]
+        resolve_batch(cfgs)  # warm compiles
+        best = min(_timed(lambda: resolve_batch(cfgs))[0] for _ in range(repeat))
+        final = resolve_batch(cfgs)
+        log(f"[pip_join] {label}: {best / n_q * 1e3:.2f} ms/q")
+        return best, final, cfgs
+
+    RASTER_ENABLED.set(False)
+    t_off, final_off, cfgs_off = run_batch("exact (raster off)")
+    RASTER_ENABLED.set(None)
+    t_on, final_on, cfgs_on = run_batch("raster on")
+    identical = all(
+        np.array_equal(a, b) for a, b in zip(final_off, final_on)
+    )
+    assert identical  # recorded either way (python -O safe)
+    rows = [{
+        "scenario": "z2_polygon_pip_batch",
+        "queries": n_q,
+        "exact_ms_per_q": round(t_off / n_q * 1e3, 3),
+        "raster_ms_per_q": round(t_on / n_q * 1e3, 3),
+        "speedup": round(t_off / max(t_on, 1e-9), 2),
+        "identical": bool(identical),
+        "rasterized_queries": int(sum(c.rast is not None for c in cfgs_on)),
+    }]
+    log(f"[pip_join] z2_polygon_pip_batch speedup {rows[0]['speedup']}x")
+
+    # -- polygon-heavy indexed join --------------------------------------
+    n_poly = int(os.environ.get("GEOMESA_BENCH_PIP_POLYS", 128))
+    jrng = np.random.default_rng(SEED + 92)
+    jpolys = [
+        jagged(
+            float(jrng.uniform(-150, 150)), float(jrng.uniform(-60, 60)),
+            float(jrng.uniform(1.0, 6.0)), int(jrng.choice([8, 16, 50, 127])),
+            seed=1000 + k,
+        )
+        for k in range(n_poly)
+    ]
+    gsft = FeatureType.from_spec("adm", "*geom:Polygon:srid=4326")
+    left = FeatureCollection.from_columns(
+        gsft, np.arange(n_poly),
+        {"geom": geo.PackedGeometryColumn.from_geometries(jpolys)},
+    )
+
+    def run_join(label, enabled):
+        RASTER_ENABLED.set(enabled if not enabled else None)
+        ds.planner.invalidate_config_memo()
+        fr.clear_cache()
+        spatial_join_indexed(ds, "fp", left, "intersects")  # warm
+        best, pairs = None, None
+        for _ in range(repeat):
+            t, out = _timed(
+                lambda: spatial_join_indexed(ds, "fp", left, "intersects")
+            )
+            if best is None or t < best:
+                best, pairs = t, out
+        log(f"[pip_join] join {label}: {best * 1e3:.0f} ms, {len(pairs[0])} pairs")
+        return best, pairs
+
+    t_joff, p_off = run_join("exact (raster off)", False)
+    t_jon, p_on = run_join("raster on", True)
+    RASTER_ENABLED.set(None)
+    join_identical = np.array_equal(p_off[0], p_on[0]) and np.array_equal(
+        p_off[1], p_on[1]
+    )
+    assert join_identical
+    rows.append({
+        "scenario": "z2_polygon_join",
+        "polygons": n_poly,
+        "pairs": int(len(p_on[0])),
+        "exact_ms": round(t_joff * 1e3, 1),
+        "raster_ms": round(t_jon * 1e3, 1),
+        "speedup": round(t_joff / max(t_jon, 1e-9), 2),
+        "identical": bool(join_identical),
+    })
+    log(f"[pip_join] z2_polygon_join speedup {rows[-1]['speedup']}x")
+
+    # -- host grid join: exact vs adaptive -------------------------------
+    sub = min(n, 2_000_000)
+    right = FeatureCollection.from_columns(
+        sft, np.arange(sub), {"geom": (px[:sub], py[:sub])}
+    )
+    m = MetricsRegistry()
+    t_hex, h_ex = _timed(
+        lambda: spatial_join(left, right, "intersects", strategy="exact")
+    )
+    t_had, h_ad = _timed(
+        lambda: spatial_join(
+            left, right, "intersects", strategy="auto", metrics=m
+        )
+    )
+    host_identical = np.array_equal(h_ex[0], h_ad[0]) and np.array_equal(
+        h_ex[1], h_ad[1]
+    )
+    assert host_identical
+    rows.append({
+        "scenario": "host_grid_join",
+        "pairs": int(len(h_ex[0])),
+        "exact_ms": round(t_hex * 1e3, 1),
+        "adaptive_ms": round(t_had * 1e3, 1),
+        "speedup": round(t_hex / max(t_had, 1e-9), 2),
+        "identical": bool(host_identical),
+        "raster_partitions": m.counter_value("geomesa.join.strategy.raster"),
+        "exact_partitions": m.counter_value("geomesa.join.strategy.exact"),
+    })
+    log(f"[pip_join] host_grid_join speedup {rows[-1]['speedup']}x")
+
+    payload = {
+        "n_rows": n,
+        "queries_per_batch": n_q,
+        "platform": jax.default_backend(),
+        "rows": rows,
+    }
+    if out_path is None:
+        out_path = os.environ.get("GEOMESA_BENCH_PIP_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_PIP_JOIN.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "z2_polygon_pip_batch_raster_speedup",
+        "value": rows[0]["speedup"],
+        "unit": "x",
+        "raster_ms_per_q": rows[0]["raster_ms_per_q"],
+        "exact_ms_per_q": rows[0]["exact_ms_per_q"],
+        "join_speedup": rows[1]["speedup"],
         "rows": rows,
         "n_rows": n,
     }
@@ -1394,7 +1638,7 @@ def child_main():
         "1": config1_z3, "2": config2_z2, "3": config3_xz2,
         "4": config4_join, "5": config5_knn, "cache": config_cache,
         "serving": config_serving, "ingest": config_ingest,
-        "fused": config_fused,
+        "fused": config_fused, "pip_join": config_pip_join,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -131,7 +131,12 @@ def cmd_ingest(args) -> int:
         conv = _converter_from_file(sft, args.converter)
         res = ingest_files(ds, conv, args.files, workers=args.workers)
         if res.errors:
-            print(f"{res.errors} records failed to parse", file=sys.stderr)
+            by = getattr(res, "error_reasons", None) or {}
+            detail = (
+                " (" + ", ".join(f"{r}: {n}" for r, n in sorted(by.items())) + ")"
+                if by else ""
+            )
+            print(f"{res.errors} records dropped{detail}", file=sys.stderr)
         persist.save(ds, args.catalog)
         print(
             f"ingested {res.written} features into '{args.feature_name}' "

@@ -19,8 +19,10 @@ from typing import Callable, Optional
 REGISTRY: dict[str, "SystemProperty"] = {}
 
 
-def _parse_bool(s: str) -> bool:
-    return s.strip().lower() in ("1", "true", "yes", "on")
+def _parse_bool(s) -> bool:
+    if isinstance(s, bool):
+        return s  # programmatic prop.set(True/False)
+    return str(s).strip().lower() in ("1", "true", "yes", "on")
 
 
 @dataclass
@@ -146,6 +148,63 @@ INGEST_MERGE_MIN_BINS = SystemProperty(
     "distinct sort bins below which the ingest finalize falls back to the "
     "whole-table LSD radix sort (the PERF.md 4f negative result: spanwise "
     "merging has nothing to parallelize over few bins)",
+)
+
+
+# -- raster-interval polygon approximations + adaptive spatial joins
+# (geomesa_tpu.filter.raster, sql/join.py; docs/joins.md) ------------------
+
+RASTER_ENABLED = SystemProperty(
+    "geomesa.raster.enabled", True, _parse_bool,
+    "precompute raster-interval approximations (arXiv 2307.01716) for "
+    "polygon queries: full/out cells resolve by integer interval checks, "
+    "exact PIP runs only on the boundary residue",
+)
+RASTER_MAX_CELLS = SystemProperty(
+    "geomesa.raster.max.cells", 16384, int,
+    "cell budget of one polygon's Z2-aligned raster grid (the level is "
+    "the finest whose bbox window fits this many cells)",
+)
+RASTER_MIN_EDGES = SystemProperty(
+    "geomesa.raster.min.edges", 8, int,
+    "polygons with fewer edges than this skip rasterization (the exact "
+    "device PIP tier is already cheap at tiny edge counts)",
+)
+RASTER_KERNEL_INTERVALS = SystemProperty(
+    "geomesa.raster.kernel.intervals", 16, int,
+    "cap on the per-query interval count shipped to the scan kernel "
+    "(coalesced conservatively past it): the raster-derived z-ranges "
+    "already prune at full resolution host-side, so a coarse in-kernel "
+    "stack trades a slightly wider residue for a much cheaper kernel leg",
+)
+RASTER_RESIDUE = SystemProperty(
+    "geomesa.raster.residue", "host", str,
+    "where the boundary-cell residue runs its exact even-odd PIP: 'host' "
+    "(f64, threaded native ray cast — the fast default) or 'device' (the "
+    "kernel's f32 _pip_unrolled/_pip_loop tier, masks bit-identical to "
+    "the pre-raster path)",
+)
+JOIN_ADAPTIVE = SystemProperty(
+    "geomesa.join.adaptive", True, _parse_bool,
+    "pick the spatial-join strategy per partition from measured "
+    "selectivity (arXiv 1802.09488) instead of one fixed plan",
+)
+JOIN_SAMPLE = SystemProperty(
+    "geomesa.join.sample", 512, int,
+    "candidate rows sampled per join partition to measure boundary-cell "
+    "selectivity before picking a strategy",
+)
+JOIN_BROAD_FRACTION = SystemProperty(
+    "geomesa.join.broad.fraction", 0.25, float,
+    "indexed-join polygons whose candidate spans cover more than this "
+    "fraction of the table skip the fused-scan probe and classify the "
+    "whole point set against their raster on host",
+)
+JOIN_IN_SELECTIVITY = SystemProperty(
+    "geomesa.join.in.selectivity", 0.5, float,
+    "attribute-join IN push-down is skipped (host membership mask "
+    "instead) when the sampled fraction of matching secondary rows "
+    "exceeds this — the scan would return most rows anyway",
 )
 
 

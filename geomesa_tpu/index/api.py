@@ -96,6 +96,18 @@ class ScanConfig:
     # and contained-range certainty must NOT (bbox containment does not
     # imply polygon membership)
     poly: Optional[np.ndarray] = None
+    # raster-interval tier (round 7, arXiv 2307.01716): the query
+    # polygon's packed [1 + R, 128] interval stack
+    # (filter.raster.RasterApprox.pack_block) — the kernel classifies
+    # candidate rows by integer interval lookup (full cells certain-in,
+    # out cells certain-out) and only the boundary residue pays the exact
+    # PIP (``poly`` when set — the device residue — else host
+    # refinement). With ``rast`` set the z-ranges come from the raster
+    # too: full cells are *contained* ranges whose rows are certain even
+    # for polygons (contained_exact is True — full-cell containment
+    # implies membership, unlike bbox containment), and out cells inside
+    # the bbox are pruned before any device work.
+    rast: Optional[np.ndarray] = None
 
     @staticmethod
     def empty(index: str) -> "ScanConfig":

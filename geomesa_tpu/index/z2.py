@@ -64,14 +64,51 @@ class Z2Index:
         if not geoms.values:
             return None  # no spatial constraint: a z2 scan would be full-table
         bounds = geometry_bounds(geoms)
-        ranges = self.sfc.ranges(bounds, inner=True)
-        if not ranges:
-            return ScanConfig.empty(self.name)
         from geomesa_tpu.index.api import shrink_boxes
-        from geomesa_tpu.index.z3 import _bounds_only, _poly_edges
+        from geomesa_tpu.index.z3 import _bounds_only, _poly_edges, _poly_raster
 
         bounds_exact = geoms.precise and _bounds_only(geoms.values)
         poly = None if bounds_exact else _poly_edges(geoms)
+        rast, approx = (None, None) if bounds_exact else _poly_raster(geoms)
+        if rast is not None and poly is not None:
+            from geomesa_tpu.conf import RASTER_RESIDUE
+
+            if str(RASTER_RESIDUE.get()).lower() != "device":
+                # host residue (default): the kernel runs the raster leg
+                # alone — partial-cell rows come back uncertain and the
+                # planner's exact refinement resolves them on host
+                poly = None
+        if approx is not None:
+            # raster-derived z-ranges (arXiv 2307.01716): FULL cells emit
+            # contained ranges — certain hits even for polygons, because
+            # full-cell containment implies membership (margin-safe at
+            # f64) — PARTIAL cells emit overlap ranges, and OUT cells
+            # inside the bbox are pruned before any device work. The
+            # Z2-aligned grid makes every cell one contiguous z-range.
+            from geomesa_tpu.conf import SCAN_RANGES_TARGET
+
+            rlo, rhi, rcont = approx.zranges(
+                max_ranges=SCAN_RANGES_TARGET.get()
+            )
+            if len(rlo) == 0:
+                return ScanConfig.empty(self.name)
+            return ScanConfig(
+                index=self.name,
+                range_bins=np.zeros(len(rlo), dtype=np.int32),
+                range_lo=rlo,
+                range_hi=rhi,
+                boxes=widen_boxes(bounds),
+                windows=None,
+                geom_precise=True,
+                range_contained=rcont,
+                contained_exact=True,
+                boxes_inner=shrink_boxes(bounds),
+                poly=poly,
+                rast=rast,
+            )
+        ranges = self.sfc.ranges(bounds, inner=True)
+        if not ranges:
+            return ScanConfig.empty(self.name)
         return ScanConfig(
             index=self.name,
             range_bins=np.zeros(len(ranges), dtype=np.int32),

@@ -244,6 +244,17 @@ class Z3Index:
             return ScanConfig.empty(self.name)
         bounds_exact = geoms.precise and _bounds_only(geoms.values)
         poly = None if (no_geom or bounds_exact) else _poly_edges(geoms)
+        # kernel-side raster tier only: z3 ranges interleave time, so the
+        # 2-D raster cannot reshape them (z2 gets the full range rework),
+        # but the interval classification still replaces most per-row PIP
+        rast = None
+        if not (no_geom or bounds_exact):
+            rast, _ = _poly_raster(geoms)
+            if rast is not None and poly is not None:
+                from geomesa_tpu.conf import RASTER_RESIDUE
+
+                if str(RASTER_RESIDUE.get()).lower() != "device":
+                    poly = None  # host residue (see z2)
         return ScanConfig(
             index=self.name,
             range_bins=np.concatenate(range_bins),
@@ -251,9 +262,10 @@ class Z3Index:
             range_hi=np.concatenate(range_hi),
             boxes=None if no_geom else widen_boxes(bounds),
             windows=windows.astype(np.int32),
-            # the device PIP tier makes single-polygon queries precise on
-            # device (see z2); contained certainty stays bbox-only
-            geom_precise=bounds_exact or poly is not None,
+            # the device PIP/raster tiers make single-polygon queries
+            # precise on device (see z2); contained certainty stays
+            # bbox-only here (z3 ranges are bbox-derived)
+            geom_precise=bounds_exact or poly is not None or rast is not None,
             time_precise=intervals.precise,
             range_contained=np.concatenate(range_cont),
             # contained certainty additionally requires the *filter* to be
@@ -263,6 +275,7 @@ class Z3Index:
             boxes_inner=None if no_geom else shrink_boxes(bounds),
             windows_inner=windows_inner.astype(np.int32),
             poly=poly,
+            rast=rast,
         )
 
 
@@ -298,3 +311,25 @@ def _poly_edges(geoms) -> "np.ndarray | None":
     if not geoms.precise or len(geoms.values) != 1:
         return None
     return bk.pack_edges(geoms.values[0])
+
+
+def _poly_raster(geoms):
+    """(packed [1 + R, 128] raster block, RasterApprox) for the kernel's
+    raster-interval tier (arXiv 2307.01716), or (None, None) when the
+    extraction cannot ride it — same eligibility as _poly_edges, minus
+    the edge-count cap (rasters approximate polygons of ANY complexity,
+    which is exactly where they pay: past E_BUCKETS the PIP tier cannot
+    run at all and every candidate row used to host-refine)."""
+    from geomesa_tpu.conf import RASTER_KERNEL_INTERVALS
+    from geomesa_tpu.filter import raster as fr
+    from geomesa_tpu.scan import block_kernels as bk
+
+    if not geoms.precise or len(geoms.values) != 1:
+        return None, None
+    approx = fr.raster_for(geoms.values[0])
+    if approx is None:
+        return None, None
+    bucket = bk.r_bucket_of(
+        min(len(approx.ilo), max(int(RASTER_KERNEL_INTERVALS.get()), 1))
+    )
+    return approx.pack_block(bucket), approx

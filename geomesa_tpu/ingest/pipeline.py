@@ -81,6 +81,14 @@ class IngestResult:
     split_errors: list = field(default_factory=list)
     # per-stage wall seconds (geomesa.ingest.* timer mirror)
     stage_seconds: dict = field(default_factory=dict)
+    # per-reason error counts aggregated over splits ("parse", or a
+    # validator's "name: reason" — the CqlValidatorFactory-style
+    # accounting; io.validators). errors == sum(error_reasons.values())
+    error_reasons: dict = field(default_factory=dict)
+
+    def add_reasons(self, reasons: dict) -> None:
+        for r, n in reasons.items():
+            self.error_reasons[r] = self.error_reasons.get(r, 0) + n
 
 
 @dataclass
@@ -493,12 +501,13 @@ def ingest_files(
 
     def feed(res) -> None:
         nonlocal base
-        idx, fc, errors, parse_s, failure = res
+        idx, fc, errors, reasons, parse_s, failure = res
         loader._stage_time("parse", parse_s)
         if failure is not None:
             raise_split_failure(failure, splits)
         result.split_errors.append(errors)
         result.errors += errors
+        result.add_reasons(reasons)
         loader._count("geomesa.ingest.errors", errors)
         if len(fc) == 0:
             return

@@ -49,9 +49,22 @@ class ConverterConfig:
     drop_errors: bool
     xml_feature_tag: Optional[str]
     user_data: dict = field(default_factory=dict)
+    # validator NAMES (io.validators.parse_validators spec) — the
+    # picklable form. Custom Validator OBJECTS cannot cross the pool:
+    # they ride ``live_validators`` instead, which works for the
+    # in-process (workers <= 1) driver paths and raises the clear error
+    # at PICKLE time if a pool ever tries to ship them (__getstate__).
+    validators: Optional[str] = None
+    live_validators: "object | None" = None
 
     @staticmethod
     def of(conv: Converter) -> "ConverterConfig":
+        from geomesa_tpu.io.validators import validator_spec
+
+        try:
+            vspec, live = validator_spec(conv.validators), None
+        except ValueError:
+            vspec, live = None, conv.validators
         return ConverterConfig(
             spec=conv.sft.to_spec(),
             type_name=conv.sft.name,
@@ -63,7 +76,18 @@ class ConverterConfig:
             drop_errors=conv.drop_errors,
             xml_feature_tag=conv.xml_feature_tag,
             user_data=dict(conv.sft.user_data),
+            validators=vspec,
+            live_validators=live,
         )
+
+    def __getstate__(self):
+        if self.live_validators is not None:
+            raise ValueError(
+                "custom Validator objects are not picklable for "
+                "multi-process ingest; pass validator NAMES or run with "
+                "workers<=1"
+            )
+        return self.__dict__
 
     def build(self) -> Converter:
         sft = FeatureType.from_spec(self.type_name, self.spec)
@@ -77,6 +101,10 @@ class ConverterConfig:
             skip_lines=self.skip_lines,
             drop_errors=self.drop_errors,
             xml_feature_tag=self.xml_feature_tag,
+            validators=(
+                self.validators if self.live_validators is None
+                else self.live_validators
+            ),
         )
 
 
@@ -132,14 +160,15 @@ def _read_split(split: Split) -> bytes:
 
 
 def run_split(cfg: ConverterConfig, split: Split):
-    """Mapper: parse one split -> (FeatureCollection, n_errors)."""
+    """Mapper: parse one split ->
+    (FeatureCollection, n_errors, {reason: count})."""
     conv = cfg.build()
     if not split.skip_header:
         conv.skip_lines = 0
     data = _read_split(split)
     fc = conv.convert(data)
     fault_point("ingest.parse", split.path)
-    return fc, conv.errors
+    return fc, conv.errors, dict(conv.error_reasons)
 
 
 @dataclass
@@ -156,14 +185,15 @@ class SplitFailure:
 
 def run_split_guarded(args):
     """Pool entry point: ``(cfg, split, index)`` ->
-    ``(index, fc | None, n_errors, parse_seconds, SplitFailure | None)``."""
+    ``(index, fc | None, n_errors, {reason: count}, parse_seconds,
+    SplitFailure | None)``."""
     cfg, split, index = args
     t0 = time.perf_counter()
     try:
-        fc, errors = run_split(cfg, split)
-        return index, fc, errors, time.perf_counter() - t0, None
+        fc, errors, reasons = run_split(cfg, split)
+        return index, fc, errors, reasons, time.perf_counter() - t0, None
     except BaseException as e:  # includes InjectedCrash: see SplitFailure
-        return index, None, 0, time.perf_counter() - t0, SplitFailure(
+        return index, None, 0, {}, time.perf_counter() - t0, SplitFailure(
             split_index=index,
             exc_type=type(e).__name__,
             tb=traceback.format_exc(),

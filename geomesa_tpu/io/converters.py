@@ -205,7 +205,12 @@ class Converter:
     fmt: str = "delimited"  # "delimited" | "json" | "xml" | "fixed-width"
     delimiter: str = ","
     skip_lines: int = 0  # header rows to drop (delimited / fixed-width)
-    drop_errors: bool = True  # skip unparseable records vs raise
+    drop_errors: bool = True  # skip unparseable/invalid records vs raise
+    # converted-row validation (the reference CqlValidatorFactory hook;
+    # io.validators): a spec string ("index", "has-geo,z-bounds", ...),
+    # a sequence of names/Validator objects, or None. Failures count per
+    # reason in ``error_reasons`` and follow ``drop_errors`` skip/raise.
+    validators: "str | Sequence | None" = None
     # xml: tag of the per-feature element (reference geomesa-convert-xml
     # featurePath); fields address the element tree with $.child.grandchild
     # paths, attributes as @name segments ($.pos.@lat)
@@ -216,9 +221,13 @@ class Converter:
     fixed_widths: Sequence[tuple[int, int]] | None = None
 
     def __post_init__(self):
+        from geomesa_tpu.io.validators import parse_validators
+
         self._exprs = [(f.name, compile_expression(f.transform)) for f in self.fields]
         self._id_expr = compile_expression(self.id_field) if self.id_field else None
+        self._validators = parse_validators(self.validators, self.sft)
         self.errors = 0
+        self.error_reasons: dict = {}
 
     def convert(self, data: "str | bytes | io.IOBase") -> FeatureCollection:
         if self.fmt == "avro":  # binary format: never decode
@@ -241,15 +250,32 @@ class Converter:
         rows = []
         ids = []
         self.errors = 0
+        self.error_reasons = {}
+
+        def reject(reason: str) -> None:
+            self.errors += 1
+            self.error_reasons[reason] = self.error_reasons.get(reason, 0) + 1
+
         for i, rec in enumerate(records):
             try:
                 row = {name: expr(rec) for name, expr in self._exprs}
                 rid = str(self._id_expr(rec)) if self._id_expr else str(i)
             except Exception:
                 if self.drop_errors:
-                    self.errors += 1
+                    reject("parse")
                     continue
                 raise
+            failed = None
+            for v in self._validators:
+                reason = v.validate(row)
+                if reason is not None:
+                    failed = f"{v.name}: {reason}"
+                    break
+            if failed is not None:
+                if self.drop_errors:
+                    reject(failed)
+                    continue
+                raise ValueError(f"validation failed ({failed}): record {i}")
             rows.append(row)
             ids.append(rid)
         return FeatureCollection.from_rows(self.sft, rows, ids=ids)

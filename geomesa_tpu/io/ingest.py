@@ -94,11 +94,12 @@ def ingest_files(
 
     def commit(res):
         nonlocal base
-        idx, fc, errors, _parse_s, failure = res
+        idx, fc, errors, reasons, _parse_s, failure = res
         if failure is not None:
             raise_split_failure(failure, splits)
         result.split_errors.append(errors)
         result.errors += errors
+        result.add_reasons(reasons)
         if len(fc) == 0:
             return
         if id_prefix_splits and converter.id_field is None:

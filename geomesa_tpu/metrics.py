@@ -57,6 +57,13 @@ class MetricsRegistry:
         with self._lock:
             self.gauges[name] = float(value)
 
+    def counter_value(self, name: str) -> int:
+        """Current value of one counter (0 when never incremented) —
+        locked read for callers asserting on strategy counters
+        (geomesa.join.*, tests, bench gates)."""
+        with self._lock:
+            return self.counters.get(name, 0)
+
     def timer_update(self, name: str, seconds: float) -> None:
         """Record one timed duration (the locked half of :meth:`time`;
         also the entry point for callers that measured the span

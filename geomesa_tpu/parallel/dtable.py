@@ -58,28 +58,36 @@ def _shard_map(body, mesh, in_specs, out_specs):
 
 
 @lru_cache(maxsize=256)
-def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0):
+def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0, n_rints=0):
     """jit(shard_map): per-device block-bitmask scan -> (wide, inner)
     planes [D, M, PACK, 128], sharded along the mesh axis so the host's one
     device_get is the only cross-host movement. ``n_edges`` > 0 runs the
-    device point-in-polygon tier (edge block replicated to every device)."""
+    device point-in-polygon tier, ``n_rints`` > 0 the raster-interval
+    tier (edge/raster blocks replicated to every device)."""
     axis = mesh.axis_names[0]
 
     skip = bk.skip_inner_plane(has_boxes, extent)
 
     def body(bids, boxes, wins, *rest):
-        # with edges, one extra replicated arg precedes the sharded cols
-        edges, cols = (rest[0], rest[1:]) if n_edges else (None, rest)
+        # with edges/rast, extra replicated args precede the sharded cols
+        edges = rast = None
+        if n_edges:
+            edges, rest = rest[0], rest[1:]
+        if n_rints:
+            rast, rest = rest[0], rest[1:]
+        cols = rest
         w, i = bk.block_scan(
             tuple(c[0] for c in cols), bids[0], boxes, wins,
             col_names=names, has_boxes=has_boxes, has_windows=has_windows,
             extent=extent, edges=edges, n_edges=n_edges,
+            rast=rast, n_rints=n_rints,
         )
         return w[None] if skip else (w[None], i[None])
 
     in_specs = (
         (P(axis), P(), P())
         + ((P(),) if n_edges else ())
+        + ((P(),) if n_rints else ())
         + (P(axis),) * len(names)
     )
     return jax.jit(_shard_map(
@@ -88,34 +96,43 @@ def _dist_scan(mesh, names, has_boxes, has_windows, extent, n_edges=0):
 
 
 @lru_cache(maxsize=256)
-def _dist_scan_multi(mesh, names, has_boxes, has_windows, extent, n_edges=0):
+def _dist_scan_multi(mesh, names, has_boxes, has_windows, extent, n_edges=0,
+                     n_rints=0):
     """jit(shard_map): the FUSED multi-query scan on every device — one
     mesh-wide dispatch scans each device's [M] slot list (local block
     bids[d, i] under query qids[d, i]'s packed params) and emits
     (wide, inner) planes [D, M, PACK, 128] sharded along the mesh axis,
     so the host's one device_get is the only cross-host movement. The
-    param stacks (boxes/wins [Q, 8, 128], optional edges [Q, E, 128])
-    are replicated; ``spip`` [D, M] selects the PIP leg per slot. This is
-    the mesh shape of bk.block_scan_multi: Q dispatches per batch become
-    ONE, preserving the zero-recompile-after-warmup property (the compile
-    key is the same static (slots, Q, columns, flags, E) tuple)."""
+    param stacks (boxes/wins [Q, 8, 128], optional edges [Q, E, 128] and
+    rasters [Q, 1 + R, 128]) are replicated; ``spip`` [D, M] selects the
+    polygon leg per slot. This is the mesh shape of bk.block_scan_multi:
+    Q dispatches per batch become ONE, preserving the
+    zero-recompile-after-warmup property (the compile key is the same
+    static (slots, Q, columns, flags, E, R) tuple)."""
     axis = mesh.axis_names[0]
 
     skip = bk.skip_inner_plane(has_boxes, extent)
+    poly_leg = bool(n_edges or n_rints)
 
     def body(bids, qids, spip, boxes, wins, *rest):
-        edges, cols = (rest[0], rest[1:]) if n_edges else (None, rest)
+        edges = rasts = None
+        if n_edges:
+            edges, rest = rest[0], rest[1:]
+        if n_rints:
+            rasts, rest = rest[0], rest[1:]
+        cols = rest
         w, i = bk.block_scan_multi(
             tuple(c[0] for c in cols), bids[0], qids[0], boxes, wins,
             col_names=names, has_boxes=has_boxes, has_windows=has_windows,
-            extent=extent, edges=edges, spip=spip[0] if n_edges else None,
-            n_edges=n_edges,
+            extent=extent, edges=edges, spip=spip[0] if poly_leg else None,
+            n_edges=n_edges, rasts=rasts, n_rints=n_rints,
         )
         return w[None] if skip else (w[None], i[None])
 
     in_specs = (
         (P(axis), P(axis), P(axis), P(), P())
         + ((P(),) if n_edges else ())
+        + ((P(),) if n_rints else ())
         + (P(axis),) * len(names)
     )
     return jax.jit(_shard_map(
@@ -295,6 +312,8 @@ class DistributedIndexTable(IndexTable):
         check_deadline(deadline, "device scan dispatch")
         boxes, wins = self._fused_param_stacks(members)
         chunk_e, edges, pip = self._chunk_edge_stack(members)
+        chunk_r, rasts, has_rast = self._chunk_raster_stack(members)
+        poly_slot = pip | has_rast
         bids2 = np.zeros((D, slots), np.int32)
         qids2 = np.zeros((D, slots), np.int32)
         spip2 = np.zeros((D, slots), np.int32)
@@ -305,17 +324,20 @@ class DistributedIndexTable(IndexTable):
                 nb = len(loc)
                 bids2[d, pos : pos + nb] = loc
                 qids2[d, pos : pos + nb] = q
-                if chunk_e and pip[q]:
+                if (chunk_e or chunk_r) and poly_slot[q]:
                     spip2[d, pos : pos + nb] = 1
                 segs[q][d] = (pos, pos + nb)
                 pos += nb
         self._record_scan(names, bids2.size)
         fn = _dist_scan_multi(
-            self.mesh, names, has_boxes, has_windows, self.extent, chunk_e
+            self.mesh, names, has_boxes, has_windows, self.extent, chunk_e,
+            chunk_r,
         )
-        edge_args = (edges,) if chunk_e else ()
+        extra = (() if not chunk_e else (edges,)) + (
+            () if not chunk_r else (rasts,)
+        )
         out = fn(
-            bids2, qids2, spip2, boxes, wins, *edge_args,
+            bids2, qids2, spip2, boxes, wins, *extra,
             *self._cols_args(names),
         )
         wide, inner = out if isinstance(out, tuple) else (out, None)
@@ -350,14 +372,17 @@ class DistributedIndexTable(IndexTable):
         kw = self._scan_kernel_kwargs(config, self._scan_cols(config))
         names = kw["col_names"]
         n_edges = kw.get("n_edges", 0)
+        n_rints = kw.get("n_rints", 0)
         self._record_scan(names, bids2.size)
         fn = _dist_scan(
             self.mesh, names, kw["has_boxes"], kw["has_windows"], kw["extent"],
-            n_edges,
+            n_edges, n_rints,
         )
         skip = bk.skip_inner_plane(kw["has_boxes"], kw["extent"])
-        edge_args = (kw["edges"],) if n_edges else ()
-        out = fn(bids2, boxes, wins, *edge_args, *self._cols_args(names))  # dispatched now
+        extra = (() if not n_edges else (kw["edges"],)) + (
+            () if not n_rints else (kw["rast"],)
+        )
+        out = fn(bids2, boxes, wins, *extra, *self._cols_args(names))  # dispatched now
         # async device->host copies: see IndexTable._device_scan_submit
         for plane in out if isinstance(out, tuple) else (out,):
             if hasattr(plane, "copy_to_host_async"):

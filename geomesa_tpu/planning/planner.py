@@ -161,12 +161,13 @@ def mask_decides_filter(
     Z3IndexKeySpace.scala:240-254).
 
     ``for_aggregation``: device aggregation kernels evaluate the BOX wide
-    plane only — a polygon-tier config (config.poly) decides the filter
-    for row scans (certainty vector + host near-band refinement) but NOT
-    for gather-free aggregations, which would count the whole bbox."""
+    plane only — a polygon-tier config (config.poly / config.rast)
+    decides the filter for row scans (certainty vector + host
+    boundary-residue refinement) but NOT for gather-free aggregations,
+    which would count the whole bbox."""
     if config is None or not (config.geom_precise and config.time_precise):
         return False
-    if for_aggregation and config.poly is not None:
+    if for_aggregation and (config.poly is not None or config.rast is not None):
         return False
     kinds = _filter_leaf_kinds(f, sft.geom_field, sft.dtg_field)
     if kinds is None:

@@ -64,6 +64,25 @@ PALLAS_MAX_EDGES = 64  # above this the unrolled kernel gets too large
 # also FUSED_E_BUCKETS[-1]: every packed polygon fits a fused bucket.
 FUSED_E_BUCKETS = (16, 64, 256)
 
+# raster-interval ladder (round 7, arXiv 2307.01716): a polygon query may
+# additionally carry a packed [1 + R, 128] interval stack
+# (filter.raster.RasterApprox.pack_block) — sorted integer intervals of
+# fully-inside / boundary cells over a Z2-aligned grid. The kernel
+# classifies each candidate row by integer interval lookup (~5 vector ops
+# per interval vs ~10 per PIP edge) and runs exact even-odd PIP only on
+# the boundary residue — on device when the config also ships edges
+# (masks bit-identical to the pre-raster path), else via host refinement
+# of the uncertain rows. R = 0 is the no-raster variant. The stack is
+# deliberately COARSE (geomesa.raster.kernel.intervals, default 16):
+# the raster-derived z-ranges already prune out-cell rows host-side at
+# full resolution, so the kernel intervals only classify rows within
+# straddling blocks — measured on the 2M-point CPU bench, 16 coalesced
+# intervals kept the wide plane within ~2x of exact while cutting the
+# kernel to ~1/25 of the 256-edge-ladder PIP cost (PERF.md §13).
+R_BUCKETS = (16, 32, 64, 256)
+FUSED_R_BUCKETS = (16, 32, 64, 256)
+PALLAS_MAX_RINTS = 64  # unrolled interval checks; larger R rides XLA
+
 
 def fused_e_bucket(n: int) -> int:
     """Static fused-chunk edge bucket: the smallest FUSED_E_BUCKETS entry
@@ -71,6 +90,29 @@ def fused_e_bucket(n: int) -> int:
     if n <= 0:
         return 0
     return next(b for b in FUSED_E_BUCKETS if n <= b)
+
+
+def fused_r_bucket(n: int) -> int:
+    """Static fused-chunk raster-interval bucket: the smallest
+    FUSED_R_BUCKETS entry >= n, or 0 for a chunk with no raster member."""
+    if n <= 0:
+        return 0
+    return next(b for b in FUSED_R_BUCKETS if n <= b)
+
+
+def r_bucket_of(n: int) -> int:
+    """Static single-query interval bucket (R_BUCKETS ladder); run counts
+    past the largest bucket coalesce into it (pack_block's safe grouping),
+    so every raster fits a static shape."""
+    if n <= 0:
+        return 0
+    return next((b for b in R_BUCKETS if n <= b), R_BUCKETS[-1])
+
+
+def n_rints_of(rast: "np.ndarray | None") -> int:
+    """Static interval-bucket size of a pack_block stack (row 0 is the
+    grid header; 0 = no raster)."""
+    return 0 if rast is None else rast.shape[0] - 1
 
 # column-set signatures -> ordered device column names
 POINT_COLS = ("x", "y")
@@ -315,9 +357,59 @@ def _pip_loop(x, y, edges, n_edges: int):
     return lax.fori_loop(0, n_edges, body, (z, z))
 
 
+def _rint_step(c, in_grid, full, part, rast, k):
+    """ONE interval's contribution to the raster cell classification —
+    shared by the unrolled and fori_loop variants (``rast`` supports
+    scalar [row, lane] indexing: Pallas ref or jnp array). Row k + 1
+    (past the grid header) holds (lo, hi, cls); pad rows carry
+    lo = 1 > hi = 0 and never match."""
+    lo, hi, cl = rast[k + 1, 0], rast[k + 1, 1], rast[k + 1, 2]
+    hit = in_grid & (c >= lo) & (c <= hi)
+    return full | (hit & (cl > 0)), part | (hit & (cl < 0))
+
+
+def _raster_cell(x, y, rast):
+    """(cell id [SUB, 128] f32, in_grid bool) from the packed grid header.
+    Cell ids are exact f32 integers (max.cells <= 2^24); sentinel pad
+    rows (x = inf) fall outside the grid and classify OUT."""
+    x0, y0 = rast[0, 0], rast[0, 1]
+    icx, icy = rast[0, 2], rast[0, 3]
+    nx, ny = rast[0, 4], rast[0, 5]
+    cx = jnp.floor((x - x0) * icx)
+    cy = jnp.floor((y - y0) * icy)
+    in_grid = (cx >= 0) & (cx < nx) & (cy >= 0) & (cy < ny)
+    return cy * nx + cx, in_grid
+
+
+def _raster_unrolled(x, y, rast, n_rints: int):
+    """(full, part) raster-interval classification of [SUB, 128] points —
+    unrolled over the static interval count (Pallas and small-R XLA)."""
+    c, in_grid = _raster_cell(x, y, rast)
+    full = jnp.zeros(x.shape, dtype=jnp.bool_)
+    part = jnp.zeros(x.shape, dtype=jnp.bool_)
+    for k in range(n_rints):
+        full, part = _rint_step(c, in_grid, full, part, rast, k)
+    return full, part
+
+
+def _raster_loop(x, y, rast, n_rints: int):
+    """Same contract as _raster_unrolled via lax.fori_loop (XLA variant
+    for large R — keeps the HLO small; rast is a jnp array)."""
+    from jax import lax
+
+    c, in_grid = _raster_cell(x, y, rast)
+
+    def body(k, acc):
+        return _rint_step(c, in_grid, acc[0], acc[1], rast, k)
+
+    z = jnp.zeros(x.shape, dtype=jnp.bool_)
+    return lax.fori_loop(0, n_rints, body, (z, z))
+
+
 def _masks(
     cols: dict, boxes, wins, has_boxes: bool, has_windows: bool, extent: bool,
     edges=None, n_edges: int = 0, pip_loop: bool = False,
+    rast=None, n_rints: int = 0,
 ):
     """(wide, inner) boolean masks for one block's columns.
 
@@ -332,11 +424,31 @@ def _masks(
     inner = parity & ~near — rows outside the f32-uncertainty bands
     resolve ON DEVICE and the host refines only the near band (VERDICT r4
     #2: the always-refine polygon path moved on device).
+
+    With ``n_rints`` > 0 the raster-interval tier classifies each row
+    FIRST (arXiv 2307.01716): full cells are certain hits (wide + inner),
+    out cells certain misses, and only the boundary residue consults the
+    exact PIP — reusing _pip_unrolled/_pip_loop verbatim when edges ride
+    along (device residue, bit-identical masks on partial rows), else
+    wide-without-inner so the host refines the residue exactly.
     """
     one = None
     w_parts = []
     i_parts = []
-    if n_edges:
+    if n_rints:
+        x, y = cols["x"], cols["y"]
+        classify = _raster_loop if pip_loop else _raster_unrolled
+        full, part = classify(x, y, rast, n_rints)
+        if n_edges:
+            pip = _pip_loop if pip_loop else _pip_unrolled
+            parity, near = pip(x, y, edges, n_edges)
+            w_parts.append(full | (part & (parity | near)))
+            i_parts.append(full | (part & parity & ~near))
+        else:
+            w_parts.append(full | part)
+            i_parts.append(full)
+        one = x
+    elif n_edges:
         x, y = cols["x"], cols["y"]
         pip = _pip_loop if pip_loop else _pip_unrolled
         parity, near = pip(x, y, edges, n_edges)
@@ -439,19 +551,22 @@ def skip_inner_plane(has_boxes: bool, extent: bool) -> bool:
     return extent and has_boxes
 
 
-def _make_pallas_kernel(col_names, has_boxes, has_windows, extent, pack, n_edges=0):
+def _make_pallas_kernel(
+    col_names, has_boxes, has_windows, extent, pack, n_edges=0, n_rints=0
+):
     n = len(col_names)
     skip = skip_inner_plane(has_boxes, extent)
 
     def kernel(bids_ref, boxes_ref, wins_ref, *refs):
+        edges_ref = rast_ref = None
         if n_edges:
             edges_ref, refs = refs[0], refs[1:]
-        else:
-            edges_ref = None
+        if n_rints:
+            rast_ref, refs = refs[0], refs[1:]
         cols = {name: refs[k][0] for k, name in enumerate(col_names)}
         w, i = _masks(
             cols, boxes_ref, wins_ref, has_boxes, has_windows, extent,
-            edges=edges_ref, n_edges=n_edges,
+            edges=edges_ref, n_edges=n_edges, rast=rast_ref, n_rints=n_rints,
         )
         refs[n][0] = _pack_bits(w, pack)
         if not skip:
@@ -463,12 +578,13 @@ def _make_pallas_kernel(col_names, has_boxes, has_windows, extent, pack, n_edges
 @partial(
     jax.jit,
     static_argnames=(
-        "col_names", "has_boxes", "has_windows", "extent", "interpret", "n_edges"
+        "col_names", "has_boxes", "has_windows", "extent", "interpret",
+        "n_edges", "n_rints",
     ),
 )
 def _pallas_block_scan(
-    cols3, bids, boxes, wins, edges=None, *, col_names, has_boxes, has_windows,
-    extent, interpret, n_edges=0,
+    cols3, bids, boxes, wins, edges=None, rast=None, *, col_names, has_boxes,
+    has_windows, extent, interpret, n_edges=0, n_rints=0,
 ):
     """cols3: tuple of [n_blocks, SUB, 128] device arrays ordered by
     col_names. bids: i32 [M] candidate block ids (pads repeat block 0; host
@@ -481,10 +597,14 @@ def _pallas_block_scan(
     PACK = SUB // 32
     n_out = 1 if skip_inner_plane(has_boxes, extent) else 2
     kernel = _make_pallas_kernel(
-        col_names, has_boxes, has_windows, extent, PACK, n_edges
+        col_names, has_boxes, has_windows, extent, PACK, n_edges, n_rints
     )
     edge_specs = (
         [pl.BlockSpec((n_edges, LANES), lambda i, bids: (0, 0))] if n_edges else []
+    )
+    rast_specs = (
+        [pl.BlockSpec((1 + n_rints, LANES), lambda i, bids: (0, 0))]
+        if n_rints else []
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -494,6 +614,7 @@ def _pallas_block_scan(
             pl.BlockSpec((8, LANES), lambda i, bids: (0, 0)),
         ]
         + edge_specs
+        + rast_specs
         + [
             pl.BlockSpec((1, SUB, LANES), lambda i, bids: (bids[i], 0, 0))
             for _ in col_names
@@ -502,23 +623,25 @@ def _pallas_block_scan(
             pl.BlockSpec((1, PACK, LANES), lambda i, bids: (i, 0, 0))
         ] * n_out,
     )
-    edge_args = (edges,) if n_edges else ()
+    extra = (() if not n_edges else (edges,)) + (() if not n_rints else (rast,))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((M, PACK, LANES), jnp.int32)] * n_out,
         interpret=interpret,
-    )(bids, boxes, wins, *edge_args, *cols3)
+    )(bids, boxes, wins, *extra, *cols3)
     return (out[0], None) if n_out == 1 else (out[0], out[1])
 
 
 @partial(
     jax.jit,
-    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "n_edges"),
+    static_argnames=(
+        "col_names", "has_boxes", "has_windows", "extent", "n_edges", "n_rints"
+    ),
 )
 def _xla_block_scan(
-    cols3, bids, boxes, wins, edges=None, *, col_names, has_boxes, has_windows,
-    extent, n_edges=0,
+    cols3, bids, boxes, wins, edges=None, rast=None, *, col_names, has_boxes,
+    has_windows, extent, n_edges=0, n_rints=0,
 ):
     """Same contract as the Pallas kernel via plain XLA (gather of candidate
     blocks). Used on CPU (tests), as a portability fallback, and for
@@ -528,6 +651,7 @@ def _xla_block_scan(
     w, i = _masks(
         gathered, boxes, wins, has_boxes, has_windows, extent,
         edges=edges, n_edges=n_edges, pip_loop=True,
+        rast=rast, n_rints=n_rints,
     )
     shifts = jnp.arange(32, dtype=jnp.int32)[None, None, :, None]
     M = bids.shape[0]
@@ -544,53 +668,64 @@ def _xla_block_scan(
 
 def block_scan(
     cols3, bids, boxes, wins, *, col_names, has_boxes, has_windows, extent,
-    edges=None, n_edges=0,
+    edges=None, n_edges=0, rast=None, n_rints=0,
 ):
     """Dispatch to Pallas (TPU) / interpret / XLA by backend. All shapes
-    static: (len(bids), col_names, flags, n_edges) determine the compiled
-    variant. Returns (wide, inner) planes; inner is None when
+    static: (len(bids), col_names, flags, n_edges, n_rints) determine the
+    compiled variant. Returns (wide, inner) planes; inner is None when
     skip_inner_plane() (extent box scans — identically false)."""
-    if use_pallas() and n_edges <= PALLAS_MAX_EDGES:
+    if use_pallas() and n_edges <= PALLAS_MAX_EDGES and n_rints <= PALLAS_MAX_RINTS:
         interpret = jax.default_backend() != "tpu"
         return _pallas_block_scan(
-            cols3, bids, boxes, wins, edges,
+            cols3, bids, boxes, wins, edges, rast,
             col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
-            extent=extent, interpret=interpret, n_edges=n_edges,
+            extent=extent, interpret=interpret, n_edges=n_edges, n_rints=n_rints,
         )
     return _xla_block_scan(
-        cols3, bids, boxes, wins, edges,
+        cols3, bids, boxes, wins, edges, rast,
         col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
-        extent=extent, n_edges=n_edges,
+        extent=extent, n_edges=n_edges, n_rints=n_rints,
     )
 
 
 # ------------------------------------------------ fused multi-query scan
 
 
-def _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, pack, n_edges=0):
+def _make_pallas_kernel_multi(
+    col_names, has_boxes, has_windows, extent, pack, n_edges=0, n_rints=0
+):
     n = len(col_names)
     skip = skip_inner_plane(has_boxes, extent)
+    poly_leg = bool(n_edges or n_rints)
 
     def kernel(bids_ref, qids_ref, *refs):
         from jax.experimental import pallas as pl
 
         del bids_ref, qids_ref  # consumed by the index maps
-        if n_edges:
-            spip_ref, boxes_ref, wins_ref, edges_ref = refs[:4]
-            refs = refs[4:]
+        edges_ref = rast_ref = None
+        if poly_leg:
+            spip_ref, boxes_ref, wins_ref = refs[:3]
+            refs = refs[3:]
+            if n_edges:
+                edges_ref, refs = refs[0], refs[1:]
+            if n_rints:
+                rast_ref, refs = refs[0], refs[1:]
         else:
             boxes_ref, wins_ref = refs[:2]
             refs = refs[2:]
         cols = {name: refs[k][0] for k, name in enumerate(col_names)}
         w, i = _masks(cols, boxes_ref[0], wins_ref[0], has_boxes, has_windows, extent)
-        if n_edges:
-            # PIP leg: the same _masks with this slot's query edge block —
-            # selected per SLOT by the scalar-prefetched spip flag, so box
-            # and polygon queries share one fused chunk (a box query's
-            # slot keeps the box leg; its zero-edge stack row is unused)
+        if poly_leg:
+            # polygon leg: the same _masks with this slot's query edge /
+            # raster-interval blocks — selected per SLOT by the
+            # scalar-prefetched spip flag, so box and polygon queries
+            # share one fused chunk (a box query's slot keeps the box
+            # leg; its zero-padded stack rows are unused)
             wp, ip = _masks(
                 cols, boxes_ref[0], wins_ref[0], has_boxes, has_windows,
-                extent, edges=edges_ref[0], n_edges=n_edges,
+                extent, edges=edges_ref[0] if n_edges else None,
+                n_edges=n_edges,
+                rast=rast_ref[0] if n_rints else None, n_rints=n_rints,
             )
             use_pip = spip_ref[pl.program_id(0)] > 0
             w = jnp.where(use_pip, wp, w)
@@ -605,20 +740,23 @@ def _make_pallas_kernel_multi(col_names, has_boxes, has_windows, extent, pack, n
 @partial(
     jax.jit,
     static_argnames=(
-        "col_names", "has_boxes", "has_windows", "extent", "interpret", "n_edges"
+        "col_names", "has_boxes", "has_windows", "extent", "interpret",
+        "n_edges", "n_rints",
     ),
 )
 def _pallas_block_scan_multi(
-    cols3, bids, qids, boxes, wins, edges=None, spip=None, *, col_names,
-    has_boxes, has_windows, extent, interpret, n_edges=0,
+    cols3, bids, qids, boxes, wins, edges=None, spip=None, rasts=None, *,
+    col_names, has_boxes, has_windows, extent, interpret, n_edges=0, n_rints=0,
 ):
     """Fused form of _pallas_block_scan: slot i scans block bids[i] against
     query qids[i]'s packed params (boxes/wins are [Q, 8, 128]). Two
     scalar-prefetch operands drive the index maps; everything else is the
-    single-query kernel per slot. With ``n_edges`` > 0 a third
-    scalar-prefetch operand ``spip`` ([M] i32, 1 = this slot's query runs
-    the PIP tier) and a [Q, n_edges, 128] ``edges`` stack (gathered per
-    slot by qid, like boxes/wins) add the fused point-in-polygon leg."""
+    single-query kernel per slot. With ``n_edges`` or ``n_rints`` > 0 a
+    third scalar-prefetch operand ``spip`` ([M] i32, 1 = this slot's query
+    runs the polygon tier) plus per-query [Q, n_edges, 128] ``edges`` /
+    [Q, 1 + n_rints, 128] ``rasts`` stacks (gathered per slot by qid,
+    like boxes/wins) add the fused point-in-polygon / raster-interval
+    legs."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -627,9 +765,9 @@ def _pallas_block_scan_multi(
     PACK = SUB // 32
     n_out = 1 if skip_inner_plane(has_boxes, extent) else 2
     kernel = _make_pallas_kernel_multi(
-        col_names, has_boxes, has_windows, extent, PACK, n_edges
+        col_names, has_boxes, has_windows, extent, PACK, n_edges, n_rints
     )
-    if n_edges:
+    if n_edges or n_rints:
         by_q = lambda i, bids, qids, spip: (qids[i], 0, 0)  # noqa: E731
         by_b = lambda i, bids, qids, spip: (bids[i], 0, 0)  # noqa: E731
         by_i = lambda i, bids, qids, spip: (i, 0, 0)        # noqa: E731
@@ -637,9 +775,15 @@ def _pallas_block_scan_multi(
         param_specs = [
             pl.BlockSpec((1, 8, LANES), by_q),
             pl.BlockSpec((1, 8, LANES), by_q),
-            pl.BlockSpec((1, n_edges, LANES), by_q),
         ]
-        args = (bids, qids, spip, boxes, wins, edges)
+        extra = ()
+        if n_edges:
+            param_specs.append(pl.BlockSpec((1, n_edges, LANES), by_q))
+            extra = extra + (edges,)
+        if n_rints:
+            param_specs.append(pl.BlockSpec((1, 1 + n_rints, LANES), by_q))
+            extra = extra + (rasts,)
+        args = (bids, qids, spip, boxes, wins) + extra
     else:
         by_b = lambda i, bids, qids: (bids[i], 0, 0)        # noqa: E731
         by_i = lambda i, bids, qids: (i, 0, 0)              # noqa: E731
@@ -668,59 +812,62 @@ def _pallas_block_scan_multi(
 
 @partial(
     jax.jit,
-    static_argnames=("col_names", "has_boxes", "has_windows", "extent", "n_edges"),
+    static_argnames=(
+        "col_names", "has_boxes", "has_windows", "extent", "n_edges", "n_rints"
+    ),
 )
 def _xla_block_scan_multi(
-    cols3, bids, qids, boxes, wins, edges=None, spip=None, *, col_names,
-    has_boxes, has_windows, extent, n_edges=0,
+    cols3, bids, qids, boxes, wins, edges=None, spip=None, rasts=None, *,
+    col_names, has_boxes, has_windows, extent, n_edges=0, n_rints=0,
 ):
     """XLA fallback for the fused multi-query scan: gather each slot's
     column block and params, vmap the single-block mask over slots. With
-    ``n_edges`` > 0 the per-slot edge block (``edges[qids]``) and the
-    ``spip`` selector add the PIP leg — the fori_loop PIP variant keeps
-    the HLO small at large E, exactly like the single-query XLA kernel."""
+    ``n_edges``/``n_rints`` > 0 the per-slot edge/raster blocks
+    (``edges[qids]``/``rasts[qids]``) and the ``spip`` selector add the
+    polygon leg — the fori_loop variants keep the HLO small at large
+    E/R, exactly like the single-query XLA kernel."""
     PACK = cols3[0].shape[1] // 32
     gathered = tuple(c[bids] for c in cols3)
     bq, wq = boxes[qids], wins[qids]
     skip = skip_inner_plane(has_boxes, extent)
+    poly_leg = bool(n_edges or n_rints)
 
-    def slot_masks(box, win, eb, sp, *colblk):
+    def slot_masks(box, win, eb, rb, sp, *colblk):
         cols = dict(zip(col_names, colblk))
         w, i = _masks(cols, box, win, has_boxes, has_windows, extent)
-        if n_edges:
+        if poly_leg:
             wp, ip = _masks(
                 cols, box, win, has_boxes, has_windows, extent,
-                edges=eb, n_edges=n_edges, pip_loop=True,
+                edges=eb if n_edges else None, n_edges=n_edges, pip_loop=True,
+                rast=rb if n_rints else None, n_rints=n_rints,
             )
             w = jnp.where(sp > 0, wp, w)
             i = jnp.where(sp > 0, ip, i)
         return w, i
 
-    if n_edges:
-        eq, sq = edges[qids], spip
-    else:
-        # dummy per-slot operands so ONE vmapped body serves both shapes
-        eq = jnp.zeros((bids.shape[0], 1), jnp.float32)
-        sq = jnp.zeros(bids.shape[0], jnp.int32)
+    # dummy per-slot operands so ONE vmapped body serves every shape
+    eq = edges[qids] if n_edges else jnp.zeros((bids.shape[0], 1), jnp.float32)
+    rq = rasts[qids] if n_rints else jnp.zeros((bids.shape[0], 1), jnp.float32)
+    sq = spip if poly_leg else jnp.zeros(bids.shape[0], jnp.int32)
 
     if skip:
 
-        def per_block_w(box, win, eb, sp, *colblk):
-            w, _ = slot_masks(box, win, eb, sp, *colblk)
+        def per_block_w(box, win, eb, rb, sp, *colblk):
+            w, _ = slot_masks(box, win, eb, rb, sp, *colblk)
             return _pack_bits(w, PACK)
 
-        return jax.vmap(per_block_w)(bq, wq, eq, sq, *gathered), None
+        return jax.vmap(per_block_w)(bq, wq, eq, rq, sq, *gathered), None
 
-    def per_block(box, win, eb, sp, *colblk):
-        w, i = slot_masks(box, win, eb, sp, *colblk)
+    def per_block(box, win, eb, rb, sp, *colblk):
+        w, i = slot_masks(box, win, eb, rb, sp, *colblk)
         return _pack_bits(w, PACK), _pack_bits(i, PACK)
 
-    return jax.vmap(per_block)(bq, wq, eq, sq, *gathered)
+    return jax.vmap(per_block)(bq, wq, eq, rq, sq, *gathered)
 
 
 def block_scan_multi(
     cols3, bids, qids, boxes, wins, *, col_names, has_boxes, has_windows,
-    extent, edges=None, spip=None, n_edges=0,
+    extent, edges=None, spip=None, n_edges=0, rasts=None, n_rints=0,
 ):
     """Fused multi-query scan (round 5): ONE kernel dispatch scans many
     queries' candidate blocks — slot i reads block ``bids[i]`` with query
@@ -738,23 +885,32 @@ def block_scan_multi(
     Past PALLAS_MAX_EDGES the chunk rides the XLA variant (the unrolled
     Pallas kernel gets too large), same as the single-query ladder.
 
+    Raster fusion (round 7): ``n_rints`` > 0 adds a [Q, 1 + n_rints, 128]
+    ``rasts`` stack (RasterApprox.pack_block blocks zero-padded to the
+    chunk's FUSED_R_BUCKETS bucket) — slots whose query carries a raster
+    classify rows by integer interval lookup first, running the exact PIP
+    only on the boundary residue (in-kernel when edges ride along, else
+    via host refinement of the uncertain rows). The ``spip`` selector
+    covers both polygon tiers.
+
     Static compile key: (M bucket, Q stack height, col_names, flags,
-    n_edges). Production callers use the canonical fixed chunk shape —
-    ``IndexTable.fused_slots`` x FUSED_CHUNK_Q (storage.table) — so ONE
-    compiled variant per (columns, flags, E bucket) serves every batch;
-    :func:`bucket_q` is a test-only helper for hand-built param stacks.
+    n_edges, n_rints). Production callers use the canonical fixed chunk
+    shape — ``IndexTable.fused_slots`` x FUSED_CHUNK_Q (storage.table) —
+    so ONE compiled variant per (columns, flags, E bucket, R bucket)
+    serves every batch; :func:`bucket_q` is a test-only helper for
+    hand-built param stacks.
     """
-    if use_pallas() and n_edges <= PALLAS_MAX_EDGES:
+    if use_pallas() and n_edges <= PALLAS_MAX_EDGES and n_rints <= PALLAS_MAX_RINTS:
         interpret = jax.default_backend() != "tpu"
         return _pallas_block_scan_multi(
-            cols3, bids, qids, boxes, wins, edges, spip,
+            cols3, bids, qids, boxes, wins, edges, spip, rasts,
             col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
-            extent=extent, interpret=interpret, n_edges=n_edges,
+            extent=extent, interpret=interpret, n_edges=n_edges, n_rints=n_rints,
         )
     return _xla_block_scan_multi(
-        cols3, bids, qids, boxes, wins, edges, spip,
+        cols3, bids, qids, boxes, wins, edges, spip, rasts,
         col_names=col_names, has_boxes=has_boxes, has_windows=has_windows,
-        extent=extent, n_edges=n_edges,
+        extent=extent, n_edges=n_edges, n_rints=n_rints,
     )
 
 

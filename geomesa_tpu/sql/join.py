@@ -1,4 +1,4 @@
-"""Grid-partitioned spatial join.
+"""Grid-partitioned spatial join with selectivity-adaptive planning.
 
 Reference: GeoMesaJoinRelation — both sides are partitioned by an envelope
 grid, candidate pairs form within each cell, and the exact JTS predicate
@@ -8,10 +8,32 @@ RelationUtils.grid). The TPU redesign keeps the grid partitioning but the
 candidate stage is one vectorized bbox-overlap test per cell (the bbox
 columns are exactly what the scan kernels use), with the exact geometry
 predicate applied only to surviving pairs.
+
+Adaptive planning (round 7; arXiv 1802.09488 + the cache tier's adaptive
+cost gate, cache/tiles.py): no single strategy wins every partition, so
+the join picks PER PARTITION from measured selectivity —
+
+- ``spatial_join``: each polygon-left partition samples its candidates'
+  raster-cell selectivity (filter.raster) and chooses between the plain
+  vectorized bbox+exact pairing and the raster-filtered pairing
+  (definite-in/definite-out by integer interval check, exact PIP only on
+  the boundary residue), using live EWMAs of both predicates' measured
+  unit costs;
+- ``spatial_join_indexed``: polygons whose candidate spans cover more
+  than ``geomesa.join.broad.fraction`` of the table skip the fused-scan
+  probe and classify the whole point set against their raster on host
+  (one vectorized pass beats scanning ~the entire store through the
+  kernel); everything else keeps the fused-scan probe, which itself now
+  rides the raster tier via ScanConfig.rast.
+
+Either strategy returns bit-identical pairs — the adaptive layer moves
+work, never answers.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -19,6 +41,46 @@ import numpy as np
 from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
+from geomesa_tpu.metrics import resolve as _resolve_metrics
+
+
+class _AdaptiveGate:
+    """Measured-cost strategy picker (the tile cache's adaptive-gate
+    pattern): EWMAs of the exact predicate's per-(point x edge) cost and
+    the raster classification's per-point cost, updated from every
+    partition actually executed. Predictions are per partition:
+    plain = n * E * pip vs raster = n * cls + boundary_frac * n * E * pip
+    with ``boundary_frac`` the partition's sampled selectivity."""
+
+    _ALPHA = 0.25
+
+    def __init__(self):
+        self.pip_s: float | None = None  # seconds per point*edge
+        self.cls_s: float | None = None  # seconds per classified point
+        self._lock = threading.Lock()
+
+    def update(self, kind: str, seconds: float, units: int) -> None:
+        if units <= 0 or seconds <= 0:
+            return
+        per = seconds / units
+        with self._lock:
+            cur = getattr(self, kind)
+            setattr(
+                self, kind,
+                per if cur is None else (1 - self._ALPHA) * cur + self._ALPHA * per,
+            )
+
+    def pick(self, n_cand: int, n_edges: int, boundary_frac: float) -> str:
+        # cold-start priors from the measured CPU bench (PERF.md §13);
+        # real measurements take over after the first partitions
+        pip = self.pip_s if self.pip_s is not None else 4e-9
+        cls = self.cls_s if self.cls_s is not None else 2e-8
+        plain = n_cand * n_edges * pip
+        rast = n_cand * cls + boundary_frac * n_cand * n_edges * pip
+        return "raster" if rast < plain else "exact"
+
+
+_GATE = _AdaptiveGate()
 
 
 def _bboxes(fc: FeatureCollection) -> np.ndarray:
@@ -77,6 +139,8 @@ def spatial_join(
     predicate: "str | Callable" = "intersects",
     grid: tuple[int, int] = (32, 32),
     max_distance: float | None = None,
+    strategy: str = "auto",
+    metrics=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Join two collections on a spatial predicate.
 
@@ -85,7 +149,17 @@ def spatial_join(
     (left contains right) | "within" (left within right) | "dwithin"
     (requires ``max_distance``, planar degrees) | a callable
     (Geometry, Geometry) -> bool.
+
+    ``strategy`` (polygon-left x point-right partitions only): "auto"
+    picks per partition between the plain exact pairing and the
+    raster-filtered pairing from sampled boundary-cell selectivity and
+    measured costs (see module docstring); "exact" / "raster" force one
+    side. Results are identical either way. ``metrics``: optional
+    MetricsRegistry for the geomesa.join.strategy.* counters (the
+    process-global registry by default).
     """
+    if strategy not in ("auto", "exact", "raster"):
+        raise ValueError(f"unknown join strategy {strategy!r}")
     if len(left) == 0 or len(right) == 0:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
 
@@ -123,6 +197,7 @@ def spatial_join(
         return _join_points_right(
             left, right, lb, pred, predicate,
             x0, y0, inv_cx, inv_cy, nx, ny, li,
+            strategy=strategy, metrics=metrics,
         )
 
     # assign features to covered cells (extents span multiple)
@@ -174,7 +249,85 @@ def spatial_join(
     return out[:, 0], out[:, 1]
 
 
-def _join_points_right(left, right, lb, pred, predicate, x0, y0, inv_cx, inv_cy, nx, ny, li):
+def _polygon_inside(xs, ys, ga, predicate, approx, metrics, cls=None):
+    """Which candidate points satisfy ``predicate`` against polygon
+    ``ga`` — the raster-filtered pairing: interval classification first
+    (definite in/out need no geometry math), exact even-odd PIP +
+    boundary test only on the boundary-cell residue. Bit-identical to
+    the plain pairing: full cells are strictly interior (margin), out
+    cells strictly exterior, so only partial-cell points can differ
+    from — and they run — the exact code. ``cls``: optionally reuse an
+    already-computed classification of exactly these points."""
+    if cls is None:
+        t0 = time.perf_counter()
+        cls = approx.classify_points(xs, ys)
+        _GATE.update("cls_s", time.perf_counter() - t0, len(xs))
+    inside = cls == geo.RASTER_FULL
+    bidx = np.flatnonzero(cls == geo.RASTER_PARTIAL)
+    metrics.counter("geomesa.join.raster.decided", len(xs) - len(bidx))
+    metrics.counter("geomesa.join.raster.residue", len(bidx))
+    if len(bidx):
+        t0 = time.perf_counter()
+        inside[bidx] = geo.points_in_polygon(xs[bidx], ys[bidx], ga)
+        if predicate != "contains":  # intersects counts boundary points
+            nb = bidx[~inside[bidx]]
+            if len(nb):
+                onb = geo.points_on_boundary(xs[nb], ys[nb], ga)
+                inside[nb[onb]] = True
+        _GATE.update(
+            "pip_s", time.perf_counter() - t0, len(bidx) * _edge_count(ga)
+        )
+    return inside
+
+
+def _plain_inside(xs, ys, ga, predicate):
+    """The pre-raster exact pairing: even-odd PIP over every candidate,
+    boundary test on the non-interior residue for intersects."""
+    t0 = time.perf_counter()
+    inside = geo.points_in_polygon(xs, ys, ga)
+    if predicate != "contains":  # intersects counts boundary points
+        out_idx = np.flatnonzero(~inside)
+        if len(out_idx):
+            onb = geo.points_on_boundary(xs[out_idx], ys[out_idx], ga)
+            inside[out_idx[onb]] = True
+    _GATE.update("pip_s", time.perf_counter() - t0, len(xs) * _edge_count(ga))
+    return inside
+
+
+def _edge_count(ga) -> int:
+    return sum(len(r) - 1 for r in geo._rings_of(ga))
+
+
+def _pick_strategy(xs, ys, ga, approx, strategy):
+    """Per-partition strategy decision (arXiv 1802.09488): sample the
+    candidates' raster-cell selectivity, predict both strategies' costs
+    from the gate's measured EWMAs, take the cheaper. Returns
+    (strategy, full classification | None) — when the partition is
+    smaller than the sample size the 'sample' covered every candidate,
+    and the raster branch reuses it instead of classifying twice."""
+    if approx is None:
+        return "exact", None
+    if strategy != "auto":
+        return strategy, None
+    from geomesa_tpu.conf import JOIN_SAMPLE
+
+    s = max(int(JOIN_SAMPLE.get()), 1)
+    step = max(len(xs) // s, 1)
+    t0 = time.perf_counter()
+    sample = approx.classify_points(xs[::step], ys[::step])
+    _GATE.update("cls_s", time.perf_counter() - t0, max(len(xs) // step, 1))
+    frac_b = float((sample == geo.RASTER_PARTIAL).mean())
+    chosen = _GATE.pick(len(xs), _edge_count(ga), frac_b)
+    return chosen, sample if step == 1 else None
+
+
+def _join_points_right(left, right, lb, pred, predicate, x0, y0, inv_cx,
+                       inv_cy, nx, ny, li, strategy="auto", metrics=None):
+    from geomesa_tpu.conf import JOIN_ADAPTIVE
+    from geomesa_tpu.filter import raster as fr
+
+    metrics = _resolve_metrics(metrics)
+    adaptive = JOIN_ADAPTIVE.get() and strategy != "exact"
     col = right.geom_column
     px, py = col.x, col.y
     cx = np.clip(((px - x0) * inv_cx).astype(np.int64), 0, nx - 1)
@@ -224,12 +377,16 @@ def _join_points_right(left, right, lb, pred, predicate, x0, y0, inv_cx, inv_cy,
             continue
         ga = _geom(left, int(k))
         if isinstance(ga, (geo.Polygon, geo.MultiPolygon)):
-            inside = geo.points_in_polygon(xs, ys, ga)
-            if predicate != "contains":  # intersects counts boundary points
-                out_idx = np.flatnonzero(~inside)
-                if len(out_idx):
-                    onb = geo.points_on_boundary(xs[out_idx], ys[out_idx], ga)
-                    inside[out_idx[onb]] = True
+            approx = fr.raster_for(ga) if adaptive else None
+            chosen, pre_cls = _pick_strategy(xs, ys, ga, approx, strategy)
+            if chosen == "raster" and approx is not None:
+                metrics.counter("geomesa.join.strategy.raster")
+                inside = _polygon_inside(
+                    xs, ys, ga, predicate, approx, metrics, cls=pre_cls
+                )
+            else:
+                metrics.counter("geomesa.join.strategy.exact")
+                inside = _plain_inside(xs, ys, ga, predicate)
             hit = sel[inside]
             if len(hit):
                 L.append(np.full(len(hit), k, dtype=np.int64))
@@ -279,6 +436,7 @@ def spatial_join_indexed(
     left: FeatureCollection,
     predicate: str = "contains",
     index: str = "z2",
+    metrics=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Device-side spatial join against an INDEXED point store (VERDICT
     r4 #3): every left geometry becomes one pipelined device scan over the
@@ -319,34 +477,68 @@ def spatial_join_indexed(
     if not isinstance(pts, PointColumn):
         raise TypeError("indexed join requires a point store")
 
+    from geomesa_tpu.conf import JOIN_ADAPTIVE, JOIN_BROAD_FRACTION
+    from geomesa_tpu.filter import raster as fr
+
+    metrics = _resolve_metrics(metrics)
+    broad_frac = float(JOIN_BROAD_FRACTION.get())
+    adaptive = bool(JOIN_ADAPTIVE.get())
+
     lgeoms = left.geometries()
     # ONE fused dispatch for all left geometries' scans: scan_submit_many
-    # groups box AND polygon-PIP scans into shared kernel chunks (the
-    # per-query edge stacks of round 6), so a polygon-heavy join pays
-    # O(chunks) dispatches instead of O(polygons)
+    # groups box, polygon-PIP, and raster-interval scans into shared
+    # kernel chunks (the per-query edge/raster stacks), so a
+    # polygon-heavy join pays O(chunks) dispatches instead of
+    # O(polygons). Adaptive strategy (arXiv 1802.09488): a polygon whose
+    # candidate spans cover most of the table would scan ~the whole
+    # store through the kernel — ONE vectorized host pass over its
+    # raster classes is cheaper, so broad partitions take that route
+    # instead (measured selectivity = candidate rows / table rows).
     cfgs: list = []
     exacts: list[bool] = []
-    for g in lgeoms:
+    host_results: dict[int, np.ndarray] = {}
+    for k, g in enumerate(lgeoms):
         rect = geo.is_rectangle(g)
         f = BBox(gf, *g.bounds()) if rect else Intersects(gf, g)
         cfg = idx.scan_config(f)
         if cfg is None or cfg.disjoint:
             cfgs.append(None)
             exacts.append(False)
-        else:
-            # certainty is only trustworthy when the device evaluated the
-            # TRUE predicate: the shrunk box for rectangles, the PIP tier
-            # for polygons. A polygon past the edge-bucket ladder
-            # (cfg.poly None) gets bbox certainty only — every row must
-            # host-refine or bbox-inside-but-outside-polygon points would
-            # join as false pairs
-            cfgs.append(cfg)
-            exacts.append(rect or cfg.poly is not None)
+            continue
+        if adaptive and not rect and not cfg.disjoint:
+            spans = table.candidate_spans(cfg)
+            cand_rows = sum(hi - lo for lo, hi in spans)
+            if cand_rows > broad_frac * max(table.n, 1):
+                approx = fr.raster_for(g)
+                if approx is not None:
+                    metrics.counter("geomesa.join.strategy.host_raster")
+                    inside = _polygon_inside(
+                        np.asarray(pts.x, np.float64),
+                        np.asarray(pts.y, np.float64),
+                        g, predicate, approx, metrics,
+                    )
+                    host_results[k] = np.flatnonzero(inside).astype(np.int64)
+                    cfgs.append(None)
+                    exacts.append(False)
+                    continue
+        metrics.counter("geomesa.join.strategy.probe")
+        # certainty is only trustworthy when the device evaluated the
+        # TRUE predicate: the shrunk box for rectangles, the PIP or
+        # raster tiers for polygons. A polygon past the edge-bucket
+        # ladder with no raster (cfg.poly and cfg.rast both None) gets
+        # bbox certainty only — every row must host-refine or
+        # bbox-inside-but-outside-polygon points would join as false
+        # pairs
+        cfgs.append(cfg)
+        exacts.append(rect or cfg.poly is not None or cfg.rast is not None)
     live_idx = [k for k, c in enumerate(cfgs) if c is not None]
     fins = table.scan_submit_many([cfgs[k] for k in live_idx])
 
-    lo_parts: list[np.ndarray] = []
-    ro_parts: list[np.ndarray] = []
+    # per-left ordinal results keyed by k, emitted in k order at the end
+    # so the documented (left, right) sort holds across strategies
+    per_left: dict[int, np.ndarray] = {
+        k: ords for k, ords in host_results.items() if len(ords)
+    }
     for k, fin in zip(live_idx, fins):
         ordinals, certain = fin()
         exact_on_device = exacts[k]
@@ -376,11 +568,17 @@ def spatial_join_indexed(
             keep = certain.copy()
             keep[unc] = ok
             ordinals = ordinals[keep]
-        lo_parts.append(np.full(len(ordinals), k, dtype=np.int64))
-        # decode yields TABLE-row order; perm makes that non-monotonic in
-        # feature ordinals — sort so the documented (left, right) pair
-        # order actually holds
-        ro_parts.append(np.sort(ordinals))
-    if not lo_parts:
+        if len(ordinals):
+            # decode yields TABLE-row order; perm makes that
+            # non-monotonic in feature ordinals — sort so the documented
+            # (left, right) pair order actually holds
+            per_left[k] = np.sort(ordinals)
+    if not per_left:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    lo_parts = []
+    ro_parts = []
+    for k in sorted(per_left):
+        ords = per_left[k]
+        lo_parts.append(np.full(len(ords), k, dtype=np.int64))
+        ro_parts.append(ords)
     return np.concatenate(lo_parts), np.concatenate(ro_parts)

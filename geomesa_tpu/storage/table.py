@@ -600,25 +600,29 @@ class IndexTable(SortedKeys):
                 continue
             blocks = self._full_or(blocks)
             names = self._scan_cols(config)
-            # the E bucket is part of the variant key: box queries group
-            # at E = 0 (their slots keep the round-5 zero-edge kernel
-            # cost and the Pallas path), polygons group per fused bucket
-            # — a 256-edge member must not inflate every box slot to
-            # 256-edge PIP work, nor demote the chunk past
-            # PALLAS_MAX_EDGES to the XLA variant, just to share one
-            # dispatch
+            # the E and R buckets are part of the variant key: box
+            # queries group at E = R = 0 (their slots keep the round-5
+            # zero-edge kernel cost and the Pallas path), polygons group
+            # per fused bucket — a 256-edge member must not inflate
+            # every box slot to 256-edge PIP work, nor demote the chunk
+            # past PALLAS_MAX_EDGES/RINTS to the XLA variant, just to
+            # share one dispatch
             e_bucket = (
                 0 if self.extent
                 else bk.fused_e_bucket(bk.n_edges_of(config.poly))
             )
+            r_bucket = (
+                0 if self.extent
+                else bk.fused_r_bucket(bk.n_rints_of(config.rast))
+            )
             key = (
                 names, config.boxes is not None, config.windows is not None,
-                e_bucket,
+                e_bucket, r_bucket,
             )
             groups.setdefault(key, []).append((j, config, blocks, overlap, contained))
 
         slots = self.fused_pack_capacity
-        for (names, has_boxes, has_windows, _e), group_members in groups.items():
+        for (names, has_boxes, has_windows, _e, _r), group_members in groups.items():
             # pack members into fixed-shape chunks (fused_pack_capacity /
             # FUSED_CHUNK_Q — see the constants' doctrine note). Broad
             # members (> half a chunk, e.g. _full_or expansions) dispatch
@@ -728,6 +732,30 @@ class IndexTable(SortedKeys):
                 pip[q] = True
         return chunk_e, edges, pip
 
+    def _chunk_raster_stack(self, members):
+        """(chunk_R, rasts [FUSED_CHUNK_Q, 1 + chunk_R, 128] | None,
+        rast [Q] bool) for one fused chunk: the per-query raster-interval
+        stack (RasterApprox.pack_block header + intervals), sized to the
+        chunk's largest member raster and zero-padded per query (pad
+        interval rows never match; an all-zero header classifies every
+        row out-of-grid, and such slots never select the polygon leg).
+        Extent tables ride R = 0 like they ride E = 0."""
+        has = np.zeros(len(members), bool)
+        if self.extent:
+            return 0, None, has
+        chunk_r = bk.fused_r_bucket(
+            max(bk.n_rints_of(m[1].rast) for m in members)
+        )
+        if chunk_r == 0:
+            return 0, None, has
+        rasts = np.zeros((FUSED_CHUNK_Q, 1 + chunk_r, bk.LANES), np.float32)
+        for q, m in enumerate(members):
+            rast = m[1].rast
+            if rast is not None:
+                rasts[q, : rast.shape[0]] = rast
+                has[q] = True
+        return chunk_r, rasts, has
+
     def _submit_fused_chunk(
         self, members, names, has_boxes, has_windows, finishes, deadline
     ):
@@ -742,6 +770,8 @@ class IndexTable(SortedKeys):
         check_deadline(deadline, "device scan dispatch")
         boxes, wins = self._fused_param_stacks(members)
         chunk_e, edges, pip = self._chunk_edge_stack(members)
+        chunk_r, rasts, has_rast = self._chunk_raster_stack(members)
+        poly_slot = pip | has_rast
         bid_parts: list[np.ndarray] = []
         qid_parts: list[np.ndarray] = []
         segs: list[tuple[int, int]] = []  # slot segment per member
@@ -758,13 +788,14 @@ class IndexTable(SortedKeys):
         qids = np.zeros(len(bids), np.int32)
         qids[:n_real] = np.concatenate(qid_parts)
         spip = None
-        if chunk_e:
-            spip = pip[qids].astype(np.int32)
+        if chunk_e or chunk_r:
+            spip = poly_slot[qids].astype(np.int32)
             spip[n_real:] = 0  # pad slots keep the (cheaper) box leg
         wide, inner = bk.block_scan_multi(
             self._cols_args(names), bids, qids, boxes, wins,
             col_names=names, has_boxes=has_boxes, has_windows=has_windows,
             extent=self.extent, edges=edges, spip=spip, n_edges=chunk_e,
+            rasts=rasts, n_rints=chunk_r,
         )
         group_pull = self._fused_pull(wide, inner)
 
@@ -855,14 +886,18 @@ class IndexTable(SortedKeys):
         )
 
     def _scan_kernel_kwargs(self, config: ScanConfig, names: tuple) -> dict:
-        """Kernel kwargs for the SCAN path only: adds the device PIP tier
-        (aggregation kernels keep the box test — their wide-plane math
-        cannot carry the near-band uncertainty, so poly configs take the
-        host aggregation path via mask_decides_filter)."""
+        """Kernel kwargs for the SCAN path only: adds the device PIP and
+        raster-interval tiers (aggregation kernels keep the box test —
+        their wide-plane math cannot carry the near-band / boundary-cell
+        uncertainty, so poly configs take the host aggregation path via
+        mask_decides_filter)."""
         kw = self._kernel_kwargs(config, names)
         if config.poly is not None and not self.extent:
             kw["edges"] = config.poly
             kw["n_edges"] = bk.n_edges_of(config.poly)
+        if config.rast is not None and not self.extent:
+            kw["rast"] = config.rast
+            kw["n_rints"] = bk.n_rints_of(config.rast)
         return kw
 
     def _cols_args(self, names: tuple) -> tuple:
@@ -1084,22 +1119,29 @@ class IndexTable(SortedKeys):
                 calls += 1
         # the canonical fused multi-query variants (scan_submit_many):
         # fixed (fused_slots, FUSED_CHUNK_Q) shape means ONE compile per
-        # (predicate-flag combo, E bucket) covers every future batch.
-        # E = 0 is the box-only chunk; point tables additionally warm the
-        # PIP-fused E ladder (polygon members always carry a bbox, so
-        # only has_boxes combos can hit them)
+        # (predicate-flag combo, E bucket, R bucket) covers every future
+        # batch. E = R = 0 is the box-only chunk; point tables
+        # additionally warm the PIP-fused E ladder and the
+        # raster-interval R ladder (polygon members always carry a bbox,
+        # so only has_boxes combos can hit them). Mixed E x R shapes
+        # (the non-default device-residue mode) compile on first use.
         if self._fused_supported():
             pip_ok = not self.extent and {"x", "y"} <= set(self.col_names)
             for has_boxes, has_w in flag_combos:
                 if not (has_boxes or has_w):
                     continue  # fused path requires a predicate
-                e_ladder = (0,) + (
-                    bk.FUSED_E_BUCKETS if (pip_ok and has_boxes) else ()
+                e_ladder = [(0, 0)] + (
+                    [(e, 0) for e in bk.FUSED_E_BUCKETS]
+                    + [(0, r) for r in bk.FUSED_R_BUCKETS]
+                    if (pip_ok and has_boxes) else []
                 )
-                for n_e in e_ladder:
+                for n_e, n_r in e_ladder:
                     cfg = make_cfg(has_boxes, has_w)
                     if n_e:
                         cfg.poly = np.zeros((n_e, bk.LANES), np.float32)
+                    if n_r:
+                        cfg.rast = np.zeros((1 + n_r, bk.LANES), np.float32)
+                        cfg.rast[1:, 0] = 1.0  # pad intervals never match
                     names = self._scan_cols(cfg)
                     # half a chunk of round-robin blocks per member:
                     # enough real slots to clear the small-batch routing

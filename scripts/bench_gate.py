@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a fresh BENCH_PIP_JOIN.json against the
+recorded baseline and FAIL on fused-PIP regression (docs/ingest.md,
+"Benchmarks & regression gate").
+
+Usage:
+    # produce a fresh run at a SCRATCH path (never the committed
+    # baseline!), then gate it against the repo's recorded file
+    GEOMESA_BENCH_CONFIGS=pip_join \
+        GEOMESA_BENCH_PIP_OUT=/tmp/BENCH_PIP_JOIN.json python bench.py
+    python scripts/bench_gate.py --fresh /tmp/BENCH_PIP_JOIN.json
+
+The gate refuses to compare a file against itself (exit 2): a
+self-comparison always passes and would mask any regression.
+
+Checks, per scenario present in BOTH files:
+- the raster-path cost may not regress by more than --max-regress
+  (default 0.20 = 20%) against the baseline's recorded cost
+  (``raster_ms_per_q`` for the fused PIP batch, ``raster_ms`` /
+  ``adaptive_ms`` for the joins);
+- every ``identical`` flag in the fresh run must be true — a speedup
+  that changed answers is a bug, not a win.
+
+Exit code 0 = pass, 1 = regression / broken identity, 2 = unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# scenario -> the raster-path cost field the gate guards
+COST_FIELDS = {
+    "z2_polygon_pip_batch": "raster_ms_per_q",
+    "z2_polygon_join": "raster_ms",
+    "host_grid_join": "adaptive_ms",
+}
+
+
+def _rows(path: str) -> dict:
+    with open(path) as fh:
+        payload = json.load(fh)
+    return {r["scenario"]: r for r in payload.get("rows", []) if "scenario" in r}
+
+
+def gate(fresh_path: str, baseline_path: str, max_regress: float) -> int:
+    if os.path.realpath(fresh_path) == os.path.realpath(baseline_path):
+        print(
+            "bench_gate: --fresh and --baseline are the same file; a "
+            "self-comparison cannot detect a regression — write the fresh "
+            "run to a scratch path (GEOMESA_BENCH_PIP_OUT)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        fresh = _rows(fresh_path)
+        base = _rows(baseline_path)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"bench_gate: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+    shared = [s for s in COST_FIELDS if s in fresh and s in base]
+    if not shared:
+        print("bench_gate: no shared scenarios between fresh and baseline",
+              file=sys.stderr)
+        return 2
+    failed = False
+    for s in shared:
+        field = COST_FIELDS[s]
+        f_row, b_row = fresh[s], base[s]
+        if not f_row.get("identical", False):
+            print(f"FAIL {s}: fresh run's identical flag is not true")
+            failed = True
+        if field not in f_row or field not in b_row:
+            continue
+        f_cost, b_cost = float(f_row[field]), float(b_row[field])
+        ratio = f_cost / max(b_cost, 1e-12) - 1.0
+        verdict = "FAIL" if ratio > max_regress else "ok"
+        print(
+            f"{verdict:4s} {s}: {field} {b_cost:.3f} -> {f_cost:.3f} "
+            f"({ratio:+.1%}, limit +{max_regress:.0%})"
+        )
+        if ratio > max_regress:
+            failed = True
+    return 1 if failed else 0
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--fresh", required=True,
+        help="freshly produced bench json (a scratch path, e.g. the "
+        "GEOMESA_BENCH_PIP_OUT target — never the committed baseline)",
+    )
+    ap.add_argument(
+        "--baseline", default=os.path.join(repo, "BENCH_PIP_JOIN.json"),
+        help="recorded baseline json (default: the committed file)",
+    )
+    ap.add_argument(
+        "--max-regress", type=float, default=0.20,
+        help="max tolerated fractional cost increase (default 0.20)",
+    )
+    args = ap.parse_args()
+    return gate(args.fresh, args.baseline, args.max_regress)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

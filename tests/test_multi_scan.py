@@ -301,7 +301,15 @@ class TestFusedPip:
         """Polygons with different edge counts in the SAME fused bucket
         zero-pad into one chunk; a bigger-bucket ring and the box members
         group separately (the E bucket is part of the variant key, so box
-        slots never pay edge work) — results exact throughout."""
+        slots never pay edge work) — results exact throughout. Raster
+        approximations are disabled: this test pins the PIP edge-ladder
+        grouping specifically (the raster tier has its own suite,
+        test_raster_join.py)."""
+        from geomesa_tpu.conf import RASTER_ENABLED
+        from geomesa_tpu.filter import raster as fr
+
+        monkeypatch.setattr(RASTER_ENABLED, "_override", False)
+        fr.clear_cache()
         ds, _ = make_store(n=30_000, seed=75, index="z2")
         idx = next(i for i in ds.indexes("pts") if i.name == "z2")
         e_seen = []
