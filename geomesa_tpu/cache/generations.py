@@ -86,15 +86,17 @@ class GenerationTracker:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._tick = 0
-        self._types: dict[str, _TypeGens] = {}
+        self._tick = 0                            # guarded-by: _lock
+        self._types: dict[str, _TypeGens] = {}    # guarded-by: _lock
 
     def tick(self) -> int:
         """The current global tick — snapshot BEFORE computing a result
-        that will be cached, so a racing write invalidates the fill."""
+        that will be cached, so a racing write invalidates the fill.
+        Lock-free read: a stale tick only makes the admission check
+        conservative (the fill is rejected, never wrongly kept)."""
         return self._tick
 
-    def _gens(self, type_name: str) -> _TypeGens:
+    def _gens_locked(self, type_name: str) -> _TypeGens:
         g = self._types.get(type_name)
         if g is None:
             g = self._types[type_name] = _TypeGens()
@@ -112,7 +114,7 @@ class GenerationTracker:
         new tick."""
         with self._lock:
             self._tick += 1
-            g = self._gens(type_name)
+            g = self._gens_locked(type_name)
             if bounds is None:
                 g.cells[:] = self._tick
             else:
@@ -135,7 +137,7 @@ class GenerationTracker:
         fingerprint) changes so even identical future specs re-key."""
         with self._lock:
             self._tick += 1
-            g = self._gens(type_name)
+            g = self._gens_locked(type_name)
             g.schema_gen = self._tick
             g.cells[:] = self._tick
             g.t_all = self._tick

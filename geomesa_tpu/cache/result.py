@@ -102,9 +102,9 @@ class ResultCache:
         self.generations = generations
         self.metrics = resolve(metrics)
         self._lock = threading.RLock()
-        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
-        self._inflight: dict[str, _Flight] = {}
-        self._bytes = 0
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
+        self._inflight: dict[str, _Flight] = {}  # guarded-by: _lock
+        self._bytes = 0                          # guarded-by: _lock
 
     def __len__(self) -> int:
         return len(self._entries)

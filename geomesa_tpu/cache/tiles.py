@@ -106,13 +106,13 @@ class TileAggregateCache:
         self.generations = generations
         self.metrics = resolve(metrics)
         self._lock = threading.RLock()
-        self._tiles: "OrderedDict[tuple, TileAggregate]" = OrderedDict()
+        self._tiles: "OrderedDict[tuple, TileAggregate]" = OrderedDict()  # guarded-by: _lock
         # adaptive cost gate state: per-type EWMAs of plain-scan vs
         # composition cost, plus the gated-attempt counter for re-probes
-        self._scan_s: dict[str, float] = {}
-        self._compose_s: dict[str, float] = {}
-        self._compose_n: dict[str, int] = {}
-        self._gated: dict[str, int] = {}
+        self._scan_s: dict[str, float] = {}      # guarded-by: _lock
+        self._compose_s: dict[str, float] = {}   # guarded-by: _lock
+        self._compose_n: dict[str, int] = {}     # guarded-by: _lock
+        self._gated: dict[str, int] = {}         # guarded-by: _lock
         self._scanning = threading.local()
         n = 1 << conf.tile_bits
         # exact binary-rational tile edges (i * 360/2^bits sums exactly in

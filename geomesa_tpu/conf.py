@@ -88,6 +88,12 @@ QUERY_TIMEOUT = SystemProperty(
     "geomesa.query.timeout", None, float,
     "default per-query wall-clock budget in seconds (None = unbounded)",
 )
+GUARD_TEMPORAL_MAX = SystemProperty(
+    "geomesa.guard.temporal.max.duration", 7 * 86_400_000, int,
+    "ms cap on a query's temporal span for TemporalQueryGuard."
+    "from_properties() (reference TemporalQueryGuard's property of the "
+    "same name; default one week)",
+)
 PALLAS_MODE = SystemProperty(
     "geomesa.tpu.pallas", None, str,
     "force the kernel backend: '1' = Pallas (interpret off-TPU), '0' = XLA",
@@ -135,8 +141,8 @@ INGEST_WORKERS = SystemProperty(
 INGEST_QUEUE_DEPTH = SystemProperty(
     "geomesa.ingest.queue.depth", 4, int,
     "bounded admission window: chunks a producer may stage ahead of the "
-    "ordered writer before put() blocks (backpressure, counted by "
-    "geomesa.ingest.queue_full)",
+    "ordered writer before put() blocks; overflow waits are counted by "
+    "the geomesa.ingest.queue_full metric",
 )
 INGEST_CHUNK_ROWS = SystemProperty(
     "geomesa.ingest.chunk.rows", 1 << 20, int,

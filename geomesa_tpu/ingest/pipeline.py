@@ -177,14 +177,14 @@ class BulkLoader:
         )
         self._sem = threading.Semaphore(max(1, self.config.queue_depth))
         self._cv = threading.Condition()
-        self._chunks: list[_Chunk] = []
-        self._rows_staged = 0
-        self._closed = False
-        self._error: "BaseException | None" = None
-        self._writer: "threading.Thread | None" = None
+        self._chunks: list[_Chunk] = []           # guarded-by: _cv
+        self._rows_staged = 0                     # guarded-by: _cv
+        self._closed = False                      # guarded-by: _cv
+        self._error: "BaseException | None" = None  # guarded-by: _cv
+        self._writer: "threading.Thread | None" = None  # guarded-by: _cv
         self._stage_lock = threading.Lock()
-        self._stage_s = {s: 0.0 for s in STAGES}
-        self._peak_chunk_bytes = 0
+        self._stage_s = {s: 0.0 for s in STAGES}  # guarded-by: _stage_lock
+        self._peak_chunk_bytes = 0                # guarded-by: _stage_lock
 
     # -- bookkeeping ------------------------------------------------------
     def _count(self, name: str, inc: int = 1) -> None:

@@ -45,9 +45,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.counters: dict[str, int] = defaultdict(int)
-        self.gauges: dict[str, float] = {}
-        self.timers: dict[str, Timer] = defaultdict(Timer)
+        self.counters: dict[str, int] = defaultdict(int)    # guarded-by: _lock
+        self.gauges: dict[str, float] = {}                  # guarded-by: _lock
+        self.timers: dict[str, Timer] = defaultdict(Timer)  # guarded-by: _lock
 
     def counter(self, name: str, inc: int = 1) -> None:
         with self._lock:

@@ -128,10 +128,24 @@ class AgeOffInterceptor:
 @dataclass
 class TemporalQueryGuard:
     """Require a bounded temporal constraint no longer than ``max_ms``
-    (reference TemporalQueryGuard: `geomesa.guard.temporal.max.duration`).
-    Applies only to schemas with a time attribute."""
+    (reference TemporalQueryGuard, configured there and here by the
+    `geomesa.guard.temporal.max.duration` property). Applies only to
+    schemas with a time attribute. The guard is opt-in, exactly like
+    the reference: install it via ``DataStore(guards=[...])``; leaving
+    ``max_ms`` unset resolves the property tier (environment-
+    overridable), the same idiom as ServingConfig/PipelineConfig."""
 
-    max_ms: int
+    max_ms: "int | None" = None
+
+    def __post_init__(self):
+        if self.max_ms is None:
+            from geomesa_tpu.conf import GUARD_TEMPORAL_MAX
+
+            self.max_ms = int(GUARD_TEMPORAL_MAX.get())
+
+    @staticmethod
+    def from_properties() -> "TemporalQueryGuard":
+        return TemporalQueryGuard()
 
     def guard(self, plan: QueryPlan, sft) -> None:
         if sft.dtg_field is None or plan.ids is not None:

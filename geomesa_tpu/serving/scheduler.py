@@ -139,10 +139,13 @@ class QueryScheduler:
         self.conf = config or ServingConfig.from_properties()
         self.metrics = resolve(metrics if metrics is not None else store.metrics)
         self._cond = threading.Condition()
-        self._queue: list[_Item] = []
-        self._closed = False
-        self._window_s = 0.0  # adaptive: grows under load, 0 when idle
-        self._thread: Optional[threading.Thread] = None
+        self._queue: list[_Item] = []  # guarded-by: _cond
+        self._closed = False           # guarded-by: _cond
+        # adaptive window: grows under load, 0 when idle. Single-writer
+        # (only the dispatcher thread mutates it); submit()'s lock-free
+        # read of a slightly stale value only mistimes one shed decision
+        self._window_s = 0.0
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _cond
 
     # -- lifecycle -------------------------------------------------------
     @property

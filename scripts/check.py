@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""geomesa-lint runner: the repo's static-analysis gate (docs/analysis.md).
+
+Runs every shipped rule (geomesa_tpu.analysis) over geomesa_tpu/ +
+scripts/ + docs/*.md and fails loudly on new findings — the same exit
+convention as scripts/bench_gate.py, so CI treats both gates alike:
+
+    0 = clean (no findings beyond the suppression baseline)
+    1 = findings (each printed as path:line: [rule-id] message + fix)
+    2 = unusable input (bad arguments, unknown rule id, missing repo)
+
+Usage:
+    python scripts/check.py                  # human output
+    python scripts/check.py --json           # machine output (CI)
+    python scripts/check.py --rules knob-undeclared,metric-convention
+    python scripts/check.py --list-rules     # rule catalog (id + summary)
+    python scripts/check.py --write-baseline # accept current findings
+
+tests/test_static_analysis.py runs the same analysis in-process, which
+makes a clean tree a tier-1 invariant; this entry point exists for
+humans, hooks and CI logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument(
+        "--root", default=REPO,
+        help="repo root to analyze (default: this checkout; exit-code "
+        "tests point it at staged mini-repos)",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="suppression baseline path (default: the checked-in "
+        "geomesa_tpu/analysis/baseline.txt)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings into the baseline (adopt-time only; "
+        "tier-1 requires the shipped baseline to stay empty)",
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print baseline/inline-suppressed findings",
+    )
+    args = ap.parse_args()
+
+    from geomesa_tpu import analysis
+    from geomesa_tpu.analysis.core import default_baseline_path
+
+    if args.list_rules:
+        for rule in analysis.ALL_RULES:
+            print(f"{rule.id:24s} {rule.description}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r.id for r in analysis.ALL_RULES}
+        unknown = rule_ids - known
+        if unknown:
+            print(
+                f"check: unknown rule id(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}",
+                file=sys.stderr,
+            )
+            return 2
+
+    baseline = args.baseline
+    if (
+        baseline is not None
+        and not os.path.exists(baseline)
+        and not args.write_baseline  # write mode creates the file
+    ):
+        print(f"check: baseline {baseline!r} does not exist", file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.root):
+        print(f"check: root {args.root!r} is not a directory", file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    try:
+        result = analysis.run(args.root, rule_ids=rule_ids, baseline=baseline)
+    except Exception as e:  # analyzer bug = unusable input, not "clean"
+        print(f"check: analysis failed: {e!r}", file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+
+    if args.write_baseline:
+        from geomesa_tpu.analysis import load_baseline
+
+        path = baseline or default_baseline_path(args.root)
+        try:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            have = load_baseline(path)
+            fresh = sorted(
+                {f.key for f in result.findings} - have
+            )
+            with open(path, "a", encoding="utf-8") as fh:
+                for key in fresh:
+                    fh.write(key + "\n")
+        except OSError as e:
+            print(f"check: cannot write baseline {path!r}: {e}", file=sys.stderr)
+            return 2
+        print(f"check: appended {len(fresh)} new keys to {path}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in result.findings],
+            "suppressed": [f.to_json() for f in result.suppressed],
+            "clean": result.clean,
+            "seconds": round(dt, 3),
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        if args.show_suppressed:
+            for f in result.suppressed:
+                print(f"suppressed: {f.render()}")
+        n, s = len(result.findings), len(result.suppressed)
+        print(
+            f"check: {n} finding(s), {s} suppressed, "
+            f"{dt * 1e3:.0f} ms"
+        )
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

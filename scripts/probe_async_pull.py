@@ -1,3 +1,11 @@
+"""Probe: device->host pull strategies for many small independent kernels.
+
+Dispatches 40 tiny jit calls and compares serialized synchronous pulls
+against copy_to_host_async + one batched device_get (the overlap the
+fused scan's _fused_pull relies on). Run on the TPU:
+    python scripts/probe_async_pull.py
+"""
+
 import sys; sys.path.insert(0, "/root/repo")
 import time
 import numpy as np

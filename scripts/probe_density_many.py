@@ -1,3 +1,11 @@
+"""Probe: repeated density() aggregations over a 50M-point Z2 store.
+
+Measures the steady-state cost of many density push-downs on one table
+(kernel reuse after the first compile, per-query dispatch + pull floor).
+Run on the TPU:
+    python scripts/probe_density_many.py
+"""
+
 import sys; sys.path.insert(0, "/root/repo")
 import time
 import numpy as np

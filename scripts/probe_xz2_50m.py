@@ -1,3 +1,11 @@
+"""Probe: XZ2 (extent) query path end to end at 50M polygons.
+
+Builds a 50M-row extent store (clustered small boxes), then times bbox
+queries across selectivities — the wide-only plane rule and the XZ
+candidate pruning under real skew. Run on the TPU:
+    python scripts/probe_xz2_50m.py
+"""
+
 import sys; sys.path.insert(0, "/root/repo")
 import time
 import numpy as np

@@ -1,3 +1,11 @@
+"""Probe: XZ2 ingest pipeline wall-time split at 50M polygons.
+
+Times the write path stage by stage (geometry build, write-key encode,
+sort, device upload) for an extent store — the numbers behind the
+pipelined-ingest design in docs/ingest.md. Run on the TPU:
+    python scripts/probe_xz2_pipeline.py
+"""
+
 import sys; sys.path.insert(0, "/root/repo")
 import time
 import numpy as np

@@ -1,3 +1,11 @@
+"""Probe: cProfile of one XZ2 bbox query at 50M polygons, per stage.
+
+Profiles the host side of a single extent query (range planning,
+candidate pruning, decode, refinement) to find the next host hotspot.
+Run on the TPU:
+    python scripts/probe_xz2_stage.py
+"""
+
 import sys; sys.path.insert(0, "/root/repo")
 import time, cProfile, pstats
 import numpy as np

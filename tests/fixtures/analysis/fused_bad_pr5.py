@@ -1,0 +1,65 @@
+"""KNOWN-BAD fixture: the PR 5 fused-chunk grouping-key defect, replayed.
+
+This is the shape `storage/table.py` had before PR 5's post-review
+hardening: the chunk grouping key carries the R bucket but OMITS the
+E (edge) bucket, while `_chunk_edge_stack` still sizes each chunk's
+edge stack with `fused_e_bucket` over the members. A 256-edge polygon
+grouped with box queries then inflates every slot in its chunk to
+256-edge PIP work and knocks the chunk off the Pallas path.
+
+The `fused-key-dimension` rule must produce exactly one finding here
+(dimension E missing from the key in scan_submit_many).
+"""
+
+
+def fused_e_bucket(n):
+    return 0 if n <= 0 else max(16, n)
+
+
+def fused_r_bucket(n):
+    return 0 if n <= 0 else max(16, n)
+
+
+def n_edges_of(poly):
+    return 0 if poly is None else len(poly)
+
+
+def n_rints_of(rast):
+    return 0 if rast is None else len(rast) - 1
+
+
+def block_scan_multi(members, n_edges=0, n_rints=0):
+    return members, n_edges, n_rints
+
+
+class Table:
+    def scan_submit_many(self, configs):
+        groups = {}
+        for j, config in enumerate(configs):
+            names = self._scan_cols(config)
+            r_bucket = fused_r_bucket(n_rints_of(config.rast))
+            # BUG under test: no e_bucket term — polygon members with
+            # different edge ladders share one chunk
+            key = (
+                names, config.boxes is not None,
+                config.windows is not None, r_bucket,
+            )
+            groups.setdefault(key, []).append((j, config))
+        for _key, members in groups.items():
+            self._submit_fused_chunk(members)
+
+    def _chunk_edge_stack(self, members):
+        return fused_e_bucket(max(n_edges_of(m[1].poly) for m in members))
+
+    def _submit_fused_chunk(self, members, stats={}):
+        chunk_e = self._chunk_edge_stack(members)
+        chunk_r = fused_r_bucket(
+            max(n_rints_of(m[1].rast) for m in members)
+        )
+        # incidental NON-tuple setdefault: must not turn this function
+        # into a "grouping function" and mask the missing-E detection
+        stats.setdefault(chunk_e, []).append(len(members))
+        return block_scan_multi(members, n_edges=chunk_e, n_rints=chunk_r)
+
+    def _scan_cols(self, config):
+        return ("x", "y")

@@ -1,0 +1,56 @@
+"""KNOWN-GOOD fixture: the post-hardening fused grouping key.
+
+Identical to fused_bad_pr5.py except the grouping key derives BOTH
+ladder dimensions (`e_bucket` and `r_bucket`), matching what
+`storage/table.py` ships today. The `fused-key-dimension` rule must
+stay silent here.
+"""
+
+
+def fused_e_bucket(n):
+    return 0 if n <= 0 else max(16, n)
+
+
+def fused_r_bucket(n):
+    return 0 if n <= 0 else max(16, n)
+
+
+def n_edges_of(poly):
+    return 0 if poly is None else len(poly)
+
+
+def n_rints_of(rast):
+    return 0 if rast is None else len(rast) - 1
+
+
+def block_scan_multi(members, n_edges=0, n_rints=0):
+    return members, n_edges, n_rints
+
+
+class Table:
+    def scan_submit_many(self, configs):
+        groups = {}
+        for j, config in enumerate(configs):
+            names = self._scan_cols(config)
+            e_bucket = fused_e_bucket(n_edges_of(config.poly))
+            r_bucket = fused_r_bucket(n_rints_of(config.rast))
+            key = (
+                names, config.boxes is not None,
+                config.windows is not None, e_bucket, r_bucket,
+            )
+            groups.setdefault(key, []).append((j, config))
+        for _key, members in groups.items():
+            self._submit_fused_chunk(members)
+
+    def _chunk_edge_stack(self, members):
+        return fused_e_bucket(max(n_edges_of(m[1].poly) for m in members))
+
+    def _submit_fused_chunk(self, members):
+        chunk_e = self._chunk_edge_stack(members)
+        chunk_r = fused_r_bucket(
+            max(n_rints_of(m[1].rast) for m in members)
+        )
+        return block_scan_multi(members, n_edges=chunk_e, n_rints=chunk_r)
+
+    def _scan_cols(self, config):
+        return ("x", "y")

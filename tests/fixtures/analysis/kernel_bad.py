@@ -1,0 +1,32 @@
+"""KNOWN-BAD fixture: kernel-purity hazards inside a jitted function.
+
+Two seeded defects:
+
+- ``float(x)`` coerces a traced parameter (concretization hazard) ->
+  `kernel-traced-coercion`; the ``int(n_pad)`` coercion of a
+  static_argnames parameter is the LEGAL pattern and must not be
+  flagged;
+- ``jnp.nonzero`` produces a data-dependent shape ->
+  `kernel-dynamic-shape`.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def bad_kernel(x, n_pad):
+    pad = int(n_pad)  # static: fine
+    scale = float(x)  # BUG under test: traced coercion
+    hits = jnp.nonzero(x > scale)  # BUG under test: dynamic shape
+    return hits, pad
+
+
+@partial(jax.jit, static_argnames="n_pad")
+def scalar_static_kernel(x, n_pad):
+    """jax's bare-scalar static_argnames form: int(n_pad) is the legal
+    trace-time pattern and must NOT be flagged (regression: the rule
+    once only recognized the tuple/list spelling)."""
+    return x + int(n_pad)

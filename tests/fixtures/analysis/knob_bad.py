@@ -1,0 +1,14 @@
+"""KNOWN-BAD fixture: an undeclared geomesa.* knob citation.
+
+An error message cites a property no registry declares (a typo drops a
+letter from the scan-ranges knob) — the drift the knob-registry family
+exists to catch. Expected: one `knob-undeclared` finding; the correctly
+spelled name on the next line resolves and must NOT be flagged.
+"""
+
+
+def explain_limit() -> str:
+    return (
+        "covering ranges exceeded geomesa.scan.rangs.target; "
+        "raise geomesa.scan.ranges.target to widen the plan"
+    )

@@ -1,0 +1,12 @@
+"""KNOWN-BAD fixture: an undeclared family named through an f-string.
+
+The f-string below names a nonexistent "bogus" family via a literal
+fragment that ends at a substitution. Expected: exactly ONE
+`knob-undeclared` finding — the JoinedStr fragment must not be scanned
+a second time when ast.walk reaches the fragment's own Constant node
+(the duplicate-findings regression).
+"""
+
+
+def render(kind: str) -> str:
+    return f"set geomesa.bogus.{kind}.target"

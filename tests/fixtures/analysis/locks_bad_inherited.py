@@ -1,0 +1,31 @@
+"""KNOWN-BAD fixture: guarded-by annotation with an INHERITED lock.
+
+The subclass never assigns ``self._lock`` itself (the base class owns
+it), so lock detection finds no locks in this class — but the
+``# guarded-by:`` annotation must stay ENFORCED: with-blocks name the
+lock attribute, so held-ness is still checkable (the regression where
+annotations in lock-less classes were silently ignored).
+
+Expected: one `lock-guarded-mutation` finding on ``add`` (and none on
+``drain``, whose mutation sits inside ``with self._lock``), with no
+bad-annotation finding.
+"""
+
+
+class Base:
+    pass  # owns self._lock in the real hierarchy
+
+
+class Child(Base):
+    def __init__(self):
+        super().__init__()
+        self._items = []  # guarded-by: _lock
+
+    def add(self, x):
+        # BUG under test: mutation outside the inherited lock
+        self._items.append(x)
+
+    def drain(self):
+        with self._lock:
+            out, self._items = self._items, []
+        return out

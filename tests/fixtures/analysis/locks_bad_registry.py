@@ -1,0 +1,32 @@
+"""KNOWN-BAD fixture: the pre-PR 3 unlocked MetricsRegistry mutation.
+
+Shape of `metrics.py` before PR 3 retrofitted locking: the class owns a
+lock and uses it on some paths (``reset``), but the hot ``counter``
+increment is a bare read-modify-write — the exact lost-update race the
+review caught. No annotations here: this exercises the lock rule's
+INFERENCE mode (an attribute mutated under the lock somewhere is
+guarded everywhere).
+
+Expected: one `lock-guarded-mutation` finding on the ``counter`` body.
+"""
+
+import threading
+from collections import defaultdict
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = defaultdict(int)
+
+    def counter(self, name, inc=1):
+        # BUG under test: unlocked += on a dict the lock guards elsewhere
+        self.counters[name] += inc
+
+    def reset(self):
+        with self._lock:
+            self.counters.clear()
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.counters)

@@ -1,0 +1,30 @@
+"""KNOWN-BAD fixture: unlocked mutation of a `# guarded-by:` field.
+
+Modeled on `serving/scheduler.py`: the admission queue is explicitly
+annotated as guarded by the scheduler condition, but ``submit`` appends
+to it without entering the ``with self._cond`` block (and ``close``
+swaps it out correctly, proving the annotation matches real usage).
+
+Expected: one `lock-guarded-mutation` finding on the ``submit`` append.
+"""
+
+import threading
+
+
+class QueryScheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = []   # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
+
+    def submit(self, item):
+        # BUG under test: append outside the condition the field declares
+        self._queue.append(item)
+        with self._cond:
+            self._cond.notify_all()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            pending, self._queue = self._queue, []
+        return pending

@@ -1,0 +1,32 @@
+"""KNOWN-GOOD fixture: lock discipline held.
+
+Every mutation of the annotated/inferred fields happens under the lock,
+through a ``*_locked`` helper (caller-holds-the-lock convention), or in
+a method declaring ``# holds-lock:``. The lock rule must stay silent.
+"""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}   # guarded-by: _lock
+        self._bytes = 0      # guarded-by: _lock
+
+    def put(self, key, value):
+        with self._lock:
+            self._store_locked(key, value)
+
+    def _store_locked(self, key, value):
+        self._entries[key] = value
+        self._bytes += len(value)
+
+    def drain(self):  # holds-lock: _lock
+        out, self._entries = self._entries, {}
+        self._bytes = 0
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._entries)

@@ -1,0 +1,23 @@
+"""KNOWN-BAD fixture: metric naming + instrument-kind defects.
+
+Two seeded defects for the metrics family:
+
+- ``geomesa.Fixture-Area.hits`` breaks the geomesa.<area>.<name>
+  convention (uppercase + hyphen) -> `metric-convention`;
+- ``geomesa.fixture.depth`` is used as BOTH a counter and a gauge ->
+  `metric-type-conflict`.
+"""
+
+
+class Probe:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def record_hit(self):
+        self.metrics.counter("geomesa.Fixture-Area.hits")
+
+    def record_depth_a(self, n):
+        self.metrics.counter("geomesa.fixture.depth", n)
+
+    def record_depth_b(self, n):
+        self.metrics.gauge("geomesa.fixture.depth", n)

@@ -1,0 +1,33 @@
+"""KNOWN-BAD fixture: warmup() missing a fused variant-key ladder.
+
+A table class that groups fused chunks (scan_submit_many) and whose
+warmup walks the E ladder but never the R ladder — first raster-fused
+queries would pay the compile at query time. Expected: one
+`warmup-coverage` finding for dimension R (and none for E).
+
+The module must mention block_scan_multi so the rule treats it as a
+kernel-dispatching table (host-only backends are exempt).
+"""
+
+FUSED_E_BUCKETS = (16, 64, 256)
+FUSED_R_BUCKETS = (16, 32, 64, 256)
+
+
+def block_scan_multi(*args, **kwargs):
+    return args, kwargs
+
+
+class Table:
+    def scan_submit_many(self, configs):
+        groups = {}
+        for j, config in enumerate(configs):
+            key = (j,)
+            groups.setdefault(key, []).append(config)
+        return groups
+
+    def warmup(self):
+        calls = 0
+        for e in FUSED_E_BUCKETS:  # R ladder missing: the seeded gap
+            block_scan_multi(n_edges=e)
+            calls += 1
+        return calls

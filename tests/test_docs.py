@@ -1,11 +1,58 @@
-"""docs/migration.md stays honest: every API it maps must exist.
+"""Docs stay honest: every API, knob, metric and rule id they name is real.
 
-The guide promises a reference user that each named call is real; this
-pins the exact surface so a rename breaks the build, not the reader."""
+The guides promise a reference user that each named call is real; this
+pins the exact surface so a rename breaks the build, not the reader.
 
+Knob and metric NAME checks run against the static-analysis registries
+(geomesa_tpu.analysis.registries) — the same single source of truth
+scripts/check.py enforces — instead of parallel hand-kept lists: the
+analyzer guarantees every doc-cited name resolves (doc-unknown-name)
+and every knob is documented (knob-undocumented); these tests add the
+per-subsystem completeness direction (each doc cites every knob/metric
+of its area) and that the AST registry agrees with the runtime
+conf.REGISTRY."""
+
+import functools
 import inspect
 import os
 import re
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@functools.lru_cache(maxsize=1)
+def _registries():
+    from geomesa_tpu.analysis.core import Project
+    from geomesa_tpu.analysis.registries import Registries
+
+    return Registries.of(Project.load(_ROOT))
+
+
+def _area_names(prefix: str) -> tuple[list[str], list[str]]:
+    """(knob names, metric names) of one geomesa.<area>. prefix, from
+    the analyzer registries."""
+    regs = _registries()
+    knobs = sorted(k for k in regs.knobs.knobs if k.startswith(prefix))
+    metrics = sorted(
+        n for n in regs.metrics.names() if n.startswith(prefix)
+    )
+    return knobs, metrics
+
+
+def _assert_documented(doc: str, names) -> None:
+    text = open(os.path.join(_ROOT, "docs", doc)).read()
+    missing = [n for n in names if n not in text]
+    assert not missing, f"docs/{doc} does not cite: {missing}"
+
+
+def _assert_runtime_declared(names) -> None:
+    """The AST-extracted knob registry agrees with the runtime property
+    tier (conf.REGISTRY): every name resolves to a live SystemProperty."""
+    from geomesa_tpu import conf
+
+    for name in names:
+        assert name in conf.REGISTRY, name
+        assert conf.REGISTRY[name].name == name
 
 
 def test_migration_guide_apis_exist():
@@ -138,7 +185,6 @@ def test_serving_doc_apis_exist():
     knob, metric, and dotted name it documents is real."""
     import inspect
 
-    from geomesa_tpu import conf
     from geomesa_tpu.datastore import DataStore
     from geomesa_tpu.metrics import MetricsRegistry
     from geomesa_tpu.serving import (
@@ -151,22 +197,24 @@ def test_serving_doc_apis_exist():
     for f in ("window_ms", "queue_max", "batch_max"):
         assert f in ServingConfig.__dataclass_fields__, f
     assert "block" in inspect.signature(QueryScheduler.submit).parameters
-    # every conf knob the doc names resolves through the property tier
-    for prop, name in [
-        (conf.SERVING_WINDOW_MS, "geomesa.serving.window_ms"),
-        (conf.SERVING_QUEUE_MAX, "geomesa.serving.queue.max"),
-        (conf.SERVING_BATCH_MAX, "geomesa.serving.batch.max"),
-    ]:
-        assert prop.name == name
-    # the documented metric names render through the registry, including
-    # the _seconds_max exposition the doc points operators at
+    # every geomesa.serving.* knob and metric (analyzer registries, the
+    # single source of truth) is declared at runtime and cited by the doc
+    knobs, metrics = _area_names("geomesa.serving.")
+    assert len(knobs) >= 3 and len(metrics) >= 6, (knobs, metrics)
+    _assert_runtime_declared(knobs)
+    _assert_documented("serving.md", knobs + metrics)
+    # the documented instrument kinds render through the registry,
+    # including the _seconds_max exposition the doc points operators at
     reg = MetricsRegistry()
-    for c in ("geomesa.serving.submitted", "geomesa.serving.shed",
-              "geomesa.serving.coalesced", "geomesa.serving.batches",
-              "geomesa.serving.batched_queries"):
-        reg.counter(c)
-    reg.gauge("geomesa.serving.window_ms", 0.0)
-    reg.timer_update("geomesa.serving.queue_wait", 0.01)
+    by_name = _registries().metrics.by_name()
+    for n in metrics:
+        kind = by_name[n][0].instrument
+        if kind == "counter":
+            reg.counter(n)
+        elif kind == "gauge":
+            reg.gauge(n, 0.0)
+        else:
+            reg.timer_update(n, 0.01)
     text = reg.render_prometheus()
     assert "geomesa_serving_shed 1" in text
     assert "geomesa_serving_queue_wait_seconds_max" in text
@@ -182,7 +230,6 @@ def test_serving_doc_apis_exist():
 def test_caching_doc_apis_exist():
     """docs/caching.md stays honest the same way: every cache API,
     knob, and metric name it documents is real."""
-    from geomesa_tpu import conf
     from geomesa_tpu.cache import (  # noqa: F401
         BUCKET_MS,
         CacheConfig,
@@ -214,16 +261,12 @@ def test_caching_doc_apis_exist():
     for m in ("fingerprint_plan", "key_range", "on_mutation",
               "on_schema_dropped", "on_quarantine", "stats"):
         assert hasattr(QueryCache, m), m
-    # every conf knob the doc names resolves through the property tier
-    for prop, name in [
-        (conf.CACHE_MAX_BYTES, "geomesa.cache.result.max.bytes"),
-        (conf.CACHE_TTL, "geomesa.cache.ttl"),
-        (conf.CACHE_MIN_COST, "geomesa.cache.min.cost"),
-        (conf.CACHE_TILE_BITS, "geomesa.cache.tile.bits"),
-        (conf.CACHE_TILE_MAX, "geomesa.cache.tile.max.entries"),
-        (conf.CACHE_TILES_PER_QUERY, "geomesa.cache.tile.max.per.query"),
-    ]:
-        assert prop.name == name
+    # every geomesa.cache.* knob and metric (analyzer registries) is
+    # declared at runtime and cited by the doc
+    knobs, metrics = _area_names("geomesa.cache.")
+    assert len(knobs) >= 6 and len(metrics) >= 12, (knobs, metrics)
+    _assert_runtime_declared(knobs)
+    _assert_documented("caching.md", knobs + metrics)
 
 
 def test_ingest_doc_apis_exist():
@@ -231,7 +274,6 @@ def test_ingest_doc_apis_exist():
     knob, metric, and fault point it documents is real."""
     import inspect
 
-    from geomesa_tpu import conf
     from geomesa_tpu.ingest import (  # noqa: F401
         BulkLoader,
         IngestError,
@@ -255,23 +297,30 @@ def test_ingest_doc_apis_exist():
     for attr in ("split_index", "worker_traceback"):
         assert attr in inspect.signature(IngestError.__init__).parameters
     assert "workers" in inspect.signature(ingest_files).parameters
-    # every conf knob the doc names resolves through the property tier
-    for prop, name in [
-        (conf.INGEST_WORKERS, "geomesa.ingest.workers"),
-        (conf.INGEST_QUEUE_DEPTH, "geomesa.ingest.queue.depth"),
-        (conf.INGEST_CHUNK_ROWS, "geomesa.ingest.chunk.rows"),
-        (conf.INGEST_MERGE_MIN_BINS, "geomesa.ingest.merge.min.bins"),
-        (conf.COMPACT_SPAN_ROWS, "geomesa.tpu.compact.span.rows"),
-    ]:
-        assert prop.name == name
-    # the documented metric names render
+    # every geomesa.ingest.* knob and metric (analyzer registries) is
+    # declared at runtime and cited by the doc; the span-rows compaction
+    # knob the doc's memory model leans on rides along
+    knobs, metrics = _area_names("geomesa.ingest.")
+    assert len(knobs) >= 4 and len(metrics) >= 4, (knobs, metrics)
+    _assert_runtime_declared(knobs + ["geomesa.tpu.compact.span.rows"])
+    _assert_documented(
+        "ingest.md", knobs + metrics + ["geomesa.tpu.compact.span.rows"]
+    )
+    # the documented metric names render, including the f-string stage
+    # timer family the registry records as a geomesa.ingest.* prefix
+    assert "geomesa.ingest." in _registries().metrics.prefixes()
+    by_name = _registries().metrics.by_name()
     reg = MetricsRegistry()
-    for c in ("geomesa.ingest.rows", "geomesa.ingest.chunks",
-              "geomesa.ingest.errors", "geomesa.ingest.queue_full"):
-        reg.counter(c)
+    for n in metrics:
+        kind = by_name[n][0].instrument
+        if kind == "counter":
+            reg.counter(n)
+        elif kind == "gauge":
+            reg.gauge(n, 0.0)
+        else:
+            reg.timer_update(n, 0.0)
     for t in ("parse", "keys", "sort", "commit", "finalize"):
         reg.timer_update(f"geomesa.ingest.{t}", 0.0)
-    reg.gauge("geomesa.ingest.chunk_bytes_peak", 0.0)
     assert "geomesa_ingest_queue_full 1" in reg.render_prometheus()
     # the documented fault points exist in the pipeline source (the fault
     # registry is pattern-based, so presence is a source-level contract)
@@ -394,22 +443,32 @@ def test_joins_doc_honest():
     for p in ("rast", "n_rints"):
         assert p in sig1, p
 
-    # every conf knob the doc's table names resolves, at its doc default
-    for prop, name, default in [
-        (conf.RASTER_ENABLED, "geomesa.raster.enabled", True),
-        (conf.RASTER_MAX_CELLS, "geomesa.raster.max.cells", 16384),
-        (conf.RASTER_MIN_EDGES, "geomesa.raster.min.edges", 8),
-        (conf.RASTER_KERNEL_INTERVALS, "geomesa.raster.kernel.intervals", 16),
-        (conf.RASTER_RESIDUE, "geomesa.raster.residue", "host"),
-        (conf.JOIN_ADAPTIVE, "geomesa.join.adaptive", True),
-        (conf.JOIN_SAMPLE, "geomesa.join.sample", 512),
-        (conf.JOIN_BROAD_FRACTION, "geomesa.join.broad.fraction", 0.25),
-        (conf.JOIN_IN_SELECTIVITY, "geomesa.join.in.selectivity", 0.5),
+    # every geomesa.raster.* / geomesa.join.* knob and metric (analyzer
+    # registries) is declared at runtime and cited by this doc, at the
+    # doc table's defaults
+    raster_knobs, raster_metrics = _area_names("geomesa.raster.")
+    join_knobs, join_metrics = _area_names("geomesa.join.")
+    assert len(raster_knobs) >= 5 and len(join_knobs) >= 4
+    assert len(join_metrics) >= 6, join_metrics
+    _assert_runtime_declared(raster_knobs + join_knobs)
+    _assert_documented(
+        "joins.md",
+        raster_knobs + join_knobs + raster_metrics + join_metrics,
+    )
+    for name, default in [
+        ("geomesa.raster.enabled", True),
+        ("geomesa.raster.max.cells", 16384),
+        ("geomesa.raster.min.edges", 8),
+        ("geomesa.raster.kernel.intervals", 16),
+        ("geomesa.raster.residue", "host"),
+        ("geomesa.join.adaptive", True),
+        ("geomesa.join.sample", 512),
+        ("geomesa.join.broad.fraction", 0.25),
+        ("geomesa.join.in.selectivity", 0.5),
     ]:
-        assert prop.name == name and prop.default == default, name
-        assert name in text, name
+        assert conf.REGISTRY[name].default == default, name
 
-    # join surfaces: strategy args + metric counters the doc names
+    # join surfaces: strategy args + the counter read path the doc names
     from geomesa_tpu.process.join import join_search
     from geomesa_tpu.sql.join import spatial_join, spatial_join_indexed
 
@@ -418,11 +477,7 @@ def test_joins_doc_honest():
     for p in ("explain", "metrics"):
         assert p in inspect.signature(join_search).parameters, p
     reg = MetricsRegistry()
-    for c in ("geomesa.join.strategy.exact", "geomesa.join.strategy.raster",
-              "geomesa.join.strategy.probe", "geomesa.join.strategy.host_raster",
-              "geomesa.join.in_cap_fallback",
-              "geomesa.join.in_skipped_selectivity"):
-        assert c in text, c
+    for c in join_metrics:
         reg.counter(c)
     assert reg.counter_value("geomesa.join.in_cap_fallback") == 1
 
@@ -447,3 +502,27 @@ def test_joins_doc_honest():
         join = rows["z2_polygon_join"]
         assert join["identical"] is True
         assert join["speedup"] >= 5.0
+
+
+def test_analysis_rule_catalog_documented():
+    """docs/analysis.md stays honest: every shipped rule id appears in
+    its catalog, and the catalog names no phantom rules."""
+    from geomesa_tpu import analysis
+
+    text = open(os.path.join(_ROOT, "docs", "analysis.md")).read()
+    ids = {r.id for r in analysis.ALL_RULES} | {"parse-error"}
+    for rid in sorted(ids):
+        assert f"`{rid}`" in text, f"rule {rid!r} missing from docs/analysis.md"
+    for rid in re.findall(r"^\| `([a-z][a-z0-9-]+)` \|", text, re.MULTILINE):
+        assert rid in ids, f"docs/analysis.md catalogs unknown rule {rid!r}"
+
+
+def test_config_doc_lists_every_knob():
+    """docs/config.md is the complete operator-facing knob index (the
+    knob-undocumented rule's backstop): every declared SystemProperty
+    appears there by full name."""
+    regs = _registries()
+    assert len(regs.knobs.knobs) >= 25
+    text = open(os.path.join(_ROOT, "docs", "config.md")).read()
+    missing = [n for n in sorted(regs.knobs.knobs) if n not in text]
+    assert not missing, f"docs/config.md does not list: {missing}"

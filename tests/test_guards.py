@@ -299,3 +299,21 @@ class TestAttributeVisibility:
         assert len(out) == 1
         # unrelated predicates still work for unauthorized auths
         assert len(ds.query("av", "name = 'x'")) == 50
+
+
+def test_temporal_guard_resolves_property_tier():
+    """geomesa.guard.temporal.max.duration (docs/config.md): an unset
+    max_ms resolves the knob — programmatic override and env included —
+    matching the reference property of the same name."""
+    from geomesa_tpu.conf import GUARD_TEMPORAL_MAX
+    from geomesa_tpu.planning.guards import TemporalQueryGuard
+
+    assert TemporalQueryGuard().max_ms == 7 * 86_400_000  # one week
+    assert TemporalQueryGuard.from_properties().max_ms == 7 * 86_400_000
+    GUARD_TEMPORAL_MAX.set(3_600_000)
+    try:
+        assert TemporalQueryGuard().max_ms == 3_600_000
+        # explicit max_ms still wins over the property
+        assert TemporalQueryGuard(max_ms=5).max_ms == 5
+    finally:
+        GUARD_TEMPORAL_MAX.clear()

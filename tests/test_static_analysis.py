@@ -1,0 +1,310 @@
+"""The geomesa-lint suite is a tier-1 invariant (docs/analysis.md).
+
+Three layers:
+
+- **the tree is clean**: every shipped rule over geomesa_tpu/ +
+  scripts/ + docs/*.md yields zero findings WITHOUT baseline help, and
+  the checked-in baseline is empty (violations get fixed, not
+  suppressed) — this is what makes the analyzer a ratchet;
+- **the rules have teeth**: per-rule known-bad/known-good fixtures
+  (tests/fixtures/analysis/) replay the defects that motivated each
+  family — the PR 5 fused E-bucket grouping-key bug, the pre-PR 3
+  unlocked MetricsRegistry mutation, an annotated scheduler queue
+  mutated outside its condition — and each must be caught;
+- **the gate convention**: scripts/check.py exits 0/1/2 exactly like
+  scripts/bench_gate.py (0 clean, 1 findings, 2 unusable input), so CI
+  treats both gates alike.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from geomesa_tpu import analysis
+from geomesa_tpu.analysis.core import (
+    Project,
+    default_baseline_path,
+    load_baseline,
+    run_rules,
+)
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXDIR = "tests/fixtures/analysis"
+
+
+def _render(findings):
+    return "\n".join(f.render() for f in findings)
+
+
+# scope-sensitive fixtures are staged under SYNTHETIC in-scope paths
+# (Project.add_file with text=) so the shipped rule scopes stay
+# production-only — the kernel rules scan geomesa_tpu/scan|curve/, the
+# lock-inference rule scans serving/cache/ingest/metrics
+_SYNTHETIC_PATHS = {
+    "kernel_bad.py": "geomesa_tpu/scan/_fixture_kernel_bad.py",
+    "locks_bad_registry.py": "geomesa_tpu/serving/_fixture_locks_bad_registry.py",
+}
+
+
+def _fixture_path(name: str) -> str:
+    return _SYNTHETIC_PATHS.get(name, f"{FIXDIR}/{name}")
+
+
+@pytest.fixture(scope="module")
+def fixture_result():
+    """One analysis run over the repo PLUS every rule fixture."""
+    project = Project.load(ROOT)
+    for fn in sorted(os.listdir(os.path.join(ROOT, FIXDIR))):
+        if fn.endswith(".py"):
+            src = open(os.path.join(ROOT, FIXDIR, fn)).read()
+            project.add_file(_fixture_path(fn), text=src)
+    return run_rules(project, analysis.ALL_RULES, baseline=set())
+
+
+def _at(result, path, rule=None):
+    return [
+        f for f in result.findings
+        if f.path == _fixture_path(path)
+        and (rule is None or f.rule_id == rule)
+    ]
+
+
+# -- layer 1: the tree is clean ------------------------------------------
+
+
+def test_repo_is_lint_clean_and_fast():
+    t0 = time.perf_counter()
+    result = analysis.run(ROOT, baseline=set())  # no suppression help
+    dt = time.perf_counter() - t0
+    assert result.clean, f"new lint findings:\n{_render(result.findings)}"
+    # acceptance bound: a full-repo run fits CI comfortably
+    assert dt < 10.0, f"analysis took {dt:.1f}s (budget 10s)"
+
+
+def test_checked_in_baseline_is_empty():
+    keys = load_baseline(default_baseline_path(ROOT))
+    assert keys == set(), (
+        "the shipped suppression baseline must stay empty — fix "
+        f"violations instead of suppressing: {sorted(keys)}"
+    )
+
+
+def test_rule_ids_unique_and_well_formed():
+    ids = [r.id for r in analysis.ALL_RULES]
+    assert len(ids) == len(set(ids)), ids
+    for r in analysis.ALL_RULES:
+        assert r.id and r.id == r.id.lower() and " " not in r.id, r.id
+        assert r.description, r.id
+        assert r.fix_hint, r.id
+
+
+# -- layer 2: the rules have teeth (fixtures) ----------------------------
+
+
+def test_pr5_e_bucket_grouping_key_bug_is_caught(fixture_result):
+    bad = _at(fixture_result, "fused_bad_pr5.py", "fused-key-dimension")
+    assert len(bad) == 1, _render(bad)
+    assert "fused_e_bucket" in bad[0].message
+    assert "scan_submit_many" in bad[0].message
+    # the hardened key is silent
+    assert _at(fixture_result, "fused_good.py") == []
+
+
+def test_unlocked_metrics_registry_mutation_is_caught(fixture_result):
+    bad = _at(fixture_result, "locks_bad_registry.py", "lock-guarded-mutation")
+    assert len(bad) == 1, _render(bad)
+    assert "counters" in bad[0].message
+    assert "counter()" in bad[0].message
+    assert "inferred" in bad[0].message  # inference mode, no annotation
+
+
+def test_inherited_lock_annotation_still_enforced(fixture_result):
+    """A guarded-by annotation is enforced even when the lock lives in
+    a base class (no lock assignment visible in the annotated class)."""
+    bad = _at(
+        fixture_result, "locks_bad_inherited.py", "lock-guarded-mutation"
+    )
+    assert len(bad) == 1, _render(bad)
+    assert "_items" in bad[0].message and "add" in bad[0].message
+    # and no bad-annotation noise for the undetectable inherited lock
+    assert all("annotation" not in f.symbol for f in bad)
+
+
+def test_scheduler_guarded_by_mutation_is_caught(fixture_result):
+    bad = _at(
+        fixture_result, "locks_bad_scheduler.py", "lock-guarded-mutation"
+    )
+    assert len(bad) == 1, _render(bad)
+    assert "_queue" in bad[0].message and "submit" in bad[0].message
+    assert "guarded-by" in bad[0].message  # explicit-annotation mode
+    # the disciplined twin (with *_locked and holds-lock escapes) passes
+    assert _at(fixture_result, "locks_good.py") == []
+
+
+def test_undeclared_knob_literal_is_caught(fixture_result):
+    bad = _at(fixture_result, "knob_bad.py", "knob-undeclared")
+    assert len(bad) == 1, _render(bad)
+    assert "geomesa.scan.rangs.target" in bad[0].message  # the typo
+    # the correctly spelled neighbor resolved against conf.py
+
+
+def test_metric_convention_and_type_conflict_are_caught(fixture_result):
+    conv = _at(fixture_result, "metric_bad.py", "metric-convention")
+    assert len(conv) == 1 and "geomesa.Fixture-Area.hits" in conv[0].message
+    dup = _at(fixture_result, "metric_bad.py", "metric-type-conflict")
+    assert len(dup) == 1 and "geomesa.fixture.depth" in dup[0].message
+    assert "counter" in dup[0].message and "gauge" in dup[0].message
+
+
+def test_kernel_purity_hazards_are_caught(fixture_result):
+    coerce = _at(fixture_result, "kernel_bad.py", "kernel-traced-coercion")
+    # float(x) only: neither int(n_pad) (tuple static form) nor the
+    # scalar-string static_argnames twin may be flagged
+    assert len(coerce) == 1, _render(coerce)
+    assert "float()" in coerce[0].message and "'x'" in coerce[0].message
+    assert "bad_kernel" in coerce[0].message
+    shape = _at(fixture_result, "kernel_bad.py", "kernel-dynamic-shape")
+    assert len(shape) == 1 and "nonzero" in shape[0].message
+    # baseline keys stay line-free (the suppression-stability contract)
+    for f in coerce + shape:
+        assert str(f.line) not in f.key, f.key
+
+
+def test_fstring_family_reported_once(fixture_result):
+    """An f-string fragment is scanned exactly once: the JoinedStr
+    branch owns it, the plain-Constant walk must skip it (the
+    duplicate-findings regression)."""
+    bad = _at(fixture_result, "knob_fstring.py", "knob-undeclared")
+    assert len(bad) == 1, _render(bad)
+    assert "geomesa.bogus" in bad[0].message
+
+
+def test_warmup_ladder_gap_is_caught(fixture_result):
+    bad = _at(fixture_result, "warmup_bad.py", "warmup-coverage")
+    assert len(bad) == 1, _render(bad)  # R missing, E covered
+    assert "FUSED_R_BUCKETS" in bad[0].message
+
+
+# -- suppression machinery ------------------------------------------------
+
+
+def test_baseline_and_inline_suppression(tmp_path):
+    project = Project.load(ROOT)
+    project.add_file(f"{FIXDIR}/knob_bad.py")
+    rules = [r for r in analysis.ALL_RULES if r.id == "knob-undeclared"]
+    result = run_rules(project, rules, baseline=set())
+    bad = [f for f in result.findings if f.path.endswith("knob_bad.py")]
+    assert len(bad) == 1
+    # baselining the key suppresses it (and survives line drift: the key
+    # carries the offending symbol, not the line number)
+    assert str(bad[0].line) not in bad[0].key
+    baselined = run_rules(project, rules, baseline={bad[0].key})
+    assert not [
+        f for f in baselined.findings if f.path.endswith("knob_bad.py")
+    ]
+    assert [
+        f for f in baselined.suppressed if f.path.endswith("knob_bad.py")
+    ]
+    # inline `# lint: ignore[rule-id]` on the flagged line also works
+    src = open(os.path.join(ROOT, FIXDIR, "knob_bad.py")).read()
+    lines = src.splitlines()
+    lines[bad[0].line - 1] += "  # lint: ignore[knob-undeclared]"
+    alt = tmp_path / "knob_bad_suppressed.py"
+    alt.write_text("\n".join(lines) + "\n")
+    p2 = Project(str(tmp_path))
+    p2.add_file("knob_bad_suppressed.py")
+    r2 = run_rules(p2, rules, baseline=set())
+    assert not r2.findings and r2.suppressed
+
+
+# -- layer 3: the shared gate exit-code convention ------------------------
+
+
+class TestCheckGateExitCodes:
+    """scripts/check.py exits exactly like scripts/bench_gate.py
+    (whose 0/1/2 contract is pinned by test_raster_join.TestBenchGate):
+    0 clean, 1 findings, 2 unusable input."""
+
+    def _run(self, *args):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "check.py"), *args],
+            capture_output=True, text=True, timeout=120,
+        )
+        return proc
+
+    def _mini_repo(self, tmp_path, body):
+        root = tmp_path / "repo"
+        (root / "geomesa_tpu").mkdir(parents=True)
+        (root / "geomesa_tpu" / "mod.py").write_text(body)
+        return str(root)
+
+    def test_clean_tree_exits_zero(self, tmp_path):
+        root = self._mini_repo(tmp_path, '"""A module."""\n\nX = 1\n')
+        proc = self._run("--root", root, "--json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        import json
+
+        payload = json.loads(proc.stdout)
+        assert payload["clean"] is True and payload["findings"] == []
+
+    def test_findings_exit_one(self, tmp_path):
+        root = self._mini_repo(
+            tmp_path,
+            '"""A module citing geomesa.not.a.knob anywhere."""\n',
+        )
+        proc = self._run("--root", root, "--json")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        import json
+
+        payload = json.loads(proc.stdout)
+        assert payload["findings"], payload
+        assert payload["findings"][0]["rule"] == "knob-undeclared"
+
+    def test_unusable_input_exits_two(self, tmp_path):
+        assert self._run("--rules", "no-such-rule").returncode == 2
+        assert self._run(
+            "--root", str(tmp_path / "missing")
+        ).returncode == 2
+        assert self._run(
+            "--baseline", str(tmp_path / "missing.txt")
+        ).returncode == 2
+
+    def test_write_baseline_bootstraps_then_suppresses(self, tmp_path):
+        """The adopt-time workflow: --write-baseline CREATES a fresh
+        baseline file, and a rerun against it exits 0."""
+        root = self._mini_repo(
+            tmp_path, '"""Cites geomesa.not.a.knob here."""\n'
+        )
+        bl = tmp_path / "bl" / "lint-baseline.txt"
+        proc = self._run(
+            "--root", root, "--write-baseline", "--baseline", str(bl)
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert bl.exists() and "knob-undeclared" in bl.read_text()
+        rerun = self._run("--root", root, "--baseline", str(bl))
+        assert rerun.returncode == 0, rerun.stdout + rerun.stderr
+        # idempotent: a second write appends nothing (no duplicate keys)
+        n_lines = len(bl.read_text().splitlines())
+        again = self._run(
+            "--root", root, "--write-baseline", "--baseline", str(bl)
+        )
+        assert again.returncode == 0
+        assert len(bl.read_text().splitlines()) == n_lines
+
+    def test_parse_error_is_baselinable(self, tmp_path):
+        """Adopt-time convergence on trees carrying broken files: the
+        parse-error finding goes through the baseline like any other."""
+        root = self._mini_repo(tmp_path, "def broken(:\n")
+        assert self._run("--root", root).returncode == 1
+        bl = tmp_path / "bl.txt"
+        assert self._run(
+            "--root", root, "--write-baseline", "--baseline", str(bl)
+        ).returncode == 0
+        assert "parse-error" in bl.read_text()
+        rerun = self._run("--root", root, "--baseline", str(bl))
+        assert rerun.returncode == 0, rerun.stdout + rerun.stderr
