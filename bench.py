@@ -27,7 +27,7 @@ Prints one JSON line per config, config 1 first. Env knobs:
 GEOMESA_BENCH_N (config-1 points), GEOMESA_BENCH_N2, GEOMESA_BENCH_N3,
 GEOMESA_BENCH_N4, GEOMESA_BENCH_N5, GEOMESA_BENCH_QUERIES,
 GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"; named scenarios "cache",
-"serving", "ingest", "fused"), GEOMESA_BENCH_PLATFORM
+"serving", "ingest", "fused", "pip_join", "stream"), GEOMESA_BENCH_PLATFORM
 (e.g. "cpu" for off-TPU verification). Supervisor knobs (see main()):
 GEOMESA_BENCH_INIT_TIMEOUT (child device-init watchdog, s),
 GEOMESA_BENCH_INIT_RETRIES (attempts), GEOMESA_BENCH_ATTEMPT_TIMEOUT
@@ -1462,6 +1462,346 @@ def config_pip_join(out_path: "str | None" = None):
     return rec
 
 
+# ---------------------------------------------------- streaming scenario
+
+
+def config_stream(out_path: "str | None" = None):
+    """Production streaming tier scenario (round 9, docs/streaming.md):
+    sustained micro-batch ingest through the LambdaStore while a
+    concurrent mixed query workload runs against the hot+cold merge.
+
+    The moving-objects workload: a cold z3 store of N tracked objects;
+    each flush batch is half UPDATES of existing ids (objects reporting
+    new positions with fresh timestamps) and half NEW ids (arrivals).
+    Two ingest paths at the same batch sizes:
+
+    - ``legacy``: the pre-round-9 per-flush full persist —
+      ``write`` + ``persist_hot(incremental=False)``, a delete-and-
+      rewrite recompaction of the whole cold table per flush;
+    - ``streamed``: ``write`` + micro-batch ``flush()`` — appends ride
+      the O(batch) delta tier, updates hold in the exact hot overlay
+      and fold incrementally past ``geomesa.stream.fold.rows``
+      (``DataStore.fold_upsert``), with a final full persist included
+      in the measured wall clock.
+
+    During the streamed run, client threads issue mixed bbox/bbox+time
+    queries through ``LambdaStore.query`` with the cold store's
+    QueryScheduler attached (fused dispatches + shedding while ingest
+    runs); their p50/p99 are recorded against the declared SLO. The
+    legacy baseline runs WITHOUT the query load (favoring the
+    baseline). Exactness is computed in-bench: after the run, every
+    probe query against the streamed store must return the same id set
+    and attribute values as a fresh batch-loaded oracle holding the
+    expected final state -> the ``identical`` flag
+    ``scripts/bench_gate.py`` enforces.
+
+    Emits BENCH_STREAM.json next to this file (or at ``out_path`` / env
+    GEOMESA_BENCH_STREAM_OUT — use a SCRATCH path when producing the
+    fresh side of a gate comparison). Env knobs:
+    GEOMESA_BENCH_STREAM_N (cold rows), GEOMESA_BENCH_STREAM_BATCH
+    (rows per flush), GEOMESA_BENCH_STREAM_FLUSHES,
+    GEOMESA_BENCH_STREAM_CLIENTS (query threads),
+    GEOMESA_BENCH_STREAM_SLO_MS (query p99 SLO)."""
+    import threading
+
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.streaming import LambdaStore, StreamConfig
+
+    n = int(os.environ.get("GEOMESA_BENCH_STREAM_N", 3_000_000))
+    batch = int(os.environ.get("GEOMESA_BENCH_STREAM_BATCH", 20_000))
+    flushes = int(os.environ.get("GEOMESA_BENCH_STREAM_FLUSHES", 24))
+    # the legacy baseline's per-flush cost is stationary (O(table) each
+    # flush): fewer flushes measure the same rate in half the wall —
+    # and bias FOR the baseline, since its table is smaller on average
+    legacy_flushes = int(os.environ.get(
+        "GEOMESA_BENCH_STREAM_LEGACY_FLUSHES", max(min(flushes, 12), 1)
+    ))
+    # query load sized to the host: half the cores as open-loop
+    # dashboard clients (a 2-core CI box gets 2 clients; a serving host
+    # scales up via the env knobs)
+    clients = int(os.environ.get(
+        "GEOMESA_BENCH_STREAM_CLIENTS", max(2, (os.cpu_count() or 2) // 2)
+    ))
+    poll_ms = float(os.environ.get("GEOMESA_BENCH_STREAM_POLL_MS", 150.0))
+    # declared p99 SLO for dashboard reads under sustained ingest on the
+    # SHARED 2-core CPU CI host (p50 sits ~50-60 ms; the tail is core
+    # contention with the flush stages plus neighbor load — serving
+    # hosts with spare cores run far tighter; observed p99 across runs
+    # spans ~200-800 ms on this box)
+    slo_ms = float(os.environ.get("GEOMESA_BENCH_STREAM_SLO_MS", 1000.0))
+    t0_ms = 1_717_200_000_000  # 2024-06-01T00:00:00Z
+    day = 86_400_000
+    spec = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+    def build():
+        rng = np.random.default_rng(SEED + 90)
+        ds = DataStore()
+        sft = FeatureType.from_spec("mv", spec)
+        ds.create_schema(sft)
+        ds.write("mv", FeatureCollection.from_columns(
+            sft, np.arange(n).astype(str), {
+                "name": np.array(["v"] * n),
+                "dtg": t0_ms + rng.integers(0, 7 * day, n),
+                "geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)),
+            }), check_ids=False)
+        ds.compact("mv")
+        return ds
+
+    # the message stream (the producer side): prebuilt so both runs
+    # ingest the identical sequence
+    log(f"[stream] building {flushes} x {batch:,}-row message stream ...")
+    rng = np.random.default_rng(SEED + 91)
+    stream = []
+    state: dict = {}
+    for k in range(flushes):
+        upd = rng.choice(n, batch // 2, replace=False)
+        ids = [str(i) for i in upd] + [
+            f"new{k}_{j}" for j in range(batch - batch // 2)
+        ]
+        xs = rng.uniform(-170, 170, batch)
+        ys = rng.uniform(-80, 80, batch)
+        ts = t0_ms + 8 * day + rng.integers(0, day, batch).astype(np.int64)
+        rows = [
+            {"name": f"r{k}", "dtg": int(ts[j]),
+             "geom": geo.Point(float(xs[j]), float(ys[j]))}
+            for j in range(batch)
+        ]
+        stream.append((rows, ids))
+        for j, fid in enumerate(ids):
+            state[fid] = (f"r{k}", float(xs[j]), float(ys[j]), int(ts[j]))
+
+    def qpool(seed):
+        # city/regional dashboard windows: small boxes (the serving
+        # bench's scale) so the query mix models live dashboards, not
+        # continental exports
+        qrng = np.random.default_rng(seed)
+        out = []
+        for _ in range(256):
+            w = float(qrng.choice([0.5, 1.0, 2.0]))
+            qx = qrng.uniform(-165, 165 - w)
+            qy = qrng.uniform(-75, 75 - w / 2)
+            q = f"bbox(geom, {qx:.3f}, {qy:.3f}, {qx + w:.3f}, {qy + w / 2:.3f})"
+            if qrng.random() < 0.3:
+                q += (" AND dtg DURING "
+                      "2024-06-01T00:00:00Z/2024-06-10T00:00:00Z")
+            out.append(q)
+        return out
+
+    # -- legacy baseline: full persist per flush, no query load ----------
+    log(f"[stream] building {n:,}-row cold store (legacy run) ...")
+    ds = build()
+    lam = LambdaStore(ds, "mv")
+    t0 = time.perf_counter()
+    for rows, ids in stream[:legacy_flushes]:
+        for s in range(0, len(rows), 2048):  # same consumer loop shape
+            lam.write(
+                [dict(r) for r in rows[s : s + 2048]], ids=ids[s : s + 2048]
+            )
+        lam.persist_hot(incremental=False)
+    legacy_s = time.perf_counter() - t0
+    legacy_rps = legacy_flushes * batch / legacy_s
+    lam.close()
+    log(f"[stream] legacy full-persist path: {legacy_rps:,.0f} rows/s")
+
+    # -- streamed run: micro-batch flushes + concurrent query load -------
+    log(f"[stream] building {n:,}-row cold store (streamed run) ...")
+    reg = MetricsRegistry()
+    ds = build()
+    ds.metrics = reg
+    # fold threshold above the run's total updates: the ONE fold happens
+    # at the explicit final persist, whose window is timed separately
+    # below (the "GC pause" of the LSM design — queries inside it queue
+    # behind the O(table) device re-upload)
+    lam = LambdaStore(ds, "mv", config=StreamConfig(
+        fold_rows=batch * flushes + 1,
+    ))
+    lam.serve()
+    # compile EVERY scan-kernel variant (single-query ladder + the fused
+    # multi-query shapes, all predicate-flag combos) before the clock
+    # starts: a first-hit XLA compile landing mid-run would show up in
+    # the measured p99 as a ~second-long straggler
+    ds.warmup("mv")
+    for q in qpool(SEED + 92)[:8]:
+        lam.query(q)
+    ds.query_many("mv", qpool(SEED + 92)[8:16])
+    stop = threading.Event()
+    lat: list = []
+    lat_lock = threading.Lock()
+
+    def client(seed):
+        # open-loop dashboard poll: one query per poll interval (a
+        # closed-loop hammer would just consume every spare core and
+        # measure CPU contention, not serving latency at a stated load)
+        pool = qpool(seed)
+        local = []
+        i = 0
+        while not stop.is_set():
+            s = time.perf_counter()
+            lam.query(pool[i % len(pool)])
+            dt = time.perf_counter() - s
+            local.append((s, dt))
+            i += 1
+            stop.wait(max(poll_ms / 1e3 - dt, 0.0))
+        with lat_lock:
+            lat.extend(local)
+
+    threads = [
+        threading.Thread(target=client, args=(SEED + 100 + c,))
+        for c in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    for rows, ids in stream:
+        # the consumer loop: messages apply in small sub-batches (a real
+        # stream consumer polls continuously; one monolithic 20k-row
+        # write would hold the interpreter in a single burst)
+        for s in range(0, len(rows), 2048):
+            lam.write(
+                [dict(r) for r in rows[s : s + 2048]], ids=ids[s : s + 2048]
+            )
+        lam.flush()
+    fold_t0 = time.perf_counter()
+    lam.persist_hot()  # the final fold is part of the measured wall
+    fold_t1 = streamed_s = time.perf_counter()
+    streamed_s -= t0
+    stop.set()
+    for t in threads:
+        t.join()
+    streamed_rps = flushes * batch / streamed_s
+    # SLO accounting: steady-state micro-batch queries vs queries that
+    # overlapped the fold window (queue behind the one O(table) device
+    # re-upload — the LSM "GC pause", reported separately)
+    steady = np.array([d for s, d in lat if s + d < fold_t0]) * 1e3
+    in_fold = np.array([d for s, d in lat if s + d >= fold_t0]) * 1e3
+    p50 = float(np.percentile(steady, 50)) if len(steady) else 0.0
+    p99 = float(np.percentile(steady, 99)) if len(steady) else 0.0
+    fold_p99 = float(np.percentile(in_fold, 99)) if len(in_fold) else 0.0
+    log(
+        f"[stream] streamed path: {streamed_rps:,.0f} rows/s with "
+        f"{len(lat)} concurrent queries (steady p99 {p99:.1f} ms; "
+        f"fold pause {fold_t1 - fold_t0:.2f}s, in-fold p99 {fold_p99:.1f} ms)"
+    )
+
+    # -- exactness: streamed store vs batch-loaded oracle ----------------
+    log("[stream] exactness: batch-loaded oracle comparison ...")
+    oracle = DataStore()
+    osft = FeatureType.from_spec("mv", spec)
+    oracle.create_schema(osft)
+    base_rng = np.random.default_rng(SEED + 90)  # replay build()'s draws
+    bt = t0_ms + base_rng.integers(0, 7 * day, n)  # dtg drawn first
+    bx = base_rng.uniform(-170, 170, n)
+    by = base_rng.uniform(-80, 80, n)
+    # expected final state: the original rows, overridden by the stream
+    oids = np.arange(n).astype(str).tolist() + sorted(
+        fid for fid in state if not fid.isdigit()
+    )
+    names, oxs, oys, ots = [], [], [], []
+    for i, fid in enumerate(oids):
+        if fid in state:
+            nm, x, y, tms = state[fid]
+        else:
+            nm, x, y, tms = "v", float(bx[i]), float(by[i]), int(bt[i])
+        names.append(nm), oxs.append(x), oys.append(y), ots.append(tms)
+    oracle.write("mv", FeatureCollection.from_columns(osft, oids, {
+        "name": np.array(names),
+        "dtg": np.array(ots, np.int64),
+        "geom": (np.array(oxs), np.array(oys)),
+    }), check_ids=False)
+    identical = True
+    for q in qpool(SEED + 93)[:24]:
+        got = lam.query(q)
+        want = oracle.query("mv", q)
+        gi = np.argsort(got.ids)
+        wi = np.argsort(want.ids)
+        gg, wg = got.geom_column, want.geom_column
+        same = (
+            len(got) == len(want)
+            and np.array_equal(np.asarray(got.ids)[gi], np.asarray(want.ids)[wi])
+            and np.array_equal(
+                np.asarray(got.columns["name"])[gi],
+                np.asarray(want.columns["name"])[wi],
+            )
+            # every attribute, bit-for-bit: a fold bug that drifted
+            # coordinates or timestamps while keeping rows inside the
+            # probe boxes must break the identical flag, not pass it
+            and np.array_equal(gg.x[gi], wg.x[wi])
+            and np.array_equal(gg.y[gi], wg.y[wi])
+            and np.array_equal(
+                np.asarray(got.columns["dtg"], np.int64)[gi],
+                np.asarray(want.columns["dtg"], np.int64)[wi],
+            )
+        )
+        if not same:
+            identical = False
+            log(f"[stream] MISMATCH on {q}")
+    lam.close()
+    ds.scheduler.close()
+
+    speedup = streamed_rps / max(legacy_rps, 1e-9)
+    slo_met = bool(p99 <= slo_ms) if len(steady) else True
+    row = {
+        "scenario": "stream_sustained",
+        "cold_rows": n,
+        "batch_rows": batch,
+        "flushes": flushes,
+        "legacy_rows_per_s": round(legacy_rps, 1),
+        "streamed_rows_per_s": round(streamed_rps, 1),
+        "speedup": round(speedup, 2),
+        "identical": identical,
+        "query": {
+            "clients": clients,
+            "poll_ms": poll_ms,
+            "queries": int(len(lat)),
+            "p50_ms": round(p50, 2),
+            "p99_ms": round(p99, 2),
+            "slo_ms": slo_ms,
+            "slo_met": slo_met,
+            "fold_pause_s": round(fold_t1 - fold_t0, 2),
+            "in_fold_queries": int(len(in_fold)),
+            "in_fold_p99_ms": round(fold_p99, 2),
+        },
+    }
+    log(
+        f"[stream] sustained {streamed_rps:,.0f} vs legacy "
+        f"{legacy_rps:,.0f} rows/s = {speedup:.2f}x, identical={identical}, "
+        f"steady p99 {p99:.1f} ms (SLO {slo_ms:.0f} ms, met={slo_met})"
+    )
+
+    import jax
+
+    payload = {
+        "platform": jax.default_backend(),
+        "rows": [row],
+    }
+    if out_path is None:
+        out_path = os.environ.get("GEOMESA_BENCH_STREAM_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_STREAM.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "stream_sustained_rows_per_s",
+        "value": row["streamed_rows_per_s"],
+        "unit": "rows/s",
+        "vs_baseline": row["speedup"],
+        "identical": identical,
+        "query_p99_ms": row["query"]["p99_ms"],
+        "slo_met": slo_met,
+        "cold_rows": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ------------------------------------------------------------- config 4
 
 
@@ -1639,6 +1979,7 @@ def child_main():
         "4": config4_join, "5": config5_knn, "cache": config_cache,
         "serving": config_serving, "ingest": config_ingest,
         "fused": config_fused, "pip_join": config_pip_join,
+        "stream": config_stream,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -40,9 +40,25 @@ def main():
             "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
         },
     ).to_rows())
-    store.persist_hot()  # flush hot -> cold
+    store.persist_hot()  # full persist: hot -> cold
     out = store.query("bbox(geom, -10, -10, 10, 10)")
     print(f"lambda-store hits: {len(out)}")
+
+    # sustained ingest (docs/streaming.md): micro-batch flush() publishes
+    # NEW ids O(batch); updates hold in the exact hot overlay until the
+    # incremental fold. With serve() attached, the cold half of every
+    # query admits through the scheduler while ingest runs.
+    store.serve()
+    store.write(
+        [{"mmsi": "m7", "geom": geo.Point(3.0, 3.0)},   # update of id "7"
+         {"mmsi": "new", "geom": geo.Point(4.0, 4.0)}],  # arrival
+        ids=["7", "live1"],
+    )
+    flushed = store.flush()      # publishes the arrival; update stays hot
+    merged = store.query("bbox(geom, 0, 0, 10, 10)")
+    print(f"micro-batch flushed {flushed}; merged hits: {len(merged)}")
+    store.persist_hot()          # the fold drains the overlay
+    store.close()
     return out
 
 

@@ -214,6 +214,46 @@ JOIN_IN_SELECTIVITY = SystemProperty(
 )
 
 
+# -- production streaming tier (geomesa_tpu.streaming; docs/streaming.md) -
+
+STREAM_WORKERS = SystemProperty(
+    "geomesa.stream.workers", 0, int,
+    "worker count for the stream flusher's parse/key/shard-sort stages "
+    "(0 = one per host core); the pool stays warm across flushes",
+)
+STREAM_CHUNK_ROWS = SystemProperty(
+    "geomesa.stream.chunk.rows", 65_536, int,
+    "rows per flush micro-chunk: the hot snapshot stages through the "
+    "warm workers in chunks of this many rows (also the shard size of "
+    "the per-chunk radix sorts)",
+)
+STREAM_QUEUE_DEPTH = SystemProperty(
+    "geomesa.stream.queue.depth", 4, int,
+    "bounded admission window: flush micro-chunks queued in the worker "
+    "pool at once before staging blocks (bounds the parse stage's "
+    "double-buffering; fully-staged chunks are held until the atomic "
+    "publish); overflow waits are counted by the "
+    "geomesa.stream.queue_full metric",
+)
+STREAM_FOLD_ROWS = SystemProperty(
+    "geomesa.stream.fold.rows", 131_072, int,
+    "pending UPDATE rows before a micro-batch flush folds them into the "
+    "cold tables (the amortized hot->cold merge): below it, updated ids "
+    "stay resident in the hot overlay — reads remain exact through the "
+    "hot-wins-by-id merge — so the steady-state flush pays O(batch) for "
+    "appends instead of O(table) per flush; a full persist "
+    "(persist_hot/checkpoint) always folds everything",
+)
+STREAM_INCREMENTAL = SystemProperty(
+    "geomesa.stream.incremental", True, _parse_bool,
+    "fold flushes into the cold tables incrementally "
+    "(DataStore.fold_upsert: no whole-table re-sort, scoped cache "
+    "invalidation); False = the legacy delete-and-rewrite upsert flush "
+    "(the pre-round-9 path, kept as the bench baseline and the escape "
+    "hatch for custom adapters without the fold_table seam)",
+)
+
+
 # -- concurrent query serving (geomesa_tpu.serving; docs/serving.md) ------
 
 SERVING_WINDOW_MS = SystemProperty(

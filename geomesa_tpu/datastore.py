@@ -187,6 +187,16 @@ class DataStore:
         import threading
 
         self._write_lock = threading.RLock()
+        # serializes only the per-chunk id-index entry cache (_id_index);
+        # entries self-validate by chunk identity, so readers never need
+        # the write lock
+        self._id_lock = threading.Lock()
+        # seqlock for renumbering publishes (fold_upsert): odd while the
+        # assignment-only swap of tables+chunks is in flight, so
+        # pin_scan_state's lock-free readers can capture a CONSISTENT
+        # (table, chunk list) pair without ever blocking on the write
+        # lock (which the fold holds for seconds around device builds)
+        self._publish_seq = 0  # guarded-by: _write_lock
         # damage accounting: persist.load replaces this with the real
         # verification outcome; a store with quarantined partitions
         # answers queries DEGRADED (per-plan warnings + metrics counter)
@@ -458,7 +468,6 @@ class DataStore:
 
             self._chunks[type_name].append(features)
             self._full[type_name] = None
-            self._id_sorted[type_name] = None
             self._stats[type_name] = stats
             for name, keys in new_keys.items():
                 self._key_chunks.setdefault((type_name, name), []).append(keys)
@@ -512,7 +521,6 @@ class DataStore:
             total_before = sum(len(c) for c in self._chunks[type_name])
             self._chunks[type_name].extend(fcs)
             self._full[type_name] = None
-            self._id_sorted[type_name] = None
             self._stats[type_name] = stats
             for name, keys in keys_by_index.items():
                 self._key_chunks.setdefault((type_name, name), []).append(keys)
@@ -567,6 +575,143 @@ class DataStore:
                 if len(existing):
                     self.write(type_name, existing)  # best-effort rollback
                 raise
+
+    def fold_upsert(
+        self,
+        type_name: str,
+        features: "FeatureCollection | Sequence[Mapping]",
+        keys: "Mapping | None" = None,
+        stats=None,
+        presorted: "Mapping | None" = None,
+    ) -> int:
+        """Incremental :meth:`upsert`: replace existing ids and append the
+        rest WITHOUT the whole-table recompaction the delete-and-rewrite
+        path pays (the streaming hot->cold merge; docs/streaming.md).
+        Results are bit-identical to :meth:`upsert` — survivors keep
+        their sorted order, the batch radix-sorts alone and two-run
+        merges in (storage.table.folded_table), and only device blocks
+        past the first touched sorted row re-upload. Adapters without
+        the ``fold_table`` seam (or mesh-sharded / secondary-sort-word
+        tables) fall back to a per-index full rebuild, still atomic.
+
+        ``keys``/``stats``: optionally pre-encoded write keys and stats
+        sketch (the stream flusher's warm key stage); ``presorted`` maps
+        index names to the batch's stable (bin, z) argsort (the
+        flusher's shard-sort stage) so the fold skips its delta sort.
+
+        Cache invalidation is SCOPED to the replaced rows' key range
+        plus the batch's own — unlike a compaction's whole-type bump —
+        so warm cached results over untouched regions survive a flush.
+        Statistics ACCUMULATE the batch sketch (sketches cannot subtract
+        the replaced rows): the documented post-update drift, restored
+        by :meth:`analyze_stats`."""
+        from geomesa_tpu.index.api import WriteKeys
+        from geomesa_tpu.storage.delta import concat_keys
+
+        sft = self._schemas[type_name]
+        if not isinstance(features, FeatureCollection):
+            features = FeatureCollection.from_rows(sft, features)
+        if len(features) == 0:
+            return 0
+        ids = np.asarray(features.ids)
+        if len(np.unique(ids)) != len(ids):
+            raise ValueError("duplicate feature ids in replacement batch")
+        if keys is None:
+            features, keys, stats = self._encode_batch(type_name, features)
+        with self._write_lock:
+            # ONE id probe: ordinals survive the compaction below
+            # (compaction preserves ordinal order), so the lookup is not
+            # repeated — at production fold sizes a second searchsorted
+            # pass over millions of string ids is a real fraction of the
+            # fold pause
+            replaced = self.id_lookup(type_name, ids)
+            if not len(replaced):
+                # nothing to replace: a plain append rides the O(batch)
+                # delta tier (LSM steady state) — no forced compaction
+                return self._commit_batch(
+                    type_name, features, keys, stats, check_ids=False
+                )
+            # the fold operates on a fully-compacted prefix: merge any
+            # outstanding host delta first (the incremental merged_table
+            # path), so sorted-row coordinates are table coordinates
+            total = sum(len(c) for c in self._chunks[type_name])
+            if self._main_rows.get(type_name, 0) != total:
+                self.compact(type_name)
+            full = self.features(type_name)
+            n = len(full)
+            # replaced is non-empty here (the pure-append case returned
+            # above): this is always a true fold, never a plain append
+            keep_ordinal = np.ones(n, dtype=bool)
+            keep_ordinal[replaced] = False
+            # old ordinal -> post-delete ordinal (valid at kept rows)
+            ordinal_map = np.cumsum(keep_ordinal, dtype=np.int64) - 1
+            removed = full.take(replaced)
+            survivors = full.mask(keep_ordinal)
+            # build every index's merged keys and folded table BEFORE any
+            # store state mutates: the publish below is assignment-only,
+            # so a failure mid-build leaves the store untouched (the
+            # streaming flush's atomicity contract)
+            fold = getattr(self.adapter, "fold_table", None)
+            staged: list = []  # (index, merged keys, new table, old table)
+            for idx in self._indexes[type_name]:
+                parts = self._key_chunks.get((type_name, idx.name)) or []
+                old_keys = concat_keys(parts) if parts else None
+                dk = keys[idx.name]
+                if old_keys is None:
+                    merged = dk
+                else:
+                    masked = WriteKeys(
+                        bins=old_keys.bins[keep_ordinal],
+                        zs=old_keys.zs[keep_ordinal],
+                        device_cols={
+                            k: v[keep_ordinal]
+                            for k, v in old_keys.device_cols.items()
+                        },
+                        sub=(
+                            old_keys.sub[keep_ordinal]
+                            if old_keys.sub is not None else None
+                        ),
+                    )
+                    merged = concat_keys([masked, dk])
+                old_table = self._tables.get((type_name, idx.name))
+                new_table = None
+                if fold is not None and old_table is not None:
+                    dperm = presorted.get(idx.name) if presorted else None
+                    new_table = fold(
+                        idx, old_table, merged, keep_ordinal, ordinal_map,
+                        dk, delta_perm=dperm,
+                    )
+                if new_table is None:
+                    new_table = self.adapter.create_table(idx, merged)
+                staged.append((idx, merged, new_table, old_table))
+            # -- publish: assignment-only, seqlock-bracketed --------------
+            self._widen_bin_ranges(type_name, keys)
+            self._publish_seq += 1  # odd: renumbering swap in flight
+            for idx, merged, new_table, old_table in staged:
+                self._key_chunks[(type_name, idx.name)] = [merged]
+                self._tables[(type_name, idx.name)] = new_table
+            self._chunks[type_name] = (
+                [survivors] if len(survivors) else []
+            ) + [features]
+            self._full[type_name] = None
+            self._publish_seq += 1  # even: pinned readers may proceed
+            for idx, merged, new_table, old_table in staged:
+                if old_table is not None and old_table is not new_table:
+                    self.adapter.delete_table(old_table)
+            prev = self._stats.get(type_name)
+            if stats is not None:
+                self._stats[type_name] = (
+                    prev.merge(stats) if prev is not None else stats
+                )
+            self._main_rows[type_name] = n - len(replaced) + len(features)
+            # scoped invalidation: the replaced rows' range + the batch's
+            # own range — NOT a whole-type bump (docs/streaming.md)
+            self.planner.invalidate_config_memo()
+            if self.cache is not None:
+                if len(removed):
+                    self.cache.on_mutation(type_name, removed)
+                self.cache.on_mutation(type_name, features)
+        return len(features)
 
     def _validate_replacement(self, type_name: str, features) -> None:
         """Fail BEFORE any row is deleted: a replacement batch that cannot
@@ -740,7 +885,6 @@ class DataStore:
         new_full = full.mask(keep)
         self._chunks[type_name] = [new_full] if len(new_full) else []
         self._full[type_name] = None
-        self._id_sorted[type_name] = None
         for idx in self._indexes[type_name]:
             key = (type_name, idx.name)
             parts = self._key_chunks.get(key)
@@ -886,39 +1030,70 @@ class DataStore:
         chunks with one sort instead of one re-index per chunk."""
         if len(np.unique(ids)) != len(ids):
             raise ValueError("duplicate feature ids in write batch")
-        existing = self._id_index(type_name)
-        if existing is not None and len(existing[0]):
-            sorted_ids = existing[0]
-            if ids.dtype.kind != sorted_ids.dtype.kind:
+        for sorted_ids, _ in self._id_index(type_name):
+            if not len(sorted_ids):
+                continue
+            probe = ids
+            if probe.dtype.kind != sorted_ids.dtype.kind:
                 if sorted_ids.dtype.kind in "US":
                     # natural-width cast: astype(sorted_ids.dtype) would
                     # TRUNCATE to the stored width ('12345' -> '123') and
                     # spuriously report duplicates; numpy compares unicode
                     # arrays of different widths correctly
-                    ids = ids.astype(str)
+                    probe = probe.astype(str)
                 else:
                     try:
-                        ids = ids.astype(sorted_ids.dtype)
+                        probe = probe.astype(sorted_ids.dtype)
                     except (ValueError, TypeError):
-                        return  # incomparable id kinds cannot collide
-            pos = np.searchsorted(sorted_ids, ids)
+                        continue  # incomparable with THIS chunk only —
+                        # later chunks may still hold comparable ids
+            pos = np.searchsorted(sorted_ids, probe)
             pos = np.clip(pos, 0, len(sorted_ids) - 1)
-            if np.any(sorted_ids[pos] == ids):
+            if np.any(sorted_ids[pos] == probe):
                 raise ValueError("duplicate feature ids in write batch")
 
-    def _id_index(self, type_name: str):
-        """(sorted ids, argsort order) for id lookups — built lazily, no
-        python dict (VERDICT r2: a dict over 100M ids is a multi-GB stall)."""
-        cached = self._id_sorted.get(type_name)
-        if cached is None:
-            fc = self.features(type_name)
-            if len(fc) == 0:
-                cached = (np.zeros(0, dtype=fc.ids.dtype), np.zeros(0, np.int64))
-            else:
-                order = np.argsort(fc.ids, kind="stable")
-                cached = (fc.ids[order], order)
-            self._id_sorted[type_name] = cached
-        return cached
+    def _id_index(self, type_name: str, chunks: "list | None" = None) -> list:
+        """Per-chunk ``(sorted ids, global argsort order)`` pairs for id
+        lookups — built lazily PER CHUNK, no python dict (VERDICT r2: a
+        dict over 100M ids is a multi-GB stall). Chunked so the streaming
+        steady state (one appended chunk per flush) sorts only the new
+        chunk instead of re-argsorting every id in the store per flush.
+
+        SELF-VALIDATING against concurrent mutation: each cached entry
+        carries the identity of the chunk object it was built from, and
+        is rebuilt whenever the chunk at its position is a different
+        object. Every mutation that reorders ordinals replaces chunk
+        objects (compaction/delete/fold build fresh collections; appends
+        leave the prefix objects — and therefore their bases — intact),
+        so no invalidation bookkeeping at the mutation sites can be
+        missed or raced; lock-free readers snapshotting mid-append
+        simply see the pre-append state (the store's documented
+        snapshot-read semantics). ``_id_lock`` serializes only the entry
+        cache itself. ``chunks``: an optional pre-captured
+        :meth:`chunk_snapshot` to resolve against (the identity-keyed
+        entries work for any snapshot)."""
+        if chunks is None:
+            chunks = list(self._chunks.get(type_name, []))
+        with self._id_lock:
+            entries = self._id_sorted.get(type_name)
+            if not isinstance(entries, list):
+                entries = []
+                self._id_sorted[type_name] = entries
+            while len(entries) < len(chunks):
+                entries.append(None)
+            del entries[len(chunks):]  # collapsed chunks: drop stale tail
+            out = []
+            base = 0
+            for i, c in enumerate(chunks):
+                e = entries[i]
+                if e is None or e[0] is not c:
+                    ids = np.asarray(c.ids)
+                    order = np.argsort(ids, kind="stable")
+                    e = (c, ids[order], order.astype(np.int64) + base)
+                    entries[i] = e
+                out.append((e[1], e[2]))
+                base += len(c)
+            return out
 
     # -- planner hooks ---------------------------------------------------
     def indexes(self, type_name: str) -> list:
@@ -958,20 +1133,127 @@ class DataStore:
             self._full[type_name] = full
         return full
 
-    def id_lookup(self, type_name: str, ids: Iterable[str]) -> np.ndarray:
-        sorted_ids, order = self._id_index(type_name)
-        if len(sorted_ids) == 0:
-            return np.zeros(0, dtype=np.int64)
+    def row_count(self, type_name: str) -> int:
+        """Total stored rows WITHOUT materializing the chunk concat
+        (``len(features())`` would): the planner's emptiness checks run
+        on every query, and under streaming flushes the concat cache is
+        invalidated every publish."""
+        return sum(len(c) for c in self._chunks.get(type_name, []))
+
+    def pin_scan_state(self, type_name: str, index_name: str):
+        """(scan table, chunk snapshot) captured consistently against the
+        fold's renumbering publish: the two reads retry while
+        ``_publish_seq`` is odd or moved (the publish's assignment-only
+        critical section is microseconds, so retries are brief). A scan
+        dispatched on the returned table gathers its ordinals against
+        the returned snapshot however long the device work takes —
+        renumbering publishes swap in fresh lists and never mutate the
+        pinned ones. (Deletes/modify retain the narrower pre-round-9
+        guarantee: they rebuild tables inside their locked section, and
+        maintenance-scan callers hold the write lock anyway.)"""
+        table = chunks = None
+        for _ in range(64):
+            s0 = self._publish_seq
+            table = self.table(type_name, index_name)
+            chunks = self.chunk_snapshot(type_name)
+            if self._publish_seq == s0 and not (s0 & 1):
+                break
+        return table, chunks
+
+    def chunk_snapshot(self, type_name: str) -> list:
+        """A point-in-time copy of the chunk list, for callers that must
+        apply scan ordinals captured NOW to feature rows gathered LATER
+        (the planner's dispatch->finish window): renumbering mutations
+        (delete/fold) swap in a brand-new list and never mutate the old
+        one, so a pinned snapshot stays internally consistent however
+        long the device scan takes."""
+        return list(self._chunks.get(type_name, []))
+
+    def gather(
+        self, type_name: str, ordinals: np.ndarray, chunks: "list | None" = None
+    ) -> FeatureCollection:
+        """``features().take(ordinals)`` without materializing the full
+        chunk concat. Under sustained streaming flushes every publish
+        invalidates the cached concat, so the take-on-full path made the
+        FIRST query after each flush pay an O(table) concatenation (and
+        queued every concurrent reader behind it — the round-9 p99
+        collapse); gathering per chunk costs O(hits) regardless of how
+        many chunks the delta tier holds. Result rows are in ``ordinals``
+        order, exactly like the full-concat take.
+
+        ``chunks``: an optional :meth:`chunk_snapshot` the ordinals were
+        resolved against — pass it whenever the ordinals were computed
+        at an earlier instant (a dispatched scan's table, an id-index
+        probe), so a fold/delete publishing in between cannot shift
+        ordinals under the gather."""
+        if chunks is None:
+            chunks = self._chunks.get(type_name, [])
+        if not chunks:
+            return FeatureCollection.from_rows(self._schemas[type_name], [])
+        if len(chunks) == 1:
+            return chunks[0].take(ordinals)
+        ordinals = np.asarray(ordinals, dtype=np.int64)
+        bases = np.cumsum([0] + [len(c) for c in chunks])
+        which = np.searchsorted(bases, ordinals, side="right") - 1
+        parts, positions = [], []
+        for ci in range(len(chunks)):
+            sel = np.flatnonzero(which == ci)
+            if len(sel):
+                parts.append(chunks[ci].take(ordinals[sel] - bases[ci]))
+                positions.append(sel)
+        if not parts:
+            return chunks[0].take(np.zeros(0, np.int64))
+        if len(parts) == 1 and len(parts[0]) == len(ordinals):
+            return parts[0]  # single-chunk hit set: already in order
+        cat = FeatureCollection.concat(parts)
+        inv = np.empty(len(ordinals), np.int64)
+        inv[np.concatenate(positions)] = np.arange(len(ordinals))
+        return cat.take(inv)
+
+    # probe rows per searchsorted call in _id_find: numpy string
+    # searchsorted holds the GIL for the whole call, and one monolithic
+    # probe of a large flush batch against millions of sorted string ids
+    # stalls every concurrent reader for its full duration — slicing
+    # bounds each hold to a few ms with negligible overhead
+    _ID_PROBE_SLICE = 8192
+
+    def _id_find(
+        self, type_name: str, ids: Iterable[str], chunks: "list | None" = None
+    ) -> np.ndarray:
+        """Per-input ordinal (or -1) for each requested id, probing every
+        chunk's sorted index (ids are store-unique, so at most one chunk
+        hits per input)."""
         want = np.asarray(list(ids))
-        if want.dtype.kind != sorted_ids.dtype.kind:
-            try:
-                want = want.astype(sorted_ids.dtype)
-            except (ValueError, TypeError):
-                return np.zeros(0, dtype=np.int64)
-        pos = np.searchsorted(sorted_ids, want)
-        pos = np.clip(pos, 0, len(sorted_ids) - 1)
-        hit = sorted_ids[pos] == want
-        return order[pos[hit]].astype(np.int64)
+        found = np.full(len(want), -1, dtype=np.int64)
+        for sorted_ids, order in self._id_index(type_name, chunks=chunks):
+            if not len(sorted_ids):
+                continue
+            probe = want
+            if probe.dtype.kind != sorted_ids.dtype.kind:
+                try:
+                    probe = probe.astype(sorted_ids.dtype)
+                except (ValueError, TypeError):
+                    continue
+            for s in range(0, len(probe), self._ID_PROBE_SLICE):
+                sub = probe[s : s + self._ID_PROBE_SLICE]
+                pos = np.searchsorted(sorted_ids, sub)
+                pos = np.clip(pos, 0, len(sorted_ids) - 1)
+                hit = sorted_ids[pos] == sub
+                found[s : s + self._ID_PROBE_SLICE][hit] = order[pos[hit]]
+        return found
+
+    def id_lookup(
+        self, type_name: str, ids: Iterable[str], chunks: "list | None" = None
+    ) -> np.ndarray:
+        found = self._id_find(type_name, ids, chunks=chunks)
+        return found[found >= 0]
+
+    def id_exists_mask(self, type_name: str, ids: Iterable[str]) -> np.ndarray:
+        """Boolean mask aligned with ``ids``: which are present in the
+        store. The streaming flush uses it to split a hot snapshot into
+        appends (O(batch) delta writes) vs updates (held in the hot
+        overlay until the fold; docs/streaming.md)."""
+        return self._id_find(type_name, ids) >= 0
 
     def stats_for(self, type_name: str):
         return self._stats.get(type_name)
@@ -1168,6 +1450,100 @@ class DataStore:
         if self.metrics is not None:
             self.metrics.counter("geomesa.query.vis_fallback")
 
+    # -- raster aggregation push-down (PR 6 leftover; docs/joins.md) -----
+    def _raster_agg_eligible(self, type_name: str, plan) -> bool:
+        """Whether a plan may take the raster aggregation path: a polygon
+        config carrying a raster-interval stack whose row-scan mask
+        decides the filter (full/out cells + certainty vector), on a
+        point schema without row-level visibility. Such configs are
+        excluded from the gather-free device aggregations (their kernels
+        evaluate the box wide plane only — see ``mask_decides_filter``'s
+        ``for_aggregation``), but count/bounds/stats can still skip the
+        full candidate gather: full raster cells decide membership
+        outright and ONLY the boundary residue pays the exact PIP."""
+        from geomesa_tpu.planning.planner import mask_decides_filter
+
+        cfg = plan.config
+        sft = self._schemas[type_name]
+        return (
+            plan.index is not None
+            and cfg is not None
+            and not cfg.disjoint
+            and cfg.rast is not None
+            and sft.is_points
+            and not self._vis_active(type_name)
+            and mask_decides_filter(plan.filter, cfg, sft)
+        )
+
+    def _raster_agg_scan(self, type_name: str, plan, explain=None):
+        """(hit count, hit ordinals, pinned chunk snapshot) for a
+        raster-eligible plan: the
+        device scan's certainty vector (full-cell / contained-range rows)
+        accepts rows WITHOUT gathering them; only the uncertain boundary
+        residue gathers and pays the exact f64 refinement — the same
+        exactness tiers as a row query, minus the full result gather.
+        Audited + counted (geomesa.query.raster_agg) like the other
+        aggregation fast paths."""
+        deadline = self._agg_deadline()
+        t0 = time.perf_counter()
+        # pinned pair: the residue gather must resolve the scan's
+        # ordinals against the chunk list the table was built over, not
+        # whatever a concurrent fold publishes mid-scan
+        table, chunks = self.pin_scan_state(type_name, plan.index)
+        ordinals, certain = table.scan(plan.config)
+        self._agg_check_deadline(deadline, "raster aggregation scan")
+        cert_ords = ordinals[certain]
+        unc = ordinals[~certain]
+        if len(unc):
+            sub = self.gather(type_name, unc, chunks=chunks)
+            m = plan.filter.evaluate(sub.batch)
+            self._agg_check_deadline(deadline, "raster residue refinement")
+            hits = np.concatenate([cert_ords, unc[m]])
+        else:
+            hits = cert_ords
+        if explain is not None:
+            explain(
+                f"raster aggregation push-down: {len(cert_ords)} certain "
+                f"(full cells / contained ranges), {len(unc)} residue "
+                f"rows re-checked exactly"
+            )
+        if self.metrics is not None:
+            self.metrics.counter("geomesa.query.raster_agg")
+        self.record_query(plan, len(hits), time.perf_counter() - t0)
+        return len(hits), hits, chunks
+
+    def _raster_agg_bounds(self, type_name: str, plan, explain=None):
+        """(count, exact envelope | None) via the raster scan — hit
+        coordinates index straight out of the point columns, no full row
+        gather."""
+        n, hits, chunks = self._raster_agg_scan(type_name, plan, explain=explain)
+        if n == 0:
+            return 0, None
+        # envelope accumulates per chunk from the POINT COLUMNS ONLY — a
+        # full gather would re-pay most of the candidate materialization
+        # this push-down exists to skip (order is irrelevant to min/max);
+        # iterates the scan's PINNED snapshot, not the live chunk list
+        hits = np.sort(np.asarray(hits, dtype=np.int64))
+        env = None
+        base = 0
+        for c in chunks:
+            lo = np.searchsorted(hits, base)
+            hi = np.searchsorted(hits, base + len(c))
+            if hi > lo:
+                sel = hits[lo:hi] - base
+                col = c.geom_column
+                x, y = col.x[sel], col.y[sel]
+                e = (
+                    float(x.min()), float(y.min()),
+                    float(x.max()), float(y.max()),
+                )
+                env = e if env is None else (
+                    min(env[0], e[0]), min(env[1], e[1]),
+                    max(env[2], e[2]), max(env[3], e[3]),
+                )
+            base += len(c)
+        return n, env
+
     def density(
         self,
         type_name: str,
@@ -1307,6 +1683,19 @@ class DataStore:
                     c.count = comp.count
                     out.append(c)
                 return out
+        if all(t.kind == "count" for t in terms) and self._raster_agg_eligible(
+            type_name, plan
+        ):
+            # raster path: exact count (full cells certain + refined
+            # residue) with no full candidate gather — serves the exact
+            # AND the estimate form
+            n = self._raster_agg_scan(type_name, plan, explain=explain)[0]
+            out = []
+            for _ in terms:
+                c = CountStat()
+                c.count = n
+                out.append(c)
+            return out
         if estimate and all(t.kind == "count" for t in terms):
             fast_eligible = plan.index is not None and mask_decides_filter(
                 plan.filter, plan.config, self._schemas[type_name],
@@ -1361,6 +1750,11 @@ class DataStore:
             plan.cache_probe_s = comp.probe_s
             self.record_query(plan, comp.count, time.perf_counter() - t0)
             return comp.bounds
+        if self._raster_agg_eligible(type_name, plan):
+            # raster path: EXACT envelope (tighter than the loose device
+            # estimate) from certain + refined-residue hit coordinates,
+            # no full row gather — serves estimate and exact alike
+            return self._raster_agg_bounds(type_name, plan, explain=explain)[1]
         bounds_eligible = (
             estimate
             and plan.index is not None
@@ -1419,13 +1813,13 @@ class DataStore:
             and not self._vis_active(type_name)
             and not self.interceptors  # an interceptor may hide rows
         ):
-            return len(self.features(type_name))
-        if self.cache is not None:
-            from geomesa_tpu.filter import ecql
+            return self.row_count(type_name)
+        from geomesa_tpu.filter import ecql
 
-            if isinstance(f, str):
-                f = ecql.parse(f)
-            plan = self.planner.plan(type_name, f)
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        plan = self.planner.plan(type_name, f)
+        if self.cache is not None:
             t0 = time.perf_counter()
             comp = self._tile_compose(type_name, plan.filter)
             if comp is not None:
@@ -1436,9 +1830,12 @@ class DataStore:
                 plan.cache_probe_s = comp.probe_s
                 self.record_query(plan, comp.count, time.perf_counter() - t0)
                 return comp.count
-            # reuse the plan rather than re-planning inside query()
-            return len(self.planner.execute(plan))
-        return len(self.query(type_name, f))
+        if self._raster_agg_eligible(type_name, plan):
+            # polygon-with-raster filters count exactly without the full
+            # candidate gather (full cells certain, residue refined)
+            return self._raster_agg_scan(type_name, plan)[0]
+        # reuse the plan rather than re-planning inside query()
+        return len(self.planner.execute(plan))
 
     def estimate_count(self, type_name: str, f: "Filter | str" = INCLUDE) -> int:
         """Estimated hit count from the stats sketches, without scanning

@@ -493,7 +493,6 @@ class QueryPlanner:
         deadline=None,
     ) -> FeatureCollection:
         exp = explain or ExplainNull()
-        fc = self.store.features(plan.type_name)
         if hints is not None:
             hints.validate()
         if deadline is None:
@@ -504,30 +503,38 @@ class QueryPlanner:
 
         certain = None
         if plan.ids is not None:  # id lookup
-            ordinals = self.store.id_lookup(plan.type_name, plan.ids)
-            candidates = fc.take(ordinals)
+            # one snapshot resolves AND gathers: a fold publishing in
+            # between cannot shift the ordinals under the gather
+            chunks = self.store.chunk_snapshot(plan.type_name)
+            ordinals = self.store.id_lookup(
+                plan.type_name, plan.ids, chunks=chunks
+            )
+            candidates = self.store.gather(
+                plan.type_name, ordinals, chunks=chunks
+            )
         elif plan.index is None:  # full host scan
+            fc = self.store.features(plan.type_name)
             check_deadline(deadline, "full-table scan start")
             with exp.span("Full-table host scan"):
                 mask = plan.filter.evaluate(fc.batch)
             check_deadline(deadline, "full-table scan")
             return self._post(fc.mask(mask), plan, hints, exp, skip_visibility)
-        elif plan.index is not None and len(fc) == 0:
+        elif plan.index is not None and self.store.row_count(plan.type_name) == 0:
             # schema exists but nothing written yet: no index tables
-            candidates = fc
+            candidates = self.store.features(plan.type_name)
         else:
             # simple index scan: the shared dispatch/finish implementation
             # (finish runs immediately here; query_many defers it)
             return self._submit_simple(
-                plan, fc, exp, hints, skip_visibility, deadline=deadline
+                plan, exp, hints, skip_visibility, deadline=deadline
             )()
 
         return self._refine_and_post(
             plan, candidates, certain, hints, exp, deadline, skip_visibility
         )
 
-    def _submit_simple(self, plan, fc, exp, hints, skip_visibility=False,
-                       finish_scan=None, deadline=None):
+    def _submit_simple(self, plan, exp, hints, skip_visibility=False,
+                       finish_scan=None, deadline=None, chunks=None):
         """Dispatch a simple index-scan plan's device work now; return
         ``finish()`` -> FeatureCollection. ONE implementation serves both
         the synchronous path (_execute calls finish immediately) and the
@@ -538,11 +545,26 @@ class QueryPlanner:
         tier anchors it at ADMISSION so queue wait is charged against
         the caller's budget instead of restarting it at dispatch.
 
+        Candidates gather through ``store.gather`` (per-chunk takes), so
+        a delta tier freshly grown by a streaming flush never makes a
+        query pay the whole-table chunk concat. The chunk snapshot is
+        PINNED at dispatch, next to the table capture: the scan's
+        ordinals are table ordinals, and a fold/delete publishing during
+        the dispatch->finish window must not shift the rows they gather
+        (renumbering publishes swap in a fresh chunk list and leave the
+        pinned one untouched).
+
         ``finish_scan``: an already-dispatched scan's finish (submit_many's
-        fused group scans); default dispatches this plan's own scan."""
+        fused group scans); default dispatches this plan's own scan.
+        ``chunks``: the chunk snapshot captured when that scan was
+        dispatched (submit_many); default captures one here."""
         if finish_scan is None:
-            table = self.store.table(plan.type_name, plan.index)
+            table, chunks = self.store.pin_scan_state(
+                plan.type_name, plan.index
+            )
             finish_scan = table.scan_submit(plan.config, deadline=None)
+        elif chunks is None:
+            chunks = self.store.chunk_snapshot(plan.type_name)
 
         def finish(deadline=deadline) -> FeatureCollection:
             if deadline is None:
@@ -553,7 +575,9 @@ class QueryPlanner:
                 ordinals, certain = finish_scan()
             check_deadline(deadline, "scan result pull")
             exp(f"Candidates: {len(ordinals)}")
-            candidates = fc.take(ordinals)
+            candidates = self.store.gather(
+                plan.type_name, ordinals, chunks=chunks
+            )
             return self._refine_and_post(
                 plan, candidates, certain, hints, exp, deadline, skip_visibility
             )
@@ -610,7 +634,7 @@ class QueryPlanner:
             and plan.ids is None
             and plan.index is not None
             and plan.config is not None
-            and len(self.store.features(plan.type_name)) > 0
+            and self.store.row_count(plan.type_name) > 0
         )
 
     def submit(self, plan: QueryPlan, explain: Explainer | None = None,
@@ -626,11 +650,10 @@ class QueryPlanner:
             return lambda: self.execute(
                 plan, explain=exp, hints=hints, deadline=deadline
             )
-        fc = self.store.features(plan.type_name)
         if hints is not None:
             hints.validate()
         return self._record_wrap(plan, self._submit_simple(
-            plan, fc, exp, hints, deadline=deadline
+            plan, exp, hints, deadline=deadline
         ))
 
     def _record_wrap(self, plan, inner):
@@ -703,8 +726,7 @@ class QueryPlanner:
                     seen.add(id(h))
                     h.validate()
         for (tname, iname), idxs in groups.items():
-            table = self.store.table(tname, iname)
-            fc = self.store.features(tname)
+            table, chunks = self.store.pin_scan_state(tname, iname)
             many = getattr(table, "scan_submit_many", None)
             if many is None or len(idxs) == 1:
                 for j in idxs:
@@ -717,8 +739,8 @@ class QueryPlanner:
             for j, scan_fin in zip(idxs, scan_fins):
                 plan = plans[j]
                 finishes[j] = self._record_wrap(plan, self._submit_simple(
-                    plan, fc, exps[j] or ExplainNull(), per[j],
-                    finish_scan=scan_fin, deadline=dls[j],
+                    plan, exps[j] or ExplainNull(), per[j],
+                    finish_scan=scan_fin, deadline=dls[j], chunks=chunks,
                 ))
         return finishes
 

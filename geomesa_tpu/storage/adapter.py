@@ -112,6 +112,46 @@ class InProcessAdapter:
         _bump_generations(self, keyspace)
         return table
 
+    def fold_table(
+        self,
+        keyspace,
+        old,
+        merged_keys,
+        keep_ordinal=None,
+        ordinal_map=None,
+        delta_keys=None,
+        delta_perm=None,
+    ):
+        """Incremental replace-merge (storage.table.folded_table): fold a
+        delete + insert batch into ``old`` without a whole-table re-sort,
+        bit-identical to a full recompaction. Returns the folded table,
+        or None when this adapter/table cannot fold (mesh-sharded tables,
+        secondary-sort-word indexes, foreign table classes) — the caller
+        then takes the full rebuild path. Optional SPI method: DataStore
+        probes it with hasattr, so custom adapters without it keep
+        working. Deliberately does NOT run the whole-type generation bump
+        ``create_table`` does — the fold's caller owns SCOPED bumps over
+        the touched key ranges (docs/streaming.md), which is what lets
+        unrelated cached entries survive a streaming flush."""
+        from geomesa_tpu.storage.table import IndexTable, folded_table
+
+        if self.mesh is not None or merged_keys.sub is not None:
+            return None
+        if (
+            not isinstance(old, IndexTable)
+            or type(old)._place_cols is not IndexTable._place_cols
+        ):
+            return None  # subclasses own their layout; rebuild instead
+
+        def attempt():
+            fault_point("adapter.create_table")
+            return folded_table(
+                old, merged_keys, keep_ordinal, ordinal_map, delta_keys,
+                delta_perm=delta_perm, tile=self.tile,
+            )
+
+        return with_retries(attempt)
+
     def delete_table(self, table) -> None:
         pass  # device arrays free with the last reference
 

@@ -1166,6 +1166,117 @@ class IndexTable(SortedKeys):
         return sum(int(v.nbytes) for v in self.cols3.values())
 
 
+def folded_table(
+    old: IndexTable,
+    merged_keys: WriteKeys,
+    keep_ordinal: "np.ndarray | None",
+    ordinal_map: "np.ndarray | None",
+    delta_keys: WriteKeys,
+    delta_perm: "np.ndarray | None" = None,
+    tile: int | None = None,
+) -> IndexTable:
+    """Incremental replace-merge: fold a delete + insert batch into a
+    sorted table WITHOUT the whole-table radix sort (the streaming
+    hot->cold merge; docs/streaming.md). :func:`merged_table` handles
+    pure appends; an upsert flush also *removes* the replaced rows'
+    keys, which round 8 and earlier paid for with a full recompaction
+    (``_main_rows = 0`` -> re-sort + re-upload the entire table per
+    flush). Here:
+
+    - survivors keep their relative sorted order (dropping rows from a
+      sorted sequence preserves sortedness), so no survivor re-sorts;
+    - the delta radix-sorts alone (or arrives pre-sorted from the
+      stream flusher's shard-sort stage as ``delta_perm``) and two-run
+      merges into the survivor order with ``side='right'`` ties — new
+      rows land AFTER equal-key survivors, exactly where the stable
+      whole-table sort of ``concat(survivors, delta)`` puts them, so
+      the result is bit-identical to a full recompaction (the
+      differential matrix in tests/test_streaming_tier.py pins
+      ``perm``/``bins``/``zs`` and every device column);
+    - device blocks before the first touched sorted row are reused
+      as-is (the ``reuse`` seam ``_stream_cols`` already honors), so
+      the re-uploaded bytes scale with the flush's key locality, not N.
+
+    ``merged_keys`` must be ``concat(masked old keys, delta_keys)`` in
+    ordinal order; ``keep_ordinal`` is the survivor mask over OLD
+    feature ordinals (None = nothing deleted) and ``ordinal_map`` maps
+    old ordinals to post-delete ordinals (None when nothing deleted).
+    Tables with a secondary sort word rebuild outright, like
+    :func:`merged_table`.
+    """
+    nd = len(delta_keys.zs)
+    if old.n == 0 or merged_keys.sub is not None:
+        return IndexTable(old.keyspace, merged_keys, tile=tile)
+
+    from geomesa_tpu import native
+
+    if keep_ordinal is None:
+        keep_sorted = None
+        nm = old.n
+        sbins, szs = old.bins, old.zs
+        sperm = np.asarray(old.perm, dtype=np.int64)
+        first_del = old.n
+    else:
+        # survivor mask in SORTED order: a sorted row survives when its
+        # feature ordinal does
+        keep_sorted = keep_ordinal[np.asarray(old.perm, dtype=np.int64)]
+        nm = int(keep_sorted.sum())
+        if nm == 0:
+            return IndexTable(old.keyspace, merged_keys, tile=tile)
+        sbins = old.bins[keep_sorted]
+        szs = old.zs[keep_sorted]
+        sperm = ordinal_map[np.asarray(old.perm, dtype=np.int64)[keep_sorted]]
+        first_del = int(np.argmax(~keep_sorted)) if not keep_sorted.all() else old.n
+
+    if nd == 0:
+        perm = sperm
+        first_change = first_del
+    else:
+        if delta_perm is not None and len(delta_perm) == nd:
+            dperm = np.asarray(delta_perm, dtype=np.int64)
+        else:
+            dperm = native.sort_bins_z(delta_keys.bins, delta_keys.zs)
+            if dperm is None:
+                dperm = np.lexsort((delta_keys.zs, delta_keys.bins))
+            dperm = np.asarray(dperm, dtype=np.int64)
+        db = delta_keys.bins[dperm]
+        dz = delta_keys.zs[dperm]
+
+        # per-bin survivor segments for the insertion searchsorted
+        subins, sstarts = np.unique(sbins, return_index=True)
+        sstarts = np.append(sstarts, nm).astype(np.int64)
+        pos = np.empty(nd, np.int64)
+        for b in np.unique(db):
+            i = int(np.searchsorted(subins, b))
+            if i < len(subins) and subins[i] == b:
+                s, e = int(sstarts[i]), int(sstarts[i + 1])
+            else:
+                s = e = int(sstarts[i]) if i < len(sstarts) else nm
+            sel = db == b
+            # side='right': delta rows land AFTER equal-key survivors —
+            # the stable concat-sort tie order (survivors hold lower
+            # ordinals in merged_keys)
+            pos[sel] = np.searchsorted(szs[s:e], dz[sel], side="right") + s
+
+        main_dest = np.arange(nm, dtype=np.int64) + np.searchsorted(
+            pos, np.arange(nm, dtype=np.int64), side="right"
+        )
+        delta_dest = pos + np.arange(nd, dtype=np.int64)
+        perm = np.empty(nm + nd, dtype=np.int64)
+        perm[main_dest] = sperm
+        perm[delta_dest] = nm + dperm
+        first_change = min(first_del, int(pos.min()))
+    if len(perm) < 2**32:
+        perm = perm.astype(np.uint32)  # keep the native take() fast path
+
+    table = IndexTable(
+        old.keyspace, merged_keys, tile=tile,
+        sorted_state=perm, reuse=(old, first_change),
+    )
+    table.rows_sorted = nd
+    return table
+
+
 def merged_table(
     old: IndexTable, merged_keys: WriteKeys, delta_keys: WriteKeys, tile: int | None = None
 ) -> IndexTable:

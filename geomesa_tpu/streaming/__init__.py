@@ -1,0 +1,28 @@
+"""geomesa_tpu.streaming: the production streaming tier (docs/streaming.md).
+
+A first-class subsystem (round 9; previously one file) for sustained
+ingest meeting live queries:
+
+- :class:`StreamingFeatureCache` — the thread-safe live hot tier
+  (upsert / expiry / listeners over a bucket grid; the
+  KafkaFeatureCacheImpl analogue);
+- :class:`StreamFlusher` / :class:`StreamConfig` — the persistent
+  pipelined flush engine: warm parse/key/shard-sort workers, bounded
+  admission window, ``geomesa.stream.*`` metrics, one atomic publish
+  per flush into ``DataStore.fold_upsert``'s incremental merge;
+- :class:`LambdaStore` — the hot/cold hybrid (reference
+  LambdaDataStore): exact hot-wins-by-id reads under concurrent
+  flushes, scheduler-admitted cold scans;
+- :class:`FeatureStream` — derived-view topologies over a change
+  stream (the geomesa-kafka streams analogue).
+"""
+
+from geomesa_tpu.streaming.cache import StreamingFeatureCache
+from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
+from geomesa_tpu.streaming.store import LambdaStore
+from geomesa_tpu.streaming.stream import FeatureStream
+
+__all__ = [
+    "StreamingFeatureCache", "StreamConfig", "StreamFlusher",
+    "LambdaStore", "FeatureStream",
+]

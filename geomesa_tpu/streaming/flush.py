@@ -1,0 +1,286 @@
+"""StreamFlusher: the persistent pipelined hot->cold flush engine.
+
+The pre-round-9 flush re-paid the whole write path per flush: snapshot
+-> one-shot parse of every hot row -> ``cold.upsert`` (a delete-and-
+rewrite that re-sorted and re-uploaded the ENTIRE cold table). At
+production rates that makes flush cost O(cold), not O(flush).
+
+This engine keeps the staged-loader shape of ``geomesa_tpu.ingest``
+(parse -> keys -> shard-sort -> one atomic publish) but holds the
+worker pool WARM across flushes — a sustained stream flushes every few
+hundred ms, and rebuilding a pool (plus its queues and stage state) per
+flush measurably taxes the steady state the way per-flush recompaction
+does, just lower. Stages:
+
+1. **parse** — the hot snapshot's row dicts become columnar
+   FeatureCollections in fixed-size micro-chunks
+   (``geomesa.stream.chunk.rows``), in pool workers;
+2. **keys**  — ``DataStore._encode_batch`` per chunk (the write path's
+   pure half: every index's write keys + the stats sketch);
+3. **sort**  — each chunk's (bin, z) keys shard-radix-sort
+   (``ingest.sort.shard_runs``); at commit the runs k-way merge into
+   the flush batch's stable argsort, handed to the fold so the
+   incremental merge never re-sorts the batch either;
+4. **commit** — ONE atomic publish: ``DataStore.fold_upsert`` folds the
+   batch into the cold tables (docs/streaming.md), under
+   ``fault.with_retries`` at the ``streaming.persist`` fault point.
+
+A bounded admission window (``geomesa.stream.queue.depth`` chunks)
+backpressures STAGING: at most that many chunks are queued in the pool
+at once, so the parse stage's double-buffering (raw row dicts alongside
+the columnar build) stays bounded. The fully-staged chunks themselves
+are retained until the single atomic publish — staged scratch is
+proportional to the FLUSH size, the price of publish atomicity (the
+same model as ``BulkLoader``'s host-resident staging). Overflow waits
+count ``geomesa.stream.queue_full``. Every stage records wall time into
+the ``geomesa.stream.*`` timer family.
+
+Failure semantics: any stage failure — including injected faults
+(``stream.flush.parse`` / ``stream.flush.keys`` / ``stream.flush.sort``
+/ ``streaming.persist``) — aborts the flush BEFORE the publish, so the
+cold store is untouched and every hot row stays resident for the next
+attempt. Transient IO errors at the commit point retry with bounded
+backoff (the round-1 flush contract, unchanged).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu import fault
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.ingest import sort as shsort
+
+STAGES = ("parse", "keys", "sort", "commit")
+
+
+@dataclass
+class StreamConfig:
+    """Streaming-tier knobs; ``from_properties`` resolves each from the
+    typed property tier (geomesa_tpu.conf)."""
+
+    workers: int = 0        # 0 = one per host core
+    chunk_rows: int = 65536  # rows per flush micro-chunk
+    queue_depth: int = 4    # chunks staged ahead of the commit stage
+    fold_rows: int = 131_072  # pending updates that trigger the fold
+    incremental: bool = True  # fold flushes (False = legacy upsert flush)
+
+    @staticmethod
+    def from_properties() -> "StreamConfig":
+        from geomesa_tpu import conf
+
+        return StreamConfig(
+            workers=conf.STREAM_WORKERS.get(),
+            chunk_rows=conf.STREAM_CHUNK_ROWS.get(),
+            queue_depth=conf.STREAM_QUEUE_DEPTH.get(),
+            fold_rows=conf.STREAM_FOLD_ROWS.get(),
+            incremental=conf.STREAM_INCREMENTAL.get(),
+        )
+
+    def resolved_workers(self) -> int:
+        import os
+
+        if self.workers and self.workers > 0:
+            return int(self.workers)
+        return max(1, os.cpu_count() or 1)
+
+
+class _FlushChunk:
+    __slots__ = ("base", "rows", "ids", "fc", "keys", "stats", "runs")
+
+    def __init__(self, base: int, rows: list, ids: list):
+        self.base = base  # global row offset within the flush batch
+        self.rows = rows
+        self.ids = ids
+        self.fc: "FeatureCollection | None" = None
+        self.keys: dict = {}
+        self.stats = None
+        self.runs: dict = {}  # index name -> list[SortRun]
+
+
+class StreamFlusher:
+    """Persistent flush engine for ONE (cold store, feature type): the
+    worker pool and stage accounting live across flushes; each
+    :meth:`flush` call is one atomic hot->cold publish. ``close()``
+    releases the pool (idempotent; a closed flusher rebuilds it on the
+    next flush, so a long-lived LambdaStore never wedges)."""
+
+    def __init__(self, store, type_name: str,
+                 config: "StreamConfig | None" = None, metrics=None):
+        from geomesa_tpu.metrics import resolve
+
+        self.store = store
+        self.type_name = type_name
+        self.config = config if config is not None else StreamConfig.from_properties()
+        self.metrics = resolve(
+            metrics if metrics is not None else getattr(store, "metrics", None)
+        )
+        self._pool_lock = threading.Lock()
+        self._pool: "ThreadPoolExecutor | None" = None  # guarded-by: _pool_lock
+        self._sem = threading.Semaphore(max(1, self.config.queue_depth))
+        self.flushes = 0  # total successful flushes (bench/introspection)
+
+    # -- pool lifecycle ---------------------------------------------------
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(2, self.config.resolved_workers()),
+                    thread_name_prefix="geomesa-stream",
+                )
+            return self._pool
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    # -- stages -----------------------------------------------------------
+    def _stage_time(self, stage: str, seconds: float) -> None:
+        self.metrics.timer_update(f"geomesa.stream.{stage}", seconds)
+
+    def _run_chunk(self, ch: _FlushChunk, incremental: bool = True) -> None:
+        """parse -> keys -> sort for one micro-chunk (one pool task:
+        chunks overlap across workers; stages attribute separately).
+        Non-incremental flushes parse only: the legacy ``cold.upsert``
+        commit re-encodes keys itself, so encoding+sorting here would be
+        discarded work that also taxes the bench baseline unfairly."""
+        sft = self.store.get_schema(self.type_name)
+        fault.fault_point("stream.flush.parse")
+        t0 = time.perf_counter()
+        ch.fc = FeatureCollection.from_rows(sft, ch.rows, ids=ch.ids)
+        ch.rows = ch.ids = None  # staged scratch: release as consumed
+        t1 = time.perf_counter()
+        self._stage_time("parse", t1 - t0)
+        if not incremental:
+            return
+        fault.fault_point("stream.flush.keys")
+        _, ch.keys, ch.stats = self.store._encode_batch(self.type_name, ch.fc)
+        t2 = time.perf_counter()
+        self._stage_time("keys", t2 - t1)
+        fault.fault_point("stream.flush.sort")
+        for name, k in ch.keys.items():
+            if len(k.zs) and k.sub is None:
+                ch.runs[name] = shsort.shard_runs(
+                    k.bins, k.zs, ch.base, max(self.config.chunk_rows, 1)
+                )
+        self._stage_time("sort", time.perf_counter() - t2)
+
+    # -- the flush --------------------------------------------------------
+    def flush(self, snapshot: Sequence[tuple], incremental: "bool | None" = None) -> int:
+        """Fold one hot snapshot (``[(id, row dict)]``) into the cold
+        store: stage micro-chunks through the warm parse/keys/sort
+        workers under the bounded admission window, then ONE atomic
+        publish. Returns rows flushed. ``incremental=False`` (or the
+        ``geomesa.stream.incremental`` knob) routes the commit through
+        the legacy ``cold.upsert`` delete-and-rewrite instead — the
+        bench baseline and the escape hatch for adapters without the
+        fold seam."""
+        n = len(snapshot)
+        if n == 0:
+            return 0
+        if incremental is None:
+            incremental = self.config.incremental
+        pool = self._ensure_pool()
+        chunk_rows = max(int(self.config.chunk_rows), 1)
+        chunks: list[_FlushChunk] = []
+        futures = []
+        error: "BaseException | None" = None
+        try:
+            for s in range(0, n, chunk_rows):
+                part = snapshot[s : s + chunk_rows]
+                if not self._sem.acquire(blocking=False):
+                    # bounded admission window: backpressures staging so
+                    # at most queue_depth chunks sit in the pool at once
+                    # (see the module docstring for what is and is NOT
+                    # bounded)
+                    self.metrics.counter("geomesa.stream.queue_full")
+                    self._sem.acquire()
+                ch = _FlushChunk(
+                    s, [r for _, r in part], [fid for fid, _ in part]
+                )
+                chunks.append(ch)
+                try:
+                    fut = pool.submit(self._run_chunk, ch, incremental)
+                except BaseException:
+                    # submit failed (e.g. close() raced the flush and shut
+                    # the pool): the permit has no completion callback to
+                    # release it — leaking it here would wedge every
+                    # future flush once the window drains to zero
+                    self._sem.release()
+                    raise
+                fut.add_done_callback(lambda _f: self._sem.release())
+                futures.append(fut)
+        except BaseException as e:
+            error = e
+        for fut in futures:
+            try:
+                fut.result()
+            except BaseException as e:  # first stage failure wins
+                if error is None:
+                    error = e
+        if error is not None:
+            raise error
+
+        t0 = time.perf_counter()
+        out = self._commit(chunks, incremental)
+        self._stage_time("commit", time.perf_counter() - t0)
+        self.flushes += 1
+        self.metrics.counter("geomesa.stream.flushes")
+        self.metrics.counter("geomesa.stream.rows", out)
+        return out
+
+    def _commit(self, chunks: list, incremental: bool) -> int:
+        """The single publish: concat the staged chunks, k-way-merge the
+        sorted runs into per-index batch argsorts, and fold (or legacy-
+        upsert) under bounded retry at the ``streaming.persist`` point."""
+        from geomesa_tpu.storage.delta import concat_keys
+
+        fcs = [ch.fc for ch in chunks]
+        fc = fcs[0] if len(fcs) == 1 else FeatureCollection.concat(fcs)
+        if not incremental:
+            def attempt_legacy():
+                fault.fault_point("streaming.persist")
+                return self.store.upsert(self.type_name, fc)
+
+            return fault.with_retries(attempt_legacy)
+
+        keys: dict = {}
+        presorted: dict = {}
+        stats = None
+        for ch in chunks:
+            stats = ch.stats if stats is None else stats.merge(ch.stats)
+        pool = self._ensure_pool()
+        from geomesa_tpu import conf
+
+        for name in chunks[0].keys:
+            runs = [r for ch in chunks for r in ch.runs.get(name, [])]
+            keys[name] = concat_keys(
+                [ch.keys[name] for ch in chunks], consume=True
+            )
+            if not runs:
+                continue
+            bins = shsort.distinct_bins(runs)
+            if len(bins) < conf.INGEST_MERGE_MIN_BINS.get():
+                continue  # §4f: few bins -> let the fold's LSD sort run
+            perm = shsort.merge_runs(runs, pool=pool, bins=bins)
+            if len(perm) == len(keys[name].zs):
+                presorted[name] = perm
+        for ch in chunks:
+            ch.runs.clear()
+
+        def attempt():
+            fault.fault_point("streaming.persist")
+            return self.store.fold_upsert(
+                self.type_name, fc, keys=keys, stats=stats,
+                presorted=presorted or None,
+            )
+
+        return fault.with_retries(attempt)

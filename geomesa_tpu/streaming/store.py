@@ -1,0 +1,256 @@
+"""LambdaStore: the hot/cold hybrid store (reference LambdaDataStore).
+
+Writes land in the transient hot tier (StreamingFeatureCache);
+``flush()`` folds the hot state into the persistent cold DataStore
+through the pipelined StreamFlusher (one atomic publish per flush, cold
+tables merged incrementally — docs/streaming.md); queries merge both
+tiers with hot-wins-by-id semantics, EXACTLY, under concurrent flushes.
+
+The reference's periodic persistence with offset tracking collapses to
+an explicit, idempotent flush; ``persist_hot()`` remains as the
+historical name for the same operation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu import fault
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import INCLUDE
+from geomesa_tpu.streaming.cache import StreamingFeatureCache
+from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
+
+
+class LambdaStore:
+    """Hot/cold hybrid: transient streaming cache + persistent DataStore
+    (reference LambdaDataStore). Writes land hot; ``flush()`` (alias
+    ``persist_hot()``) folds the hot tier into the cold store; queries
+    merge both tiers with hot-wins-by-id semantics.
+
+    Round 9 rebuilt the flush and read paths for sustained rates
+    (docs/streaming.md):
+
+    - flushes route through a persistent pipelined
+      :class:`~geomesa_tpu.streaming.flush.StreamFlusher` (warm
+      parse/key/shard-sort workers, bounded admission window,
+      ``geomesa.stream.*`` metrics) into
+      :meth:`~geomesa_tpu.datastore.DataStore.fold_upsert` — an
+      incremental merge bit-identical to a full recompaction, with
+      cache invalidation scoped to the touched key ranges;
+    - reads are EXACT under concurrent flushes: the hot result and the
+      live-id shadow set capture atomically, cold rows shadowed by any
+      live hot id drop, and the final merge dedups by feature id
+      (hot wins) — so a row mid-flush (present in both tiers between
+      the cold commit and the hot eviction, see the
+      ``streaming.evict`` fault point) is returned exactly once;
+    - when the cold store has a serving tier attached
+      (``cold.serve()`` / :meth:`serve`), the cold half of every query
+      is admitted through the QueryScheduler, so concurrent readers
+      fuse into shared device dispatches and shed under pressure while
+      ingest runs.
+    """
+
+    def __init__(self, cold, type_name: str, expiry_ms: Optional[int] = None,
+                 config: "StreamConfig | None" = None):
+        self.cold = cold
+        self.type_name = type_name
+        self.config = config if config is not None else StreamConfig.from_properties()
+        self.hot = StreamingFeatureCache(
+            cold.get_schema(type_name), expiry_ms,
+            metrics=getattr(cold, "metrics", None),
+        )
+        self.flusher = StreamFlusher(
+            cold, type_name, config=self.config,
+            metrics=getattr(cold, "metrics", None),
+        )
+        # a cache-enabled cold store: hot-tier upsert/delete/expiry bump
+        # the shared generations, so merged answers over a mutated hot
+        # tier never compose against stale cold cache entries
+        # ids known to exist in the cold store (flushed before, or probed
+        # by an earlier flush): the split probe runs only over ids NOT in
+        # this set, so a long-lived overlay of pending updates is never
+        # re-probed against the cold id index every flush. Monotonic-safe:
+        # this tier never deletes cold rows, and a stale entry (an id a
+        # direct cold delete removed) only downgrades that id's fold to
+        # an append inside fold_upsert.
+        self._known_cold: set = set()
+        cache = getattr(cold, "cache", None)
+        if cache is not None:
+            self.hot.generations = cache.generations
+            self.hot.gen_type = type_name
+
+    # -- writes ----------------------------------------------------------
+    def write(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
+        n = self.hot.upsert(rows, ids)
+        self._gauge_hot()
+        return n
+
+    def _gauge_hot(self) -> None:
+        metrics = getattr(self.cold, "metrics", None)
+        if metrics is not None:
+            metrics.gauge("geomesa.stream.hot_rows", len(self.hot))
+
+    # -- flush -----------------------------------------------------------
+    def flush(self, incremental: "bool | None" = None, full: bool = False) -> int:
+        """Micro-batch persist: returns rows published to the cold store.
+
+        LSM-shaped amortization (docs/streaming.md): hot rows whose ids
+        are NEW to the cold store flush every call through the O(batch)
+        delta-tier append; rows that *update* persisted ids stay
+        resident in the hot overlay — reads remain exact through the
+        hot-wins-by-id merge — until the pending updates outgrow
+        ``geomesa.stream.fold.rows`` (or ``full=True``), when ONE atomic
+        fold publishes everything and replaces the touched cold rows
+        in-place (``DataStore.fold_upsert``: no whole-table re-sort,
+        scoped cache invalidation). So the steady-state flush costs
+        O(batch) and the O(table) merge work amortizes over many
+        flushes — the pre-round-9 path paid a full delete-and-rewrite
+        recompaction EVERY flush.
+
+        The publish runs under bounded retry for transient IO faults
+        (``streaming.persist``); hot copies are dropped only AFTER the
+        cold publish commits (the ``streaming.evict`` fault point sits
+        between the two): a failed flush leaves the cold tier intact
+        and every hot row resident for the next attempt. A query
+        landing in the commit->evict window sees rows in BOTH tiers and
+        returns them once (the id dedup in :meth:`query`).
+
+        With ``expiry_ms`` configured on the hot tier, every flush
+        drains fully regardless of the threshold: an ``expire()`` sweep
+        between flushes must never drop an update the overlay had not
+        yet persisted (and resurface the stale cold row).
+
+        ``incremental=False`` (or ``geomesa.stream.incremental``) takes
+        the legacy delete-and-rewrite ``cold.upsert`` flush of the
+        WHOLE hot state instead — the bench baseline, and the path for
+        adapters without the ``fold_table`` seam."""
+        snapshot = self.hot.snapshot_rows()
+        if not snapshot:
+            return 0
+        if incremental is None:
+            incremental = self.config.incremental
+        if self.hot.expiry_ms is not None:
+            # an expiring hot tier must not retain unpersisted updates in
+            # the overlay: an expire() sweep between flushes would drop
+            # them before they ever fold and resurface the stale cold
+            # rows — so every flush drains fully (the round 1-8
+            # durability), trading the O(batch) steady state away
+            full = True
+        if not incremental:
+            n = self.flusher.flush(snapshot, incremental=False)
+            fault.fault_point("streaming.evict")
+            self.hot.evict(snapshot)
+            self._gauge_hot()
+            return n
+        known = self._known_cold
+        unknown = [fid for fid, _ in snapshot if fid not in known]
+        if unknown:
+            mask = self.cold.id_exists_mask(self.type_name, unknown)
+            known.update(fid for fid, e in zip(unknown, mask) if e)
+        exists = [fid in known for fid, _ in snapshot]
+        n_upd = sum(exists)
+        if full or n_upd >= max(int(self.config.fold_rows), 1):
+            batch = snapshot  # fold everything: updates + appends, one publish
+        elif n_upd:
+            batch = [sn for sn, e in zip(snapshot, exists) if not e]
+        else:
+            batch = snapshot
+        if not batch:
+            return 0
+        n = self.flusher.flush(batch, incremental=True)
+        fault.fault_point("streaming.evict")
+        known.update(fid for fid, _ in batch)  # published: now cold-resident
+        # identity-checked eviction: a write racing the publish keeps its
+        # newer hot version resident for the next flush
+        self.hot.evict(batch)
+        self._gauge_hot()
+        return n
+
+    def persist_hot(self, incremental: "bool | None" = None) -> int:
+        """Full persist (the round 1-8 API): drain the ENTIRE hot tier —
+        pending updates fold regardless of the ``geomesa.stream.fold.rows``
+        threshold — and return the rows published."""
+        return self.flush(incremental=incremental, full=True)
+
+    def checkpoint(self, root: str) -> int:
+        """Periodic persistence (the reference Lambda store's scheduled
+        persist): flush the hot tier, then write the cold store to disk
+        through the crash-safe v3 path (storage.persist.save — atomic
+        renames, checksums, per-step retry). A failure at any point
+        leaves the previous on-disk store and the hot/cold state
+        consistent. Returns rows flushed from the hot tier."""
+        from geomesa_tpu.storage import persist
+
+        n = self.flush(full=True)
+        persist.save(self.cold, root)
+        return n
+
+    # -- serving ---------------------------------------------------------
+    def serve(self, config=None):
+        """Attach (or return) the cold store's serving tier
+        (docs/serving.md): with a scheduler attached, the cold half of
+        every :meth:`query` is admitted through it — concurrent readers
+        fuse into shared fused-kernel dispatches and shed under
+        pressure while the flush loop runs. Returns the scheduler."""
+        return self.cold.serve(config)
+
+    def _cold_query(self, f, hints=None) -> FeatureCollection:
+        sched = getattr(self.cold, "scheduler", None)
+        if sched is not None and not sched.closed:
+            return sched.submit(self.type_name, f, hints=hints).result()
+        return self.cold.query(self.type_name, f, hints=hints)
+
+    # -- reads -----------------------------------------------------------
+    def query(self, f=INCLUDE, hints=None) -> FeatureCollection:
+        """Exact hot+cold merge. Ordering matters for exactness under a
+        concurrent flush: the hot result + live-id shadow snapshot FIRST
+        (atomically), the cold scan after — a row evicted from hot
+        before the snapshot is already committed cold (eviction follows
+        the commit), and a row still hot shadows its (possibly stale)
+        cold copy. The final id dedup (hot first) catches the
+        both-tiers window mid-flush."""
+        from geomesa_tpu.filter import ecql
+
+        if isinstance(f, str):
+            f = ecql.parse(f)
+        hot, live = self.hot.query_shadow(f)
+        cold = self._cold_query(f, hints=hints)
+        # shadow cold rows by EVERY live hot id, not just the hot hits: a
+        # hot update that moved a feature out of the query window must
+        # hide the stale persisted row too (hot-wins-by-id). Set probes
+        # over the (small) cold RESULT, not an array build over the
+        # (large) live set — materializing/sorting ~100k live ids per
+        # query dominated read latency under a deep pending-update overlay
+        if live and len(cold):
+            ids = np.asarray(cold.ids).tolist()
+            keep = np.fromiter(
+                (str(i) not in live for i in ids), bool, count=len(ids)
+            )
+            if not keep.all():
+                cold = cold.mask(keep)
+        if len(hot) == 0:
+            return cold
+        if len(cold) == 0:
+            return hot
+        out = FeatureCollection.concat([hot, cold])
+        # belt + braces: dedup by feature id, first occurrence (= hot)
+        # wins — exactness under every flush interleaving, including the
+        # commit->evict window where a row is live in BOTH tiers. Only
+        # conceivable when BOTH tiers contributed rows, so pure-cold
+        # queries (the overwhelming steady state) skip the string sort
+        ids = np.asarray(out.ids).astype(str)
+        _, first = np.unique(ids, return_index=True)
+        if len(first) != len(out):
+            out = out.take(np.sort(first))
+        return out
+
+    def count(self, f=INCLUDE) -> int:
+        return len(self.query(f))
+
+    def close(self) -> None:
+        """Release the flusher's worker pool (idempotent)."""
+        self.flusher.close()

@@ -10,11 +10,17 @@ collects the covered buckets. Extents insert into every covered bucket
 
 from __future__ import annotations
 
-import numpy as np
+import math
 
 
 class BucketIndex:
-    """Grid-bucketed point/extent index keyed by feature id."""
+    """Grid-bucketed point/extent index keyed by feature id.
+
+    Pure-scalar cell math: the original numpy clip/meshgrid per insert
+    cost ~45 µs/row and dominated the streaming hot tier's sustained
+    write rate (the per-point cell set is ONE integer) — scalar
+    floor/clamp is ~20x cheaper at the single-feature granularity this
+    index lives at (docs/streaming.md)."""
 
     def __init__(
         self,
@@ -24,6 +30,8 @@ class BucketIndex:
     ):
         self.nx, self.ny = nx, ny
         self.x0, self.y0, self.x1, self.y1 = (float(v) for v in envelope)
+        self._fx = self.nx / (self.x1 - self.x0)
+        self._fy = self.ny / (self.y1 - self.y0)
         self._buckets: dict[int, set] = {}
         self._entries: dict[object, tuple] = {}  # id -> (bbox, bucket ids)
 
@@ -33,14 +41,19 @@ class BucketIndex:
     def __contains__(self, key) -> bool:
         return key in self._entries
 
-    def _cells(self, bbox) -> np.ndarray:
+    def _cells(self, bbox) -> list:
         x0, y0, x1, y1 = bbox
-        i0 = int(np.clip((x0 - self.x0) / (self.x1 - self.x0) * self.nx, 0, self.nx - 1))
-        i1 = int(np.clip((x1 - self.x0) / (self.x1 - self.x0) * self.nx, 0, self.nx - 1))
-        j0 = int(np.clip((y0 - self.y0) / (self.y1 - self.y0) * self.ny, 0, self.ny - 1))
-        j1 = int(np.clip((y1 - self.y0) / (self.y1 - self.y0) * self.ny, 0, self.ny - 1))
-        ii, jj = np.meshgrid(np.arange(i0, i1 + 1), np.arange(j0, j1 + 1))
-        return (jj * self.nx + ii).ravel()
+        i0 = min(max(math.floor((x0 - self.x0) * self._fx), 0), self.nx - 1)
+        j0 = min(max(math.floor((y0 - self.y0) * self._fy), 0), self.ny - 1)
+        if x1 == x0 and y1 == y0:  # points: one cell, no loop
+            return [j0 * self.nx + i0]
+        i1 = min(max(math.floor((x1 - self.x0) * self._fx), 0), self.nx - 1)
+        j1 = min(max(math.floor((y1 - self.y0) * self._fy), 0), self.ny - 1)
+        return [
+            j * self.nx + i
+            for j in range(j0, j1 + 1)
+            for i in range(i0, i1 + 1)
+        ]
 
     def insert(self, key, bbox) -> None:
         """Insert/replace an entry; bbox = (xmin, ymin, xmax, ymax) (a
@@ -48,7 +61,7 @@ class BucketIndex:
         if key in self._entries:
             self.remove(key)
         cells = self._cells(bbox)
-        for c in cells.tolist():
+        for c in cells:
             self._buckets.setdefault(c, set()).add(key)
         self._entries[key] = (tuple(float(v) for v in bbox), cells)
 
@@ -56,7 +69,7 @@ class BucketIndex:
         entry = self._entries.pop(key, None)
         if entry is None:
             return False
-        for c in entry[1].tolist():
+        for c in entry[1]:
             b = self._buckets.get(c)
             if b is not None:
                 b.discard(key)
@@ -69,7 +82,7 @@ class BucketIndex:
         x0, y0, x1, y1 = bbox
         seen: set = set()
         out = []
-        for c in self._cells(bbox).tolist():
+        for c in self._cells(bbox):
             for key in self._buckets.get(c, ()):
                 if key in seen:
                     continue

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Bench regression gate: compare a fresh BENCH_PIP_JOIN.json against the
-recorded baseline and FAIL on fused-PIP regression (docs/ingest.md,
-"Benchmarks & regression gate").
+"""Bench regression gate: compare a fresh bench json against the recorded
+baseline and FAIL on regression (docs/ingest.md "Benchmarks & regression
+gate"; docs/streaming.md "Bench recipe").
 
 Usage:
     # produce a fresh run at a SCRATCH path (never the committed
@@ -10,14 +10,21 @@ Usage:
         GEOMESA_BENCH_PIP_OUT=/tmp/BENCH_PIP_JOIN.json python bench.py
     python scripts/bench_gate.py --fresh /tmp/BENCH_PIP_JOIN.json
 
-The gate refuses to compare a file against itself (exit 2): a
-self-comparison always passes and would mask any regression.
+    GEOMESA_BENCH_CONFIGS=stream \
+        GEOMESA_BENCH_STREAM_OUT=/tmp/BENCH_STREAM.json python bench.py
+    python scripts/bench_gate.py --fresh /tmp/BENCH_STREAM.json
+
+The default --baseline is inferred from the fresh file's name
+(BENCH_STREAM* gates against the committed BENCH_STREAM.json, everything
+else against BENCH_PIP_JOIN.json). The gate refuses to compare a file
+against itself (exit 2): a self-comparison always passes and would mask
+any regression.
 
 Checks, per scenario present in BOTH files:
-- the raster-path cost may not regress by more than --max-regress
-  (default 0.20 = 20%) against the baseline's recorded cost
-  (``raster_ms_per_q`` for the fused PIP batch, ``raster_ms`` /
-  ``adaptive_ms`` for the joins);
+- the guarded metric may not regress by more than --max-regress
+  (default 0.20 = 20%) against the baseline — cost metrics
+  (``raster_ms_per_q``, ``raster_ms``, ``adaptive_ms``) may not rise,
+  throughput metrics (``streamed_rows_per_s``) may not fall;
 - every ``identical`` flag in the fresh run must be true — a speedup
   that changed answers is a bug, not a win.
 
@@ -31,12 +38,27 @@ import json
 import os
 import sys
 
-# scenario -> the raster-path cost field the gate guards
-COST_FIELDS = {
-    "z2_polygon_pip_batch": "raster_ms_per_q",
-    "z2_polygon_join": "raster_ms",
-    "host_grid_join": "adaptive_ms",
+# scenario -> (guarded metric, direction): "lower" metrics are costs
+# (regression = rising), "higher" metrics are throughputs (regression =
+# falling)
+SCENARIO_SPECS = {
+    "z2_polygon_pip_batch": ("raster_ms_per_q", "lower"),
+    "z2_polygon_join": ("raster_ms", "lower"),
+    "host_grid_join": ("adaptive_ms", "lower"),
+    "stream_sustained": ("streamed_rows_per_s", "higher"),
 }
+
+# fresh-file basename marker -> committed baseline it gates against
+BASELINES = {"BENCH_STREAM": "BENCH_STREAM.json"}
+DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
+
+
+def default_baseline(fresh_path: str, repo: str) -> str:
+    name = os.path.basename(fresh_path).upper()
+    for marker, baseline in BASELINES.items():
+        if name.startswith(marker):
+            return os.path.join(repo, baseline)
+    return os.path.join(repo, DEFAULT_BASELINE)
 
 
 def _rows(path: str) -> dict:
@@ -50,7 +72,8 @@ def gate(fresh_path: str, baseline_path: str, max_regress: float) -> int:
         print(
             "bench_gate: --fresh and --baseline are the same file; a "
             "self-comparison cannot detect a regression — write the fresh "
-            "run to a scratch path (GEOMESA_BENCH_PIP_OUT)",
+            "run to a scratch path (GEOMESA_BENCH_PIP_OUT / "
+            "GEOMESA_BENCH_STREAM_OUT)",
             file=sys.stderr,
         )
         return 2
@@ -60,26 +83,30 @@ def gate(fresh_path: str, baseline_path: str, max_regress: float) -> int:
     except (OSError, ValueError, KeyError) as e:
         print(f"bench_gate: cannot read inputs: {e}", file=sys.stderr)
         return 2
-    shared = [s for s in COST_FIELDS if s in fresh and s in base]
+    shared = [s for s in SCENARIO_SPECS if s in fresh and s in base]
     if not shared:
         print("bench_gate: no shared scenarios between fresh and baseline",
               file=sys.stderr)
         return 2
     failed = False
     for s in shared:
-        field = COST_FIELDS[s]
+        field, direction = SCENARIO_SPECS[s]
         f_row, b_row = fresh[s], base[s]
         if not f_row.get("identical", False):
             print(f"FAIL {s}: fresh run's identical flag is not true")
             failed = True
         if field not in f_row or field not in b_row:
             continue
-        f_cost, b_cost = float(f_row[field]), float(b_row[field])
-        ratio = f_cost / max(b_cost, 1e-12) - 1.0
+        f_val, b_val = float(f_row[field]), float(b_row[field])
+        if direction == "lower":
+            ratio = f_val / max(b_val, 1e-12) - 1.0
+        else:
+            ratio = 1.0 - f_val / max(b_val, 1e-12)
         verdict = "FAIL" if ratio > max_regress else "ok"
+        arrow = "rose" if direction == "lower" else "fell"
         print(
-            f"{verdict:4s} {s}: {field} {b_cost:.3f} -> {f_cost:.3f} "
-            f"({ratio:+.1%}, limit +{max_regress:.0%})"
+            f"{verdict:4s} {s}: {field} {b_val:.3f} -> {f_val:.3f} "
+            f"({arrow} {ratio:+.1%}, limit +{max_regress:.0%})"
         )
         if ratio > max_regress:
             failed = True
@@ -92,18 +119,21 @@ def main() -> int:
     ap.add_argument(
         "--fresh", required=True,
         help="freshly produced bench json (a scratch path, e.g. the "
-        "GEOMESA_BENCH_PIP_OUT target — never the committed baseline)",
+        "GEOMESA_BENCH_PIP_OUT / GEOMESA_BENCH_STREAM_OUT target — never "
+        "the committed baseline)",
     )
     ap.add_argument(
-        "--baseline", default=os.path.join(repo, "BENCH_PIP_JOIN.json"),
-        help="recorded baseline json (default: the committed file)",
+        "--baseline", default=None,
+        help="recorded baseline json (default: the committed file matching "
+        "the fresh file's name)",
     )
     ap.add_argument(
         "--max-regress", type=float, default=0.20,
-        help="max tolerated fractional cost increase (default 0.20)",
+        help="max tolerated fractional regression (default 0.20)",
     )
     args = ap.parse_args()
-    return gate(args.fresh, args.baseline, args.max_regress)
+    baseline = args.baseline or default_baseline(args.fresh, repo)
+    return gate(args.fresh, baseline, args.max_regress)
 
 
 if __name__ == "__main__":

@@ -466,6 +466,44 @@ class TestBenchGate:
         # a self-comparison can never detect a regression: refused
         assert gate.gate(str(base), str(base), 0.20) == 2
 
+    def _stream_payload(self, rps, identical=True):
+        return {"rows": [
+            {"scenario": "stream_sustained", "streamed_rows_per_s": rps,
+             "legacy_rows_per_s": rps / 3.0, "identical": identical},
+        ]}
+
+    def test_stream_scenario_direction_aware(self, tmp_path):
+        """Throughput scenarios regress DOWNWARD: the gate must fail on
+        falling rows/s and pass on rising rows/s (the inverse of the
+        cost scenarios), and still enforce the identical flag."""
+        import json
+
+        gate = self._load_gate()
+        base = tmp_path / "BENCH_STREAM.json"
+        base.write_text(json.dumps(self._stream_payload(30_000.0)))
+        ok = tmp_path / "BENCH_STREAM_ok.json"
+        ok.write_text(json.dumps(self._stream_payload(33_000.0)))
+        slower_ok = tmp_path / "BENCH_STREAM_slower.json"
+        slower_ok.write_text(json.dumps(self._stream_payload(27_000.0)))
+        bad = tmp_path / "BENCH_STREAM_bad.json"
+        bad.write_text(json.dumps(self._stream_payload(20_000.0)))
+        broken = tmp_path / "BENCH_STREAM_broken.json"
+        broken.write_text(json.dumps(self._stream_payload(50_000.0, False)))
+        assert gate.gate(str(ok), str(base), 0.20) == 0
+        assert gate.gate(str(slower_ok), str(base), 0.20) == 0  # within 20%
+        assert gate.gate(str(bad), str(base), 0.20) == 1
+        assert gate.gate(str(broken), str(base), 0.20) == 1
+
+    def test_default_baseline_inference(self, tmp_path):
+        gate = self._load_gate()
+        repo = str(tmp_path)
+        assert gate.default_baseline("/x/BENCH_STREAM_fresh.json", repo) == (
+            f"{repo}/BENCH_STREAM.json"
+        )
+        assert gate.default_baseline("/x/fresh.json", repo) == (
+            f"{repo}/BENCH_PIP_JOIN.json"
+        )
+
 
 class TestValidators:
     def _sft(self):

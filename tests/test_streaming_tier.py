@@ -1,0 +1,653 @@
+"""Production streaming tier (docs/streaming.md): the incremental
+hot->cold fold, the pipelined flusher's atomicity + fault matrix, exact
+reads under concurrent flushes, generation scoping under sustained
+writes, and the raster aggregation push-down satellite."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import conf, fault, geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.filter.predicates import And, During, Intersects
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.streaming import LambdaStore, StreamConfig
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64))
+DAY = 86_400_000
+
+
+def _build(n=4000, seed=0, spec=SPEC, cache=None, metrics=None, name="t"):
+    rng = np.random.default_rng(seed)
+    ds = DataStore(tile=64, cache=cache, metrics=metrics)
+    sft = FeatureType.from_spec(name, spec)
+    ds.create_schema(sft)
+    if n:
+        ds.write(name, _batch(sft, [f"f{i}" for i in range(n)], seed=seed))
+        ds.compact(name)
+    return ds
+
+
+def _batch(sft, ids, seed=1, name="n", box=(-60.0, -60.0, 60.0, 60.0)):
+    rng = np.random.default_rng(seed)
+    m = len(ids)
+    x0, y0, x1, y1 = box
+    return FeatureCollection.from_columns(sft, list(ids), {
+        "name": np.array([name] * m),
+        "dtg": T0 + rng.integers(0, 30 * DAY, m),
+        "geom": (rng.uniform(x0, x1, m), rng.uniform(y0, y1, m)),
+    })
+
+
+def _assert_tables_identical(a, b, type_name="t"):
+    import jax
+
+    for idx in a.indexes(type_name):
+        ta, tb = a.table(type_name, idx.name), b.table(type_name, idx.name)
+        assert type(ta) is type(tb), idx.name
+        assert np.array_equal(
+            np.asarray(ta.perm, np.int64), np.asarray(tb.perm, np.int64)
+        ), f"{idx.name} perm"
+        assert np.array_equal(ta.bins, tb.bins), f"{idx.name} bins"
+        assert np.array_equal(ta.zs, tb.zs), f"{idx.name} zs"
+        cols_a = getattr(ta, "cols3", None)
+        if cols_a is not None:
+            for k in cols_a:
+                assert np.array_equal(
+                    np.asarray(jax.device_get(cols_a[k])),
+                    np.asarray(jax.device_get(tb.cols3[k])),
+                ), (idx.name, k)
+    fa, fb = a.features(type_name), b.features(type_name)
+    assert fa.ids.tolist() == fb.ids.tolist()
+    for col in fa.columns:
+        ca, cb = fa.columns[col], fb.columns[col]
+        if hasattr(ca, "x"):
+            assert np.array_equal(ca.x, cb.x) and np.array_equal(ca.y, cb.y)
+        else:
+            assert np.array_equal(np.asarray(ca), np.asarray(cb)), col
+
+
+def _star(cx, cy, r, n_arms=9):
+    a = np.linspace(0, 2 * np.pi, 2 * n_arms + 1)[:-1]
+    rad = np.where(np.arange(2 * n_arms) % 2 == 0, r, 0.35 * r)
+    return geo.Polygon(
+        [(cx + rr * np.cos(t), cy + rr * np.sin(t)) for t, rr in zip(a, rad)]
+    )
+
+
+# -- the incremental fold (DataStore.fold_upsert) --------------------------
+
+
+class TestFoldUpsert:
+    @pytest.mark.parametrize("n_upd,n_new", [
+        (300, 200),   # mixed replace + append
+        (500, 0),     # pure replace
+        (0, 400),     # pure append
+        (1, 1),       # minimal
+        (4000, 100),  # replace EVERY existing row
+    ])
+    def test_bit_identical_to_upsert(self, n_upd, n_new):
+        a, b = _build(), _build()
+        rng = np.random.default_rng(7)
+        upd = rng.choice(4000, n_upd, replace=False) if n_upd else []
+        ids = [f"f{i}" for i in upd] + [f"g{j}" for j in range(n_new)]
+        sft = a.get_schema("t")
+        batch = _batch(sft, ids, seed=11, name="u")
+        a.upsert("t", batch)
+        a.compact("t")
+        assert b.fold_upsert("t", batch) == len(ids)
+        b.compact("t")  # pure appends ride the delta tier until compaction
+        _assert_tables_identical(a, b)
+        for q in [
+            "bbox(geom,-20,-20,40,40)",
+            "bbox(geom,0,0,10,10) AND dtg DURING "
+            "2024-01-01T00:00:00Z/2024-01-20T00:00:00Z",
+        ]:
+            ra, rb = a.query("t", q), b.query("t", q)
+            assert ra.ids.tolist() == rb.ids.tolist(), q
+
+    def test_tie_keys_bit_identical_to_full_recompaction(self):
+        """Duplicate positions/timestamps (identical (bin, z) keys) pin
+        the stable tie order: folded rows must land exactly where the
+        whole-table stable sort of ``concat(survivors, batch)`` puts
+        them — the from-scratch recompaction order. (The delete-and-
+        rewrite ``upsert`` path routes ties through ``merged_table``'s
+        insert-before rule instead; result SETS are identical, the
+        sorted tie order is not, so the oracle here is a fresh build.)"""
+        a, b = _build(n=0), _build(n=0)
+        sft = a.get_schema("t")
+        n = 512
+        base = FeatureCollection.from_columns(
+            sft, [f"f{i}" for i in range(n)], {
+                "name": np.array(["n"] * n),
+                "dtg": np.full(n, T0, np.int64),
+                "geom": (np.repeat(np.arange(8.0), n // 8),
+                         np.zeros(n)),
+            })
+        for ds in (a, b):
+            ds.write("t", base)
+            ds.compact("t")
+        ids = [f"f{i}" for i in range(0, 200, 2)] + ["x1", "x2", "x3"]
+        m = len(ids)
+        batch = FeatureCollection.from_columns(sft, ids, {
+            "name": np.array(["u"] * m),
+            "dtg": np.full(m, T0, np.int64),
+            "geom": (np.repeat(np.arange(8.0), -(-m // 8))[:m], np.zeros(m)),
+        })
+        b.fold_upsert("t", batch)
+        # full-recompaction oracle: survivors (ordinal order) + batch,
+        # written once into a fresh store and sorted from scratch
+        keep = np.ones(n, bool)
+        keep[[int(i[1:]) for i in ids if i.startswith("f")]] = False
+        a.delete_schema("t")
+        a.create_schema(FeatureType.from_spec("t", SPEC))
+        a.write("t", FeatureCollection.concat([base.mask(keep), batch]))
+        a.compact("t")
+        _assert_tables_identical(a, b)
+
+    def test_attribute_index_falls_back_but_matches(self):
+        spec = SPEC.replace("name:String", "name:String:index=true")
+        a, b = _build(spec=spec), _build(spec=spec)
+        sft = a.get_schema("t")
+        ids = [f"f{i}" for i in range(50, 150)] + ["new0", "new1"]
+        batch = _batch(sft, ids, seed=3, name="upd")
+        a.upsert("t", batch)
+        a.compact("t")
+        b.fold_upsert("t", batch)
+        _assert_tables_identical(a, b)
+        assert (
+            a.query("t", "name = 'upd'").ids.tolist()
+            == b.query("t", "name = 'upd'").ids.tolist()
+        )
+
+    def test_empty_store_and_empty_batch(self):
+        ds = _build(n=0)
+        sft = ds.get_schema("t")
+        assert ds.fold_upsert("t", FeatureCollection.from_rows(sft, [])) == 0
+        assert ds.fold_upsert("t", _batch(sft, ["a", "b"], seed=5)) == 2
+        assert len(ds.features("t")) == 2
+        # duplicate ids within a batch are refused before any mutation
+        with pytest.raises(ValueError):
+            ds.fold_upsert("t", _batch(sft, ["c", "c"], seed=6))
+        assert len(ds.features("t")) == 2
+
+    def test_uncompacted_delta_folds_first(self):
+        a, b = _build(), _build()
+        sft = a.get_schema("t")
+        extra = _batch(sft, [f"d{i}" for i in range(100)], seed=9)
+        for ds in (a, b):
+            ds.write("t", extra)  # below the compaction threshold: host delta
+        batch = _batch(sft, [f"f{i}" for i in range(40)] + ["d1", "q0"], seed=13)
+        a.upsert("t", batch)
+        a.compact("t")
+        b.fold_upsert("t", batch)
+        _assert_tables_identical(a, b)
+
+    def test_scoped_invalidation_preserves_unrelated_entries(self):
+        """The fold bumps generations over the touched key ranges only:
+        a warm cached result over an untouched region must survive the
+        flush (the round-8 whole-type compaction bump killed it)."""
+        reg = MetricsRegistry()
+        ds = _build(cache=True, metrics=reg, seed=21)
+        sft = ds.get_schema("t")
+        far = "bbox(geom, 40, 40, 55, 55)"
+        near = "bbox(geom, -55, -55, -40, -40)"
+        n_far, n_near = len(ds.query("t", far)), len(ds.query("t", near))
+        # fold a batch strictly inside the NEAR region
+        batch = _batch(sft, [f"z{i}" for i in range(50)], seed=22,
+                       box=(-54.0, -54.0, -41.0, -41.0))
+        ds.fold_upsert("t", batch)
+        h0 = reg.counters.get("geomesa.cache.hit", 0)
+        assert len(ds.query("t", far)) == n_far       # served from cache
+        assert reg.counters.get("geomesa.cache.hit", 0) == h0 + 1
+        # the touched region's entry was invalidated AND the fresh scan
+        # sees the folded rows
+        assert len(ds.query("t", near)) == n_near + 50
+
+
+# -- the pipelined flusher -------------------------------------------------
+
+
+class TestStreamFlusher:
+    def _lambda(self, n=2000, seed=0, metrics=None, config=None, cache=None):
+        ds = _build(n=n, seed=seed, metrics=metrics, cache=cache)
+        return ds, LambdaStore(ds, "t", config=config)
+
+    def test_incremental_flush_matches_legacy(self):
+        ds_i, lam_i = self._lambda()
+        ds_l, lam_l = self._lambda()
+        sft = ds_i.get_schema("t")
+        rows = [
+            {"name": "h", "dtg": T0 + i, "geom": geo.Point(i * 0.01, -i * 0.01)}
+            for i in range(500)
+        ]
+        ids = [f"f{i}" for i in range(250)] + [f"h{i}" for i in range(250)]
+        lam_i.write(rows, ids=ids)
+        lam_l.write(rows, ids=ids)
+        # micro-batch flush: the 250 NEW ids append; the 250 updates stay
+        # in the hot overlay (below the fold threshold) — reads exact
+        assert lam_i.flush(incremental=True) == 250
+        assert len(lam_i.hot) == 250
+        assert lam_l.flush(incremental=False) == 500
+        for q in ["bbox(geom,-60,-60,60,60)", "name = 'h'"]:
+            ri = sorted(lam_i.query(q).ids.tolist())
+            rl = sorted(lam_l.query(q).ids.tolist())
+            assert ri == rl, q
+        # full persist folds the pending updates; still identical
+        assert lam_i.persist_hot() == 250
+        assert len(lam_i.hot) == 0
+        for q in ["bbox(geom,-60,-60,60,60)", "name = 'h'"]:
+            ri = sorted(lam_i.query(q).ids.tolist())
+            rl = sorted(lam_l.query(q).ids.tolist())
+            assert ri == rl, q
+        lam_i.close(), lam_l.close()
+
+    def test_stage_metrics_and_admission_window(self):
+        reg = MetricsRegistry()
+        cfg = StreamConfig(workers=2, chunk_rows=64, queue_depth=1)
+        ds, lam = self._lambda(metrics=reg, config=cfg)
+        lam.write([
+            {"name": "h", "dtg": T0 + i, "geom": geo.Point(i * 0.001, 0.0)}
+            for i in range(1000)
+        ], ids=[f"h{i}" for i in range(1000)])
+        assert lam.flush() == 1000
+        for stage in ("parse", "keys", "sort", "commit"):
+            t = reg.timers.get(f"geomesa.stream.{stage}")
+            assert t is not None and t.count >= 1, stage
+        assert reg.counters.get("geomesa.stream.flushes") == 1
+        assert reg.counters.get("geomesa.stream.rows") == 1000
+        # 1000 rows / 64-row chunks through a 1-deep window: staging blocked
+        assert reg.counters.get("geomesa.stream.queue_full", 0) > 0
+        assert reg.gauges.get("geomesa.stream.hot_rows") == 0.0
+        lam.close()
+
+    def test_expiring_hot_tier_always_drains(self):
+        """With expiry_ms configured, flush() must drain the overlay
+        fully: an expire() sweep between flushes would otherwise drop a
+        pending (unpersisted) update and resurface the stale cold row."""
+        ds = _build(n=50, seed=17)
+        lam = LambdaStore(ds, "t", config=StreamConfig(fold_rows=10**9))
+        lam.hot.expiry_ms = 1
+        lam.write([{"name": "upd", "dtg": T0, "geom": geo.Point(1.0, 1.0)}],
+                  ids=["f0"])  # an UPDATE of a persisted id
+        assert lam.flush() == 1   # drained despite the huge fold threshold
+        assert len(lam.hot) == 0
+        lam.hot.expire(now_ms=int(time.time() * 1000) + 10_000)
+        out = ds.query("t", "IN ('f0')")
+        assert np.asarray(out.columns["name"])[0] == "upd"
+        lam.close()
+
+    def test_worker_pool_warm_across_flushes(self):
+        ds, lam = self._lambda()
+        lam.write([{"name": "a", "dtg": T0, "geom": geo.Point(1, 1)}], ids=["a"])
+        lam.flush()
+        pool1 = lam.flusher._pool
+        lam.write([{"name": "b", "dtg": T0, "geom": geo.Point(2, 2)}], ids=["b"])
+        lam.flush()
+        assert lam.flusher._pool is pool1  # kept warm, not rebuilt
+        assert lam.flusher.flushes == 2
+        lam.close()
+        assert lam.flusher._pool is None
+        lam.close()  # idempotent
+        # a closed flusher recovers on the next flush
+        lam.write([{"name": "c", "dtg": T0, "geom": geo.Point(3, 3)}], ids=["c"])
+        assert lam.flush() == 1
+
+
+# -- flush atomicity: the crash/fault matrix -------------------------------
+
+
+class TestFlushFaultMatrix:
+    POINTS = (
+        "stream.flush.parse", "stream.flush.keys", "stream.flush.sort",
+        "streaming.persist",
+    )
+
+    def _lambda(self, tmp_path):
+        from geomesa_tpu.storage import persist
+
+        ds = _build(n=300, seed=3)
+        root = tmp_path / "cold"
+        persist.save(ds, root)
+        lam = LambdaStore(ds, "t", config=StreamConfig(chunk_rows=32))
+        lam.write([
+            {"name": "h", "dtg": T0 + i, "geom": geo.Point(i * 0.01, 1.0)}
+            for i in range(100)
+        ], ids=[f"f{i}" for i in range(50)] + [f"h{i}" for i in range(50)])
+        return ds, lam, root
+
+    @staticmethod
+    def _state(ds):
+        fc = ds.features("t")
+        return (
+            fc.ids.tolist(),
+            np.asarray(fc.columns["name"]).tolist(),
+            {i.name: np.asarray(ds.table("t", i.name).zs).tobytes()
+             for i in ds.indexes("t")},
+        )
+
+    @pytest.mark.parametrize("point", POINTS)
+    @pytest.mark.parametrize("kind", ["crash", "io_error"])
+    def test_fault_leaves_cold_untouched_hot_resident(
+        self, tmp_path, point, kind
+    ):
+        from geomesa_tpu.storage import persist
+
+        ds, lam, root = self._lambda(tmp_path)
+        before = self._state(ds)
+        exc = fault.InjectedCrash if kind == "crash" else OSError
+        with fault.inject(point, kind=kind, times=None):
+            with pytest.raises(exc):
+                lam.persist_hot()
+        assert self._state(ds) == before   # cold tier untouched
+        assert len(lam.hot) == 100         # every hot row still resident
+        # the on-disk store never tore: reload clean, no quarantine
+        back = persist.load(root)
+        assert back.store_health.status == "ok"
+        assert not (root / "_quarantine").exists()
+        # the fault cleared: the SAME flusher (warm pool) converges
+        assert lam.persist_hot() == 100
+        assert len(lam.hot) == 0
+        assert "h0" in ds.features("t").ids.tolist()
+        lam.close()
+
+    def test_transient_commit_fault_retries_internally(self, tmp_path):
+        ds, lam, _ = self._lambda(tmp_path)
+        with fault.inject("streaming.persist", kind="io_error", times=1):
+            assert lam.persist_hot() == 100  # one blip, retried inside
+        assert len(lam.hot) == 0
+        lam.close()
+
+
+# -- exact reads under writes ----------------------------------------------
+
+
+class TestExactReadsUnderFlush:
+    def test_mid_persist_window_no_double_count(self):
+        """Regression (round-8 bug): between the cold commit and the hot
+        eviction a flushed row lives in BOTH tiers; queries racing that
+        window returned/counted it twice. The ``streaming.evict`` fault
+        point pauses the window open; queries inside it must dedup."""
+        ds = _build(n=200, seed=5)
+        lam = LambdaStore(ds, "t")
+        lam.write([
+            {"name": "h", "dtg": T0 + i, "geom": geo.Point(0.5 + i * 1e-4, 0.5)}
+            for i in range(20)
+        ], ids=[f"f{i}" for i in range(10)] + [f"h{i}" for i in range(10)])
+        q = "bbox(geom, 0, 0, 1, 1)"
+        expect = sorted(lam.query(q).ids.tolist())
+        n_total_before = lam.count()
+        in_window = threading.Event()
+        done: list = []
+
+        def flush():
+            with fault.inject("streaming.evict", kind="latency", delay_s=1.0):
+                done.append(lam.persist_hot())
+
+        t = threading.Thread(target=flush)
+        t.start()
+        # wait until the cold commit landed (the window is open: rows in
+        # BOTH tiers, eviction paused behind the latency fault)
+        deadline = time.monotonic() + 10
+        while "h0" not in ds.features("t").ids.tolist():
+            assert time.monotonic() < deadline, "flush never committed"
+            time.sleep(0.01)
+        in_window.set()
+        out = lam.query(q)
+        got = out.ids.tolist()
+        assert len(got) == len(set(got)), "duplicate ids mid-persist"
+        assert sorted(got) == expect
+        assert lam.count() == n_total_before
+        # a write racing the evict window must survive it: the flush may
+        # only evict the exact row versions it persisted
+        lam.write([{"name": "late", "dtg": T0, "geom": geo.Point(0.6, 0.6)}],
+                  ids=["h0"])
+        t.join()
+        assert done == [20]
+        assert "h0" in lam.hot._rows  # the racing write stayed resident
+        late = lam.query(q)
+        names = dict(zip(late.ids.tolist(), np.asarray(late.columns["name"])))
+        assert names["h0"] == "late"
+        # after the window closes the answer is unchanged
+        assert sorted(lam.query(q).ids.tolist()) == expect
+        lam.close()
+
+    def test_hot_update_shadows_stale_cold_copy(self):
+        ds = _build(n=50, seed=6)
+        lam = LambdaStore(ds, "t")
+        lam.write([{"name": "v1", "dtg": T0, "geom": geo.Point(0.1, 0.1)}],
+                  ids=["m"])
+        lam.flush()
+        # the update moves the feature OUT of the window: the stale cold
+        # copy must be hidden even before any flush
+        lam.write([{"name": "v2", "dtg": T0, "geom": geo.Point(30.0, 30.0)}],
+                  ids=["m"])
+        assert "m" not in lam.query("bbox(geom, 0, 0, 1, 1)").ids.tolist()
+        out = lam.query("bbox(geom, 29, 29, 31, 31)")
+        assert out.ids.tolist() == ["m"]
+        assert np.asarray(out.columns["name"])[0] == "v2"
+        lam.flush()
+        out = lam.query("bbox(geom, 29, 29, 31, 31)")
+        assert np.asarray(out.columns["name"])[0] == "v2"
+        lam.close()
+
+    def test_scheduler_admitted_cold_queries(self):
+        reg = MetricsRegistry()
+        ds = _build(n=2000, seed=7, metrics=reg)
+        lam = LambdaStore(ds, "t")
+        seq = {}
+        qs = [f"bbox(geom, {i}, {i}, {i + 9}, {i + 9})" for i in range(-40, 40, 10)]
+        lam.write([
+            {"name": "h", "dtg": T0, "geom": geo.Point(i + 0.5, i + 0.5)}
+            for i in range(-40, 40, 10)
+        ], ids=[f"s{i}" for i in range(8)])
+        for q in qs:
+            seq[q] = sorted(lam.query(q).ids.tolist())
+        sched = lam.serve()
+        assert ds.scheduler is sched and not sched.closed
+        s0 = reg.counters.get("geomesa.serving.submitted", 0)
+        results: dict = {}
+        lock = threading.Lock()
+
+        def worker(q):
+            out = sorted(lam.query(q).ids.tolist())
+            with lock:
+                results[q] = out
+
+        threads = [threading.Thread(target=worker, args=(q,)) for q in qs * 4]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == seq
+        assert reg.counters.get("geomesa.serving.submitted", 0) >= s0 + len(qs)
+        sched.close()
+        # scheduler closed: queries fall back to the direct path
+        assert sorted(lam.query(qs[0]).ids.tolist()) == seq[qs[0]]
+        lam.close()
+
+
+# -- generation scoping under sustained streaming writes -------------------
+
+
+class TestStreamingMutationFuzz:
+    def test_cached_merge_never_stale_and_unrelated_entries_survive(self):
+        """Cached-vs-oracle fuzz under sustained flushes: every merged
+        answer over the cache-enabled cold store must equal a fresh
+        uncached oracle built from the expected live state; meanwhile a
+        repeated query over an untouched far region must keep HITTING
+        its cached entry across flushes (scoped invalidation)."""
+        reg = MetricsRegistry()
+        ds = _build(n=1500, seed=8, cache=True, metrics=reg)
+        lam = LambdaStore(ds, "t", config=StreamConfig(chunk_rows=256))
+        sft = ds.get_schema("t")
+        rng = np.random.default_rng(42)
+        state = {}  # id -> (name, x, y, dtg): the expected merged view
+        base = ds.features("t")
+        bx, by = base.geom_column.x, base.geom_column.y
+        bn = np.asarray(base.columns["name"])
+        bt = np.asarray(base.columns["dtg"], np.int64)
+        for i, fid in enumerate(base.ids.tolist()):
+            state[fid] = (bn[i], float(bx[i]), float(by[i]), int(bt[i]))
+        far = "bbox(geom, 70, 70, 85, 85)"   # no write ever lands here
+        n_far = len(ds.query("t", far))
+        queries = [
+            "bbox(geom, -30, -30, 0, 0)",
+            "bbox(geom, 5, 5, 25, 25)",
+            "bbox(geom, -10, -10, 10, 10) AND dtg DURING "
+            "2024-01-01T00:00:00Z/2024-01-15T00:00:00Z",
+        ]
+        far_hits0 = reg.counters.get("geomesa.cache.hit", 0)
+        for rnd in range(6):
+            # mutate: updates to existing ids + some appends, confined
+            # to the [-30, 30] region
+            ids = [f"f{int(i)}" for i in rng.choice(1500, 60, replace=False)]
+            ids += [f"n{rnd}_{j}" for j in range(20)]
+            m = len(ids)
+            x = rng.uniform(-30, 30, m)
+            y = rng.uniform(-30, 30, m)
+            t = T0 + rng.integers(0, 14 * DAY, m).astype(np.int64)
+            lam.write([
+                {"name": f"r{rnd}", "dtg": int(t[j]),
+                 "geom": geo.Point(float(x[j]), float(y[j]))}
+                for j in range(m)
+            ], ids=ids)
+            for j, fid in enumerate(ids):
+                state[fid] = (f"r{rnd}", float(x[j]), float(y[j]), int(t[j]))
+            if rnd % 2 == 1:
+                lam.flush()
+            # oracle: an uncached store holding the expected live state
+            oracle = DataStore(tile=64)
+            oracle.create_schema(FeatureType.from_spec("t", SPEC))
+            oids = sorted(state)
+            oracle.write("t", FeatureCollection.from_columns(
+                oracle.get_schema("t"), oids, {
+                    "name": np.array([state[i][0] for i in oids]),
+                    "dtg": np.array([state[i][3] for i in oids], np.int64),
+                    "geom": (np.array([state[i][1] for i in oids]),
+                             np.array([state[i][2] for i in oids])),
+                }), check_ids=False)
+            for q in queries:
+                got = sorted(lam.query(q).ids.tolist())
+                want = sorted(oracle.query("t", q).ids.tolist())
+                assert got == want, (rnd, q)
+            # the far region is untouched by every mutation above: its
+            # cached entry must still serve (scoped generation bumps)
+            assert len(ds.query("t", far)) == n_far
+        assert reg.counters.get("geomesa.cache.hit", 0) > far_hits0
+        # a final full persist (drains the pending-update overlay) stays
+        # exact and still leaves the far entry warm
+        lam.persist_hot()
+        assert len(lam.hot) == 0
+        oids = sorted(state)
+        oracle = DataStore(tile=64)
+        oracle.create_schema(FeatureType.from_spec("t", SPEC))
+        oracle.write("t", FeatureCollection.from_columns(
+            oracle.get_schema("t"), oids, {
+                "name": np.array([state[i][0] for i in oids]),
+                "dtg": np.array([state[i][3] for i in oids], np.int64),
+                "geom": (np.array([state[i][1] for i in oids]),
+                         np.array([state[i][2] for i in oids])),
+            }), check_ids=False)
+        for q in queries:
+            assert sorted(lam.query(q).ids.tolist()) == sorted(
+                oracle.query("t", q).ids.tolist()
+            ), q
+        assert len(ds.query("t", far)) == n_far
+        lam.close()
+
+
+# -- satellite: raster aggregation push-down -------------------------------
+
+
+class TestRasterAggregationPushdown:
+    def _store(self, n=120_000, seed=0, metrics=None, auths=None, spec=SPEC):
+        rng = np.random.default_rng(seed)
+        ds = DataStore(tile=64, metrics=metrics, auths=auths)
+        sft = FeatureType.from_spec("t", spec)
+        ds.create_schema(sft)
+        cols = {
+            "name": np.array(["n"] * n),
+            "dtg": T0 + rng.integers(0, 30 * DAY, n),
+            "geom": (rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)),
+        }
+        if "vis" in spec:
+            cols["vis"] = np.array(["", "admin"] * (n // 2))
+        ds.write("t", FeatureCollection.from_columns(
+            ds.get_schema("t"), np.arange(n).astype(str), cols),
+            check_ids=False)
+        return ds
+
+    def _differential(self, ds, f):
+        """(host-path results, raster-path results) for count/bounds/
+        stats over one filter."""
+        conf.RASTER_ENABLED.set(False)
+        ds.planner.invalidate_config_memo()
+        try:
+            host = (
+                ds.count("t", f),
+                ds.bounds("t", f, estimate=False),
+                ds.stats_query("t", "Count()", f)[0].count,
+            )
+        finally:
+            conf.RASTER_ENABLED.set(None)
+            ds.planner.invalidate_config_memo()
+        rast = (
+            ds.count("t", f),
+            ds.bounds("t", f),
+            ds.stats_query("t", "Count()", f, estimate=True)[0].count,
+        )
+        return host, rast
+
+    @pytest.mark.parametrize("poly", [
+        _star(0, 0, 8),
+        _star(5, -5, 3, n_arms=17),
+        geo.Polygon(  # concave with a hole
+            [(-12, -12), (12, -12), (12, 12), (-12, 12)],
+            holes=[[(-6, -6), (6, -6), (6, 6), (-6, 6)]],
+        ),
+    ])
+    def test_count_bounds_stats_match_host_path(self, poly):
+        reg = MetricsRegistry()
+        ds = self._store(metrics=reg)
+        f = Intersects("geom", poly)
+        c0 = reg.counters.get("geomesa.query.raster_agg", 0)
+        host, rast = self._differential(ds, f)
+        assert rast[0] == host[0]
+        assert rast[2] == host[2]
+        assert host[1] is not None and np.allclose(rast[1], host[1])
+        # all three raster-path calls took the push-down, host took none
+        assert reg.counters.get("geomesa.query.raster_agg", 0) == c0 + 3
+
+    def test_polygon_with_time_predicate(self):
+        ds = self._store(seed=2)
+        f = And([
+            Intersects("geom", _star(0, 0, 8)),
+            During("dtg", T0, T0 + 10 * DAY),
+        ])
+        host, rast = self._differential(ds, f)
+        assert rast[0] == host[0] and rast[2] == host[2]
+        assert np.allclose(rast[1], host[1])
+
+    def test_visibility_disables_push_down_exactly(self):
+        spec = SPEC + ",vis:String;geomesa.vis.field=vis"
+        reg = MetricsRegistry()
+        ds = self._store(n=10_000, metrics=reg, auths=[], spec=spec)
+        f = Intersects("geom", _star(0, 0, 8))
+        c0 = reg.counters.get("geomesa.query.raster_agg", 0)
+        n = ds.count("t", f)
+        # push-down refused (it cannot evaluate visibility); results
+        # still exact through the host path
+        assert reg.counters.get("geomesa.query.raster_agg", 0) == c0
+        assert n == len(ds.query("t", f))
+
+    def test_disjoint_polygon(self):
+        ds = self._store(n=5_000, seed=4)
+        f = Intersects("geom", _star(170, 80, 2))
+        assert ds.count("t", f) == 0
+        assert ds.bounds("t", f) is None
