@@ -1802,6 +1802,246 @@ def config_stream(out_path: "str | None" = None):
     return rec
 
 
+def config_wal(out_path: "str | None" = None):
+    """Streaming WAL overhead + recovery scenario (ISSUE 10,
+    docs/durability.md "Streaming WAL"): the SAME micro-batch
+    write+flush workload runs four times — no WAL, then WAL under
+    ``sync=off`` / ``interval`` / ``always`` — and sustained rows/s is
+    recorded for each; then a separate run streams
+    ``GEOMESA_BENCH_WAL_REPLAY`` rows (with periodic flush watermarks),
+    hard-kills, and times ``LambdaStore.recover`` end to end.
+
+    Exactness is computed in-bench: after the ``sync=always`` run the
+    store is recovered from disk and every probe query must return the
+    same ids and values as the live (never-killed) store — the
+    ``identical`` flag ``scripts/bench_gate.py`` enforces, alongside the
+    within-run bound that ``sync=interval`` throughput stays within 15%
+    of the no-WAL path.
+
+    Emits BENCH_WAL.json (or ``out_path`` / GEOMESA_BENCH_WAL_OUT — use
+    a scratch path for the fresh side of a gate run). Env knobs:
+    GEOMESA_BENCH_WAL_COLD (cold rows), GEOMESA_BENCH_WAL_N (streamed
+    rows per mode), GEOMESA_BENCH_WAL_BATCH, GEOMESA_BENCH_WAL_REPLAY
+    (rows in the recovery run)."""
+    import shutil
+    import tempfile
+
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.storage import persist
+    from geomesa_tpu.streaming import LambdaStore, StreamConfig, WalConfig
+
+    n_cold = int(os.environ.get("GEOMESA_BENCH_WAL_COLD", 200_000))
+    n_stream = int(os.environ.get("GEOMESA_BENCH_WAL_N", 400_000))
+    batch = int(os.environ.get("GEOMESA_BENCH_WAL_BATCH", 20_000))
+    n_replay = int(os.environ.get("GEOMESA_BENCH_WAL_REPLAY", 1_000_000))
+    t0_ms = 1_717_200_000_000
+    spec = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+    def build_root(base_dir):
+        rng = np.random.default_rng(SEED + 95)
+        ds = DataStore()
+        sft = FeatureType.from_spec("mv", spec)
+        ds.create_schema(sft)
+        if n_cold:
+            ds.write("mv", FeatureCollection.from_columns(
+                sft, np.arange(n_cold).astype(str), {
+                    "name": np.array(["v"] * n_cold),
+                    "dtg": t0_ms + rng.integers(0, 86_400_000, n_cold),
+                    "geom": (rng.uniform(-170, 170, n_cold),
+                             rng.uniform(-80, 80, n_cold)),
+                }), check_ids=False)
+            ds.compact("mv")
+        root = os.path.join(base_dir, "s")
+        persist.save(ds, root)
+        return ds, root
+
+    def message_stream(n):
+        """Prebuilt (ids, rows) batches: half updates of cold ids, half
+        arrivals — identical across every mode."""
+        rng = np.random.default_rng(SEED + 96)
+        out = []
+        arrivals = 0
+        for s in range(0, n, batch):
+            k = min(batch, n - s)
+            ids, rows = [], []
+            upd = rng.integers(0, max(n_cold, 1), k // 2)
+            xs = rng.uniform(-170, 170, k)
+            ys = rng.uniform(-80, 80, k)
+            for j in range(k):
+                if j < k // 2 and n_cold:
+                    ids.append(str(int(upd[j])))
+                else:
+                    arrivals += 1
+                    ids.append(f"a{arrivals}")
+                rows.append({
+                    "name": "u", "dtg": t0_ms + s + j,
+                    "geom": geo.Point(float(xs[j]), float(ys[j])),
+                })
+            out.append((ids, rows))
+        return out
+
+    stream = message_stream(n_stream)
+    probes = [
+        "bbox(geom, -40, -40, 0, 0)", "bbox(geom, 10, 10, 60, 50)",
+        "IN ('0', '1', 'a1', 'a2')",
+    ]
+
+    def run_mode(mode):
+        """One full streamed run; returns (rows/s, lam, root, tmp)."""
+        tmp = tempfile.mkdtemp(prefix="geomesa_wal_bench_")
+        ds, root = build_root(tmp)
+        kw = {}
+        if mode != "nowal":
+            kw = dict(
+                wal_dir=os.path.join(root, "_wal"),
+                wal_config=WalConfig(sync=mode),
+            )
+        lam = LambdaStore(ds, "mv", config=StreamConfig(), **kw)
+        t0 = time.perf_counter()
+        for ids, rows in stream:
+            lam.write(rows, ids=ids)
+            lam.flush()
+        dt = time.perf_counter() - t0
+        return n_stream / dt, lam, root, tmp
+
+    # warmup: one discarded short run so the first MEASURED mode does
+    # not pay the fold/scan kernel compilations for everyone
+    log("[wal] warmup ...")
+    tmpw = tempfile.mkdtemp(prefix="geomesa_wal_warm_")
+    dsw, _rootw = build_root(tmpw)
+    lamw = LambdaStore(dsw, "mv", config=StreamConfig())
+    for ids, rows in stream[: max(1, min(3, len(stream)))]:
+        lamw.write(rows, ids=ids)
+        lamw.flush()
+    lamw.close()
+    shutil.rmtree(tmpw, ignore_errors=True)
+
+    # best-of-N per mode: the measured window is seconds on a SHARED CI
+    # host, and a neighbor's burst during one mode would otherwise read
+    # as WAL overhead (or mask it); every repeat streams the identical
+    # prebuilt message sequence
+    repeat = int(os.environ.get("GEOMESA_BENCH_WAL_REPEAT", 2))
+    results = {}
+    keep = {}
+    for mode in ("nowal", "off", "interval", "always"):
+        best = 0.0
+        for r in range(max(repeat, 1)):
+            rps, lam, root, tmp = run_mode(mode)
+            best = max(best, rps)
+            last = r == max(repeat, 1) - 1
+            if mode == "always" and last:
+                keep = {"lam": lam, "root": root, "tmp": tmp}
+            else:
+                lam.close()
+                shutil.rmtree(tmp, ignore_errors=True)
+        results[mode] = best
+        log(f"[wal] {mode}: {best:,.0f} rows/s (best of {repeat})")
+
+    # exactness: hard-kill the sync=always store and recover from disk
+    lam, root = keep["lam"], keep["root"]
+    live = [sorted(zip(
+        (str(i) for i in lam.query(q).ids.tolist()),
+        (str(v) for v in np.asarray(lam.query(q).columns["name"]).tolist()),
+    )) for q in probes]
+    lam.wal.crash()
+    lam.flusher.close()
+    rec = LambdaStore.recover(root)
+    recovered = [sorted(zip(
+        (str(i) for i in rec.query(q).ids.tolist()),
+        (str(v) for v in np.asarray(rec.query(q).columns["name"]).tolist()),
+    )) for q in probes]
+    identical = bool(
+        recovered == live and rec.cold.store_health.status == "ok"
+    )
+    rec.close()
+    shutil.rmtree(keep["tmp"], ignore_errors=True)
+
+    # recovery throughput: stream n_replay rows (periodic flushes leave
+    # watermarks in the log), hard-kill, time the full recover()
+    tmp = tempfile.mkdtemp(prefix="geomesa_wal_replay_")
+    ds, root = build_root(tmp)
+    lam = LambdaStore(
+        ds, "mv", config=StreamConfig(),
+        wal_dir=os.path.join(root, "_wal"),
+        wal_config=WalConfig(sync="off"),  # isolate REPLAY cost
+    )
+    rng = np.random.default_rng(SEED + 97)
+    for s in range(0, n_replay, batch):
+        k = min(batch, n_replay - s)
+        xs = rng.uniform(-170, 170, k)
+        ys = rng.uniform(-80, 80, k)
+        lam.write(
+            [{"name": "r", "dtg": t0_ms + s + j,
+              "geom": geo.Point(float(xs[j]), float(ys[j]))}
+             for j in range(k)],
+            ids=[f"r{s + j}" for j in range(k)],
+        )
+        lam.flush()
+    lam.wal.sync()  # sync=off: drains the app buffer (no fsync)
+    lam.wal.crash()
+    lam.flusher.close()
+    t0 = time.perf_counter()
+    rec = LambdaStore.recover(root)
+    recover_s = time.perf_counter() - t0
+    replayed = len(rec.cold.features("mv")) + len(rec.hot) - n_cold
+    rec.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    interval_over_nowal = results["interval"] / results["nowal"]
+    row = {
+        "scenario": "stream_wal",
+        "cold_rows": n_cold, "streamed_rows": n_stream, "batch": batch,
+        "nowal_rows_per_s": round(results["nowal"], 1),
+        "wal_off_rows_per_s": round(results["off"], 1),
+        "wal_interval_rows_per_s": round(results["interval"], 1),
+        "wal_always_rows_per_s": round(results["always"], 1),
+        "interval_over_nowal": round(interval_over_nowal, 4),
+        "identical": identical,
+    }
+    replay_row = {
+        "scenario": "wal_replay",
+        "replay_rows": n_replay, "replayed_rows": int(replayed),
+        "recover_s": round(recover_s, 3),
+        "replay_rows_per_s": round(n_replay / recover_s, 1),
+        # exactness proxy the gate enforces: recovery surfaced every
+        # streamed row, none lost, none invented
+        "identical": bool(int(replayed) == n_replay),
+    }
+    log(
+        f"[wal] interval/nowal = {interval_over_nowal:.3f}, "
+        f"always = {results['always'] / results['nowal']:.3f}x of nowal, "
+        f"identical={identical}; replay {n_replay:,} rows in "
+        f"{recover_s:.1f}s = {n_replay / recover_s:,.0f} rows/s"
+    )
+
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": [row, replay_row]}
+    if out_path is None:
+        out_path = os.environ.get("GEOMESA_BENCH_WAL_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_WAL.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec_line = {
+        "metric": "wal_interval_rows_per_s",
+        "value": row["wal_interval_rows_per_s"],
+        "unit": "rows/s",
+        "interval_over_nowal": row["interval_over_nowal"],
+        "identical": identical,
+        "replay_rows_per_s": replay_row["replay_rows_per_s"],
+    }
+    print(json.dumps(rec_line), flush=True)
+    return rec_line
+
+
 # ------------------------------------------------------------- config 4
 
 
@@ -1979,7 +2219,7 @@ def child_main():
         "4": config4_join, "5": config5_knn, "cache": config_cache,
         "serving": config_serving, "ingest": config_ingest,
         "fused": config_fused, "pip_join": config_pip_join,
-        "stream": config_stream,
+        "stream": config_stream, "wal": config_wal,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -151,11 +151,16 @@ class Project:
     #: deliberately out of scope — they stage bad patterns on purpose
     PY_ROOTS = ("geomesa_tpu", "scripts")
     DOC_ROOT = "docs"
+    #: test tree loaded as RAW TEXT only (never linted): coverage-style
+    #: rules (fault-point-unknown) check that names the production tree
+    #: declares are actually exercised by some test
+    TEST_ROOT = "tests"
 
     def __init__(self, root: str):
         self.root = root
         self.files: dict[str, SourceFile] = {}
         self.docs: dict[str, DocFile] = {}
+        self.tests: dict[str, str] = {}  # relpath -> raw text
 
     @classmethod
     def load(cls, root: str) -> "Project":
@@ -178,6 +183,29 @@ class Project:
                 if fn.endswith(".md"):
                     rel = f"{cls.DOC_ROOT}/{fn}"
                     p.docs[rel] = DocFile(root, rel)
+        testdir = os.path.join(root, cls.TEST_ROOT)
+        if os.path.isdir(testdir):
+            for dirpath, dirnames, filenames in os.walk(testdir):
+                # fixtures stage rule inputs that never RUN: a fault
+                # point named only in a fixture must not count as
+                # test-exercised (the vacuous-coverage hole the
+                # fault-point-unknown rule exists to close)
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", "fixtures")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), root
+                        ).replace(os.sep, "/")
+                        try:
+                            with open(
+                                os.path.join(root, rel), encoding="utf-8"
+                            ) as fh:
+                                p.tests[rel] = fh.read()
+                        except OSError:
+                            continue
         return p
 
     def add_file(self, relpath: str, text: "str | None" = None) -> SourceFile:

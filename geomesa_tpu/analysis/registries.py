@@ -51,6 +51,52 @@ USER_DATA_KEYS: dict[str, str] = {
     "geomesa.vis.field": "attribute carrying per-feature visibility labels",
 }
 
+# -- fault points ---------------------------------------------------------
+# The FOURTH dotted-name namespace (PR 10): every ``fault.fault_point``
+# name in the tree. Like USER_DATA_KEYS, the registry IS the declaration
+# — fault points have no typed declaration form in code — and the
+# ``fault-point-unknown`` rule machine-checks three directions: a
+# literal used in code must be registered here, a registered name must
+# have a code use site, and a registered name must be exercised by at
+# least one test (directly, or through an fnmatch pattern a test arms).
+# ``fault.atomic_write(..., point="X")`` contributes the derived pair
+# ``X.write`` / ``X.rename``.
+FAULT_POINTS: dict[str, str] = {
+    # crash-safe persistence (storage/persist.py; docs/durability.md)
+    "persist.partition.write": "before a partition file's tmp write",
+    "persist.partition.rename": "before a partition's atomic rename",
+    "persist.partition.commit": "after the rename (durable bytes)",
+    "persist.manifest.write": "before the manifest's tmp write",
+    "persist.manifest.rename": "before the manifest commit rename",
+    "persist.manifest.commit": "after the manifest commit (durable)",
+    "persist.gc": "before post-commit garbage collection",
+    "load.partition.read": "before reading a partition on load",
+    # catalog metadata (storage/metadata.py FileMetadata)
+    "metadata.write": "before a catalog KV tmp write",
+    "metadata.rename": "before a catalog KV atomic rename",
+    # index-table (re)build (storage/adapter.py)
+    "adapter.create_table": "before an index table (re)build",
+    # pipelined ingest (ingest/; docs/ingest.md)
+    "ingest.split.read": "before reading an input split",
+    "ingest.parse": "before converting a split's records",
+    "ingest.keys": "before a chunk's key encoding",
+    "ingest.sort": "before a chunk's shard radix sort",
+    "ingest.commit": "before a chunk's staged commit",
+    "ingest.finalize": "before the one atomic ingest publish",
+    # streaming flush (streaming/flush.py, store.py; docs/streaming.md)
+    "stream.flush.parse": "before a flush micro-chunk's parse stage",
+    "stream.flush.keys": "before a flush micro-chunk's key stage",
+    "stream.flush.sort": "before a flush micro-chunk's shard sort",
+    "streaming.persist": "before the one atomic hot->cold publish",
+    "streaming.evict": "between the cold commit and the hot eviction",
+    # streaming WAL (streaming/wal.py; docs/durability.md)
+    "stream.wal.append": "before a WAL record is encoded/buffered",
+    "stream.wal.sync": "before a WAL fsync (group commit)",
+    "stream.wal.rotate": "before sealing/rotating the active segment",
+    "stream.wal.truncate": "before cutting a torn WAL tail",
+    "stream.wal.replay": "before replaying a WAL segment on recovery",
+}
+
 # metric instrument methods on MetricsRegistry, by instrument kind
 INSTRUMENT_METHODS = {
     "counter": "counter",
@@ -268,6 +314,67 @@ def _infer_wrappers(project: Project) -> dict[str, set]:
                         (instrument, params.index(a0.id))
                     )
     return out
+
+
+# -- fault-point occurrences ----------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaultPointUse:
+    name: str
+    path: str
+    line: int
+    via: str  # "fault_point" | "atomic_write"
+
+
+def fault_point_uses(project: Project) -> list[FaultPointUse]:
+    """Every literal fault-point name the production tree can fire:
+    ``fault_point("X")`` first arguments, plus the ``X.write``/
+    ``X.rename`` pair an ``atomic_write(..., point="X")`` call derives.
+    Non-literal names (f-strings, variables) are skipped — they are
+    covered at their literal call sites."""
+    out: list[FaultPointUse] = []
+    for sf in project.python_files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = call_name(node)
+            if fname == "fault_point" and node.args:
+                s = const_str(node.args[0])
+                if s is not None:
+                    out.append(
+                        FaultPointUse(s, sf.relpath, node.lineno, fname)
+                    )
+            elif fname == "atomic_write":
+                for kw in node.keywords:
+                    if kw.arg == "point":
+                        s = const_str(kw.value)
+                        if s is not None:
+                            for suffix in (".write", ".rename"):
+                                out.append(FaultPointUse(
+                                    s + suffix, sf.relpath,
+                                    node.lineno, fname,
+                                ))
+    return out
+
+
+def test_string_tokens(project: Project) -> set[str]:
+    """Every quoted string token in the test tree that could name or
+    match a fault point (contains a dot) — the coverage side of the
+    fault-point-unknown rule. Cached on the project (one regex pass)."""
+    cached = getattr(project, "_lint_test_tokens", None)
+    if cached is not None:
+        return cached
+    tokens: set[str] = set()
+    pattern = re.compile(r"[\"']([A-Za-z0-9_.*/:-]+)[\"']")
+    for text in project.tests.values():
+        for tok in pattern.findall(text):
+            if "." in tok:
+                tokens.add(tok)
+    project._lint_test_tokens = tokens  # type: ignore[attr-defined]
+    return tokens
 
 
 # -- doc occurrences ------------------------------------------------------

@@ -8,6 +8,7 @@ the id in docs/analysis.md (tests/test_docs.py enforces that), and add
 known-bad/known-good fixtures under tests/fixtures/analysis/.
 """
 
+from geomesa_tpu.analysis.rules.faults import FaultPointRule
 from geomesa_tpu.analysis.rules.fused import FusedVariantKeyRule
 from geomesa_tpu.analysis.rules.kernels import (
     KernelDynamicShapeRule,
@@ -36,6 +37,7 @@ ALL_RULES = [
     DocUnknownNameRule(),
     MetricConventionRule(),
     MetricTypeConflictRule(),
+    FaultPointRule(),
     FusedVariantKeyRule(),
     LockDisciplineRule(),
     KernelTracedCoercionRule(),

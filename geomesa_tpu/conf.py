@@ -244,6 +244,25 @@ STREAM_FOLD_ROWS = SystemProperty(
     "appends instead of O(table) per flush; a full persist "
     "(persist_hot/checkpoint) always folds everything",
 )
+STREAM_WAL_SYNC = SystemProperty(
+    "geomesa.stream.wal.sync", "always", str,
+    "streaming WAL fsync policy (docs/durability.md): 'always' = every "
+    "acknowledged write is fsync'd first (group-committed, zero "
+    "acknowledged-row loss on kill -9), 'interval' = fsync at most every "
+    "geomesa.stream.wal.sync.interval.ms (bounded loss window), 'off' = "
+    "never fsync (redo-from-checkpoint workloads / bench baseline)",
+)
+STREAM_WAL_SYNC_INTERVAL_MS = SystemProperty(
+    "geomesa.stream.wal.sync.interval.ms", 50.0, float,
+    "fsync cadence under geomesa.stream.wal.sync=interval: a hard kill "
+    "loses at most the writes acknowledged since the last sync",
+)
+STREAM_WAL_SEGMENT_BYTES = SystemProperty(
+    "geomesa.stream.wal.segment.bytes", 64 << 20, int,
+    "streaming WAL segment size: the active log rotates past this many "
+    "bytes; sealed segments retire only once a checkpoint watermark "
+    "covers them (LambdaStore.checkpoint — the durable cold publish)",
+)
 STREAM_INCREMENTAL = SystemProperty(
     "geomesa.stream.incremental", True, _parse_bool,
     "fold flushes into the cold tables incrementally "

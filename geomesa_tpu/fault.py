@@ -36,14 +36,37 @@ faults; empty fields take their defaults, e.g.
 ``persist.*:latency::-1:0.05``); ``point`` is an ``fnmatch`` pattern
 (``persist.*``). Retry
 tuning: ``GEOMESA_TPU_IO_RETRIES`` (attempts, default 3) and
-``GEOMESA_TPU_IO_BACKOFF_S`` (initial backoff, default 0.01, doubled per
-attempt).
+``GEOMESA_TPU_IO_BACKOFF_S`` (initial backoff, default 0.01; the sleep
+sequence uses decorrelated jitter so concurrent workers hitting the
+same transient fault don't retry in lockstep). Retries are observable:
+``geomesa.fault.retry`` counts every absorbed transient failure and
+``geomesa.fault.retries_exhausted`` every operation that failed past
+its budget.
+
+Seeded background chaos (the machine-checked durability harness)::
+
+    with fault.chaos(seed=7, rate=0.02,
+                     points="stream.*,streaming.*,persist.*"):
+        run_closed_loop_workload()
+
+fires random faults from a deterministic (seeded) schedule at every
+matching fault point while a workload runs — the streaming chaos test
+asserts exactness and zero acknowledged-row loss under it
+(tests/test_wal.py; ``GEOMESA_TPU_CHAOS_SEED`` overrides the fixed CI
+seed for soak runs).
+
+Every fault-point NAME is registered in
+``geomesa_tpu/analysis/registries.py`` (``FAULT_POINTS``) and the
+``fault-point-unknown`` lint rule machine-checks that code, registry and
+test coverage agree.
 """
 
 from __future__ import annotations
 
 import fnmatch
 import os
+import random
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -102,13 +125,63 @@ def _corrupt_file(path: Optional[str], kind: str) -> None:
             fh.write(bytes([b[0] ^ 0x40]))
 
 
+class ChaosSpec:
+    """A seeded random fault schedule: at every fault point matching one
+    of ``points`` (comma-separated fnmatch patterns), fire with
+    probability ``rate``, picking the kind uniformly from ``kinds``
+    (repeat a kind to weight it). Deterministic: the schedule is a pure
+    function of the seed and the sequence of matching hits — rerunning
+    the same single-threaded workload replays the same faults; under
+    concurrency the hit ORDER may interleave differently, but the
+    decision stream itself never changes."""
+
+    def __init__(self, seed: int, rate: float = 0.02,
+                 points: str = "stream.*,streaming.*,persist.*",
+                 kinds: tuple = ("io_error", "latency"),
+                 delay_s: float = 0.001):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"chaos rate must be in [0, 1], got {rate!r}")
+        for k in kinds:
+            if k not in KINDS:
+                raise ValueError(f"unknown fault kind {k!r} (one of {KINDS})")
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.patterns = tuple(
+            p.strip() for p in str(points).split(",") if p.strip()
+        )
+        self.kinds = tuple(kinds)
+        self.delay_s = float(delay_s)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.hits = 0   # guarded-by: _lock
+        self.fired = 0  # guarded-by: _lock
+        self.log: list[tuple[int, str, str]] = []  # guarded-by: _lock
+
+    def decide(self, point: str) -> Optional[str]:
+        """The kind to fire at this hit, or None. One rng draw per
+        MATCHING hit (so the schedule depends only on the matching-hit
+        sequence, not on unrelated fault points)."""
+        if not any(fnmatch.fnmatch(point, p) for p in self.patterns):
+            return None
+        with self._lock:
+            self.hits += 1
+            if self._rng.random() >= self.rate:
+                return None
+            kind = self._rng.choice(self.kinds)
+            self.fired += 1
+            self.log.append((self.hits, point, kind))
+            return kind
+
+
 class FaultInjector:
-    """Registry of armed :class:`FaultSpec`s, consulted at every
-    :func:`fault_point`. Process-global; deterministic (specs fire by hit
-    count, nothing random)."""
+    """Registry of armed :class:`FaultSpec`s (and at most one
+    :class:`ChaosSpec`), consulted at every :func:`fault_point`.
+    Process-global; deterministic (specs fire by hit count, chaos by a
+    seeded schedule — nothing draws from global randomness)."""
 
     def __init__(self):
         self.specs: list[FaultSpec] = []
+        self.chaos_spec: Optional[ChaosSpec] = None
 
     def install(self, spec: FaultSpec) -> FaultSpec:
         self.specs.append(spec)
@@ -118,8 +191,23 @@ class FaultInjector:
         if spec in self.specs:
             self.specs.remove(spec)
 
+    def install_chaos(self, spec: ChaosSpec) -> ChaosSpec:
+        if self.chaos_spec is not None:
+            raise RuntimeError("a chaos schedule is already installed")
+        self.chaos_spec = spec
+        return spec
+
+    def remove_chaos(self, spec: ChaosSpec) -> None:
+        if self.chaos_spec is spec:
+            self.chaos_spec = None
+
     def reset(self) -> None:
         self.specs.clear()
+        self.chaos_spec = None
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.specs) or self.chaos_spec is not None
 
     def load_env(self, env: Optional[dict] = None, strict: bool = True) -> list[FaultSpec]:
         """Arm faults from ``GEOMESA_TPU_FAULTS`` (see module docstring);
@@ -161,7 +249,8 @@ class FaultInjector:
         return out
 
     def on(self, point: str, path: Optional[str] = None) -> None:
-        """Fire any armed spec matching this fault point."""
+        """Fire any armed spec (then the chaos schedule) matching this
+        fault point."""
         for spec in list(self.specs):
             if not fnmatch.fnmatch(point, spec.point):
                 continue
@@ -171,17 +260,28 @@ class FaultInjector:
             if spec.times is not None and spec.fired >= spec.times:
                 continue
             spec.fired += 1
-            if spec.kind == "latency":
-                time.sleep(spec.delay_s)
-            elif spec.kind == "io_error":
-                raise InjectedIOError(f"injected IO error at {point}")
-            elif spec.kind == "bit_flip":
-                _corrupt_file(path, "bit_flip")
-            elif spec.kind == "partial_write":
-                _corrupt_file(path, "partial_write")
-                raise InjectedCrash(f"injected crash (partial write) at {point}")
-            else:  # crash
-                raise InjectedCrash(f"injected crash at {point}")
+            _fire(spec.kind, point, path, spec.delay_s)
+        chaos_spec = self.chaos_spec
+        if chaos_spec is not None:
+            kind = chaos_spec.decide(point)
+            if kind is not None:
+                _fire(kind, point, path, chaos_spec.delay_s)
+
+
+def _fire(kind: str, point: str, path: Optional[str], delay_s: float) -> None:
+    """Apply one fault kind at a point (shared by armed specs and the
+    chaos schedule)."""
+    if kind == "latency":
+        time.sleep(delay_s)
+    elif kind == "io_error":
+        raise InjectedIOError(f"injected IO error at {point}")
+    elif kind == "bit_flip":
+        _corrupt_file(path, "bit_flip")
+    elif kind == "partial_write":
+        _corrupt_file(path, "partial_write")
+        raise InjectedCrash(f"injected crash (partial write) at {point}")
+    else:  # crash
+        raise InjectedCrash(f"injected crash at {point}")
 
 
 _GLOBAL = FaultInjector()
@@ -233,10 +333,10 @@ def injector() -> FaultInjector:
 
 
 def fault_point(name: str, path: Optional[str] = None) -> None:
-    """Mark an injectable point; no-op unless a matching fault is armed.
-    ``path``: the file the point is about to (or just did) touch — the
-    target for partial_write/bit_flip damage."""
-    if _GLOBAL.specs:
+    """Mark an injectable point; no-op unless a matching fault (or a
+    chaos schedule) is armed. ``path``: the file the point is about to
+    (or just did) touch — the target for partial_write/bit_flip damage."""
+    if _GLOBAL.armed:
         _GLOBAL.on(name, path)
 
 
@@ -258,28 +358,75 @@ def inject(
         _GLOBAL.remove(spec)
 
 
+@contextmanager
+def chaos(
+    seed: int,
+    rate: float = 0.02,
+    points: str = "stream.*,streaming.*,persist.*",
+    kinds: tuple = ("io_error", "latency"),
+    delay_s: float = 0.001,
+) -> Iterator[ChaosSpec]:
+    """Arm a seeded background chaos schedule for the duration of a
+    ``with`` block (at most one at a time): every fault point matching
+    ``points`` fires with probability ``rate``, kind drawn from
+    ``kinds``. The schedule is a pure function of ``seed`` — the
+    deterministic soak harness tests/test_wal.py drives under a
+    closed-loop writer+reader workload. Yields the spec so callers can
+    inspect ``hits`` / ``fired`` / ``log`` afterwards."""
+    spec = _GLOBAL.install_chaos(
+        ChaosSpec(seed, rate=rate, points=points, kinds=kinds, delay_s=delay_s)
+    )
+    try:
+        yield spec
+    finally:
+        _GLOBAL.remove_chaos(spec)
+
+
 def with_retries(
     fn: Callable,
     attempts: Optional[int] = None,
     backoff_s: Optional[float] = None,
     retry_on: tuple = (OSError,),
     sleep: Callable = time.sleep,
+    metrics=None,
+    rng: Optional[Callable] = None,
 ):
-    """Run ``fn()`` with bounded exponential-backoff retries on transient
+    """Run ``fn()`` with bounded decorrelated-jitter retries on transient
     IO errors (the reference's client retry policies around region-server
     blips). :class:`InjectedCrash` is a BaseException and always
-    propagates — a crash is not a transient fault."""
+    propagates — a crash is not a transient fault.
+
+    Backoff: decorrelated jitter — ``sleep_i ~ U(base, min(cap,
+    3 * sleep_{i-1}))`` with ``cap = base * 2**(attempts - 1)`` — so N
+    concurrent flush workers tripping over the same transient point
+    spread their retries instead of re-colliding in exponential
+    lockstep (the thundering-herd fix). ``rng(lo, hi)`` overrides the
+    draw for deterministic tests (default: ``random.uniform``).
+
+    Observability: ``geomesa.fault.retry`` counts every absorbed
+    transient failure, ``geomesa.fault.retries_exhausted`` every
+    operation re-raised past its budget; ``metrics`` is a
+    MetricsRegistry (None = the process-global fallback)."""
+    from geomesa_tpu.metrics import resolve
+
     if attempts is None:
         attempts = int(os.environ.get("GEOMESA_TPU_IO_RETRIES", DEFAULT_RETRIES))
     if backoff_s is None:
         backoff_s = float(
             os.environ.get("GEOMESA_TPU_IO_BACKOFF_S", DEFAULT_BACKOFF_S)
         )
+    if rng is None:
+        rng = random.uniform
     attempts = max(1, attempts)
+    cap = backoff_s * (2 ** (attempts - 1))
+    prev = backoff_s
     for attempt in range(attempts):
         try:
             return fn()
         except retry_on:
             if attempt == attempts - 1:
+                resolve(metrics).counter("geomesa.fault.retries_exhausted")
                 raise
-            sleep(backoff_s * (2 ** attempt))
+            resolve(metrics).counter("geomesa.fault.retry")
+            prev = rng(backoff_s, max(min(cap, prev * 3), backoff_s))
+            sleep(prev)

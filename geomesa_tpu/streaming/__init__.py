@@ -12,7 +12,12 @@ ingest meeting live queries:
   per flush into ``DataStore.fold_upsert``'s incremental merge;
 - :class:`LambdaStore` — the hot/cold hybrid (reference
   LambdaDataStore): exact hot-wins-by-id reads under concurrent
-  flushes, scheduler-admitted cold scans;
+  flushes, scheduler-admitted cold scans, WAL-backed durability and
+  :meth:`~geomesa_tpu.streaming.store.LambdaStore.recover` crash
+  recovery;
+- :class:`WriteAheadLog` / :class:`WalConfig` — the segmented,
+  checksummed write-ahead log under the hot tier (round 10;
+  docs/durability.md "Streaming WAL");
 - :class:`FeatureStream` — derived-view topologies over a change
   stream (the geomesa-kafka streams analogue).
 """
@@ -21,8 +26,9 @@ from geomesa_tpu.streaming.cache import StreamingFeatureCache
 from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
 from geomesa_tpu.streaming.store import LambdaStore
 from geomesa_tpu.streaming.stream import FeatureStream
+from geomesa_tpu.streaming.wal import WalConfig, WriteAheadLog
 
 __all__ = [
     "StreamingFeatureCache", "StreamConfig", "StreamFlusher",
-    "LambdaStore", "FeatureStream",
+    "LambdaStore", "FeatureStream", "WalConfig", "WriteAheadLog",
 ]

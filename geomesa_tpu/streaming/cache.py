@@ -144,18 +144,25 @@ class StreamingFeatureCache:
             )
         return n
 
+    def _resolve_id_locked(self, row, ids, i) -> str:
+        """The ONE id-resolution precedence (explicit ids -> ``__id__``
+        -> auto counter), shared by :meth:`upsert` and
+        :meth:`assign_ids` so the id the WAL logs can never drift from
+        the id the hot tier applies."""
+        if ids is not None:
+            return str(ids[i])
+        if "__id__" in row:
+            return str(row["__id__"])
+        fid = str(self._next_id)
+        self._next_id += 1
+        return fid
+
     def _upsert_chunk(self, rows, ids) -> int:
         now = int(_time.time() * 1000)
         with self._lock:
             applied = []
             for i, row in enumerate(rows):
-                if ids is not None:
-                    fid = str(ids[i])
-                elif "__id__" in row:
-                    fid = str(row["__id__"])
-                else:
-                    fid = str(self._next_id)
-                    self._next_id += 1
+                fid = self._resolve_id_locked(row, ids, i)
                 if "__id__" in row:
                     row = {k: v for k, v in row.items() if k != "__id__"}
                 g = row.get(self.sft.geom_field)
@@ -175,10 +182,55 @@ class StreamingFeatureCache:
                 self._bump_gen(applied)
             return len(rows)
 
-    def delete(self, ids: Sequence[str]) -> int:
+    def assign_ids(self, rows: Sequence[Mapping],
+                   ids: Sequence[str] | None) -> tuple[list, int]:
+        """Resolve the id each row of a batch will upsert under —
+        explicit ``ids``, the row's ``__id__``, or the auto-id counter
+        (CONSUMED here, exactly as :meth:`upsert` would) — without
+        applying anything. The WAL path uses this so the log records
+        resolved ids and recovery never re-draws the counter (a replayed
+        auto-id colliding with a fresh one would silently replace a
+        live row). Returns ``(ids, next auto-id counter value)``; pass
+        the ids back into :meth:`upsert`."""
+        with self._lock:
+            out = [self._resolve_id_locked(row, ids, i)
+                   for i, row in enumerate(rows)]
+            return out, self._next_id
+
+    def bump_next_id(self, value: int) -> None:
+        """Raise the auto-id counter to at least ``value`` (WAL replay:
+        restores the counter recorded at append time so post-recovery
+        auto-ids continue past every replayed one)."""
+        with self._lock:
+            self._next_id = max(self._next_id, int(value))
+
+    def snapshot_pairs(self, ids: Sequence[str]) -> list[tuple[str, dict]]:
+        """The resident ``(id, row)`` pairs for a subset of ids, in the
+        given order, skipping absent ids — the WAL flush-watermark
+        replay's input (same shared-row contract as
+        :meth:`snapshot_rows`)."""
+        with self._lock:
+            return [
+                (fid, self._rows[fid])
+                for fid in (str(i) for i in ids)
+                if fid in self._rows
+            ]
+
+    def delete(self, ids: Sequence[str],
+               after_remove: Optional[Callable] = None) -> int:
+        """Remove rows by id. ``after_remove(removed_ids)`` runs under
+        the lock AFTER the removals — the WAL hook: the record is
+        logged atomically with its application, so no write serialized
+        after this delete can be outrun by the delete's record on
+        replay. A raising hook leaves the removals applied (the op is
+        then un-acknowledged but consistent either way on recovery:
+        record durable -> replay deletes too; record lost -> the
+        unacknowledged delete is undone). Same caveat as listeners: the
+        hook must not block on another thread's cache access."""
         with self._lock:
             n = 0
             removed = []
+            removed_ids = []
             for fid in ids:
                 fid = str(fid)
                 row = self._rows.pop(fid, None)
@@ -188,9 +240,12 @@ class StreamingFeatureCache:
                     self.index.remove(fid)
                     self._notify("removed", fid, row)
                     removed.append(row)
+                    removed_ids.append(fid)
                     n += 1
             if removed:
                 self._bump_gen(removed)
+            if removed_ids and after_remove is not None:
+                after_remove(removed_ids)
             return n
 
     def evict(self, pairs: Sequence[tuple]) -> int:
@@ -251,8 +306,17 @@ class StreamingFeatureCache:
             for fid in list(self._rows):
                 self.delete([fid])
 
-    def expire(self, now_ms: Optional[int] = None) -> int:
-        """Sweep features older than expiry_ms; returns count expired."""
+    def expire(self, now_ms: Optional[int] = None,
+               on_swept: Optional[Callable] = None) -> int:
+        """Sweep features older than expiry_ms; returns count expired.
+        ``on_swept(stale_ids)`` runs under the lock AFTER the removals —
+        the WAL hook: the sweep is wall-clock-driven (not replayable),
+        so the exact swept ids hit the log, atomically with their
+        application (an upsert serialized after the sweep can never be
+        outrun by the sweep's record on replay). A raising hook leaves
+        the sweep applied — consistent either way on recovery, like
+        :meth:`delete`'s hook. Same caveat as listeners: the hook must
+        not block on another thread's cache access."""
         if self.expiry_ms is None:
             return 0
         now = int(_time.time() * 1000) if now_ms is None else now_ms
@@ -269,6 +333,8 @@ class StreamingFeatureCache:
                 expired.append(row)
             if expired:
                 self._bump_gen(expired)
+            if stale and on_swept is not None:
+                on_swept(list(stale))
             return len(stale)
 
     # -- queries ---------------------------------------------------------

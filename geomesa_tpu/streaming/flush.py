@@ -250,7 +250,7 @@ class StreamFlusher:
                 fault.fault_point("streaming.persist")
                 return self.store.upsert(self.type_name, fc)
 
-            return fault.with_retries(attempt_legacy)
+            return fault.with_retries(attempt_legacy, metrics=self.metrics)
 
         keys: dict = {}
         presorted: dict = {}
@@ -283,4 +283,4 @@ class StreamFlusher:
                 presorted=presorted or None,
             )
 
-        return fault.with_retries(attempt)
+        return fault.with_retries(attempt, metrics=self.metrics)

@@ -13,7 +13,7 @@ historical name for the same operation.
 
 from __future__ import annotations
 
-import time
+import os
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
@@ -23,6 +23,9 @@ from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import INCLUDE
 from geomesa_tpu.streaming.cache import StreamingFeatureCache
 from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
+from geomesa_tpu.streaming.wal import WalConfig, WriteAheadLog, unpack_upsert
+
+WAL_DIR = "_wal"  # default WAL location under a store root
 
 
 class LambdaStore:
@@ -55,10 +58,39 @@ class LambdaStore:
     """
 
     def __init__(self, cold, type_name: str, expiry_ms: Optional[int] = None,
-                 config: "StreamConfig | None" = None):
+                 config: "StreamConfig | None" = None,
+                 wal: "WriteAheadLog | None" = None,
+                 wal_dir: "str | None" = None,
+                 wal_config: "WalConfig | None" = None):
         self.cold = cold
         self.type_name = type_name
         self.config = config if config is not None else StreamConfig.from_properties()
+        # durability (docs/durability.md "Streaming WAL"): with a WAL
+        # attached, every hot-tier mutation is logged BEFORE it is
+        # acknowledged; LambdaStore.recover(root) replays the log over
+        # the last checkpointed cold store. No WAL (the default) keeps
+        # the round-9 contract: the hot tier is process memory, durable
+        # only from the last checkpoint.
+        if wal is None and wal_dir is not None:
+            wal = WriteAheadLog(
+                wal_dir, config=wal_config,
+                metrics=getattr(cold, "metrics", None),
+            )
+            if wal.needs_recovery:
+                # continuing over unreplayed records would let the next
+                # checkpoint cover and RETIRE them without their effects
+                # ever reaching a store — permanent acknowledged-row
+                # loss through an innocent-looking constructor call
+                from geomesa_tpu.streaming.wal import WalError
+
+                wal.close()  # release the fd + interval sync thread
+                raise WalError(
+                    f"WAL at {wal_dir!r} holds records past its last "
+                    "checkpoint — open this store with "
+                    "LambdaStore.recover(root) so they replay (or pass "
+                    "an explicitly replayed WriteAheadLog via wal=)"
+                )
+        self.wal = wal
         self.hot = StreamingFeatureCache(
             cold.get_schema(type_name), expiry_ms,
             metrics=getattr(cold, "metrics", None),
@@ -85,7 +117,57 @@ class LambdaStore:
 
     # -- writes ----------------------------------------------------------
     def write(self, rows: Sequence[Mapping], ids: Sequence[str] | None = None) -> int:
+        """Apply a batch to the hot tier. With a WAL attached the batch
+        is logged (ids resolved, auto-ids consumed) and made durable to
+        the sync policy's guarantee BEFORE it applies — the return is
+        the acknowledgment: under ``sync=always`` an acknowledged batch
+        survives ``kill -9``."""
+        if self.wal is not None:
+            ids, next_id = self.hot.assign_ids(rows, ids)
+            seq = self.wal.log_upsert(ids, rows, next_id)
+            try:
+                n = self.hot.upsert(rows, ids)
+            finally:
+                # logged -> applied: the checkpoint cover (applied
+                # horizon) may now pass this record — before this, a
+                # concurrent checkpoint's snapshot could miss the rows
+                # while its cover skipped the record at replay (the
+                # acknowledged-loss race the chaos harness caught)
+                self.wal.applied(seq)
+            self._gauge_hot()
+            return n
         n = self.hot.upsert(rows, ids)
+        self._gauge_hot()
+        return n
+
+    def delete(self, ids: Sequence[str]) -> int:
+        """Remove live hot rows by id (the Kafka cache's delete
+        messages). Cold-resident copies of the ids are untouched — this
+        is the hot tier's delete, not a cold-store maintenance op.
+
+        Destructive ops log APPLY-THEN-RECORD, atomically under the hot
+        lock (the inverse of :meth:`write`'s record-then-apply): a
+        delete record that reached the disk can then never outrun a
+        later acknowledged re-upsert on replay, and a record whose
+        append failed describes a removal that really happened — either
+        way recovery can only converge, never lose an acknowledged
+        write. (The asymmetry is deliberate: an unacknowledged failed
+        DELETE may resurrect on recovery — allowed; an unacknowledged
+        failed WRITE must never be served first and lost after.)"""
+        ids = [str(i) for i in ids]
+        hook = self.wal.log_delete if self.wal is not None else None
+        n = self.hot.delete(ids, after_remove=hook)
+        self._gauge_hot()
+        return n
+
+    def expire(self, now_ms: Optional[int] = None) -> int:
+        """TTL sweep of the hot tier (requires ``expiry_ms``). The
+        swept ids hit the WAL atomically with the sweep, under the hot
+        lock (the sweep is wall-clock-driven, so replay needs the
+        decision, not the clock; apply-then-record like
+        :meth:`delete`)."""
+        hook = self.wal.log_expire if self.wal is not None else None
+        n = self.hot.expire(now_ms=now_ms, on_swept=hook)
         self._gauge_hot()
         return n
 
@@ -142,6 +224,7 @@ class LambdaStore:
             full = True
         if not incremental:
             n = self.flusher.flush(snapshot, incremental=False)
+            self._log_watermark(snapshot, incremental=False)
             fault.fault_point("streaming.evict")
             self.hot.evict(snapshot)
             self._gauge_hot()
@@ -162,6 +245,7 @@ class LambdaStore:
         if not batch:
             return 0
         n = self.flusher.flush(batch, incremental=True)
+        self._log_watermark(batch, incremental=True)
         fault.fault_point("streaming.evict")
         known.update(fid for fid, _ in batch)  # published: now cold-resident
         # identity-checked eviction: a write racing the publish keeps its
@@ -169,6 +253,18 @@ class LambdaStore:
         self.hot.evict(batch)
         self._gauge_hot()
         return n
+
+    def _log_watermark(self, batch: Sequence[tuple], incremental: bool) -> None:
+        """Flush-seqno watermark: the publish above committed (to the
+        in-process cold tier), so the WAL and the LSM flush policy agree
+        on what is cold-resident — replay re-folds exactly this batch.
+        Written AFTER the publish: a crash between publish and watermark
+        recovers the rows HOT (the in-process cold tier died with the
+        process), which the next flush re-publishes — never a loss.
+        Watermarks do NOT retire segments; only a checkpoint (durable
+        save) does."""
+        if self.wal is not None:
+            self.wal.log_watermark([fid for fid, _ in batch], incremental)
 
     def persist_hot(self, incremental: "bool | None" = None) -> int:
         """Full persist (the round 1-8 API): drain the ENTIRE hot tier —
@@ -182,12 +278,102 @@ class LambdaStore:
         through the crash-safe v3 path (storage.persist.save — atomic
         renames, checksums, per-step retry). A failure at any point
         leaves the previous on-disk store and the hot/cold state
-        consistent. Returns rows flushed from the hot tier."""
+        consistent. Returns rows flushed from the hot tier.
+
+        With a WAL attached, a checkpoint watermark lands (force-synced)
+        only AFTER ``persist.save`` commits, and sealed segments the
+        watermark covers retire. A crash anywhere inside the save —
+        including after the flush published to the in-process cold tier
+        — leaves the watermark unwritten, so ``recover(root)`` replays
+        the retained records over the previous on-disk store and loses
+        nothing (the crash-matrix interleaving
+        tests/test_wal.py pins)."""
         from geomesa_tpu.storage import persist
 
+        # the cover seqno is captured BEFORE the drain, and only up to
+        # the APPLIED horizon: every record at or below it has reached
+        # the hot tier, so the full flush + save reflects it; a write
+        # racing the checkpoint (logged, not yet applied, or acked
+        # after this capture) keeps its record and replays
+        cover = self.wal.applied_horizon() if self.wal is not None else 0
         n = self.flush(full=True)
         persist.save(self.cold, root)
+        if self.wal is not None:
+            self.wal.checkpoint(cover)
         return n
+
+    # -- recovery ---------------------------------------------------------
+    @classmethod
+    def recover(cls, root: str, type_name: "str | None" = None,
+                wal_dir: "str | None" = None,
+                expiry_ms: Optional[int] = None,
+                config: "StreamConfig | None" = None,
+                wal_config: "WalConfig | None" = None,
+                on_damage: str = "quarantine", **load_kwargs) -> "LambdaStore":
+        """Open-time crash recovery: load the cold store from ``root``
+        (the verified v3 path — quarantine + degraded health on damage),
+        open the WAL at ``wal_dir`` (default ``<root>/_wal``), and
+        replay every record past the last checkpoint watermark —
+        re-applying acknowledged mutations to the hot tier and re-folding
+        flush watermarks into the cold tier — so the recovered store
+        answers queries exactly as the never-crashed store would
+        (bit-identically, for a non-racing op stream: same hot rows,
+        same cold tables). Torn WAL tails truncate; checksum-damaged
+        tails quarantine under ``<root>/_quarantine/_wal/`` and surface
+        on ``cold.store_health``. The returned store continues logging
+        to the same WAL."""
+        from geomesa_tpu.storage import persist
+
+        cold = persist.load(root, on_damage=on_damage, **load_kwargs)
+        if type_name is None:
+            names = cold.type_names()
+            if len(names) != 1:
+                raise ValueError(
+                    f"recover() needs type_name for a multi-type store "
+                    f"(found {sorted(names)!r})"
+                )
+            type_name = names[0]
+        if wal_dir is None:
+            wal_dir = os.path.join(str(root), WAL_DIR)
+        wal = WriteAheadLog(
+            wal_dir, config=wal_config,
+            metrics=getattr(cold, "metrics", None),
+            quarantine_root=str(root),
+        )
+        store = cls(cold, type_name, expiry_ms=expiry_ms, config=config,
+                    wal=wal)
+        store._replay()
+        if wal.damage:
+            # WAL damage joins the store's health surface (type "_wal"):
+            # the operator sees ONE degraded-status report for disk and
+            # log damage alike
+            cold.health.damage.extend(wal.damage)
+        return store
+
+    def _replay(self) -> None:
+        """Apply the WAL's post-checkpoint records in order: upserts/
+        deletes/expiry sweeps rebuild the hot tier; flush watermarks
+        re-publish exactly the batch the live store published (through
+        the same flusher + fold), so hot/cold placement matches the
+        never-crashed store. Idempotent: replaying records whose effects
+        are already in the loaded cold store converges to the same
+        query results (latest-wins upserts, identity-checked evicts)."""
+        for rec in self.wal.replay():
+            kind = rec.get("k")
+            if kind == "u":
+                self.hot.upsert(unpack_upsert(rec), rec["ids"])
+                self.hot.bump_next_id(rec.get("nid", 0))
+            elif kind in ("d", "x"):  # delete / expiry sweep: same effect
+                self.hot.delete(rec["ids"])
+            elif kind == "w":
+                pairs = self.hot.snapshot_pairs(rec["ids"])
+                if pairs:
+                    self.flusher.flush(
+                        pairs, incremental=bool(rec.get("inc", True))
+                    )
+                    self._known_cold.update(fid for fid, _ in pairs)
+                    self.hot.evict(pairs)
+        self._gauge_hot()
 
     # -- serving ---------------------------------------------------------
     def serve(self, config=None):
@@ -252,5 +438,8 @@ class LambdaStore:
         return len(self.query(f))
 
     def close(self) -> None:
-        """Release the flusher's worker pool (idempotent)."""
+        """Release the flusher's worker pool and seal the WAL
+        (idempotent)."""
         self.flusher.close()
+        if self.wal is not None:
+            self.wal.close()

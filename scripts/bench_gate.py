@@ -24,9 +24,13 @@ Checks, per scenario present in BOTH files:
 - the guarded metric may not regress by more than --max-regress
   (default 0.20 = 20%) against the baseline — cost metrics
   (``raster_ms_per_q``, ``raster_ms``, ``adaptive_ms``) may not rise,
-  throughput metrics (``streamed_rows_per_s``) may not fall;
+  throughput metrics (``streamed_rows_per_s``,
+  ``wal_interval_rows_per_s``, ``replay_rows_per_s``) may not fall;
 - every ``identical`` flag in the fresh run must be true — a speedup
-  that changed answers is a bug, not a win.
+  that changed answers is a bug, not a win;
+- within-run bounds on the fresh file alone (FRESH_BOUNDS): the
+  streaming WAL's ``sync=interval`` overhead must stay within 15% of
+  the same run's no-WAL throughput (``interval_over_nowal >= 0.85``).
 
 Exit code 0 = pass, 1 = regression / broken identity, 2 = unusable input.
 """
@@ -46,10 +50,25 @@ SCENARIO_SPECS = {
     "z2_polygon_join": ("raster_ms", "lower"),
     "host_grid_join": ("adaptive_ms", "lower"),
     "stream_sustained": ("streamed_rows_per_s", "higher"),
+    "stream_wal": ("wal_interval_rows_per_s", "higher"),
+    "wal_replay": ("replay_rows_per_s", "higher"),
+}
+
+# within-run invariants checked on the FRESH file alone (no baseline
+# needed): scenario -> (field, minimum, message). The WAL bound is the
+# ISSUE 10 acceptance: sync=interval overhead within 15% of no-WAL.
+FRESH_BOUNDS = {
+    "stream_wal": (
+        "interval_over_nowal", 0.85,
+        "sync=interval throughput must stay within 15% of no-WAL",
+    ),
 }
 
 # fresh-file basename marker -> committed baseline it gates against
-BASELINES = {"BENCH_STREAM": "BENCH_STREAM.json"}
+BASELINES = {
+    "BENCH_STREAM": "BENCH_STREAM.json",
+    "BENCH_WAL": "BENCH_WAL.json",
+}
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 
 
@@ -89,6 +108,14 @@ def gate(fresh_path: str, baseline_path: str, max_regress: float) -> int:
               file=sys.stderr)
         return 2
     failed = False
+    for s, (field, lo, why) in FRESH_BOUNDS.items():
+        if s not in fresh or field not in fresh[s]:
+            continue
+        val = float(fresh[s][field])
+        verdict = "FAIL" if val < lo else "ok"
+        print(f"{verdict:4s} {s}: {field} {val:.3f} (floor {lo}; {why})")
+        if val < lo:
+            failed = True
     for s in shared:
         field, direction = SCENARIO_SPECS[s]
         f_row, b_row = fresh[s], base[s]

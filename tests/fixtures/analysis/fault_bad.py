@@ -1,0 +1,9 @@
+"""Known-bad fixture: fires a fault point no registry declares — the
+typo'd/renamed-point failure mode where a crash test arms a name the
+code never reaches and passes vacuously (fault-point-unknown)."""
+
+from geomesa_tpu import fault
+
+
+def save_with_typo():
+    fault.fault_point("streem.wal.append")  # typo: streem

@@ -1,0 +1,12 @@
+"""Known-good fixture: a registered, test-exercised fault point plus a
+non-literal name (skipped — covered at its literal call sites)."""
+
+from geomesa_tpu import fault
+
+
+def publish():
+    fault.fault_point("streaming.persist")
+
+
+def dynamic(point: str):
+    fault.fault_point(f"{point}.write")  # non-literal: out of scope

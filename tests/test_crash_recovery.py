@@ -415,7 +415,11 @@ class TestRetryAndEnv:
         with pytest.raises(ValueError):
             fault.injector().load_env()
 
-    def test_with_retries_backoff_sequence(self):
+    def test_with_retries_backoff_jitter_bounds(self):
+        """Decorrelated jitter (the thundering-herd fix): every sleep
+        draws from U(base, min(cap, 3 * prev)) with cap = base *
+        2^(attempts-1) — bounded like the old exponential schedule, but
+        concurrent workers no longer retry in lockstep."""
         sleeps = []
         calls = {"n": 0}
 
@@ -429,7 +433,42 @@ class TestRetryAndEnv:
             flaky, attempts=4, backoff_s=0.01, sleep=sleeps.append
         )
         assert out == "ok"
-        assert sleeps == [0.01, 0.02, 0.04]  # exponential
+        assert len(sleeps) == 3
+        assert all(0.01 <= s <= 0.08 for s in sleeps), sleeps
+        # the rng seam pins the exact schedule for deterministic tests:
+        # hi_i = min(cap, 3 * prev) starting from prev = base
+        sleeps2, calls["n"] = [], 0
+        fault.with_retries(
+            flaky, attempts=4, backoff_s=0.01, sleep=sleeps2.append,
+            rng=lambda lo, hi: hi,
+        )
+        assert sleeps2 == [0.03, 0.08, 0.08]
+
+    def test_with_retries_counters(self):
+        """geomesa.fault.retry / retries_exhausted observability: every
+        absorbed transient counts, every budget exhaustion counts."""
+        reg = MetricsRegistry()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("blip")
+            return "ok"
+
+        fault.with_retries(
+            flaky, attempts=3, backoff_s=0.0001, metrics=reg
+        )
+        assert reg.counter_value("geomesa.fault.retry") == 2
+        assert reg.counter_value("geomesa.fault.retries_exhausted") == 0
+
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            fault.with_retries(dead, attempts=2, backoff_s=0.0001, metrics=reg)
+        assert reg.counter_value("geomesa.fault.retry") == 3
+        assert reg.counter_value("geomesa.fault.retries_exhausted") == 1
 
     def test_crash_is_never_retried(self):
         calls = {"n": 0}
@@ -493,6 +532,103 @@ class TestStreamingFlush:
         assert _ids(persist.load(root)) == old  # on-disk store intact
         lam.checkpoint(root)  # hot already flushed to cold; save converges
         assert sorted(old + ["h0", "h1"]) == _ids(persist.load(root))
+
+
+class TestChaosSchedule:
+    """fault.chaos: the seeded background schedule (the closed-loop
+    harness lives in tests/test_wal.py; this pins the API contract)."""
+
+    def test_schedule_is_deterministic(self):
+        runs = []
+        for _ in range(2):
+            fired = []
+            with fault.chaos(seed=5, rate=0.5, points="demo.*",
+                             kinds=("io_error",), delay_s=0.0) as spec:
+                for i in range(30):
+                    try:
+                        fault.fault_point("demo.p")
+                    except OSError:
+                        fired.append(i)
+            assert spec.hits == 30 and spec.fired == len(fired)
+            runs.append(fired)
+        assert runs[0] == runs[1] and runs[0]  # same seed, same schedule
+
+    def test_non_matching_points_never_fire(self):
+        with fault.chaos(seed=1, rate=1.0, points="persist.*") as spec:
+            fault.fault_point("stream.wal.append")
+        assert spec.hits == 0 and spec.fired == 0
+
+    def test_validation_and_single_schedule(self):
+        with pytest.raises(ValueError, match="rate"):
+            fault.ChaosSpec(1, rate=1.5)
+        with pytest.raises(ValueError, match="kind"):
+            fault.ChaosSpec(1, kinds=("segfault",))
+        with fault.chaos(seed=1):
+            with pytest.raises(RuntimeError, match="already installed"):
+                fault.injector().install_chaos(fault.ChaosSpec(2))
+        # the exit released the slot
+        with fault.chaos(seed=3):
+            pass
+
+
+class TestFaultPointCoverage:
+    """Every FAULT_POINTS entry must be exercised by some test (the
+    fault-point-unknown lint rule's coverage direction); these arm the
+    points no recovery scenario above reaches, asserting the spec FIRED
+    — a renamed point turns these into hard failures, not vacuous
+    passes."""
+
+    def test_load_partition_read_transient_fault_retried(self, tmp_path):
+        ds = _store(n=40)
+        persist.save(ds, tmp_path / "s")
+        with fault.inject("load.partition.read", kind="io_error",
+                          times=1) as spec:
+            back = persist.load(tmp_path / "s")
+        assert spec.fired == 1
+        assert _ids(back) == _ids(ds)
+        assert back.store_health.status == "ok"
+
+    def test_metadata_write_and_rename_points_fire(self, tmp_path):
+        from geomesa_tpu.storage.metadata import FileMetadata
+
+        md = FileMetadata(str(tmp_path / "md"))
+        with fault.inject("metadata.write", kind="latency", times=None,
+                          delay_s=0.0) as w:
+            with fault.inject("metadata.rename", kind="io_error",
+                              times=1) as r:
+                md.insert("schema/t", "spec")  # one blip, retried inside
+        assert w.fired >= 1 and r.fired == 1
+        assert md.get("schema/t") == "spec"
+
+    def test_adapter_create_table_point_fires(self):
+        with fault.inject("adapter.create_table", kind="latency",
+                          times=None, delay_s=0.0) as spec:
+            ds = _store(n=30)
+            ds.compact("t")
+        assert spec.fired >= 1
+        assert ds.count("t") == 30
+
+    def test_ingest_parse_point_fires(self, tmp_path):
+        from geomesa_tpu import ingest as ing
+        from geomesa_tpu.io.converters import Converter, FieldSpec
+
+        p = tmp_path / "d.csv"
+        p.write_text("name,lon,lat\n" + "".join(
+            f"r{i},{i % 50},{i % 40}\n" for i in range(30)
+        ))
+        sft = FeatureType.from_spec("t", "name:String,*geom:Point:srid=4326")
+        conv = Converter(
+            sft=sft, fmt="delimited", skip_lines=1, id_field="$1",
+            fields=[FieldSpec("name", "$1"),
+                    FieldSpec("geom", "point($2, $3)")],
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        with fault.inject("ingest.parse", kind="latency", times=None,
+                          delay_s=0.0) as spec:
+            res = ing.ingest_files(ds, conv, [str(p)], workers=0)
+        assert spec.fired >= 1
+        assert res.written == 30 == ds.count("t")
 
 
 class TestSignature:

@@ -108,15 +108,29 @@ def test_durability_doc_apis_exist():
                  "StoreHealth", "DamageRecord"):
         assert hasattr(persist, name), name
     for name in ("inject", "with_retries", "fault_point", "injector",
-                 "InjectedCrash", "InjectedIOError"):
+                 "InjectedCrash", "InjectedIOError", "chaos", "ChaosSpec"):
         assert hasattr(fault, name), name
     assert set(fault.KINDS) == {
         "io_error", "crash", "partial_write", "bit_flip", "latency",
     }
     assert isinstance(DataStore.store_health, property)
-    for m in ("persist_hot", "checkpoint"):
+    for m in ("persist_hot", "checkpoint", "recover", "write", "delete",
+              "expire"):
         assert hasattr(LambdaStore, m), m
     assert "on_damage" in inspect.signature(persist.load).parameters
+    # the streaming WAL surface the doc's "Streaming WAL" section names
+    from geomesa_tpu.streaming import WalConfig, WriteAheadLog
+
+    for m in ("append", "sync", "replay", "checkpoint", "retire", "close"):
+        assert hasattr(WriteAheadLog, m), m
+    for f in ("sync", "sync_interval_ms", "segment_bytes"):
+        assert f in WalConfig.__dataclass_fields__, f
+    for p in ("wal", "wal_dir", "wal_config"):
+        assert p in inspect.signature(LambdaStore.__init__).parameters, p
+    for p in ("metrics", "rng"):
+        assert p in inspect.signature(fault.with_retries).parameters, p
+    for p in ("seed", "rate", "points", "kinds"):
+        assert p in inspect.signature(fault.chaos).parameters, p
 
 
 def test_migration_guide_dotted_names_resolve():

@@ -503,6 +503,37 @@ class TestBenchGate:
         assert gate.default_baseline("/x/fresh.json", repo) == (
             f"{repo}/BENCH_PIP_JOIN.json"
         )
+        assert gate.default_baseline("/x/BENCH_WAL_fresh.json", repo) == (
+            f"{repo}/BENCH_WAL.json"
+        )
+
+    def _wal_payload(self, rps, ratio, identical=True):
+        return {"rows": [
+            {"scenario": "stream_wal", "wal_interval_rows_per_s": rps,
+             "nowal_rows_per_s": rps / ratio,
+             "interval_over_nowal": ratio, "identical": identical},
+        ]}
+
+    def test_wal_within_run_overhead_bound(self, tmp_path):
+        """The ISSUE 10 acceptance bound is checked on the FRESH file
+        alone: sync=interval throughput must stay within 15% of the
+        same run's no-WAL path, regardless of how the baseline did."""
+        import json
+
+        gate = self._load_gate()
+        base = tmp_path / "BENCH_WAL.json"
+        base.write_text(json.dumps(self._wal_payload(50_000.0, 0.95)))
+        ok = tmp_path / "BENCH_WAL_ok.json"
+        ok.write_text(json.dumps(self._wal_payload(51_000.0, 0.90)))
+        heavy = tmp_path / "BENCH_WAL_heavy.json"
+        heavy.write_text(json.dumps(self._wal_payload(52_000.0, 0.70)))
+        slow = tmp_path / "BENCH_WAL_slow.json"
+        slow.write_text(json.dumps(self._wal_payload(30_000.0, 0.95)))
+        assert gate.gate(str(ok), str(base), 0.20) == 0
+        # overhead bound fails even though throughput beat the baseline
+        assert gate.gate(str(heavy), str(base), 0.20) == 1
+        # and the baseline comparison still guards absolute throughput
+        assert gate.gate(str(slow), str(base), 0.20) == 1
 
 
 class TestValidators:

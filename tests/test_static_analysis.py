@@ -175,6 +175,27 @@ def test_kernel_purity_hazards_are_caught(fixture_result):
         assert str(f.line) not in f.key, f.key
 
 
+def test_unregistered_fault_point_is_caught(fixture_result):
+    """A typo'd fault-point literal (the vacuous-crash-test failure
+    mode) is flagged; registered points and non-literal names pass."""
+    bad = _at(fixture_result, "fault_bad.py", "fault-point-unknown")
+    assert len(bad) == 1, _render(bad)
+    assert "streem.wal.append" in bad[0].message
+    assert _at(fixture_result, "fault_good.py") == []
+
+
+def test_fault_point_registry_matches_kinds():
+    """Registry hygiene: FAULT_POINTS names are dotted, lowercase, and
+    every one resolves to a real code site in the clean-tree run (the
+    unreached/unexercised directions of the rule)."""
+    from geomesa_tpu.analysis.registries import FAULT_POINTS
+
+    assert len(FAULT_POINTS) >= 25
+    for name, doc in FAULT_POINTS.items():
+        assert "." in name and name == name.lower() and " " not in name, name
+        assert doc, name
+
+
 def test_fstring_family_reported_once(fixture_result):
     """An f-string fragment is scanned exactly once: the JoinedStr
     branch owns it, the plain-Constant walk must skip it (the
