@@ -27,7 +27,8 @@ Prints one JSON line per config, config 1 first. Env knobs:
 GEOMESA_BENCH_N (config-1 points), GEOMESA_BENCH_N2, GEOMESA_BENCH_N3,
 GEOMESA_BENCH_N4, GEOMESA_BENCH_N5, GEOMESA_BENCH_QUERIES,
 GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"; named scenarios "cache",
-"serving", "ingest", "fused", "pip_join", "stream"), GEOMESA_BENCH_PLATFORM
+"serving", "ingest", "fused", "pip_join", "stream", "wal", "knn"),
+GEOMESA_BENCH_PLATFORM
 (e.g. "cpu" for off-TPU verification). Supervisor knobs (see main()):
 GEOMESA_BENCH_INIT_TIMEOUT (child device-init watchdog, s),
 GEOMESA_BENCH_INIT_RETRIES (attempts), GEOMESA_BENCH_ATTEMPT_TIMEOUT
@@ -1674,17 +1675,31 @@ def config_stream(out_path: "str | None" = None):
         t.join()
     streamed_rps = flushes * batch / streamed_s
     # SLO accounting: steady-state micro-batch queries vs queries that
-    # overlapped the fold window (queue behind the one O(table) device
-    # re-upload — the LSM "GC pause", reported separately)
+    # overlapped the fold window. Round 11 killed the monolithic pause
+    # (pre-staged parse/keys + sliced publishes + scheduler yielding —
+    # docs/streaming.md "Incremental fold"): the window is now a train
+    # of bounded per-slice pauses, reported as a histogram, and the
+    # in-window query p99 is gated against steady state
     steady = np.array([d for s, d in lat if s + d < fold_t0]) * 1e3
     in_fold = np.array([d for s, d in lat if s + d >= fold_t0]) * 1e3
     p50 = float(np.percentile(steady, 50)) if len(steady) else 0.0
     p99 = float(np.percentile(steady, 99)) if len(steady) else 0.0
     fold_p99 = float(np.percentile(in_fold, 99)) if len(in_fold) else 0.0
+    report = getattr(ds, "last_fold_report", None) or {}
+    slice_ms = np.array(report.get("slice_s", [])) * 1e3
+    fold_hist = {
+        "count": int(len(slice_ms)),
+        "p50_ms": round(float(np.percentile(slice_ms, 50)), 2) if len(slice_ms) else 0.0,
+        "p99_ms": round(float(np.percentile(slice_ms, 99)), 2) if len(slice_ms) else 0.0,
+        "max_ms": round(float(slice_ms.max()), 2) if len(slice_ms) else 0.0,
+    }
+    prestaged = reg.counter_value("geomesa.stream.fold.prestaged")
     log(
         f"[stream] streamed path: {streamed_rps:,.0f} rows/s with "
         f"{len(lat)} concurrent queries (steady p99 {p99:.1f} ms; "
-        f"fold pause {fold_t1 - fold_t0:.2f}s, in-fold p99 {fold_p99:.1f} ms)"
+        f"fold window {fold_t1 - fold_t0:.2f}s over {fold_hist['count']} "
+        f"slices, max slice pause {fold_hist['max_ms']:.0f} ms, "
+        f"in-window p99 {fold_p99:.1f} ms, {prestaged} rows pre-staged)"
     )
 
     # -- exactness: streamed store vs batch-loaded oracle ----------------
@@ -1744,11 +1759,20 @@ def config_stream(out_path: "str | None" = None):
 
     speedup = streamed_rps / max(legacy_rps, 1e-9)
     slo_met = bool(p99 <= slo_ms) if len(steady) else True
+    # the round-11 acceptance bar: query p99 INSIDE the fold window must
+    # stay within 2x the steady-state p99 (the pause-kill claim, gated by
+    # scripts/bench_gate.py FRESH_BOUNDS as a within-run invariant)
+    fold_over_steady = round(fold_p99 / max(p99, 1e-9), 2) if len(in_fold) else 0.0
     row = {
         "scenario": "stream_sustained",
         "cold_rows": n,
         "batch_rows": batch,
         "flushes": flushes,
+        # absolute rows/s and latencies are HOST-dependent (the round-9
+        # baseline ran on 2 cores; round 11 re-pinned on 1): record the
+        # run's core count so a baseline comparison across hosts is
+        # interpretable in the artifact itself
+        "host_cores": os.cpu_count(),
         "legacy_rows_per_s": round(legacy_rps, 1),
         "streamed_rows_per_s": round(streamed_rps, 1),
         "speedup": round(speedup, 2),
@@ -1761,15 +1785,24 @@ def config_stream(out_path: "str | None" = None):
             "p99_ms": round(p99, 2),
             "slo_ms": slo_ms,
             "slo_met": slo_met,
-            "fold_pause_s": round(fold_t1 - fold_t0, 2),
+            "fold_window_s": round(fold_t1 - fold_t0, 2),
             "in_fold_queries": int(len(in_fold)),
-            "in_fold_p99_ms": round(fold_p99, 2),
+            "fold_window_p99_ms": round(fold_p99, 2),
+            "fold_window_p99_over_steady": fold_over_steady,
         },
+        "fold": {
+            "rows": int(report.get("rows", 0)),
+            "slices": int(report.get("slices", 0)),
+            "prestaged_rows": int(prestaged),
+            "slice_pause_ms": fold_hist,
+        },
+        **LINK_PROFILE,
     }
     log(
         f"[stream] sustained {streamed_rps:,.0f} vs legacy "
         f"{legacy_rps:,.0f} rows/s = {speedup:.2f}x, identical={identical}, "
-        f"steady p99 {p99:.1f} ms (SLO {slo_ms:.0f} ms, met={slo_met})"
+        f"steady p99 {p99:.1f} ms (SLO {slo_ms:.0f} ms, met={slo_met}), "
+        f"fold-window p99 {fold_p99:.1f} ms = {fold_over_steady}x steady"
     )
 
     import jax
@@ -1797,6 +1830,126 @@ def config_stream(out_path: "str | None" = None):
         "query_p99_ms": row["query"]["p99_ms"],
         "slo_met": slo_met,
         "cold_rows": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def config_knn(out_path: "str | None" = None):
+    """Batched kNN throughput scenario (round 11; VERDICT weak #5's
+    34.7 q/s vs the 60 q/s bar): ``knn_many`` over trajectory-shaped
+    points — every pending query's speculative wide window rides ONE
+    ``planner.submit_many`` sweep per round, fusing into shared
+    ``block_scan_multi`` dispatches (round 11 halved the per-query
+    windows: the estimate radius resolves from the wide window's own
+    result, see process/knn.py).
+
+    Exactness is computed in-bench: every measured query's result must
+    match (ids, in order) both the per-point ``knn_search`` protocol and
+    a brute-force full-scan haversine top-k oracle -> the ``identical``
+    flag ``scripts/bench_gate.py`` enforces alongside the q/s floor.
+
+    Emits BENCH_KNN.json (or ``out_path`` / env GEOMESA_BENCH_KNN_OUT —
+    use a SCRATCH path for the fresh side of a gate comparison). Env:
+    GEOMESA_BENCH_KNN_N (points), GEOMESA_BENCH_KNN_QUERIES."""
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.process import knn_many, knn_search
+    from geomesa_tpu.process.knn import haversine_m
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_KNN_N", 2_000_000))
+    n_q = int(os.environ.get("GEOMESA_BENCH_KNN_QUERIES", 64))
+    k = 10
+    rng = np.random.default_rng(SEED + 50)
+    n_tracks = max(n // 4000, 8)
+    per = n // n_tracks
+    sx = rng.uniform(-170, 170, n_tracks)
+    sy = rng.uniform(-75, 75, n_tracks)
+    x = np.clip(
+        (sx[:, None] + np.cumsum(rng.normal(0, 0.02, (n_tracks, per)), axis=1)).ravel(),
+        -180, 180,
+    )
+    y = np.clip(
+        (sy[:, None] + np.cumsum(rng.normal(0, 0.015, (n_tracks, per)), axis=1)).ravel(),
+        -90, 90,
+    )
+    log(f"[knn] building {len(x):,} point store ...")
+    sft = FeatureType.from_spec("ais", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    ds = DataStore()
+    ds.create_schema(sft)
+    ds.write(
+        "ais",
+        FeatureCollection.from_columns(sft, np.arange(len(x)), {"geom": (x, y)}),
+        check_ids=False,
+    )
+    qs = [
+        (float(rng.uniform(-150, 150)), float(rng.uniform(-60, 60)))
+        for _ in range(n_q)
+    ]
+    knn_search(ds, "ais", *qs[0], k=k)  # warmup compiles
+    knn_many(ds, "ais", qs[:3], k=k)    # + the fused batch variants
+
+    best = None
+    for _ in range(2):  # best-of-2: shared-host noise
+        t0 = time.perf_counter()
+        outs = knn_many(ds, "ais", qs, k=k)
+        wall = time.perf_counter() - t0
+        best = wall if best is None else min(best, wall)
+    qps = n_q / best
+
+    log("[knn] exactness: per-point + brute-force oracle comparison ...")
+    identical = True
+    for i, (qx, qy) in enumerate(qs):
+        got = [str(v) for v in outs[i].ids.tolist()]
+        single = [
+            str(v) for v in knn_search(ds, "ais", qx, qy, k=k).ids.tolist()
+        ]
+        if got != single:
+            identical = False
+            log(f"[knn] MISMATCH vs per-point at query {i}")
+        d = haversine_m(x, y, qx, qy)
+        kth = np.partition(d, k - 1)[k - 1]
+        sub = np.nonzero(d <= kth)[0]
+        want = sub[np.argsort(d[sub], kind="stable")][:k]
+        if kth <= 1_000_000.0 and got != [str(j) for j in want.tolist()]:
+            identical = False
+            log(f"[knn] MISMATCH vs brute oracle at query {i}")
+
+    row = {
+        "scenario": "knn_batched",
+        "n_points": int(len(x)),
+        "queries": n_q,
+        "k": k,
+        "host_cores": os.cpu_count(),
+        "batched_qps": round(qps, 1),
+        "batched_wall_s": round(best, 3),
+        "identical": identical,
+        **LINK_PROFILE,
+    }
+    log(f"[knn] batched {qps:.1f} q/s over {n_q} queries, identical={identical}")
+
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": [row]}
+    if out_path is None:
+        out_path = os.environ.get("GEOMESA_BENCH_KNN_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_KNN.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "knn_batched_queries_per_sec",
+        "value": row["batched_qps"],
+        "unit": "q/s",
+        "vs_baseline": round(qps / 60.0, 2),  # the VERDICT 60 q/s bar
+        "identical": identical,
+        "n_points": int(len(x)),
     }
     print(json.dumps(rec), flush=True)
     return rec
@@ -2219,7 +2372,7 @@ def child_main():
         "4": config4_join, "5": config5_knn, "cache": config_cache,
         "serving": config_serving, "ingest": config_ingest,
         "fused": config_fused, "pip_join": config_pip_join,
-        "stream": config_stream, "wal": config_wal,
+        "stream": config_stream, "wal": config_wal, "knn": config_knn,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:
@@ -2276,6 +2429,23 @@ def _probe_link():
                 "WARNING: link profile far from the PERF.md §1 constants "
                 "the M-bucket ladder / one-pull design are tuned for"
             )
+        # round 11 (VERDICT weak #8): re-derive the fused-chunk slot cap
+        # and M-bucket floor from the MEASURED link instead of trusting
+        # the 66 ms-era hand tuning, installed before any table builds or
+        # warmups so every compiled shape uses them; the chosen constants
+        # ride LINK_PROFILE into each scenario row (PERF.md §14)
+        from geomesa_tpu.scan import block_kernels as bk
+
+        derived = bk.derive_link_constants(rtt_ms, mbps)
+        bk.set_link_constants(derived)
+        LINK_PROFILE.update(
+            fused_chunk_slots=derived["fused_chunk_slots"],
+            m_floor=derived["m_floor"],
+        )
+        log(
+            f"link-derived constants: fused_chunk_slots="
+            f"{derived['fused_chunk_slots']}, m_floor={derived['m_floor']}"
+        )
     except Exception as e:  # pragma: no cover - probe must never kill a run
         log(f"link probe failed: {e}")
 

@@ -89,6 +89,10 @@ FAULT_POINTS: dict[str, str] = {
     "stream.flush.sort": "before a flush micro-chunk's shard sort",
     "streaming.persist": "before the one atomic hot->cold publish",
     "streaming.evict": "between the cold commit and the hot eviction",
+    # incremental sliced fold (datastore.fold_upsert; docs/streaming.md)
+    "stream.fold.stage": "before pre-staging update chunks at micro-flush",
+    "stream.fold.slice": "before building one fold slice",
+    "stream.fold.publish": "before a fold slice's atomic publish",
     # streaming WAL (streaming/wal.py; docs/durability.md)
     "stream.wal.append": "before a WAL record is encoded/buffered",
     "stream.wal.sync": "before a WAL fsync (group commit)",

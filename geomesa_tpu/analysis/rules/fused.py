@@ -28,6 +28,13 @@ Static check, per module that references ``block_scan_multi``:
 Modules with chunk-side derivations but no grouping function (e.g. a
 subclass overriding only ``_submit_fused_chunk``) are skipped: the
 grouping lives in the base class whose module carries the check.
+
+Round 11 widened the ladder pattern to ``fold_<dim>_bucket`` as well:
+the incremental fold's device plan deliberately ships NO static-bucket
+shapes today (eager device ops, nothing compile-keyed), but a future
+fold-side ladder shaping fold operands would recreate exactly the PR 5
+defect class — any ``fold_*_bucket`` derivation must likewise be
+derivable from a grouping key the moment one appears.
 """
 
 from __future__ import annotations
@@ -37,7 +44,7 @@ import re
 
 from geomesa_tpu.analysis.core import Project, Rule, call_name
 
-_DERIV_RE = re.compile(r"^fused_[a-z0-9]+_bucket$")
+_DERIV_RE = re.compile(r"^(fused|fold)_[a-z0-9]+_bucket$")
 
 
 def _function_defs(tree):
@@ -108,8 +115,9 @@ def _key_flow(fn, key_expr) -> set[str]:
 class FusedVariantKeyRule(Rule):
     id = "fused-key-dimension"
     description = (
-        "every fused_<dim>_bucket ladder dimension used to shape chunk "
-        "operands must be derivable from the chunk grouping key"
+        "every fused_<dim>_bucket / fold_<dim>_bucket ladder dimension "
+        "used to shape chunk operands must be derivable from the chunk "
+        "grouping key"
     )
     fix_hint = (
         "add the missing <dim>_bucket term to the grouping-key tuple in "

@@ -244,6 +244,40 @@ STREAM_FOLD_ROWS = SystemProperty(
     "appends instead of O(table) per flush; a full persist "
     "(persist_hot/checkpoint) always folds everything",
 )
+STREAM_FOLD_SLICE_ROWS = SystemProperty(
+    "geomesa.stream.fold.slice.rows", 65_536, int,
+    "update-fold slice size: a fold batch larger than this splits into "
+    "bounded key-contiguous slices, each published atomically on its own "
+    "(readers see exact intermediate states; the scheduler's admission "
+    "window drains between slices), so the fold stops being one "
+    "O(table) stop-the-world pause; 0 folds monolithically",
+)
+STREAM_FOLD_YIELD_MS = SystemProperty(
+    "geomesa.stream.fold.yield.ms", 15.0, float,
+    "cap on the between-slice scheduler yield: after each published fold "
+    "slice the folding thread waits up to this long for the cold store's "
+    "QueryScheduler admission queue to drain (live dashboard queries "
+    "interleave instead of queueing behind the whole fold); an idle "
+    "queue returns immediately",
+)
+STREAM_FOLD_PRESTAGE = SystemProperty(
+    "geomesa.stream.fold.prestage", True, _parse_bool,
+    "parse/key/shard-sort pending update rows through the warm flush "
+    "workers AT MICRO-FLUSH TIME (as the updates arrive), so the "
+    "eventual fold window pays only merge+publish; rows re-updated "
+    "after staging re-stage at fold time. False defers all staging to "
+    "the fold (the round-9 behavior)",
+)
+STREAM_FOLD_DEVICE = SystemProperty(
+    "geomesa.stream.fold.device", "auto", str,
+    "device-side fold plan: 'auto'/'on' rebuilds a folded index table's "
+    "device columns ON DEVICE from the old table plus an O(touched) "
+    "upload (removed positions, insert positions, the slice's sorted "
+    "rows) instead of re-gathering and re-uploading the O(table) "
+    "suffix over the link; 'off' keeps the host gather + suffix upload "
+    "(the round-9 path, and the fallback whenever the plan is "
+    "ineligible)",
+)
 STREAM_WAL_SYNC = SystemProperty(
     "geomesa.stream.wal.sync", "always", str,
     "streaming WAL fsync policy (docs/durability.md): 'always' = every "

@@ -22,6 +22,7 @@ from typing import Iterable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from geomesa_tpu import fault
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import Filter, INCLUDE, Include, PointColumn
 from geomesa_tpu.index import AttributeIndex, S2Index, S3Index, XZ2Index, XZ3Index, Z2Index, Z3Index
@@ -79,18 +80,19 @@ def parse_expiry_ms(spec: str, dtg_field: str | None = None) -> int:
     raise ValueError(f"unparseable expiry spec: {spec!r}")
 
 
-def _slice_keys(keys, start: int):
-    """WriteKeys rows [start:] (delta-tier view of a partially-compacted
-    chunk)."""
-    if start == 0:
+def _slice_keys(keys, start: int, stop: "int | None" = None):
+    """WriteKeys rows [start:stop] (delta-tier view of a partially-
+    compacted chunk; the fold's batch-contiguous slices)."""
+    if start == 0 and (stop is None or stop >= len(keys.bins)):
         return keys
     from geomesa_tpu.index.api import WriteKeys
 
+    sl = slice(start, stop)
     return WriteKeys(
-        bins=keys.bins[start:],
-        zs=keys.zs[start:],
-        device_cols={k: v[start:] for k, v in keys.device_cols.items()},
-        sub=keys.sub[start:] if keys.sub is not None else None,
+        bins=keys.bins[sl],
+        zs=keys.zs[sl],
+        device_cols={k: v[sl] for k, v in keys.device_cols.items()},
+        sub=keys.sub[sl] if keys.sub is not None else None,
     )
 
 
@@ -103,6 +105,11 @@ class DataStore:
     # hasattr(DataStore, ...) — the doc-honesty check in test_docs.py
     # verifies every documented `ds.X` against the class
     scheduler = None
+
+    # last fold's timing report (docs/streaming.md "Incremental fold"):
+    # {"rows", "slices", "slice_s": [per-publish seconds]} — the bench's
+    # per-slice pause histogram source. None until a fold runs.
+    last_fold_report = None
 
     def __init__(
         self,
@@ -197,6 +204,9 @@ class DataStore:
         # (table, chunk list) pair without ever blocking on the write
         # lock (which the fold holds for seconds around device builds)
         self._publish_seq = 0  # guarded-by: _write_lock
+        # sliced-fold progress surface (type -> (published, total) slices)
+        # for explain lines and the geomesa.stream.fold.progress gauge
+        self._fold_progress: dict[str, tuple] = {}  # guarded-by: _write_lock
         # damage accounting: persist.load replaces this with the real
         # verification outcome; a store with quarantined partitions
         # answers queries DEGRADED (per-plan warnings + metrics counter)
@@ -583,6 +593,9 @@ class DataStore:
         keys: "Mapping | None" = None,
         stats=None,
         presorted: "Mapping | None" = None,
+        slice_rows: "int | None" = None,
+        pacer=None,
+        on_slice=None,
     ) -> int:
         """Incremental :meth:`upsert`: replace existing ids and append the
         rest WITHOUT the whole-table recompaction the delete-and-rewrite
@@ -590,23 +603,45 @@ class DataStore:
         Results are bit-identical to :meth:`upsert` — survivors keep
         their sorted order, the batch radix-sorts alone and two-run
         merges in (storage.table.folded_table), and only device blocks
-        past the first touched sorted row re-upload. Adapters without
-        the ``fold_table`` seam (or mesh-sharded / secondary-sort-word
-        tables) fall back to a per-index full rebuild, still atomic.
+        past the first touched sorted row re-upload (or, with the
+        device-side fold plan, only the batch's rows cross the link at
+        all). Adapters without the ``fold_table`` seam (or mesh-sharded /
+        secondary-sort-word tables) fall back to a per-index full
+        rebuild, still atomic.
 
         ``keys``/``stats``: optionally pre-encoded write keys and stats
         sketch (the stream flusher's warm key stage); ``presorted`` maps
         index names to the batch's stable (bin, z) argsort (the
         flusher's shard-sort stage) so the fold skips its delta sort.
 
+        SLICED folds (round 11, docs/streaming.md "Incremental fold"):
+        a batch larger than ``slice_rows`` (default
+        ``geomesa.stream.fold.slice.rows``; 0 disables) splits into
+        batch-contiguous slices, each folded and published ATOMICALLY on
+        its own — every intermediate state is exactly the fold of the
+        applied batch prefix (one live version of every id; readers
+        pinned mid-fold see a consistent store), and the final state is
+        bit-identical to the monolithic fold. Between slices the fold
+        calls ``pacer()`` (the LambdaStore wires the QueryScheduler's
+        admission drain there) so live queries interleave instead of
+        queueing behind one O(table) pause. ``on_slice(ids)`` fires
+        after each atomic publish with the ids that just became
+        cold-resident — the WAL advances its flush watermark per slice,
+        so a crash mid-fold replays only the unpublished suffix. The
+        write lock is held across all slices (writers serialize exactly
+        like the monolithic fold; readers never take it). A failure
+        mid-fold leaves the published prefix committed and every later
+        row unpublished — the flusher's bounded retry re-folds the whole
+        batch, which is idempotent (re-replacing a row with identical
+        content).
+
         Cache invalidation is SCOPED to the replaced rows' key range
-        plus the batch's own — unlike a compaction's whole-type bump —
-        so warm cached results over untouched regions survive a flush.
-        Statistics ACCUMULATE the batch sketch (sketches cannot subtract
-        the replaced rows): the documented post-update drift, restored
-        by :meth:`analyze_stats`."""
-        from geomesa_tpu.index.api import WriteKeys
-        from geomesa_tpu.storage.delta import concat_keys
+        plus the batch's own — per slice, in the sliced form — unlike a
+        compaction's whole-type bump, so warm cached results over
+        untouched regions survive a flush. Statistics ACCUMULATE the
+        batch sketch (sketches cannot subtract the replaced rows): the
+        documented post-update drift, restored by :meth:`analyze_stats`."""
+        from geomesa_tpu import conf
 
         sft = self._schemas[type_name]
         if not isinstance(features, FeatureCollection):
@@ -619,99 +654,262 @@ class DataStore:
         if keys is None:
             features, keys, stats = self._encode_batch(type_name, features)
         with self._write_lock:
-            # ONE id probe: ordinals survive the compaction below
-            # (compaction preserves ordinal order), so the lookup is not
-            # repeated — at production fold sizes a second searchsorted
-            # pass over millions of string ids is a real fraction of the
-            # fold pause
-            replaced = self.id_lookup(type_name, ids)
+            # ONE id probe for the whole batch: per-slice ordinals derive
+            # from it by subtracting earlier slices' removals — at
+            # production fold sizes a second searchsorted pass over
+            # millions of string ids is a real fraction of the fold pause
+            found = self._id_find(type_name, ids)
+            replaced = found[found >= 0]
             if not len(replaced):
                 # nothing to replace: a plain append rides the O(batch)
                 # delta tier (LSM steady state) — no forced compaction
-                return self._commit_batch(
+                n = self._commit_batch(
                     type_name, features, keys, stats, check_ids=False
                 )
+                if on_slice is not None:
+                    on_slice([str(i) for i in ids.tolist()])
+                return n
             # the fold operates on a fully-compacted prefix: merge any
             # outstanding host delta first (the incremental merged_table
-            # path), so sorted-row coordinates are table coordinates
+            # path), so sorted-row coordinates are table coordinates.
+            # Ordinals survive the compaction (it preserves ordinal order)
             total = sum(len(c) for c in self._chunks[type_name])
             if self._main_rows.get(type_name, 0) != total:
                 self.compact(type_name)
-            full = self.features(type_name)
-            n = len(full)
-            # replaced is non-empty here (the pure-append case returned
-            # above): this is always a true fold, never a plain append
-            keep_ordinal = np.ones(n, dtype=bool)
-            keep_ordinal[replaced] = False
-            # old ordinal -> post-delete ordinal (valid at kept rows)
-            ordinal_map = np.cumsum(keep_ordinal, dtype=np.int64) - 1
-            removed = full.take(replaced)
-            survivors = full.mask(keep_ordinal)
-            # build every index's merged keys and folded table BEFORE any
-            # store state mutates: the publish below is assignment-only,
-            # so a failure mid-build leaves the store untouched (the
-            # streaming flush's atomicity contract)
-            fold = getattr(self.adapter, "fold_table", None)
-            staged: list = []  # (index, merged keys, new table, old table)
-            for idx in self._indexes[type_name]:
-                parts = self._key_chunks.get((type_name, idx.name)) or []
-                old_keys = concat_keys(parts) if parts else None
-                dk = keys[idx.name]
-                if old_keys is None:
-                    merged = dk
-                else:
-                    masked = WriteKeys(
-                        bins=old_keys.bins[keep_ordinal],
-                        zs=old_keys.zs[keep_ordinal],
-                        device_cols={
-                            k: v[keep_ordinal]
-                            for k, v in old_keys.device_cols.items()
-                        },
-                        sub=(
-                            old_keys.sub[keep_ordinal]
-                            if old_keys.sub is not None else None
-                        ),
-                    )
-                    merged = concat_keys([masked, dk])
-                old_table = self._tables.get((type_name, idx.name))
-                new_table = None
-                if fold is not None and old_table is not None:
-                    dperm = presorted.get(idx.name) if presorted else None
-                    new_table = fold(
-                        idx, old_table, merged, keep_ordinal, ordinal_map,
-                        dk, delta_perm=dperm,
-                    )
-                if new_table is None:
-                    new_table = self.adapter.create_table(idx, merged)
-                staged.append((idx, merged, new_table, old_table))
-            # -- publish: assignment-only, seqlock-bracketed --------------
-            self._widen_bin_ranges(type_name, keys)
-            self._publish_seq += 1  # odd: renumbering swap in flight
-            for idx, merged, new_table, old_table in staged:
-                self._key_chunks[(type_name, idx.name)] = [merged]
-                self._tables[(type_name, idx.name)] = new_table
-            self._chunks[type_name] = (
-                [survivors] if len(survivors) else []
-            ) + [features]
-            self._full[type_name] = None
-            self._publish_seq += 1  # even: pinned readers may proceed
-            for idx, merged, new_table, old_table in staged:
-                if old_table is not None and old_table is not new_table:
-                    self.adapter.delete_table(old_table)
-            prev = self._stats.get(type_name)
-            if stats is not None:
-                self._stats[type_name] = (
-                    prev.merge(stats) if prev is not None else stats
+            elif len(self._chunks[type_name]) > 1:
+                # collapse earlier folds' chunk splits (ordinal-preserving
+                # concat, no re-sort) so replaced ordinals land in chunk 0
+                # — the invariant _fold_slice_locked relies on
+                self._chunks[type_name] = [self.features(type_name)]
+            n_batch = len(features)
+            sr = (
+                slice_rows if slice_rows is not None
+                else conf.STREAM_FOLD_SLICE_ROWS.get()
+            )
+            if not (sr and 0 < sr < n_batch) or not self._fold_sliceable(
+                type_name, keys
+            ):
+                t0 = time.perf_counter()
+                self._fold_slice_locked(
+                    type_name, features, keys, replaced, stats, presorted
                 )
-            self._main_rows[type_name] = n - len(replaced) + len(features)
-            # scoped invalidation: the replaced rows' range + the batch's
-            # own range — NOT a whole-type bump (docs/streaming.md)
-            self.planner.invalidate_config_memo()
-            if self.cache is not None:
-                if len(removed):
-                    self.cache.on_mutation(type_name, removed)
-                self.cache.on_mutation(type_name, features)
+                self.last_fold_report = {
+                    "rows": n_batch, "slices": 1,
+                    "slice_s": [time.perf_counter() - t0],
+                }
+                if on_slice is not None:
+                    on_slice([str(i) for i in ids.tolist()])
+                return n_batch
+            self._fold_sliced_locked(
+                type_name, features, keys, stats, presorted, found, sr,
+                pacer, on_slice,
+            )
         return len(features)
+
+    def _fold_sliceable(self, type_name: str, keys: Mapping) -> bool:
+        """Whether every index of ``type_name`` takes the incremental
+        fold seam (adapter ``fold_table``, base-class single-device
+        table, no secondary sort words): slicing a fold whose indexes
+        rebuild outright would pay a full O(n log n) rebuild PER SLICE
+        instead of once — those folds stay monolithic."""
+        if (
+            getattr(self.adapter, "fold_table", None) is None
+            or getattr(self.adapter, "mesh", None) is not None
+        ):
+            return False
+        for idx in self._indexes[type_name]:
+            k = keys.get(idx.name)
+            if k is None or k.sub is not None:
+                return False
+            old = self._tables.get((type_name, idx.name))
+            if (
+                not isinstance(old, IndexTable)
+                or type(old)._place_cols is not IndexTable._place_cols
+            ):
+                return False
+            parts = self._key_chunks.get((type_name, idx.name)) or []
+            if any(p.sub is not None for p in parts):
+                return False
+        return True
+
+    def _fold_sliced_locked(
+        self, type_name, features, keys, stats, presorted, found, sr,
+        pacer, on_slice,
+    ) -> None:
+        """The sliced fold loop (write lock held; see :meth:`fold_upsert`).
+        Slices are batch-contiguous, so the final chunk layout —
+        survivors + batch rows in batch order — is bit-identical to the
+        monolithic fold's. ``found`` is the whole-batch id probe against
+        the PRE-FOLD table; each slice's current-table ordinals derive
+        from it by rank-subtracting the ordinals earlier slices removed
+        (replaced ids are always pre-fold rows — batch ids are unique —
+        so removals only ever land in the surviving original chunk,
+        which stays chunk 0 throughout)."""
+        from geomesa_tpu.metrics import resolve
+
+        metrics = resolve(self.metrics)
+        n_batch = len(features)
+        n_slices = -(-n_batch // sr)
+        # guarded-by: _write_lock (one fold at a time mutates it; readers
+        # treat a racing snapshot as best-effort progress reporting)
+        self._fold_progress[type_name] = (0, n_slices)
+        metrics.gauge("geomesa.stream.fold.progress", 0.0)
+        removed_cum = np.zeros(0, dtype=np.int64)  # sorted pre-fold ordinals
+        ids = np.asarray(features.ids)
+        slice_s: list[float] = []
+        try:
+            for si, s in enumerate(range(0, n_batch, sr)):
+                e = min(s + sr, n_batch)
+                fault.fault_point("stream.fold.slice")
+                t0 = time.perf_counter()
+                sub_fc = features.take(np.arange(s, e, dtype=np.int64))
+                sub_keys = {
+                    name: _slice_keys(k, s, stop=e) for name, k in keys.items()
+                }
+                sub_pre = None
+                if presorted:
+                    sub_pre = {}
+                    for name, perm in presorted.items():
+                        perm = np.asarray(perm)
+                        sel = (perm >= s) & (perm < e)
+                        sub_pre[name] = perm[sel] - s
+                sub_found = found[s:e]
+                rep = np.sort(sub_found[sub_found >= 0])
+                # pre-fold ordinal -> current ordinal: subtract the rank
+                # of earlier slices' removals (appends land after the
+                # original chunk and never shift it)
+                cur = rep - np.searchsorted(removed_cum, rep, side="left")
+                self._fold_slice_locked(
+                    type_name, sub_fc, sub_keys, cur,
+                    stats if e == n_batch else None,  # merge the batch
+                    # sketch ONCE, like the monolithic fold
+                    sub_pre,
+                )
+                removed_cum = np.union1d(removed_cum, rep)
+                self._fold_progress[type_name] = (si + 1, n_slices)
+                metrics.gauge(
+                    "geomesa.stream.fold.progress", (si + 1) / n_slices
+                )
+                metrics.counter("geomesa.stream.fold.slices")
+                slice_s.append(time.perf_counter() - t0)
+                metrics.timer_update("geomesa.stream.fold.slice", slice_s[-1])
+                if on_slice is not None:
+                    on_slice([str(i) for i in ids[s:e].tolist()])
+                if pacer is not None and e < n_batch:
+                    pacer()
+        finally:
+            self._fold_progress.pop(type_name, None)
+            metrics.gauge("geomesa.stream.fold.progress", 0.0)
+            self.last_fold_report = {
+                "rows": n_batch, "slices": n_slices, "slice_s": slice_s,
+            }
+
+    def _fold_slice_locked(
+        self, type_name, features, keys, replaced, stats, presorted
+    ) -> None:
+        """Fold ONE batch (or batch slice) whose ``replaced`` current-table
+        ordinals all lie in chunk 0, and publish atomically (write lock
+        held; seqlock-bracketed assignment-only swap). This is the
+        monolithic round-9 fold body, chunk-aware so the sliced loop
+        never re-concatenates the appended slices: removals only touch
+        the surviving original chunk."""
+        from geomesa_tpu.index.api import WriteKeys
+        from geomesa_tpu.storage.delta import concat_keys
+
+        chunks = self._chunks[type_name]
+        main = chunks[0]
+        n0 = len(main)
+        n = sum(len(c) for c in chunks)
+        keep0 = np.ones(n0, dtype=bool)
+        keep0[replaced] = False
+        if n > n0:
+            keep_ordinal = np.concatenate(
+                [keep0, np.ones(n - n0, dtype=bool)]
+            )
+        else:
+            keep_ordinal = keep0
+        # old ordinal -> post-delete ordinal (valid at kept rows)
+        ordinal_map = np.cumsum(keep_ordinal, dtype=np.int64) - 1
+        removed = main.take(replaced)
+        survivors0 = main.mask(keep0)
+        # build every index's merged keys and folded table BEFORE any
+        # store state mutates: the publish below is assignment-only,
+        # so a failure mid-build leaves the store untouched (the
+        # streaming flush's atomicity contract)
+        fold = getattr(self.adapter, "fold_table", None)
+        staged: list = []  # (index, merged keys, new table, old table)
+
+        def mask_concat(old_col, new_col):
+            """survivors ++ delta in ONE output allocation: np.compress
+            writes the masked rows straight into the destination, so the
+            fold never pays the mask-then-concatenate double copy (a
+            real fraction of the per-slice wall at production sizes)."""
+            nk = int(keep_ordinal.sum())
+            out = np.empty((nk + len(new_col),) + old_col.shape[1:],
+                           dtype=old_col.dtype)
+            np.compress(keep_ordinal, old_col, axis=0, out=out[:nk])
+            out[nk:] = new_col
+            return out
+
+        for idx in self._indexes[type_name]:
+            parts = self._key_chunks.get((type_name, idx.name)) or []
+            old_keys = concat_keys(parts) if parts else None
+            dk = keys[idx.name]
+            if old_keys is None:
+                merged = dk
+            else:
+                merged = WriteKeys(
+                    bins=mask_concat(old_keys.bins, dk.bins),
+                    zs=mask_concat(old_keys.zs, dk.zs),
+                    device_cols={
+                        k: mask_concat(v, dk.device_cols[k])
+                        for k, v in old_keys.device_cols.items()
+                    },
+                    sub=(
+                        mask_concat(old_keys.sub, dk.sub)
+                        if old_keys.sub is not None else None
+                    ),
+                )
+            old_table = self._tables.get((type_name, idx.name))
+            new_table = None
+            if fold is not None and old_table is not None:
+                dperm = presorted.get(idx.name) if presorted else None
+                new_table = fold(
+                    idx, old_table, merged, keep_ordinal, ordinal_map,
+                    dk, delta_perm=dperm,
+                )
+            if new_table is None:
+                new_table = self.adapter.create_table(idx, merged)
+            staged.append((idx, merged, new_table, old_table))
+        fault.fault_point("stream.fold.publish")
+        # -- publish: assignment-only, seqlock-bracketed --------------
+        self._widen_bin_ranges(type_name, keys)
+        self._publish_seq += 1  # odd: renumbering swap in flight
+        for idx, merged, new_table, old_table in staged:
+            self._key_chunks[(type_name, idx.name)] = [merged]
+            self._tables[(type_name, idx.name)] = new_table
+        self._chunks[type_name] = (
+            ([survivors0] if len(survivors0) else [])
+            + list(chunks[1:]) + [features]
+        )
+        self._full[type_name] = None
+        self._publish_seq += 1  # even: pinned readers may proceed
+        for idx, merged, new_table, old_table in staged:
+            if old_table is not None and old_table is not new_table:
+                self.adapter.delete_table(old_table)
+        prev = self._stats.get(type_name)
+        if stats is not None:
+            self._stats[type_name] = (
+                prev.merge(stats) if prev is not None else stats
+            )
+        self._main_rows[type_name] = n - len(replaced) + len(features)
+        # scoped invalidation: the replaced rows' range + the batch's
+        # own range — NOT a whole-type bump (docs/streaming.md)
+        self.planner.invalidate_config_memo()
+        if self.cache is not None:
+            if len(removed):
+                self.cache.on_mutation(type_name, removed)
+            self.cache.on_mutation(type_name, features)
 
     def _validate_replacement(self, type_name: str, features) -> None:
         """Fail BEFORE any row is deleted: a replacement batch that cannot

@@ -238,7 +238,7 @@ class DistributedIndexTable(IndexTable):
         if mx > bk.M_BUCKETS[-1]:
             per = [np.arange(self.blocks_local, dtype=np.int64)] * D
             mx = self.blocks_local
-        m = bk.bucket_of(mx)
+        m = bk.m_bucket_of(mx)  # single-query ladder: link floor applies
         bids2 = np.full((D, m), pad, np.int32)
         n_real = np.zeros(D, np.int64)
         for d, p in enumerate(per):
@@ -264,9 +264,7 @@ class DistributedIndexTable(IndexTable):
         single-chip clamp applied to the LOCAL block count (each device
         scans its own round-robin share, so a mesh table's fused dispatch
         is D lists of this size, not one global list)."""
-        from geomesa_tpu.storage.table import FUSED_CHUNK_SLOTS
-
-        return min(FUSED_CHUNK_SLOTS, bk.bucket_of(max(1, self.blocks_local)))
+        return min(bk.fused_slot_cap(), bk.bucket_of(max(1, self.blocks_local)))
 
     @property
     def fused_pack_capacity(self) -> int:

@@ -497,6 +497,13 @@ class QueryPlanner:
             hints.validate()
         if deadline is None:
             deadline = self._deadline(hints)
+        prog = getattr(self.store, "_fold_progress", {}).get(plan.type_name)
+        if prog is not None:
+            # lock-free snapshot of the sliced-fold progress surface
+            # (docs/streaming.md): the query is interleaving with an
+            # in-flight incremental fold — visible in explain alongside
+            # the geomesa.stream.fold.progress gauge
+            exp(f"Streaming fold in progress: slice {prog[0]}/{prog[1]}")
 
         if plan.union is not None:
             return self._execute_union(plan, exp, hints, deadline)

@@ -110,13 +110,19 @@ def knn_many(
             else float(estimated_distance_m)
         )
         radii[i] = min(max(r, 1.0), float(max_distance_m))
-    # speculative dual-window rounds: each pending query submits its
-    # radius window AND the 4x window in the SAME pipelined sweep, so a
-    # sketch under-estimate costs zero extra device round-trips (the
-    # round-trip floor dominates kNN latency, PERF.md §1). The larger
-    # window's finish() only runs when the smaller missed — its plane
-    # pull already overlapped either way. Radius jumps 16x between
-    # rounds (both windows missed => the estimate was far off).
+    # speculative wide-window rounds: each pending query scans ONE window
+    # at 4x its radius estimate per round — the estimate radius resolves
+    # from the SAME result (the degree window is conservatively over-wide,
+    # so every point within the estimate radius lies inside the estimate's
+    # bbox, which the 4x bbox contains; filtering the wide result by
+    # distance is therefore bit-equivalent to scanning the narrow window).
+    # Rounds 5-10 dispatched BOTH windows speculatively; halving the
+    # per-query dispatches this way is what lets a whole batch's window
+    # probes pack into fewer fused block_scan_multi chunks (and halves
+    # the plan/decomposition host work per round). A sketch
+    # under-estimate still costs zero extra device round-trips — the 4x
+    # acceptance check reads the already-pulled result. Radius jumps 16x
+    # between rounds (a miss at 4x means the estimate was far off).
     SPEC = 4.0
 
     def _plan(i: int, r: float):
@@ -126,48 +132,49 @@ def knn_many(
         f = box if isinstance(filter, Include) else And((box, filter))
         return store.planner.plan(type_name, f)
 
-    def _resolve(i: int, res, r: float):
-        """k-or-more within r -> the k nearest, else None (miss)."""
+    def _top_k(res, d, in_radius):
+        """The k nearest among ``in_radius`` rows, nearest-first — ties
+        resolved by original position exactly like a full stable argsort
+        (the argpartition prefilter keeps every kth-distance tie, so the
+        stable sort of the survivors selects the same rows)."""
+        sel = np.nonzero(in_radius)[0]
+        ds = d[sel]
+        if len(sel) > 4 * k + 64:
+            kth = np.partition(ds, k - 1)[k - 1]
+            sub = np.nonzero(ds <= kth)[0]
+            order = sel[sub[np.argsort(ds[sub], kind="stable")]][:k]
+        else:
+            order = sel[np.argsort(ds, kind="stable")][:k]
+        return res.take(order)
+
+    def _resolve(i: int, res, radii_try):
+        """First radius in ``radii_try`` (ascending) holding k-or-more
+        hits -> its k nearest; else None (miss -> expand)."""
         x, y = pts[i]
         if len(res):
             cx, cy = res.representative_xy()
             d = haversine_m(x, y, cx, cy)
-            in_radius = d <= r
-            if in_radius.sum() >= k or r >= max_distance_m:
-                keep = np.nonzero(in_radius)[0]
-                order = keep[np.argsort(d[keep], kind="stable")][:k]
-                return res.take(order)
-        elif r >= max_distance_m:
+            for r in radii_try:
+                in_radius = d <= r
+                if in_radius.sum() >= k or r >= max_distance_m:
+                    return _top_k(res, d, in_radius)
+        elif radii_try[-1] >= max_distance_m:
             return res
         return None
 
     pending = list(range(len(pts)))
     while pending:
-        # both windows of every pending query go through ONE submit_many:
+        # every pending query's window goes through ONE submit_many:
         # scans sharing the index fuse into a single kernel dispatch per
         # variant group (planner.submit_many -> table.scan_submit_many)
-        plans, owner = [], []
-        for i in pending:
-            r = float(radii[i])
-            wide_r = min(r * SPEC, max_distance_m)
-            plans.append(_plan(i, r))
-            owner.append((i, False))
-            if wide_r > r:
-                plans.append(_plan(i, wide_r))
-                owner.append((i, True))
-        fins = store.planner.submit_many(plans, hints=None)
-        per: dict[int, list] = {i: [None, None] for i in pending}
-        for (i, is_wide), f in zip(owner, fins):
-            per[i][1 if is_wide else 0] = f
-        finishes = [(i, per[i][0], per[i][1]) for i in pending]
+        wides = [min(float(radii[i]) * SPEC, max_distance_m) for i in pending]
+        fins = store.planner.submit_many(
+            [_plan(i, w) for i, w in zip(pending, wides)], hints=None
+        )
         nxt = []
-        for i, fin, fin_wide in finishes:
+        for i, w, fin in zip(pending, wides, fins):
             r = float(radii[i])
-            got = _resolve(i, fin(), r)
-            if got is None and fin_wide is not None:
-                wide_r = min(r * SPEC, max_distance_m)
-                got = _resolve(i, fin_wide(), wide_r)
-                r = wide_r
+            got = _resolve(i, fin(), [r, w] if w > r else [r])
             if got is not None:
                 out[i] = got
                 continue

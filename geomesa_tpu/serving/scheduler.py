@@ -285,6 +285,25 @@ class QueryScheduler:
             self._cond.notify_all()
         return fut
 
+    def admission_gap(self, max_wait_s: float = 0.05) -> bool:
+        """Wait (bounded) for the admission queue to DRAIN — every query
+        admitted so far handed to the dispatcher — and return whether it
+        did. The streaming fold calls this between slices
+        (docs/streaming.md "Incremental fold"): a maintenance thread
+        that yields here lets queued dashboard queries dispatch before
+        the next slice's build competes for the host, instead of letting
+        them queue behind the whole fold. An idle queue returns
+        immediately; the bound keeps a saturating query load from
+        stalling the fold forever."""
+        deadline = time.monotonic() + max(max_wait_s, 0.0)
+        with self._cond:
+            while self._queue and not self._closed:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._cond.wait(rem)
+            return True
+
     def query(
         self,
         type_name: str,

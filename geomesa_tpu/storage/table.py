@@ -70,6 +70,32 @@ FUSED_CHUNK_SLOTS = 2048
 FUSED_CHUNK_Q = 128
 
 
+def _block_rows(tile: "int | None") -> int:
+    """Rows per device scan block for a ``tile`` request (the ONE rounding
+    rule, shared by IndexTable.__init__ and the fold-plan eligibility
+    check so they can never drift)."""
+    return bk.BLOCK if tile is None else max(4096, -(-int(tile) // 4096) * 4096)
+
+
+def _device_fold_enabled() -> bool:
+    """Whether folded_table may build device columns through the
+    device-side fold plan (geomesa.stream.fold.device). 'on' forces it;
+    'auto' (the default) uses it only on a TPU backend, where the
+    O(touched)-vs-O(table) LINK transfer is the cost that matters — on
+    the CPU backend every "transfer" is a memcpy, while the plan's
+    eager device ops re-specialize per slice shape, so the host
+    gather + upload path is strictly faster there (measured: ~3x lower
+    slice pause on the CPU stream bench)."""
+    import jax
+
+    from geomesa_tpu.conf import STREAM_FOLD_DEVICE
+
+    mode = str(STREAM_FOLD_DEVICE.get()).lower()
+    if mode in ("on", "1", "true"):
+        return True
+    return mode == "auto" and jax.default_backend() == "tpu"
+
+
 class SortedKeys:
     """Host-side sorted key structure shared by the single-device and
     distributed tables: the (bin, z) lexicographic sort, the permutation
@@ -115,8 +141,19 @@ class SortedKeys:
         self.zs = _take(keys.zs, perm)
         self.subkeys = keys.sub[perm] if keys.sub is not None else None  # [n, W]
 
-        # per-bin segments for searchsorted pruning
-        self.ubins, starts = np.unique(self.bins, return_index=True)
+        # per-bin segments for searchsorted pruning. self.bins is sorted
+        # (it IS the primary sort key), so the segment boundaries come
+        # from one linear diff pass — np.unique's O(n log n) sort here
+        # was a measurable slice of every table build (the round-11 fold
+        # profile: ~60 ms per 3M-row build, x2 indexes x slices)
+        if n:
+            starts = np.concatenate([
+                [0], np.flatnonzero(self.bins[1:] != self.bins[:-1]) + 1
+            ])
+            self.ubins = self.bins[starts]
+        else:
+            starts = np.zeros(0, np.int64)
+            self.ubins = self.bins[:0]
         self.bin_starts = np.append(starts, n).astype(np.int64)
 
     def _narrow_lo(self, a: int, ae: int, words: np.ndarray) -> int:
@@ -305,10 +342,11 @@ class IndexTable(SortedKeys):
         device=None,
         sorted_state: "np.ndarray | None" = None,
         reuse: "tuple[IndexTable, int] | None" = None,
+        fold_plan: "tuple | None" = None,
     ):
         # device scan granularity: BLOCK rows (Pallas layout constraint:
         # SUB multiple of 32 sublanes); `tile` requests are rounded up
-        block = bk.BLOCK if tile is None else max(4096, -(-int(tile) // 4096) * 4096)
+        block = _block_rows(tile)
         super().__init__(keyspace, keys, block, sorted_state=sorted_state)
         self.block = block
         self.sub = block // bk.LANES
@@ -328,7 +366,17 @@ class IndexTable(SortedKeys):
         # compaction keeps every device block before the first insertion
         # point and uploads only the changed suffix
         self._reuse = reuse
-        if type(self)._place_cols is IndexTable._place_cols:
+        if (
+            fold_plan is not None
+            and type(self)._place_cols is IndexTable._place_cols
+        ):
+            # device-side fold plan (round 11, docs/streaming.md
+            # "Incremental fold"): the folded columns are computed ON
+            # DEVICE from the old table's resident blocks plus an
+            # O(touched) upload, instead of re-gathering and re-uploading
+            # the O(table) sorted suffix over the link
+            self._fold_cols_device(fold_plan, device)
+        elif type(self)._place_cols is IndexTable._place_cols:
             # bounded-memory build: sort-gather each column in
             # block-aligned spans and upload it before touching the next —
             # host peak is ONE padded column, never a second full copy of
@@ -352,8 +400,11 @@ class IndexTable(SortedKeys):
         bucket (see the constants' doctrine note) — still one static
         shape per (columns, flags), but a small table never scans a
         multiple of its own size in pad slots. For the distributed table
-        this is the PER-DEVICE slot bucket."""
-        return min(FUSED_CHUNK_SLOTS, bk.bucket_of(self.n_blocks))
+        this is the PER-DEVICE slot bucket. The cap itself is
+        link-derived (bk.fused_slot_cap: the hand-tuned 2048 on the 66 ms
+        design link, smaller on a measured fast link — bench.py installs
+        the probe-derived constants before warmup)."""
+        return min(bk.fused_slot_cap(), bk.bucket_of(self.n_blocks))
 
     @property
     def fused_pack_capacity(self) -> int:
@@ -442,6 +493,70 @@ class IndexTable(SortedKeys):
             else:
                 self.cols3[k] = suffix
             del out, v3, suffix
+
+    def _fold_cols_device(self, plan, device) -> None:
+        """Fold-plan device build (round 11): the new sorted columns are a
+        pure permutation of the OLD table's device-resident rows plus the
+        delta's — so instead of host-gathering and uploading the changed
+        O(table) suffix (``_stream_cols``), ship only the fold's
+        *description* (removed sorted positions, insert destinations, the
+        delta's sorted rows — all O(touched)) and let the device compute
+        each new row's source:
+
+        - a non-insert destination ``i`` holds survivor rank
+          ``r = i - #inserts<=i``; its OLD sorted position solves
+          ``p = r + #removed<=p`` via one searchsorted over
+          ``removed - arange`` (survivors-before-each-removal, a
+          non-decreasing key);
+        - an insert destination takes its value from the uploaded sorted
+          delta rows;
+        - pad rows past ``self.n`` take the never-matching sentinels.
+
+        One gather per column over HBM — fold-time cost, never on the
+        query path (the "no gathers" doctrine in scan/block_kernels.py
+        guards kernels, not maintenance). Bit-identical to the host
+        rebuild: every value is a copy of an old-table or delta value
+        (tests/test_streaming_tier.py pins cols3 equality both ways).
+        ``rows_uploaded`` records the rows that actually crossed the
+        link — the fold's O(touched) claim, surfaced by the bench."""
+        import jax
+        import jax.numpy as jnp
+
+        old, removed, delta_dest, delta_sorted_cols = plan
+        nr, nd = len(removed), len(delta_dest)
+        # i32 position math: the fold plan is gated to < 2**31 padded rows
+        # (the u32-perm regime; the 1B single-chip layout is well inside)
+        i = jnp.arange(self.n_pad, dtype=jnp.int32)
+        if nd:
+            dd = jnp.asarray(np.asarray(delta_dest, np.int32))
+            k_ins = jnp.searchsorted(dd, i, side="right").astype(jnp.int32)
+            is_ins = (k_ins > 0) & (dd[jnp.clip(k_ins - 1, 0, nd - 1)] == i)
+            ins_idx = jnp.clip(k_ins - 1, 0, nd - 1)
+        else:
+            k_ins = jnp.zeros(self.n_pad, jnp.int32)
+            is_ins = None
+            ins_idx = None
+        r = i - k_ins
+        if nr:
+            rem_adj = jnp.asarray(
+                np.asarray(removed, np.int64) - np.arange(nr, dtype=np.int64)
+            ).astype(jnp.int32)
+            src = r + jnp.searchsorted(rem_adj, r, side="right").astype(jnp.int32)
+        else:
+            src = r
+        src = jnp.clip(src, 0, max(old.n_pad - 1, 0))
+        valid = i < self.n
+        self.rows_uploaded = nd  # only the delta rows cross the link
+        self.cols3 = {}
+        for k in self.col_names:
+            old_flat = old.cols3[k].reshape(-1)
+            vals = jnp.take(old_flat, src)
+            if is_ins is not None:
+                dcol = np.asarray(delta_sorted_cols[k])
+                dvals = jax.device_put(dcol, device) if device else jnp.asarray(dcol)
+                vals = jnp.where(is_ins, jnp.take(dvals, ins_idx), vals)
+            vals = jnp.where(valid, vals, _SENTINELS[k].astype(vals.dtype))
+            self.cols3[k] = vals.reshape(self.n_blocks, self.sub, bk.LANES)
 
     # -- scanning --------------------------------------------------------
     def candidate_blocks(self, spans: list[tuple[int, int]]) -> np.ndarray:
@@ -1269,9 +1384,27 @@ def folded_table(
     if len(perm) < 2**32:
         perm = perm.astype(np.uint32)  # keep the native take() fast path
 
+    fold_plan = None
+    if _device_fold_enabled() and getattr(old, "cols3", None) is not None:
+        removed = (
+            np.flatnonzero(~keep_sorted) if keep_sorted is not None
+            else np.zeros(0, np.int64)
+        )
+        if (
+            old.block == _block_rows(tile)
+            and set(old.col_names) == set(merged_keys.device_cols)
+            and max(old.n_pad, nm + nd) < 2**31  # i32 position math
+        ):
+            delta_sorted_cols = (
+                {k: v[dperm] for k, v in delta_keys.device_cols.items()}
+                if nd else {}
+            )
+            dest = delta_dest if nd else np.zeros(0, np.int64)
+            fold_plan = (old, removed, dest, delta_sorted_cols)
+
     table = IndexTable(
         old.keyspace, merged_keys, tile=tile,
-        sorted_state=perm, reuse=(old, first_change),
+        sorted_state=perm, reuse=(old, first_change), fold_plan=fold_plan,
     )
     table.rows_sorted = nd
     return table

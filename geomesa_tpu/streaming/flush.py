@@ -70,6 +70,10 @@ class StreamConfig:
     queue_depth: int = 4    # chunks staged ahead of the commit stage
     fold_rows: int = 131_072  # pending updates that trigger the fold
     incremental: bool = True  # fold flushes (False = legacy upsert flush)
+    # round-11 fold-pause knobs (docs/streaming.md "Incremental fold")
+    slice_rows: int = 65_536   # fold slice size (0 = monolithic)
+    fold_yield_ms: float = 15.0  # between-slice scheduler-drain cap
+    prestage: bool = True      # parse/key deferred updates at flush time
 
     @staticmethod
     def from_properties() -> "StreamConfig":
@@ -81,6 +85,9 @@ class StreamConfig:
             queue_depth=conf.STREAM_QUEUE_DEPTH.get(),
             fold_rows=conf.STREAM_FOLD_ROWS.get(),
             incremental=conf.STREAM_INCREMENTAL.get(),
+            slice_rows=conf.STREAM_FOLD_SLICE_ROWS.get(),
+            fold_yield_ms=conf.STREAM_FOLD_YIELD_MS.get(),
+            prestage=conf.STREAM_FOLD_PRESTAGE.get(),
         )
 
     def resolved_workers(self) -> int:
@@ -92,7 +99,8 @@ class StreamConfig:
 
 
 class _FlushChunk:
-    __slots__ = ("base", "rows", "ids", "fc", "keys", "stats", "runs")
+    __slots__ = ("base", "rows", "ids", "fc", "keys", "stats", "runs",
+                 "src_rows")
 
     def __init__(self, base: int, rows: list, ids: list):
         self.base = base  # global row offset within the flush batch
@@ -102,6 +110,11 @@ class _FlushChunk:
         self.keys: dict = {}
         self.stats = None
         self.runs: dict = {}  # index name -> list[SortRun]
+        # pre-staged chunks retain their source row-dict REFERENCES (no
+        # copies — the hot tier owns the dicts) so the fold can identity-
+        # check each staged row against the live hot state: a row
+        # re-updated after staging re-stages, never folds stale
+        self.src_rows: "list | None" = None
 
 
 class StreamFlusher:
@@ -125,6 +138,11 @@ class StreamFlusher:
         self._pool: "ThreadPoolExecutor | None" = None  # guarded-by: _pool_lock
         self._sem = threading.Semaphore(max(1, self.config.queue_depth))
         self.flushes = 0  # total successful flushes (bench/introspection)
+        # pre-staged update chunks (docs/streaming.md "Incremental fold"):
+        # parse/keys run at micro-flush time, consumed by the next fold
+        self._stage_lock = threading.Lock()
+        self._staged: list = []        # guarded-by: _stage_lock
+        self._staged_rows: dict = {}   # guarded-by: _stage_lock
 
     # -- pool lifecycle ---------------------------------------------------
     def _ensure_pool(self) -> ThreadPoolExecutor:
@@ -146,17 +164,27 @@ class StreamFlusher:
     def _stage_time(self, stage: str, seconds: float) -> None:
         self.metrics.timer_update(f"geomesa.stream.{stage}", seconds)
 
-    def _run_chunk(self, ch: _FlushChunk, incremental: bool = True) -> None:
+    def _run_chunk(
+        self, ch: _FlushChunk, incremental: bool = True,
+        retain: bool = False, sort: bool = True,
+    ) -> None:
         """parse -> keys -> sort for one micro-chunk (one pool task:
         chunks overlap across workers; stages attribute separately).
         Non-incremental flushes parse only: the legacy ``cold.upsert``
         commit re-encodes keys itself, so encoding+sorting here would be
-        discarded work that also taxes the bench baseline unfairly."""
+        discarded work that also taxes the bench baseline unfairly.
+        ``retain`` keeps the source row references + ids for the
+        pre-stage identity check; ``sort=False`` defers the shard sort
+        (a pre-staged chunk's batch offset is unknown until the fold
+        assigns final chunk order — :meth:`_sort_chunk` runs then)."""
         sft = self.store.get_schema(self.type_name)
         fault.fault_point("stream.flush.parse")
         t0 = time.perf_counter()
         ch.fc = FeatureCollection.from_rows(sft, ch.rows, ids=ch.ids)
-        ch.rows = ch.ids = None  # staged scratch: release as consumed
+        if retain:
+            ch.src_rows, ch.rows = ch.rows, None
+        else:
+            ch.rows = ch.ids = None  # staged scratch: release as consumed
         t1 = time.perf_counter()
         self._stage_time("parse", t1 - t0)
         if not incremental:
@@ -165,20 +193,185 @@ class StreamFlusher:
         _, ch.keys, ch.stats = self.store._encode_batch(self.type_name, ch.fc)
         t2 = time.perf_counter()
         self._stage_time("keys", t2 - t1)
+        if sort:
+            self._sort_chunk(ch)
+
+    def _sort_chunk(self, ch: _FlushChunk) -> None:
+        """Shard-radix-sort one chunk's (bin, z) keys at its assigned
+        batch offset (the 'sort' stage; split out so pre-staged chunks
+        can sort once their final base is known)."""
         fault.fault_point("stream.flush.sort")
+        t0 = time.perf_counter()
         for name, k in ch.keys.items():
             if len(k.zs) and k.sub is None:
                 ch.runs[name] = shsort.shard_runs(
                     k.bins, k.zs, ch.base, max(self.config.chunk_rows, 1)
                 )
-        self._stage_time("sort", time.perf_counter() - t2)
+        self._stage_time("sort", time.perf_counter() - t0)
+
+    # -- pre-staging (round 11: parse/keys leave the fold window) ---------
+    def stage(self, pairs: Sequence[tuple]) -> int:
+        """Stage deferred update rows NOW, at micro-flush time: parse +
+        key-encode them through the warm pool so the eventual fold pays
+        only sort+merge+publish. Rows already staged under the same row
+        object are skipped; a row re-updated later is re-staged by the
+        next call (latest object wins at fold via the identity check).
+        Returns rows submitted for staging."""
+        if not pairs:
+            return 0
+        fault.fault_point("stream.fold.stage")
+        pool = self._ensure_pool()
+        chunk_rows = max(int(self.config.chunk_rows), 1)
+        with self._stage_lock:
+            fresh = [
+                (str(fid), row) for fid, row in pairs
+                if self._staged_rows.get(str(fid)) is not row
+            ]
+            if not fresh:
+                return 0
+            for fid, row in fresh:
+                self._staged_rows[fid] = row
+            for s in range(0, len(fresh), chunk_rows):
+                part = fresh[s : s + chunk_rows]
+                ch = _FlushChunk(
+                    0, [r for _, r in part], [fid for fid, _ in part]
+                )
+                fut = pool.submit(
+                    self._run_chunk, ch, True, retain=True, sort=False
+                )
+                self._staged.append((ch, fut))
+        self.metrics.counter("geomesa.stream.fold.prestaged", len(fresh))
+        return len(fresh)
+
+    def _discard_staged(self) -> None:
+        with self._stage_lock:
+            self._staged, self._staged_rows = [], {}
+
+    def unstage(self, ids: Sequence[str]) -> int:
+        """Drop staged state for rows REMOVED from the hot tier
+        (delete / expiry sweep): a removed row never appears in another
+        flush snapshot, so its staged chunk would otherwise be retained
+        forever (an unbounded leak under update-then-delete workloads).
+        Chunks left with no staged-live row drop whole; a chunk that
+        still carries live staged rows stays (its dead rows mask out at
+        the fold's identity check). Returns chunks dropped."""
+        dead = {str(i) for i in ids}
+        if not dead:
+            return 0
+        with self._stage_lock:
+            if not self._staged and not self._staged_rows:
+                return 0
+            for fid in dead:
+                self._staged_rows.pop(fid, None)
+            kept = [
+                e for e in self._staged
+                if any(fid in self._staged_rows for fid in e[0].ids)
+            ]
+            dropped = len(self._staged) - len(kept)
+            self._staged = kept
+        return dropped
+
+    def _take_staged(self, snapshot: Sequence[tuple]):
+        """Consume the pre-staged chunks whose rows this batch is about
+        to publish: await their parse/keys futures, identity-check every
+        staged row against the CURRENT batch (a re-updated or deleted
+        row never folds stale; the newest staging of an id wins), and
+        return ``(usable chunks, leftover (id, row) pairs)`` — leftovers
+        stage freshly in the fold window. Chunks whose rows are NOT in
+        this batch stay staged untouched — an appends-only micro-flush
+        must not burn the overlay's staging (the batch and the staged
+        rows are disjoint there). A staged chunk that failed (injected
+        fault, bad row) is dropped whole — its rows revert to fresh
+        staging — and the first failure aborts this flush attempt like
+        any stage fault (cold store untouched; the retry re-stages)."""
+        with self._stage_lock:
+            staged = list(self._staged)
+        if not staged:
+            return [], list(snapshot)
+        current = {str(fid): row for fid, row in snapshot}
+        error: "BaseException | None" = None
+        retained: list = []   # (ch, fut), oldest-first after reverse
+        consumed: list = []
+        claimed: set = set()
+        # fid -> the ROW OBJECT whose staging this fold spent: the
+        # bookkeeping pop below is identity-conditional, so a concurrent
+        # stage() that re-registered the id with a NEWER row keeps its
+        # entry (popping it would double-stage the row later)
+        spent: dict = {}
+        for ch, fut in reversed(staged):  # newest staging of an id wins
+            if not any(fid in current for fid in ch.ids):
+                retained.append((ch, fut))
+                continue
+            try:
+                fut.result()
+            except BaseException as e:
+                if error is None:
+                    error = e
+                rows_src = ch.src_rows if ch.src_rows is not None else ch.rows
+                if rows_src is not None:
+                    spent.update(zip(ch.ids, rows_src))
+                continue
+            spent.update(zip(ch.ids, ch.src_rows))
+            keep = np.fromiter(
+                (
+                    fid not in claimed and current.get(fid) is row
+                    for fid, row in zip(ch.ids, ch.src_rows)
+                ),
+                bool, count=len(ch.ids),
+            )
+            if not keep.any():
+                continue
+            claimed.update(
+                fid for fid, k in zip(ch.ids, keep.tolist()) if k
+            )
+            if not keep.all():
+                # partially stale (or straddling the batch): mask the
+                # columnar rows and re-encode keys/stats for the kept
+                # subset (the expensive parse is already done; only
+                # re-updated rows pay again, freshly)
+                ch.fc = ch.fc.mask(keep)
+                ch.ids = [
+                    fid for fid, k in zip(ch.ids, keep.tolist()) if k
+                ]
+                _, ch.keys, ch.stats = self.store._encode_batch(
+                    self.type_name, ch.fc
+                )
+            ch.src_rows = None
+            consumed.append(ch)
+        retained.reverse()
+        consumed.reverse()
+        with self._stage_lock:
+            still = {id(e[0]) for e in self._staged}
+            tapped = {id(e[0]) for e in staged}
+            # write back: a retained chunk survives only if it is STILL
+            # registered — a concurrent unstage() (hot-tier delete/expire
+            # during our future wait) must stay dropped, not resurrect —
+            # alongside anything staged since our snapshot
+            self._staged = [
+                e for e in retained if id(e[0]) in still
+            ] + [e for e in self._staged if id(e[0]) not in tapped]
+            for fid, row in spent.items():
+                if self._staged_rows.get(fid) is row:
+                    del self._staged_rows[fid]
+        if error is not None:
+            raise error
+        rest = [
+            (fid, row) for fid, row in snapshot if str(fid) not in claimed
+        ]
+        return consumed, rest
 
     # -- the flush --------------------------------------------------------
-    def flush(self, snapshot: Sequence[tuple], incremental: "bool | None" = None) -> int:
+    def flush(
+        self, snapshot: Sequence[tuple], incremental: "bool | None" = None,
+        pacer=None, on_slice=None,
+    ) -> int:
         """Fold one hot snapshot (``[(id, row dict)]``) into the cold
-        store: stage micro-chunks through the warm parse/keys/sort
-        workers under the bounded admission window, then ONE atomic
-        publish. Returns rows flushed. ``incremental=False`` (or the
+        store: consume any pre-staged update chunks (their parse/keys ran
+        at micro-flush time), stage the rest through the warm
+        parse/keys/sort workers under the bounded admission window, then
+        publish — atomically per fold slice (``pacer``/``on_slice``
+        thread through to :meth:`DataStore.fold_upsert`'s sliced fold).
+        Returns rows flushed. ``incremental=False`` (or the
         ``geomesa.stream.incremental`` knob) routes the commit through
         the legacy ``cold.upsert`` delete-and-rewrite instead — the
         bench baseline and the escape hatch for adapters without the
@@ -190,12 +383,28 @@ class StreamFlusher:
             incremental = self.config.incremental
         pool = self._ensure_pool()
         chunk_rows = max(int(self.config.chunk_rows), 1)
-        chunks: list[_FlushChunk] = []
+        if incremental and self.config.prestage:
+            chunks, rest = self._take_staged(snapshot)
+        else:
+            if not incremental:
+                # the legacy path re-publishes the whole hot state; any
+                # staged scratch is superseded by this full drain
+                self._discard_staged()
+            chunks, rest = [], list(snapshot)
+        base = 0
+        for ch in chunks:  # final batch order: staged first, then fresh
+            ch.base = base
+            base += len(ch.fc)
         futures = []
         error: "BaseException | None" = None
         try:
-            for s in range(0, n, chunk_rows):
-                part = snapshot[s : s + chunk_rows]
+            if incremental:
+                for ch in chunks:
+                    # pre-staged chunks deferred their shard sort until
+                    # this flush assigned their batch offsets
+                    futures.append(pool.submit(self._sort_chunk, ch))
+            for s in range(0, len(rest), chunk_rows):
+                part = rest[s : s + chunk_rows]
                 if not self._sem.acquire(blocking=False):
                     # bounded admission window: backpressures staging so
                     # at most queue_depth chunks sit in the pool at once
@@ -204,7 +413,7 @@ class StreamFlusher:
                     self.metrics.counter("geomesa.stream.queue_full")
                     self._sem.acquire()
                 ch = _FlushChunk(
-                    s, [r for _, r in part], [fid for fid, _ in part]
+                    base + s, [r for _, r in part], [fid for fid, _ in part]
                 )
                 chunks.append(ch)
                 try:
@@ -230,17 +439,22 @@ class StreamFlusher:
             raise error
 
         t0 = time.perf_counter()
-        out = self._commit(chunks, incremental)
+        out = self._commit(chunks, incremental, pacer, on_slice)
         self._stage_time("commit", time.perf_counter() - t0)
         self.flushes += 1
         self.metrics.counter("geomesa.stream.flushes")
         self.metrics.counter("geomesa.stream.rows", out)
         return out
 
-    def _commit(self, chunks: list, incremental: bool) -> int:
-        """The single publish: concat the staged chunks, k-way-merge the
-        sorted runs into per-index batch argsorts, and fold (or legacy-
-        upsert) under bounded retry at the ``streaming.persist`` point."""
+    def _commit(
+        self, chunks: list, incremental: bool, pacer=None, on_slice=None
+    ) -> int:
+        """The publish: concat the staged chunks, k-way-merge the sorted
+        runs into per-index batch argsorts, and fold (or legacy-upsert)
+        under bounded retry at the ``streaming.persist`` point. Fold
+        publishes land per slice (docs/streaming.md "Incremental fold");
+        the retry re-folds the whole batch, which is idempotent over any
+        already-published slice prefix."""
         from geomesa_tpu.storage.delta import concat_keys
 
         fcs = [ch.fc for ch in chunks]
@@ -281,6 +495,8 @@ class StreamFlusher:
             return self.store.fold_upsert(
                 self.type_name, fc, keys=keys, stats=stats,
                 presorted=presorted or None,
+                slice_rows=self.config.slice_rows,
+                pacer=pacer, on_slice=on_slice,
             )
 
         return fault.with_retries(attempt, metrics=self.metrics)

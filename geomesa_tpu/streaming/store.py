@@ -155,10 +155,25 @@ class LambdaStore:
         DELETE may resurrect on recovery — allowed; an unacknowledged
         failed WRITE must never be served first and lost after.)"""
         ids = [str(i) for i in ids]
-        hook = self.wal.log_delete if self.wal is not None else None
-        n = self.hot.delete(ids, after_remove=hook)
+        n = self.hot.delete(ids, after_remove=self._removed_hook)
         self._gauge_hot()
         return n
+
+    def _removed_hook(self, removed: Sequence[str]) -> None:
+        """Runs under the hot lock after a delete's removals: log to the
+        WAL (apply-then-record) and drop the removed rows' pre-staged
+        fold state — a removed row never re-enters a flush snapshot, so
+        a staged chunk it pinned would otherwise be retained forever."""
+        if self.wal is not None:
+            self.wal.log_delete(removed)
+        self.flusher.unstage(removed)
+
+    def _swept_hook(self, stale: Sequence[str]) -> None:
+        """The expiry-sweep twin of :meth:`_removed_hook` (the WAL logs
+        the exact swept ids — the sweep is wall-clock-driven)."""
+        if self.wal is not None:
+            self.wal.log_expire(stale)
+        self.flusher.unstage(stale)
 
     def expire(self, now_ms: Optional[int] = None) -> int:
         """TTL sweep of the hot tier (requires ``expiry_ms``). The
@@ -166,8 +181,7 @@ class LambdaStore:
         lock (the sweep is wall-clock-driven, so replay needs the
         decision, not the clock; apply-then-record like
         :meth:`delete`)."""
-        hook = self.wal.log_expire if self.wal is not None else None
-        n = self.hot.expire(now_ms=now_ms, on_swept=hook)
+        n = self.hot.expire(now_ms=now_ms, on_swept=self._swept_hook)
         self._gauge_hot()
         return n
 
@@ -240,12 +254,27 @@ class LambdaStore:
             batch = snapshot  # fold everything: updates + appends, one publish
         elif n_upd:
             batch = [sn for sn, e in zip(snapshot, exists) if not e]
+            if self.config.prestage:
+                # pre-stage the deferred updates NOW (docs/streaming.md
+                # "Incremental fold"): their parse/keys run through the
+                # warm workers while they wait in the overlay, so the
+                # eventual fold window pays only sort+merge+publish
+                self.flusher.stage(
+                    [sn for sn, e in zip(snapshot, exists) if e]
+                )
         else:
             batch = snapshot
         if not batch:
             return 0
-        n = self.flusher.flush(batch, incremental=True)
-        self._log_watermark(batch, incremental=True)
+        n = self.flusher.flush(
+            batch, incremental=True,
+            pacer=self._fold_pacer, on_slice=self._fold_slice_published,
+        )
+        # no trailing watermark: fold_upsert invoked on_slice after every
+        # atomic publish (append, monolithic, or per slice), so the WAL
+        # watermark already covers exactly the published ids — advanced
+        # PER SLICE, so a crash mid-fold replays only the unpublished
+        # suffix (durability semantics otherwise unchanged)
         fault.fault_point("streaming.evict")
         known.update(fid for fid, _ in batch)  # published: now cold-resident
         # identity-checked eviction: a write racing the publish keeps its
@@ -253,6 +282,32 @@ class LambdaStore:
         self.hot.evict(batch)
         self._gauge_hot()
         return n
+
+    def _fold_slice_published(self, ids: Sequence[str]) -> None:
+        """One atomic fold publish landed (a slice, or the whole batch):
+        advance the WAL flush watermark over exactly those ids — the WAL
+        and the LSM flush policy agree on cold-residency per slice, and
+        replay re-folds only what was never published. Written AFTER the
+        publish, like :meth:`_log_watermark` (a crash between publish
+        and watermark recovers the rows HOT — never a loss)."""
+        if self.wal is not None:
+            self.wal.log_watermark(list(ids), True)
+
+    def _fold_pacer(self) -> None:
+        """Between-slice yield (docs/streaming.md "Incremental fold"):
+        with a serving tier attached, wait (bounded by
+        ``geomesa.stream.fold.yield.ms``) for the QueryScheduler's
+        admission queue to drain so live dashboard queries interleave
+        with the fold instead of queueing behind it; otherwise just
+        yield the interpreter."""
+        import time
+
+        sched = getattr(self.cold, "scheduler", None)
+        wait_s = max(float(self.config.fold_yield_ms), 0.0) / 1e3
+        if sched is not None and not sched.closed and wait_s > 0:
+            sched.admission_gap(wait_s)
+        else:
+            time.sleep(0)
 
     def _log_watermark(self, batch: Sequence[tuple], incremental: bool) -> None:
         """Flush-seqno watermark: the publish above committed (to the

@@ -42,34 +42,64 @@ import json
 import os
 import sys
 
-# scenario -> (guarded metric, direction): "lower" metrics are costs
-# (regression = rising), "higher" metrics are throughputs (regression =
-# falling)
+# scenario -> guarded metric specs, each (field path, direction,
+# fallback baseline paths): "lower" metrics are costs (regression =
+# rising), "higher" metrics are throughputs (regression = falling).
+# Paths are dot-nested into the scenario row ("query.fold_window_p99_ms");
+# fallbacks let a renamed field gate against a baseline recorded under
+# the old name (round 11: fold_window_p99_ms was in_fold_p99_ms).
 SCENARIO_SPECS = {
-    "z2_polygon_pip_batch": ("raster_ms_per_q", "lower"),
-    "z2_polygon_join": ("raster_ms", "lower"),
-    "host_grid_join": ("adaptive_ms", "lower"),
-    "stream_sustained": ("streamed_rows_per_s", "higher"),
-    "stream_wal": ("wal_interval_rows_per_s", "higher"),
-    "wal_replay": ("replay_rows_per_s", "higher"),
+    "z2_polygon_pip_batch": [("raster_ms_per_q", "lower", ())],
+    "z2_polygon_join": [("raster_ms", "lower", ())],
+    "host_grid_join": [("adaptive_ms", "lower", ())],
+    "stream_sustained": [
+        ("streamed_rows_per_s", "higher", ()),
+        ("query.fold_window_p99_ms", "lower", ("query.in_fold_p99_ms",)),
+    ],
+    "stream_wal": [("wal_interval_rows_per_s", "higher", ())],
+    "wal_replay": [("replay_rows_per_s", "higher", ())],
+    "knn_batched": [("batched_qps", "higher", ())],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
-# needed): scenario -> (field, minimum, message). The WAL bound is the
-# ISSUE 10 acceptance: sync=interval overhead within 15% of no-WAL.
+# needed): scenario -> (field path, bound, kind, message). kind "min":
+# the value may not fall below the bound (the ISSUE 10 WAL acceptance);
+# kind "max": it may not exceed it (the round-11 pause-kill acceptance:
+# fold-window query p99 within 2x steady state; the round-11 kNN bar:
+# batched throughput >= 60 q/s).
 FRESH_BOUNDS = {
-    "stream_wal": (
-        "interval_over_nowal", 0.85,
+    "stream_wal": [(
+        "interval_over_nowal", 0.85, "min",
         "sync=interval throughput must stay within 15% of no-WAL",
-    ),
+    )],
+    "stream_sustained": [(
+        "query.fold_window_p99_over_steady", 2.0, "max",
+        "fold-window query p99 must stay within 2x steady-state p99",
+    )],
+    "knn_batched": [(
+        "batched_qps", 60.0, "min",
+        "batched kNN must clear the 60 q/s bar (VERDICT weak #5)",
+    )],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
 BASELINES = {
     "BENCH_STREAM": "BENCH_STREAM.json",
     "BENCH_WAL": "BENCH_WAL.json",
+    "BENCH_KNN": "BENCH_KNN.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
+
+
+def _get(row: dict, path: str):
+    """Dot-nested field lookup ("query.fold_window_p99_ms"); None when
+    any step is missing."""
+    cur = row
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
 
 
 def default_baseline(fresh_path: str, repo: str) -> str:
@@ -108,35 +138,50 @@ def gate(fresh_path: str, baseline_path: str, max_regress: float) -> int:
               file=sys.stderr)
         return 2
     failed = False
-    for s, (field, lo, why) in FRESH_BOUNDS.items():
-        if s not in fresh or field not in fresh[s]:
+    for s, bounds in FRESH_BOUNDS.items():
+        if s not in fresh:
             continue
-        val = float(fresh[s][field])
-        verdict = "FAIL" if val < lo else "ok"
-        print(f"{verdict:4s} {s}: {field} {val:.3f} (floor {lo}; {why})")
-        if val < lo:
-            failed = True
+        for field, bound, kind, why in bounds:
+            val = _get(fresh[s], field)
+            if val is None:
+                continue
+            val = float(val)
+            bad = val < bound if kind == "min" else val > bound
+            verdict = "FAIL" if bad else "ok"
+            edge = "floor" if kind == "min" else "ceiling"
+            print(f"{verdict:4s} {s}: {field} {val:.3f} ({edge} {bound}; {why})")
+            if bad:
+                failed = True
     for s in shared:
-        field, direction = SCENARIO_SPECS[s]
         f_row, b_row = fresh[s], base[s]
         if not f_row.get("identical", False):
             print(f"FAIL {s}: fresh run's identical flag is not true")
             failed = True
-        if field not in f_row or field not in b_row:
-            continue
-        f_val, b_val = float(f_row[field]), float(b_row[field])
-        if direction == "lower":
-            ratio = f_val / max(b_val, 1e-12) - 1.0
-        else:
-            ratio = 1.0 - f_val / max(b_val, 1e-12)
-        verdict = "FAIL" if ratio > max_regress else "ok"
-        arrow = "rose" if direction == "lower" else "fell"
-        print(
-            f"{verdict:4s} {s}: {field} {b_val:.3f} -> {f_val:.3f} "
-            f"({arrow} {ratio:+.1%}, limit +{max_regress:.0%})"
-        )
-        if ratio > max_regress:
-            failed = True
+        for field, direction, fallbacks in SCENARIO_SPECS[s]:
+            f_val = _get(f_row, field)
+            b_val = _get(b_row, field)
+            b_name = field
+            for fb in fallbacks if b_val is None else ():
+                b_val = _get(b_row, fb)
+                if b_val is not None:
+                    b_name = fb
+                    break
+            if f_val is None or b_val is None:
+                continue
+            f_val, b_val = float(f_val), float(b_val)
+            if direction == "lower":
+                ratio = f_val / max(b_val, 1e-12) - 1.0
+            else:
+                ratio = 1.0 - f_val / max(b_val, 1e-12)
+            verdict = "FAIL" if ratio > max_regress else "ok"
+            arrow = "rose" if direction == "lower" else "fell"
+            via = "" if b_name == field else f" (baseline field {b_name})"
+            print(
+                f"{verdict:4s} {s}: {field} {b_val:.3f} -> {f_val:.3f} "
+                f"({arrow} {ratio:+.1%}, limit +{max_regress:.0%}){via}"
+            )
+            if ratio > max_regress:
+                failed = True
     return 1 if failed else 0
 
 

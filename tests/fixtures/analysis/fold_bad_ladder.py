@@ -1,0 +1,44 @@
+"""KNOWN-BAD fixture: a FOLD-side bucket ladder outside the grouping key.
+
+Round 11's incremental fold ships no static-bucket shapes (its device
+plan runs eager ops), but the `fused-key-dimension` rule was widened to
+`fold_<dim>_bucket` so a future fold ladder cannot silently recreate
+the PR 5 defect class: here a fold slice ladder (`fold_s_bucket`) sizes
+the fold-plan operands while the module's grouping key omits that
+dimension — the rule must produce exactly one finding (dimension S).
+"""
+
+
+def fold_s_bucket(n):
+    return 0 if n <= 0 else max(256, n)
+
+
+def fused_r_bucket(n):
+    return 0 if n <= 0 else max(16, n)
+
+
+def n_rints_of(rast):
+    return 0 if rast is None else len(rast) - 1
+
+
+def block_scan_multi(members, n_rints=0, n_slice=0):
+    return members, n_rints, n_slice
+
+
+class Table:
+    def scan_submit_many(self, configs):
+        groups = {}
+        for j, config in enumerate(configs):
+            r_bucket = fused_r_bucket(n_rints_of(config.rast))
+            # BUG under test: no fold-slice bucket term in the key
+            key = (config.boxes is not None, r_bucket)
+            groups.setdefault(key, []).append((j, config))
+        for _key, members in groups.items():
+            self._submit_fold_chunk(members)
+
+    def _submit_fold_chunk(self, members):
+        n_slice = fold_s_bucket(max(len(m[1].rows) for m in members))
+        chunk_r = fused_r_bucket(
+            max(n_rints_of(m[1].rast) for m in members)
+        )
+        return block_scan_multi(members, n_rints=chunk_r, n_slice=n_slice)

@@ -384,3 +384,59 @@ class TestColumnProjection:
         table.scan(cfg2)
         assert table.last_scan_cols == ("tbin", "toff")
         assert table.last_scan_bytes < bytes_full
+
+
+class TestLinkDerivedConstants:
+    """Round 11 (VERDICT weak #8): the fused-chunk slot cap and M-bucket
+    floor re-derive from the measured link probe instead of the 66 ms-era
+    hand tuning; bench.py installs them before warmup."""
+
+    def teardown_method(self):
+        bk.set_link_constants(None)  # never leak tuning into other tests
+
+    def test_design_link_reproduces_hand_tuning(self):
+        from geomesa_tpu.storage.table import FUSED_CHUNK_SLOTS
+
+        d = bk.derive_link_constants(66.0, 30.0)
+        assert d["fused_chunk_slots"] == FUSED_CHUNK_SLOTS
+        assert d["m_floor"] == bk.M_BUCKETS[0]
+
+    def test_fast_link_shrinks_chunks_and_raises_floor(self):
+        d = bk.derive_link_constants(0.4, 2000.0)
+        assert d["fused_chunk_slots"] == 256
+        assert d["m_floor"] == 128
+        # intermediate links scale between the endpoints
+        mid = bk.derive_link_constants(20.0, 30.0)
+        assert 256 <= mid["fused_chunk_slots"] <= 1024
+        assert mid["m_floor"] == bk.M_BUCKETS[0]
+
+    def test_install_changes_bucket_and_cap_then_resets(self):
+        base_bucket = bk.m_bucket_of(10)
+        bk.set_link_constants(bk.derive_link_constants(0.4, 2000.0))
+        try:
+            # the floor applies ONLY to the single-query candidate
+            # ladder — fused slot sizing (bucket_of) must stay unfloored
+            # or small tables' chunks would inflate with pad-slot work
+            assert bk.bucket_of(10) == 32
+            assert bk.m_bucket_of(10) == 128
+            assert len(bk.pad_bids(np.arange(10), 100)[0]) == 128
+            assert bk.m_bucket_of(300) == 512   # ladder above floor intact
+            assert bk.fused_slot_cap() == 256
+            assert bk.link_constants()["m_floor"] == 128
+            # a table built now clamps its fused shape to the new cap
+            ds = DataStore(tile=64)
+            sft = FeatureType.from_spec("lk", "*geom:Point:srid=4326")
+            ds.create_schema(sft)
+            n = 40_000
+            rng = np.random.default_rng(3)
+            ds.write("lk", FeatureCollection.from_columns(
+                sft, np.arange(n).astype(str),
+                {"geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n))},
+            ), check_ids=False)
+            ds.compact("lk")
+            t = ds.table("lk", ds.indexes("lk")[0].name)
+            assert t.fused_slots <= 256
+        finally:
+            bk.set_link_constants(None)
+        assert bk.m_bucket_of(10) == base_bucket
+        assert bk.fused_slot_cap() == 2048

@@ -366,3 +366,20 @@ def test_queue_wait_attribution(ds):
     # the device-scan trace reaches the caller's explainer even through
     # the fused dispatch (submit_many per-plan explains)
     assert any("Device scan" in l for l in exp.lines)
+
+
+def test_admission_gap_drains_and_bounds(ds):
+    """The fold's between-slice yield (docs/streaming.md "Incremental
+    fold"): an idle queue returns immediately; a queue that cannot drain
+    (unstarted dispatcher) returns False at the bound; once the
+    dispatcher runs, the gap closes."""
+    sched = QueryScheduler(ds, ServingConfig(window_ms=0.0))
+    assert sched.admission_gap(0.01) is True  # idle: immediate
+    fut = sched.submit("ev", Q)               # queued, nothing drains it
+    t0 = time.perf_counter()
+    assert sched.admission_gap(0.05) is False
+    assert time.perf_counter() - t0 < 2.0     # bounded wait
+    sched.start()
+    assert sched.admission_gap(5.0) is True
+    assert len(fut.result(10)) > 0
+    sched.close()

@@ -115,6 +115,15 @@ def test_pr5_e_bucket_grouping_key_bug_is_caught(fixture_result):
     assert _at(fixture_result, "fused_good.py") == []
 
 
+def test_fold_side_bucket_ladder_is_caught(fixture_result):
+    """Round 11: the rule pattern widened to fold_<dim>_bucket — a
+    future fold-operand ladder omitted from the grouping key is the
+    same defect class as the PR 5 E-bucket bug."""
+    bad = _at(fixture_result, "fold_bad_ladder.py", "fused-key-dimension")
+    assert len(bad) == 1, _render(bad)
+    assert "fold_s_bucket" in bad[0].message
+
+
 def test_unlocked_metrics_registry_mutation_is_caught(fixture_result):
     bad = _at(fixture_result, "locks_bad_registry.py", "lock-guarded-mutation")
     assert len(bad) == 1, _render(bad)

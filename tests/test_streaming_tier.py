@@ -210,6 +210,308 @@ class TestFoldUpsert:
         assert len(ds.query("t", near)) == n_near + 50
 
 
+# -- the sliced fold (round 11: kill the fold pause) ------------------------
+
+
+def _adversarial_batch(sft, seed=31):
+    """A fold batch crafted for adversarial slice boundaries: a
+    pure-APPEND prefix (a slice with nothing to replace), a pure-UPDATE
+    run, then a mixed tail — so small ``slice_rows`` values cut slices
+    of every composition, straddling chunk/bin boundaries."""
+    rng = np.random.default_rng(seed)
+    upd = rng.choice(4000, 600, replace=False)
+    ids = (
+        [f"n{j}" for j in range(150)]
+        + [f"f{i}" for i in upd[:400]]
+        + [f"n{150 + j}" for j in range(50)]
+        + [f"f{i}" for i in upd[400:]]
+    )
+    return ids, _batch(sft, ids, seed=seed + 1, name="u")
+
+
+class TestSlicedFold:
+    # 64 < the tile-64 block (4096 rows); 100 straddles the batch's
+    # composition boundaries; 1000 gives one fat slice + a remainder
+    @pytest.mark.parametrize("slice_rows", [64, 100, 1000])
+    def test_bit_identical_to_monolithic_and_recompaction(self, slice_rows):
+        a, b, c = _build(), _build(), _build()
+        sft = a.get_schema("t")
+        ids, batch = _adversarial_batch(sft)
+        a.fold_upsert("t", batch)  # monolithic (slice_rows default off at this size)
+        published: list = []
+        b.fold_upsert(
+            "t", batch, slice_rows=slice_rows,
+            on_slice=lambda i: published.append(list(i)),
+        )
+        # every id published exactly once, in batch order, per slice
+        assert [f for sl in published for f in sl] == ids
+        assert len(published) == -(-len(ids) // slice_rows)
+        _assert_tables_identical(a, b)
+        # and against the delete-and-rewrite recompaction oracle
+        c.upsert("t", batch)
+        for ds in (b, c):
+            ds.compact("t")
+        for q in [
+            "bbox(geom,-20,-20,40,40)",
+            "bbox(geom,0,0,10,10) AND dtg DURING "
+            "2024-01-01T00:00:00Z/2024-01-20T00:00:00Z",
+        ]:
+            assert sorted(b.query("t", q).ids.tolist()) == sorted(
+                c.query("t", q).ids.tolist()
+            ), q
+
+    def test_mid_fold_state_is_exact_prefix_fold(self):
+        """A crash between slices leaves EXACTLY the fold of the applied
+        batch prefix — one live version of every id, queries consistent
+        — and re-folding the whole batch converges (idempotent)."""
+        a, b = _build(), _build()
+        sft = a.get_schema("t")
+        ids, batch = _adversarial_batch(sft)
+        sr = 128
+        with fault.inject("stream.fold.slice", kind="crash", after=2, times=1):
+            with pytest.raises(fault.InjectedCrash):
+                b.fold_upsert("t", batch, slice_rows=sr)
+        # prefix oracle: fold of the first two slices only
+        prefix = batch.take(np.arange(2 * sr))
+        a.fold_upsert("t", prefix)
+        _assert_tables_identical(a, b)
+        # retry converges to the full fold, bit-identical to monolithic
+        b.fold_upsert("t", batch, slice_rows=sr)
+        c = _build()
+        c.fold_upsert("t", batch)
+        for q in ["bbox(geom,-60,-60,60,60)", "bbox(geom,-5,-5,25,25)"]:
+            assert sorted(b.query("t", q).ids.tolist()) == sorted(
+                c.query("t", q).ids.tolist()
+            ), q
+
+    def test_fold_fault_matrix_publish_and_stage(self):
+        """crash/io_error at the new stream.fold.* points: an io_error
+        retries inside the flusher's bounded retry (the whole-batch
+        re-fold is idempotent over published slices); a crash surfaces
+        with the published prefix committed, hot rows resident, and
+        LambdaStore reads exact throughout (hot-wins shadowing)."""
+        ds = _build(n=2000, seed=8)
+        lam = LambdaStore(ds, "t", config=StreamConfig(
+            chunk_rows=256, fold_rows=1, slice_rows=200,
+        ))
+        rows = [
+            {"name": "v2", "dtg": T0 + i, "geom": geo.Point(i * 0.01, 2.0)}
+            for i in range(800)
+        ]
+        ids = [f"f{i}" for i in range(600)] + [f"x{j}" for j in range(200)]
+        lam.write([dict(r) for r in rows], ids=ids)
+        expect = sorted(
+            [f"f{i}" for i in range(600, 2000)] + ids
+        )
+        # crash mid-fold: published prefix + resident hot = exact reads
+        with fault.inject("stream.fold.publish", kind="crash", after=1, times=1):
+            with pytest.raises(fault.InjectedCrash):
+                lam.flush()
+        assert len(lam.hot) == 800  # eviction never ran
+        got = sorted(str(i) for i in lam.query("bbox(geom,-60,-60,60,60)").ids.tolist())
+        assert got == expect
+        # transient io_error at the slice point: retried internally
+        with fault.inject("stream.fold.slice", kind="io_error", times=1):
+            assert lam.flush() == 800
+        assert len(lam.hot) == 0
+        got = sorted(str(i) for i in lam.query("bbox(geom,-60,-60,60,60)").ids.tolist())
+        assert got == expect
+        lam.close()
+
+    def test_stage_fault_leaves_flush_atomic(self):
+        """A fault while PRE-STAGING (micro-flush time) aborts that flush
+        before any publish; the retry re-stages and converges."""
+        ds = _build(n=500, seed=9)
+        lam = LambdaStore(ds, "t", config=StreamConfig(chunk_rows=64))
+        before = len(ds.features("t"))
+        lam.write([
+            {"name": "u", "dtg": T0 + i, "geom": geo.Point(i * 0.01, -1.0)}
+            for i in range(100)
+        ], ids=[f"f{i}" for i in range(50)] + [f"new{j}" for j in range(50)])
+        with fault.inject("stream.fold.stage", kind="io_error", times=None):
+            with pytest.raises(OSError):
+                lam.flush()
+        assert len(ds.features("t")) == before  # nothing published
+        assert len(lam.hot) == 100
+        assert lam.flush() == 50   # appends publish; updates stay deferred
+        assert lam.persist_hot() == 50
+        assert len(lam.hot) == 0
+        lam.close()
+
+    def test_prestaged_rows_skip_fold_window_parse(self):
+        """Deferred updates parse/key at micro-flush time; the fold
+        window re-parses NOTHING when no rows changed after staging —
+        and a row re-updated after staging folds its NEWEST version."""
+        reg = MetricsRegistry()
+        ds = _build(n=1000, seed=10, metrics=reg)
+        lam = LambdaStore(ds, "t", config=StreamConfig(chunk_rows=128))
+        upd = [
+            {"name": "s1", "dtg": T0 + i, "geom": geo.Point(i * 0.01, 3.0)}
+            for i in range(200)
+        ]
+        lam.write([dict(r) for r in upd], ids=[f"f{i}" for i in range(200)])
+        assert lam.flush() == 0     # pure updates: deferred + pre-staged
+        assert reg.counter_value("geomesa.stream.fold.prestaged") == 200
+        # re-update a subset AFTER staging: the newer rows must win
+        lam.write([
+            {"name": "s2", "dtg": T0 + i, "geom": geo.Point(i * 0.01, 3.5)}
+            for i in range(40)
+        ], ids=[f"f{i}" for i in range(40)])
+        assert lam.flush() == 0
+        # second stage covers only the re-updated rows
+        assert reg.counter_value("geomesa.stream.fold.prestaged") == 240
+        for _ch, fut in list(lam.flusher._staged):
+            fut.result()  # staging is async: settle before counting
+        parses = reg.timers["geomesa.stream.parse"].count
+        assert lam.persist_hot() == 200
+        # the fold window parsed nothing fresh: every row came pre-staged
+        assert reg.timers["geomesa.stream.parse"].count == parses
+        assert sorted(
+            str(i) for i in lam.query("name = 's2'").ids.tolist()
+        ) == [f"f{i}" for i in sorted(range(40), key=str)]
+        assert len(lam.query("name = 's1'")) == 160
+        lam.close()
+
+    def test_deleted_rows_release_staged_chunks(self):
+        """Update-then-delete must not pin pre-staged fold state forever
+        (the staged chunk's rows never re-enter a flush snapshot): the
+        hot-tier removal hooks drop the staged chunk + bookkeeping."""
+        ds = _build(n=300, seed=14)
+        lam = LambdaStore(ds, "t", config=StreamConfig(chunk_rows=64))
+        for cycle in range(3):
+            lam.write([
+                {"name": f"c{cycle}", "dtg": T0 + i,
+                 "geom": geo.Point(i * 0.01, -2.0)}
+                for i in range(40)
+            ], ids=[f"f{i}" for i in range(40)])
+            assert lam.flush() == 0  # pure updates: deferred + staged
+            assert len(lam.flusher._staged) >= 1
+            lam.delete([f"f{i}" for i in range(40)])
+            assert lam.flusher._staged == []         # chunk released
+            assert lam.flusher._staged_rows == {}    # bookkeeping too
+        # and the store still answers exactly (the rows are gone hot,
+        # stale cold copies shadowed... deletes are hot-tier only, so
+        # the ORIGINAL cold rows resurface — the documented semantics)
+        assert len(lam.query("name = 'c2'")) == 0
+        lam.close()
+
+    def test_unstage_during_fold_wait_stays_dropped(self):
+        """A hot-tier delete landing WHILE a fold waits on staged
+        futures must stay dropped: the fold's write-back may not
+        resurrect a chunk unstage() released mid-wait (and must pop
+        bookkeeping identity-conditionally, so concurrent re-staging
+        keeps its entry)."""
+        ds = _build(n=200, seed=15)
+        lam = LambdaStore(ds, "t", config=StreamConfig(chunk_rows=32))
+        fl = lam.flusher
+        mk = lambda lo: [
+            {"name": "s", "dtg": T0 + i, "geom": geo.Point(i * 0.01, 1.0)}
+            for i in range(lo, lo + 32)
+        ]
+        rows_a, rows_b = mk(0), mk(32)
+        ids_a = [f"f{i}" for i in range(32)]
+        ids_b = [f"f{i}" for i in range(32, 64)]
+        with fault.inject(
+            "stream.flush.keys", kind="latency", times=None, delay_s=0.3
+        ):
+            fl.stage(list(zip(ids_a, rows_a)))  # chunk A: in the batch
+            fl.stage(list(zip(ids_b, rows_b)))  # chunk B: retained side
+            t = threading.Thread(
+                target=lambda: (time.sleep(0.05), fl.unstage(ids_b))
+            )
+            t.start()
+            # B is classified retained instantly; A's future wait spans
+            # the concurrent unstage of B
+            consumed, rest = fl._take_staged(list(zip(ids_a, rows_a)))
+            t.join()
+        assert [fid for ch in consumed for fid in ch.ids] == ids_a
+        assert rest == []
+        assert fl._staged == []        # B not resurrected
+        assert fl._staged_rows == {}   # A spent + B unstaged
+        lam.close()
+
+    def test_concurrent_cached_reads_exact_mid_slice(self):
+        """Readers racing a sliced fold (latency-widened mid-slice
+        windows, cache tier on) must observe the exact hot-wins answer
+        at EVERY instant — never a half-applied fold."""
+        reg = MetricsRegistry()
+        ds = _build(n=3000, seed=41, cache=True, metrics=reg)
+        lam = LambdaStore(ds, "t", config=StreamConfig(
+            chunk_rows=256, fold_rows=1, slice_rows=150,
+        ))
+        rows = [
+            {"name": "mid", "dtg": T0 + i, "geom": geo.Point(i * 0.001, 0.5)}
+            for i in range(600)
+        ]
+        ids = [f"f{i}" for i in range(500)] + [f"m{j}" for j in range(100)]
+        lam.write([dict(r) for r in rows], ids=ids)
+        expect = sorted(
+            [f"f{i}" for i in range(500, 3000)] + ids
+        )
+        q = "bbox(geom,-60,-60,60,60)"
+        errors: list = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                got = sorted(str(i) for i in lam.query(q).ids.tolist())
+                if got != expect:
+                    errors.append(len(got))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            with fault.inject(
+                "stream.fold.publish", kind="latency", times=None,
+                delay_s=0.01,
+            ):
+                lam.flush()  # the sliced fold, slices paused open
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
+        assert reg.counter_value("geomesa.stream.fold.slices") >= 2
+        lam.close()
+
+    def test_device_fold_plan_bit_identical_to_host_path(self):
+        from geomesa_tpu import conf
+
+        a, b = _build(), _build()
+        sft = a.get_schema("t")
+        _, batch = _adversarial_batch(sft, seed=51)
+        conf.STREAM_FOLD_DEVICE.set("on")  # auto is TPU-only; force here
+        try:
+            a.fold_upsert("t", batch, slice_rows=128)
+        finally:
+            conf.STREAM_FOLD_DEVICE.clear()
+        b.fold_upsert("t", batch, slice_rows=128)  # CPU auto: host path
+        _assert_tables_identical(a, b)
+
+    def test_fold_progress_surfaces_in_explain_and_gauge(self):
+        reg = MetricsRegistry()
+        ds = _build(n=2000, seed=12, metrics=reg)
+        sft = ds.get_schema("t")
+        _, batch = _adversarial_batch(sft, seed=13)
+        seen: list = []
+
+        def pacer():
+            # mid-fold: the progress surface is live for explain + gauge
+            seen.append(ds._fold_progress.get("t"))
+            from geomesa_tpu.planning.explain import Explainer
+
+            exp = Explainer()
+            plan = ds.planner.plan("t", "bbox(geom,-10,-10,10,10)")
+            ds.planner.execute(plan, explain=exp)
+            assert any("fold in progress" in ln.lower() for ln in exp.lines)
+
+        ds.fold_upsert("t", batch, slice_rows=200, pacer=pacer)
+        assert seen and all(s is not None for s in seen)
+        assert reg.counter_value("geomesa.stream.fold.slices") == -(-800 // 200)
+        assert ds._fold_progress.get("t") is None  # cleared after
+        assert ds.last_fold_report["slices"] == -(-800 // 200)
+        assert len(ds.last_fold_report["slice_s"]) == -(-800 // 200)
+
+
 # -- the pipelined flusher -------------------------------------------------
 
 

@@ -690,6 +690,43 @@ class TestRecovery:
         assert rec.hot._rows["x0"]["name"] == "v2"
         rec.close(), lam.flusher.close()
 
+    def test_sliced_fold_advances_watermarks_per_slice(self, tmp_path):
+        """Round 11 (docs/streaming.md "Incremental fold"): the fold
+        publishes per slice and the WAL flush watermark advances with
+        EACH published slice — a crash mid-fold replays only the
+        unpublished suffix, with zero acknowledged-row loss and exact
+        query results after recovery."""
+        metrics = MetricsRegistry()
+        root, lam = _saved_lambda(tmp_path, fold_rows=1, metrics=metrics)
+        lam.config.slice_rows = 40  # shared with the flusher (same object)
+        rows = [
+            {"name": f"u{i}", "dtg": T0 + i, "geom": geo.Point(i * 0.05, 2.0)}
+            for i in range(120)
+        ]
+        ids = [f"c{i}" for i in range(100)] + [f"nw{j}" for j in range(20)]
+        lam.write([dict(r) for r in rows], ids=ids)
+        live = _results(lam)  # the acknowledged state
+        # crash entering the SECOND slice: slice 1 published + watermarked
+        with fault.inject("stream.fold.slice", kind="crash", after=1, times=1):
+            with pytest.raises(fault.InjectedCrash):
+                lam.flush()
+        assert metrics.counter_value("geomesa.stream.fold.slices") == 1
+        lam.wal.crash()  # kill -9 mid-fold
+        cfg = StreamConfig(chunk_rows=64, fold_rows=1, slice_rows=40)
+        rec = LambdaStore.recover(root, config=cfg)
+        assert rec.cold.store_health.status == "ok"
+        assert _results(rec) == live  # nothing acknowledged was lost
+        # a successful sliced fold writes one watermark PER slice
+        assert rec.flush() > 0
+        rec.wal.crash()
+        reread = WriteAheadLog(str(root / "_wal"))
+        kinds = [r.get("k") for r in reread.replay()]
+        assert kinds.count("w") >= 3  # slice-grained, not batch-grained
+        reread.close()
+        rec2 = LambdaStore.recover(root, config=cfg)
+        assert _results(rec2) == live
+        rec2.close(), rec.flusher.close(), lam.flusher.close()
+
     def test_recovery_crash_is_restartable(self, tmp_path):
         """A crash DURING replay (stream.wal.replay) leaves the log
         untouched: recovery simply runs again."""
