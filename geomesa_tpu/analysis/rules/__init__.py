@@ -8,6 +8,12 @@ the id in docs/analysis.md (tests/test_docs.py enforces that), and add
 known-bad/known-good fixtures under tests/fixtures/analysis/.
 """
 
+from geomesa_tpu.analysis.rules.concurrency import (
+    BlockingUnderLockRule,
+    CheckThenActRule,
+    GuardedEscapeRule,
+    LockOrderRule,
+)
 from geomesa_tpu.analysis.rules.faults import FaultPointRule
 from geomesa_tpu.analysis.rules.fused import FusedVariantKeyRule
 from geomesa_tpu.analysis.rules.kernels import (
@@ -40,6 +46,10 @@ ALL_RULES = [
     FaultPointRule(),
     FusedVariantKeyRule(),
     LockDisciplineRule(),
+    LockOrderRule(),
+    CheckThenActRule(),
+    BlockingUnderLockRule(),
+    GuardedEscapeRule(),
     KernelTracedCoercionRule(),
     KernelDynamicShapeRule(),
     WarmupCoverageRule(),

@@ -55,11 +55,16 @@ def _class_methods(cls):
 
 
 def _lock_attrs(cls) -> set[str]:
+    from geomesa_tpu.analysis.lockmodel import lock_ctor
+
     locks = set()
     for method in _class_methods(cls):
         for node in ast.walk(method):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                if call_name(node.value) in LOCK_CTORS:
+                # direct ctor, or wrapped as witness(threading.RLock(), ...)
+                if call_name(node.value) in LOCK_CTORS or (
+                    lock_ctor(node.value) is not None
+                ):
                     for t in node.targets:
                         attr = self_attr(t)
                         if attr is not None:

@@ -85,7 +85,9 @@ class GenerationTracker:
     numpy ops — nanoseconds next to any scan)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from geomesa_tpu.lockwitness import witness
+
+        self._lock = witness(threading.Lock(), "GenerationTracker._lock")
         self._tick = 0                            # guarded-by: _lock
         self._types: dict[str, _TypeGens] = {}    # guarded-by: _lock
 

@@ -98,10 +98,12 @@ class ResultCache:
     ):
         from geomesa_tpu.metrics import resolve
 
+        from geomesa_tpu.lockwitness import witness
+
         self.conf = conf
         self.generations = generations
         self.metrics = resolve(metrics)
-        self._lock = threading.RLock()
+        self._lock = witness(threading.RLock(), "ResultCache._lock")
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
         self._inflight: dict[str, _Flight] = {}  # guarded-by: _lock
         self._bytes = 0                          # guarded-by: _lock

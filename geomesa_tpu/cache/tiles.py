@@ -102,10 +102,12 @@ class TileAggregateCache:
     ):
         from geomesa_tpu.metrics import resolve
 
+        from geomesa_tpu.lockwitness import witness
+
         self.conf = conf
         self.generations = generations
         self.metrics = resolve(metrics)
-        self._lock = threading.RLock()
+        self._lock = witness(threading.RLock(), "TileAggregateCache._lock")
         self._tiles: "OrderedDict[tuple, TileAggregate]" = OrderedDict()  # guarded-by: _lock
         # adaptive cost gate state: per-type EWMAs of plain-scan vs
         # composition cost, plus the gated-attempt counter for re-probes

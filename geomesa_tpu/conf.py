@@ -307,6 +307,23 @@ STREAM_INCREMENTAL = SystemProperty(
 )
 
 
+# -- lock-witness runtime (geomesa_tpu.lockwitness; docs/concurrency.md) --
+
+LOCK_WITNESS = SystemProperty(
+    "geomesa.tpu.lock.witness", False, _parse_bool,
+    "arm the dynamic lock witness: registry-declared locks constructed "
+    "AFTER arming wrap in an order-recording proxy; the observed "
+    "acquisition graph must stay acyclic and inside the static model's "
+    "predicted edges (tests/test_lock_witness.py; resolves from "
+    "GEOMESA_TPU_LOCK_WITNESS=1 like every knob)",
+)
+LOCK_WITNESS_ARTIFACT = SystemProperty(
+    "geomesa.tpu.lock.witness.artifact", "/tmp/lock_witness.json", str,
+    "where lockwitness.dump() writes the observed edge graph / blocking "
+    "events so a CI failure is diagnosable from logs alone",
+)
+
+
 # -- concurrent query serving (geomesa_tpu.serving; docs/serving.md) ------
 
 SERVING_WINDOW_MS = SystemProperty(

@@ -193,11 +193,13 @@ class DataStore:
         # (reference: synchronized metadata + single-writer invariants)
         import threading
 
-        self._write_lock = threading.RLock()
+        from geomesa_tpu.lockwitness import witness
+
+        self._write_lock = witness(threading.RLock(), "DataStore._write_lock")
         # serializes only the per-chunk id-index entry cache (_id_index);
         # entries self-validate by chunk identity, so readers never need
         # the write lock
-        self._id_lock = threading.Lock()
+        self._id_lock = witness(threading.Lock(), "DataStore._id_lock")
         # seqlock for renumbering publishes (fold_upsert): odd while the
         # assignment-only swap of tables+chunks is in flight, so
         # pin_scan_state's lock-free readers can capture a CONSISTENT

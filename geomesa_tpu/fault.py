@@ -72,6 +72,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from geomesa_tpu import lockwitness as _lockwitness
+
 DEFAULT_RETRIES = 3
 DEFAULT_BACKOFF_S = 0.01
 
@@ -151,8 +153,10 @@ class ChaosSpec:
         )
         self.kinds = tuple(kinds)
         self.delay_s = float(delay_s)
+        from geomesa_tpu.lockwitness import witness
+
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = witness(threading.Lock(), "ChaosSpec._lock")
         self.hits = 0   # guarded-by: _lock
         self.fired = 0  # guarded-by: _lock
         self.log: list[tuple[int, str, str]] = []  # guarded-by: _lock
@@ -335,7 +339,15 @@ def injector() -> FaultInjector:
 def fault_point(name: str, path: Optional[str] = None) -> None:
     """Mark an injectable point; no-op unless a matching fault (or a
     chaos schedule) is armed. ``path``: the file the point is about to
-    (or just did) touch — the target for partial_write/bit_flip damage."""
+    (or just did) touch — the target for partial_write/bit_flip damage.
+
+    Fault points mark exactly the IO/latency steps, so they double as
+    the lock witness's held-while-blocking probes: with the witness
+    armed (docs/concurrency.md), reaching one while a witnessed lock is
+    held records a blocking event — the runtime twin of the static
+    blocking-under-lock rule."""
+    if _lockwitness.ENABLED:
+        _lockwitness.note_blocking(name)
     if _GLOBAL.armed:
         _GLOBAL.on(name, path)
 

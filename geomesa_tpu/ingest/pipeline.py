@@ -175,14 +175,16 @@ class BulkLoader:
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, workers), thread_name_prefix="geomesa-ingest"
         )
+        from geomesa_tpu.lockwitness import witness
+
         self._sem = threading.Semaphore(max(1, self.config.queue_depth))
-        self._cv = threading.Condition()
+        self._cv = witness(threading.Condition(), "BulkLoader._cv")
         self._chunks: list[_Chunk] = []           # guarded-by: _cv
         self._rows_staged = 0                     # guarded-by: _cv
         self._closed = False                      # guarded-by: _cv
         self._error: "BaseException | None" = None  # guarded-by: _cv
         self._writer: "threading.Thread | None" = None  # guarded-by: _cv
-        self._stage_lock = threading.Lock()
+        self._stage_lock = witness(threading.Lock(), "BulkLoader._stage_lock")
         self._stage_s = {s: 0.0 for s in STAGES}  # guarded-by: _stage_lock
         self._peak_chunk_bytes = 0                # guarded-by: _stage_lock
 

@@ -135,10 +135,12 @@ class QueryScheduler:
     def __init__(self, store, config: "ServingConfig | None" = None, metrics=None):
         from geomesa_tpu.metrics import resolve
 
+        from geomesa_tpu.lockwitness import witness
+
         self.store = store
         self.conf = config or ServingConfig.from_properties()
         self.metrics = resolve(metrics if metrics is not None else store.metrics)
-        self._cond = threading.Condition()
+        self._cond = witness(threading.Condition(), "QueryScheduler._cond")
         self._queue: list[_Item] = []  # guarded-by: _cond
         self._closed = False           # guarded-by: _cond
         # adaptive window: grows under load, 0 when idle. Single-writer

@@ -50,9 +50,13 @@ class StreamingFeatureCache:
 
     def __init__(self, sft: FeatureType, expiry_ms: Optional[int] = None,
                  grid: tuple[int, int] = (360, 180), metrics=None):
+        from geomesa_tpu.lockwitness import witness
+
         self.sft = sft
         self.expiry_ms = expiry_ms
-        self._lock = threading.RLock()
+        self._lock = witness(
+            threading.RLock(), "StreamingFeatureCache._lock"
+        )
         self.index = BucketIndex(*grid)           # guarded-by: _lock
         self._rows: dict[str, dict] = {}          # guarded-by: _lock
         self._ingest_ms: dict[str, int] = {}      # guarded-by: _lock

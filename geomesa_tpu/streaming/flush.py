@@ -134,13 +134,19 @@ class StreamFlusher:
         self.metrics = resolve(
             metrics if metrics is not None else getattr(store, "metrics", None)
         )
-        self._pool_lock = threading.Lock()
+        from geomesa_tpu.lockwitness import witness
+
+        self._pool_lock = witness(
+            threading.Lock(), "StreamFlusher._pool_lock"
+        )
         self._pool: "ThreadPoolExecutor | None" = None  # guarded-by: _pool_lock
         self._sem = threading.Semaphore(max(1, self.config.queue_depth))
         self.flushes = 0  # total successful flushes (bench/introspection)
         # pre-staged update chunks (docs/streaming.md "Incremental fold"):
         # parse/keys run at micro-flush time, consumed by the next fold
-        self._stage_lock = threading.Lock()
+        self._stage_lock = witness(
+            threading.Lock(), "StreamFlusher._stage_lock"
+        )
         self._staged: list = []        # guarded-by: _stage_lock
         self._staged_rows: dict = {}   # guarded-by: _stage_lock
 

@@ -292,8 +292,14 @@ class WriteAheadLog:
             else os.path.dirname(os.path.abspath(self.dir)) or "."
         )
         os.makedirs(self.dir, exist_ok=True)
-        self._lock = threading.Lock()       # buffer / seqno / fd state
-        self._sync_lock = threading.Lock()  # commit (write+fsync) order
+        from geomesa_tpu.lockwitness import witness
+
+        # buffer / seqno / fd state
+        self._lock = witness(threading.Lock(), "WriteAheadLog._lock")
+        # commit (write+fsync) order
+        self._sync_lock = witness(
+            threading.Lock(), "WriteAheadLog._sync_lock"
+        )
         self._buffer = bytearray()   # guarded-by: _lock
         self._pending = set()        # guarded-by: _lock
         self._closed = False         # guarded-by: _lock
@@ -635,23 +641,53 @@ class WriteAheadLog:
 
     def _rotate(self) -> None:
         """Seal the active segment (flush + fsync + close) and open a
-        fresh one named by the next seqno."""
+        fresh one named by the next seqno.
+
+        The seal's fsync runs OUTSIDE the append lock (under the sync
+        lock only — the blocking-under-lock discipline, docs/
+        concurrency.md): producers keep appending (buffering) while the
+        old segment fsyncs, instead of every acknowledged write
+        stalling behind the rotation's disk flush. The fsync happens
+        BEFORE the fd swap: on failure the exception propagates with
+        the active segment unchanged, so the next ``sync()``/append
+        retries the SAME fd — a failed seal can never be masked by a
+        later fsync of the fresh segment. Safe because every fd write
+        serializes on ``_sync_lock`` (held here throughout): records
+        buffered during the fsync only reach a file at the NEXT
+        sync/flush, which runs after the swap and targets the new
+        segment, with seqnos above the sealed range."""
         with self._sync_lock:
             with self._lock:
                 if self._closed:
                     return
-                fault.fault_point("stream.wal.rotate", self._active_path)
+                path = self._active_path
+            # the fault point fires under the SYNC lock only (appends
+            # keep flowing); _active_path is stable here — only _rotate
+            # and _open_tail move it, both serialized by _sync_lock
+            fault.fault_point("stream.wal.rotate", path)
+            with self._lock:
+                if self._closed:
+                    return
+                # drain everything appended so far to the OLD fd; the
+                # seal fsync below then covers exactly seqnos <= end
                 self._flush_buffer_locked()
-                if self._fd is not None:
-                    os.fsync(self._fd)
-                    os.close(self._fd)
-                self._open_segment_locked(self._last_seq + 1)
-                # captured INSIDE the lock: a concurrent append landing
-                # right after the fresh segment opens must not be
-                # marked synced before its bytes ever hit the fd (its
-                # producer's group-commit check would then skip the
-                # fsync — acked-row loss under sync=always)
+                old_fd = self._fd
                 end = self._last_seq
+            if old_fd is not None:
+                # outside _lock: appends buffer concurrently. A raise
+                # here leaves _fd on the old segment — no masking.
+                os.fsync(old_fd)
+            with self._lock:
+                if self._closed:
+                    return
+                self._open_segment_locked(self._last_seq + 1)
+            if old_fd is not None:
+                os.close(old_fd)
+            # advanced only AFTER the seal fsync succeeded: a
+            # producer's group-commit check must never treat a
+            # page-cache-only record as durable (acked-row loss under
+            # sync=always). Records buffered during the fsync have
+            # seqnos > end and stay uncovered until their own sync.
             self._synced_seq = end
             self._last_sync_t = time.monotonic()
         self.metrics.counter("geomesa.stream.wal.rotations")
@@ -767,21 +803,26 @@ class WriteAheadLog:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
-        """Flush + fsync + close (idempotent)."""
+        """Flush + fsync + close (idempotent). Like :meth:`_rotate`,
+        the final fsync runs outside the append lock: ``_closed`` is
+        set (and the buffer drained) under ``_lock``, after which no
+        append can touch the fd, so the seal needs only the sync
+        lock."""
         self._stop.set()
         with self._sync_lock:
             with self._lock:
                 if self._closed:
                     return
                 self._flush_buffer_locked()
-                if self._fd is not None:
-                    try:
-                        os.fsync(self._fd)
-                    finally:
-                        os.close(self._fd)
-                    self._fd = None
+                fd, self._fd = self._fd, None
                 self._closed = True
-            self._synced_seq = self._last_seq
+                end = self._last_seq
+            if fd is not None:
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            self._synced_seq = end
 
     def crash(self) -> None:
         """TEST SURFACE: simulate ``kill -9`` — the in-process buffer

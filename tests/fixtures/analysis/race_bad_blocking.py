@@ -1,0 +1,32 @@
+"""KNOWN-BAD fixture: blocking calls under a hot-path lock.
+
+The PR 8 reader-stall class (and the WAL ``_rotate`` seal-fsync this
+PR fixed): a lock every reader/writer crosses is held across an fsync
+and a Future wait, so one slow disk flush stalls the whole tier.
+
+Expected: two ``blocking-under-lock`` findings inside ``flush`` (the
+fsync and the ``Future.result``); ``note`` is silent (the counter
+bumps under a lock, but nothing blocks).
+"""
+
+import os
+import threading
+
+
+class HotTier:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-rank: 31 hot
+        self._rows = {}                # guarded-by: _lock
+        self._flushes = 0              # guarded-by: _lock
+
+    def note(self):
+        with self._lock:
+            self._flushes += 1
+
+    def flush(self, fh, fut):
+        with self._lock:
+            os.fsync(fh.fileno())      # BUG: disk flush under the hot lock
+            merged = fut.result()      # BUG: cross-thread wait under it
+            self._rows.update(merged)
+            self._flushes += 1
+        return merged

@@ -1,0 +1,37 @@
+"""KNOWN-BAD fixture: a guarded container escaping its lock.
+
+The adopted-row-dict aliasing class: the hot tier's row map is guarded,
+but one accessor returns the live dict bare and another stores it into
+an unguarded attribute — callers then iterate/mutate it with no lock,
+racing every guarded writer.
+
+Expected: two ``guarded-escape`` findings (the bare return and the
+unguarded store); ``snapshot`` (copy) and ``drain`` (swap-and-drain
+into a local) are silent.
+"""
+
+import threading
+
+
+class LeakyCache:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-rank: 35
+        self._rows = {}                # guarded-by: _lock
+        self.exported = None
+
+    def rows(self):
+        with self._lock:
+            return self._rows          # BUG: live guarded dict escapes
+
+    def publish(self):
+        with self._lock:
+            self.exported = self._rows  # BUG: unguarded alias
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._rows)    # copy: fine
+
+    def drain(self):
+        with self._lock:
+            out, self._rows = self._rows, {}
+        return out                     # swap-and-drain: fine

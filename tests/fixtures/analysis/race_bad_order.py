@@ -1,0 +1,34 @@
+"""KNOWN-BAD fixture: a lock-order cycle (geomesa-race).
+
+Two locks with declared ranks (inline ``# lock-rank:``, the fixture/
+adopter form of the LOCKS registry), acquired in OPPOSITE orders by two
+methods — the deadlock shape the LambdaStore hot-lock / cache-lock
+nesting would take if any inner tier ever called back out. Two threads
+running ``transfer`` and ``audit`` concurrently deadlock.
+
+Expected: one ``lock-order-cycle`` cycle finding plus one rank
+violation on the inverted edge (``_audit_lock`` -> ``_hot_lock``
+acquires rank 11 under rank 19).
+"""
+
+import threading
+
+
+class RaceyLedger:
+    def __init__(self):
+        self._hot_lock = threading.Lock()    # lock-rank: 11
+        self._audit_lock = threading.Lock()  # lock-rank: 19
+        self._rows = {}    # guarded-by: _hot_lock
+        self._trail = []   # guarded-by: _audit_lock
+
+    def transfer(self, key, value):
+        with self._hot_lock:
+            self._rows[key] = value
+            with self._audit_lock:       # 11 -> 19: legal
+                self._trail.append(key)
+
+    def audit(self):
+        with self._audit_lock:
+            seen = list(self._trail)
+            with self._hot_lock:         # BUG: 19 -> 11, the inversion
+                return [self._rows.get(k) for k in seen]

@@ -1,0 +1,41 @@
+"""KNOWN-BAD fixture: the PR 11 ``_take_staged`` write-back race.
+
+The shipped bug (two post-review hardening rounds): the fold snapshots
+the staged-chunk list under the stage lock, filters it unlocked, then
+writes the filtered list BACK wholesale — clobbering chunks a
+concurrent ``stage()`` registered (double-publish) and resurrecting
+chunks a concurrent ``unstage()`` dropped (folding deleted rows). The
+production fix re-reads ``self._staged`` inside the write-back scope
+and reconciles by identity.
+
+Expected: one ``atomicity-check-then-act`` finding on the write-back
+scope of ``take``.
+"""
+
+import threading
+
+
+class MiniFlusher:
+    def __init__(self):
+        self._stage_lock = threading.Lock()  # lock-rank: 33
+        self._staged = []                    # guarded-by: _stage_lock
+
+    def stage(self, chunk):
+        with self._stage_lock:
+            self._staged.append(chunk)
+
+    def take(self, wanted):
+        with self._stage_lock:
+            staged = list(self._staged)
+        consumed = []
+        retained = []
+        for ch in staged:  # the slow filter runs unlocked (by design)
+            if ch in wanted:
+                consumed.append(ch)
+            else:
+                retained.append(ch)
+        with self._stage_lock:
+            # BUG under test: wholesale write-back of the stale filter
+            # result — concurrent stage()/unstage() calls are undone
+            self._staged = retained
+        return consumed

@@ -1,0 +1,40 @@
+"""KNOWN-BAD fixture: the PR 9 checkpoint-cover-before-drain race.
+
+The shipped bug (caught by the chaos harness, fixed by the WAL's
+``pending``/``applied_horizon`` protocol): the checkpoint captured the
+pending-record set under the lock, released it to run the drain+save,
+then CLEARED the set from the stale capture — wiping registrations a
+concurrent producer added during the drain, so the next checkpoint's
+cover retired acknowledged records whose effects never reached a store
+(permanent acknowledged-row loss).
+
+Expected: one ``atomicity-check-then-act`` finding on the second lock
+scope of ``checkpoint`` (writes ``_pending`` back from the stale
+capture without re-reading it).
+"""
+
+import threading
+
+
+class MiniWal:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-rank: 41
+        self._pending = set()          # guarded-by: _lock
+        self._last_seq = -1            # guarded-by: _lock
+
+    def append(self, seq):
+        with self._lock:
+            self._last_seq = seq
+            self._pending.add(seq)
+
+    def checkpoint(self, save):
+        with self._lock:
+            cover = self._last_seq
+            captured = set(self._pending)
+        save(cover)  # the drain + durable save, outside the lock
+        with self._lock:
+            if captured:
+                # BUG under test: clears from the PRE-DRAIN capture —
+                # a record logged during save() is wiped un-applied
+                self._pending = set()
+        return cover

@@ -1,0 +1,28 @@
+"""KNOWN-BAD fixture: a concurrent-tier lock with no LOCKS entry.
+
+Staged under a synthetic ``geomesa_tpu/streaming/`` path (an ENFORCED
+scope): a new lock in the concurrent tiers that nobody registered has
+no declared rank, so the order checker cannot place it — the
+"undeclared lock rank" findings this PR fixed in the production tree
+by writing the registry.
+
+Expected: one ``lock-order-cycle`` finding (``unregistered:``) on the
+construction line.
+"""
+
+import threading
+
+
+class UnrankedBuffer:
+    def __init__(self):
+        self._buf_lock = threading.Lock()
+        self._pending = []  # guarded-by: _buf_lock
+
+    def push(self, item):
+        with self._buf_lock:
+            self._pending.append(item)
+
+    def drain(self):
+        with self._buf_lock:
+            out, self._pending = self._pending, []
+        return out

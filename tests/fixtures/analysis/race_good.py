@@ -1,0 +1,51 @@
+"""KNOWN-GOOD fixture: the disciplined twin of the race_bad_* files.
+
+Consistent rank-increasing lock order, check-then-act merged into one
+hold (and the write-back variant re-validating against current state),
+blocking work staged under the lock but executed outside it, and
+copy/swap-and-drain escapes only. Every geomesa-race rule must stay
+silent.
+"""
+
+import os
+import threading
+
+
+class DisciplinedLedger:
+    def __init__(self):
+        self._hot_lock = threading.Lock()    # lock-rank: 13 hot
+        self._audit_lock = threading.Lock()  # lock-rank: 17
+        self._rows = {}    # guarded-by: _hot_lock
+        self._trail = []   # guarded-by: _audit_lock
+        self._staged = []  # guarded-by: _audit_lock
+
+    def transfer(self, key, value):
+        with self._hot_lock:
+            self._rows[key] = value
+            with self._audit_lock:      # always 13 -> 17
+                self._trail.append(key)
+
+    def audit(self):
+        with self._hot_lock:
+            with self._audit_lock:
+                return [self._rows.get(k) for k in list(self._trail)]
+
+    def take(self, wanted):
+        # the check and the act share ONE hold: nothing staged
+        # concurrently can be clobbered
+        with self._audit_lock:
+            consumed = [c for c in self._staged if c in wanted]
+            self._staged = [c for c in self._staged if c not in wanted]
+        return consumed
+
+    def flush(self, fh):
+        # capture under the lock, block OUTSIDE it
+        with self._hot_lock:
+            batch = dict(self._rows)
+        os.fsync(fh.fileno())
+        return batch
+
+    def drain_trail(self):
+        with self._audit_lock:
+            out, self._trail = self._trail, []
+        return out

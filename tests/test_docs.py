@@ -560,6 +560,62 @@ def test_streaming_doc_honest():
     assert "geomesa.stream.*" in text
 
 
+def test_concurrency_doc_honest():
+    """docs/concurrency.md stays honest BOTH directions, derived from
+    the LOCKS registry (the knob/metric/fault convention): every
+    registered lock appears in the doc's table with its exact rank and
+    hot flag, the table names no phantom locks, and every witness API /
+    knob the doc leans on is real."""
+    import inspect
+
+    from geomesa_tpu import conf, lockwitness
+    from geomesa_tpu.analysis.lockmodel import (
+        DECLARED_BLOCKING, DECLARED_EDGES, LOCKS,
+    )
+
+    text = open(os.path.join(_ROOT, "docs", "concurrency.md")).read()
+    # parse the registry table: | `Class.attr` | rank | hot? | guards |
+    doc_rows = {}
+    for line in text.splitlines():
+        m = re.match(r"^\| `([\w.]+)` \| (\d+) \| (hot)? ?\|", line)
+        if m:
+            doc_rows[m.group(1)] = (int(m.group(2)), bool(m.group(3)))
+    assert doc_rows, "docs/concurrency.md lock table not found"
+    for name, d in sorted(LOCKS.items()):
+        assert name in doc_rows, f"LOCKS entry {name} missing from the doc"
+        assert doc_rows[name] == (d.rank, d.hot), (
+            f"{name}: doc says {doc_rows[name]}, registry says "
+            f"{(d.rank, d.hot)}"
+        )
+    for name in doc_rows:
+        assert name in LOCKS, f"doc table names phantom lock {name!r}"
+    # guarded fields the table cites are the registry's
+    for name, d in LOCKS.items():
+        for f in d.fields:
+            assert f"`{f}`" in text or f in text, (name, f)
+    # the witness surface the doc describes is real
+    for fn in ("witness", "enable", "disable", "dump", "note_blocking",
+               "held_locks"):
+        assert hasattr(lockwitness, fn), fn
+    for m in ("cycle", "snapshot", "reset", "note_acquire"):
+        assert hasattr(lockwitness.WitnessReport, m), m
+    assert "path" in inspect.signature(lockwitness.dump).parameters
+    # env gate mapping: the documented GEOMESA_TPU_LOCK_WITNESS really
+    # is the knob's env key, and both knobs resolve at runtime
+    assert conf.LOCK_WITNESS.env_key == "GEOMESA_TPU_LOCK_WITNESS"
+    assert "GEOMESA_TPU_LOCK_WITNESS" in text
+    assert conf.REGISTRY["geomesa.tpu.lock.witness"].default is False
+    assert conf.REGISTRY["geomesa.tpu.lock.witness.artifact"].default == (
+        "/tmp/lock_witness.json"
+    )
+    # declared exceptions carry justifications (they are doc-adjacent:
+    # each is a visible, accepted design cost)
+    for a, b, why in DECLARED_EDGES:
+        assert why and a in LOCKS and b in LOCKS
+    for lock, pat, why in DECLARED_BLOCKING:
+        assert why and lock in LOCKS and pat
+
+
 def test_config_doc_lists_every_knob():
     """docs/config.md is the complete operator-facing knob index (the
     knob-undocumented rule's backstop): every declared SystemProperty

@@ -48,6 +48,9 @@ def _render(findings):
 _SYNTHETIC_PATHS = {
     "kernel_bad.py": "geomesa_tpu/scan/_fixture_kernel_bad.py",
     "locks_bad_registry.py": "geomesa_tpu/serving/_fixture_locks_bad_registry.py",
+    # the unregistered-lock direction needs an ENFORCED scope (the
+    # concurrent tiers require a LOCKS registry entry)
+    "race_bad_unregistered.py": "geomesa_tpu/streaming/_fixture_race_unregistered.py",
 }
 
 
@@ -153,6 +156,138 @@ def test_scheduler_guarded_by_mutation_is_caught(fixture_result):
     assert "guarded-by" in bad[0].message  # explicit-annotation mode
     # the disciplined twin (with *_locked and holds-lock escapes) passes
     assert _at(fixture_result, "locks_good.py") == []
+
+
+def test_lock_order_cycle_is_caught(fixture_result):
+    """geomesa-race: the A->B / B->A inversion is a cycle finding plus
+    a rank violation on the inverted edge."""
+    bad = _at(fixture_result, "race_bad_order.py", "lock-order-cycle")
+    cycles = [f for f in bad if f.symbol.startswith("cycle:")]
+    ranks = [f for f in bad if f.symbol.startswith("rank:")]
+    assert len(cycles) == 1, _render(bad)
+    assert "RaceyLedger._hot_lock" in cycles[0].message
+    assert "RaceyLedger._audit_lock" in cycles[0].message
+    assert "deadlock" in cycles[0].message
+    assert len(ranks) == 1, _render(bad)
+    assert "rank 19" in ranks[0].message and "rank 11" in ranks[0].message
+
+
+def test_unregistered_concurrent_tier_lock_is_caught(fixture_result):
+    """A lock constructed in an enforced scope (the concurrent tiers)
+    without a LOCKS registry entry has no declared rank — the finding
+    class the production registry in analysis/lockmodel.py closed."""
+    bad = _at(
+        fixture_result, "race_bad_unregistered.py", "lock-order-cycle"
+    )
+    assert len(bad) == 1, _render(bad)
+    assert "UnrankedBuffer._buf_lock" in bad[0].message
+    assert "no LOCKS registry entry" in bad[0].message
+
+
+def test_pr9_checkpoint_cover_race_is_caught(fixture_result):
+    """The PR 9 checkpoint-cover-before-drain race replays as a
+    must-fail fixture (the E-bucket convention): the stale pending-set
+    write-back is a check-then-act finding."""
+    bad = _at(
+        fixture_result, "race_bad_pr9_checkpoint.py",
+        "atomicity-check-then-act",
+    )
+    assert len(bad) == 1, _render(bad)
+    assert "_pending" in bad[0].message
+    assert "checkpoint" in bad[0].message
+    assert "without re-reading" in bad[0].message
+
+
+def test_pr11_take_staged_race_is_caught(fixture_result):
+    """The PR 11 _take_staged write-back race replays the same way:
+    filtered-snapshot write-back without re-reading the staged list."""
+    bad = _at(
+        fixture_result, "race_bad_pr11_takestaged.py",
+        "atomicity-check-then-act",
+    )
+    assert len(bad) == 1, _render(bad)
+    assert "_staged" in bad[0].message and "take" in bad[0].message
+
+
+def test_blocking_under_hot_lock_is_caught(fixture_result):
+    """fsync + Future.result under an inline-annotated hot lock are the
+    PR 8 reader-stall class (and the WAL _rotate fix this PR shipped)."""
+    bad = _at(fixture_result, "race_bad_blocking.py", "blocking-under-lock")
+    assert len(bad) == 2, _render(bad)
+    kinds = {f.message.split(" call ")[0] for f in bad}
+    assert kinds == {"fsync", "Future.result"}, kinds
+    for f in bad:
+        assert "HotTier._lock" in f.message
+
+
+def test_guarded_escape_is_caught(fixture_result):
+    """A guarded container returned bare / stored into an unguarded
+    attribute is the adopted-row-dict aliasing class; copies and
+    swap-and-drain stay legal."""
+    bad = _at(fixture_result, "race_bad_escape.py", "guarded-escape")
+    assert len(bad) == 2, _render(bad)
+    symbols = {f.symbol for f in bad}
+    assert symbols == {
+        "LeakyCache.rows._rows:return", "LeakyCache.publish._rows:store",
+    }, symbols
+
+
+def test_race_good_twin_is_silent(fixture_result):
+    """The disciplined twin exercises every rule's good path: rank-
+    increasing order, one-hold check-then-act, blocking outside the
+    lock, copy/swap escapes — zero geomesa-race findings."""
+    for rule in ("lock-order-cycle", "atomicity-check-then-act",
+                 "blocking-under-lock", "guarded-escape"):
+        assert _at(fixture_result, "race_good.py", rule) == [], rule
+
+
+def test_lock_registry_hygiene():
+    """LOCKS registry invariants: Class.attr names, unique strictly
+    ordered ranks... (rank ties would make the order a partial one),
+    every entry discovered in the tree with a matching witness name,
+    and every declared edge rank-increasing."""
+    from geomesa_tpu.analysis.core import Project
+    from geomesa_tpu.analysis.lockmodel import (
+        DECLARED_EDGES, LOCKS, LockModel,
+    )
+
+    assert len(LOCKS) >= 12
+    ranks = [d.rank for d in LOCKS.values()]
+    assert len(ranks) == len(set(ranks)), "ranks must be unique"
+    for name, d in LOCKS.items():
+        assert name == d.name and "." in name, name
+        assert d.doc, name
+    model = LockModel.of(Project.load(ROOT))
+    for name in LOCKS:
+        assert name in model.sites, f"{name} has no construction site"
+        assert model.sites[name].witness_name == name, name
+    for a, b, why in DECLARED_EDGES:
+        assert a in LOCKS and b in LOCKS, (a, b)
+        assert LOCKS[a].rank < LOCKS[b].rank, (a, b)
+        assert why, (a, b)
+
+
+def test_static_model_edges_are_rank_consistent():
+    """The production acquisition graph (AST-derived + declared) is
+    acyclic and every ranked edge strictly increases — the invariant
+    the lock-order-cycle rule enforces at zero findings."""
+    from geomesa_tpu.analysis.core import Project
+    from geomesa_tpu.analysis.lockmodel import LockModel
+
+    model = LockModel.of(Project.load(ROOT))
+    assert model.cycles() == []
+    # the model must actually SEE the load-bearing nesting, not be
+    # vacuously clean
+    edges = model.predicted_edges()
+    assert ("WriteAheadLog._sync_lock", "WriteAheadLog._lock") in edges
+    assert (
+        "StreamingFeatureCache._lock", "GenerationTracker._lock"
+    ) in edges
+    assert ("ResultCache._lock", "GenerationTracker._lock") in edges
+    for a, b in edges:
+        ra, rb = model.rank_of(a), model.rank_of(b)
+        if ra is not None and rb is not None:
+            assert ra < rb, (a, b)
 
 
 def test_undeclared_knob_literal_is_caught(fixture_result):
@@ -325,6 +460,50 @@ class TestCheckGateExitCodes:
         )
         assert again.returncode == 0
         assert len(bl.read_text().splitlines()) == n_lines
+
+    def test_profile_table_and_json_schema_version(self, tmp_path):
+        """--profile prints a per-rule wall-time table; --json carries
+        the stable schema_version (the CI pinning contract)."""
+        import json
+
+        root = self._mini_repo(tmp_path, '"""A module."""\n\nX = 1\n')
+        proc = self._run("--root", root, "--profile")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "knob-undeclared" in proc.stdout and " ms " in proc.stdout
+        jproc = self._run("--root", root, "--profile", "--json")
+        payload = json.loads(jproc.stdout)
+        assert payload["schema_version"] == 1
+        assert isinstance(payload["profile"], list) and payload["profile"]
+        row = payload["profile"][0]
+        assert set(row) == {"rule", "seconds", "raised"}
+        plain = json.loads(self._run("--root", root, "--json").stdout)
+        assert plain["schema_version"] == 1
+        assert plain["changed_only"] is False
+
+    def test_changed_scope(self, tmp_path):
+        """--changed reports only findings in files the git work tree
+        touched (rules still see the whole repo); a git-less root is
+        unusable input (exit 2)."""
+        import subprocess
+
+        root = self._mini_repo(
+            tmp_path, '"""Cites geomesa.not.a.knob here."""\n'
+        )
+        assert self._run("--root", root, "--changed").returncode == 2
+        subprocess.run(["git", "init", "-q"], cwd=root, check=True)
+        # untracked bad file: in scope -> finding survives the filter
+        proc = self._run("--root", root, "--changed")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "knob-undeclared" in proc.stdout
+        # committed clean tree: nothing changed -> findings filter away
+        subprocess.run(["git", "add", "-A"], cwd=root, check=True)
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "commit", "-qm", "x"], cwd=root, check=True,
+        )
+        proc = self._run("--root", root, "--changed")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "(changed files only)" in proc.stdout
 
     def test_parse_error_is_baselinable(self, tmp_path):
         """Adopt-time convergence on trees carrying broken files: the

@@ -312,6 +312,57 @@ class TestWriteAheadLog:
         assert list(wal.replay()) == []
         wal.close()
 
+    def test_failed_seal_fsync_is_never_masked(self, tmp_path, monkeypatch):
+        """The rotation seal (docs/concurrency.md: fsync moved OUTSIDE
+        the append lock) fsyncs the old segment BEFORE the fd swap: a
+        failing seal fsync must leave the active segment unchanged so
+        the retry hits the SAME fd — a later sync() of a fresh segment
+        must never advance the durability horizon over records that
+        only reached the sealed segment's page cache."""
+        import geomesa_tpu.streaming.wal as walmod2
+
+        # sync=off: appends never fsync, so the ONLY fsync in play is
+        # the rotation seal — the path under test
+        wal = WriteAheadLog(
+            tmp_path / "w",
+            WalConfig(sync="off", segment_bytes=1 << 10),
+        )
+        real_fsync = os.fsync
+        boom = {"armed": False, "hits": 0}
+
+        def flaky_fsync(fd):
+            if boom["armed"]:
+                boom["hits"] += 1
+                raise OSError("injected seal-fsync failure")
+            return real_fsync(fd)
+
+        monkeypatch.setattr(walmod2.os, "fsync", flaky_fsync)
+        path_before = wal._active_path
+        boom["armed"] = True
+        with pytest.raises(OSError):
+            for i in range(40):  # enough appends to trigger a rotation
+                wal.append(
+                    "u", {"ids": [f"a{i}"], "rows": ["x" * 64], "nid": 0}
+                )
+        assert boom["hits"] >= 1
+        # the swap never happened: active segment (and fd) unchanged,
+        # and the durability horizon did not ride over the failure
+        assert wal._active_path == path_before
+        synced_after_failure = wal.synced_seq
+        boom["armed"] = False
+        seq = wal.append("u", {"ids": ["ok"], "rows": [], "nid": 0})
+        # the retry fsyncs the ORIGINAL fd: everything buffered there
+        # becomes durable and the horizon advances past it
+        assert wal.synced_seq == seq > synced_after_failure
+        wal.close()
+        # every acknowledged record survives a reopen (the mask would
+        # have silently dropped the pre-failure suffix on power loss;
+        # here we at least prove the log itself is intact and ordered)
+        wal2 = WriteAheadLog(tmp_path / "w", WalConfig(sync="always"))
+        recs = list(wal2.replay())
+        assert [r["s"] for r in recs] == list(range(seq + 1))
+        wal2.close()
+
     def test_reopen_continues_seqnos(self, tmp_path):
         wal = WriteAheadLog(tmp_path / "w", WalConfig(sync="always"))
         for i in range(3):
