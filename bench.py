@@ -1030,6 +1030,266 @@ def config_serving(out_path: "str | None" = None):
     return rec
 
 
+# ------------------------------------------------ observability scenario
+
+
+def config_obs(out_path: "str | None" = None):
+    """Observability overhead + fidelity scenario (docs/observability.md):
+    serving QPS through the scheduler with tracing OFF (both arming
+    knobs 0 — the disarmed no-op check), SAMPLED (1/64) and FULL
+    (every root), on identical query pools; plus (a) live-histogram
+    p99 vs the offline numpy percentile of the same latencies, and
+    (b) a captured slow-query trace of a fused batched query whose
+    top-level phases must cover the root wall. Emits BENCH_OBS.json
+    (or ``out_path``; env GEOMESA_BENCH_OBS_OUT), gated by
+    scripts/bench_gate.py. CPU-runnable. Env knobs:
+    GEOMESA_BENCH_OBS_N (points), GEOMESA_BENCH_OBS_CLIENTS,
+    GEOMESA_BENCH_OBS_Q (total queries per mode)."""
+    import threading
+
+    from geomesa_tpu import conf, obs
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import HIST_EDGES, MetricsRegistry
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_OBS_N", 2_000_000))
+    clients = int(os.environ.get("GEOMESA_BENCH_OBS_CLIENTS", 4))
+    total_q = int(os.environ.get("GEOMESA_BENCH_OBS_Q", 1024))
+    out_path = out_path or os.environ.get("GEOMESA_BENCH_OBS_OUT")
+    rng = np.random.default_rng(SEED + 90)
+    log(f"[obs] building {n:,} point store ...")
+    x, y = gdelt_points(n, rng)
+    sft = FeatureType.from_spec("srv", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    reg = MetricsRegistry()
+    ds = DataStore(metrics=reg)
+    ds.create_schema(sft)
+    ds.write("srv", FeatureCollection.from_columns(
+        sft, np.arange(n), {"geom": (x, y)}), check_ids=False)
+
+    qrng = np.random.default_rng(SEED + 91)
+
+    def qbox():
+        w = float(qrng.choice([0.5, 1.0, 2.0]))
+        qx = qrng.uniform(-175, 175 - w)
+        qy = qrng.uniform(-85, 85 - w / 2)
+        return f"bbox(geom, {qx:.4f}, {qy:.4f}, {qx + w:.4f}, {qy + w / 2:.4f})"
+
+    pool = [qbox() for _ in range(total_q)]
+    for q in pool[:8]:
+        ds.query("srv", q)
+    ds.query_many("srv", pool[:8])
+    for q in pool:
+        ds.planner.plan("srv", q)
+
+    def run_clients(body):
+        per = max(1, total_q // clients)
+        lat: list[float] = []
+        hits = [0]
+        lock = threading.Lock()
+        start = threading.Barrier(clients + 1)
+
+        def worker(qs):
+            loc, h = [], 0
+            start.wait()
+            for q in qs:
+                s = time.perf_counter()
+                h += body(q)
+                loc.append(time.perf_counter() - s)
+            with lock:
+                lat.extend(loc)
+                hits[0] += h
+
+        threads = [
+            threading.Thread(target=worker, args=(pool[i * per:(i + 1) * per],))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return np.array(lat), hits[0], time.perf_counter() - t0
+
+    def arm(sample, slow_ms):
+        conf.OBS_TRACE_SAMPLE.set(sample)
+        conf.OBS_SLOW_MS.set(slow_ms)
+        obs.install(obs.Tracer())
+
+    modes = {"off": (0, 0.0), "sampled": (64, 0.0), "full": (1, 0.0)}
+    results: dict = {}
+    hits_by_mode: dict = {}
+    try:
+        # untimed warm pass: compiles every fused batch-size variant the
+        # concurrent load will hit, so mode ordering cannot bias the
+        # overhead ratios
+        arm(0, 0.0)
+        sched = ds.serve()
+        run_clients(lambda q: len(sched.query("srv", q)))
+        sched.close()
+        # median-of-5 per mode, modes INTERLEAVED round-robin so slow
+        # machine drift (thermal, page cache) hits every mode equally
+        # instead of biasing whichever ran last; the median (not the
+        # best) is the robust center the overhead ratios divide
+        runs: dict = {m: [] for m in modes}
+        for _rep in range(5):
+            for mode, (sample, slow_ms) in modes.items():
+                arm(sample, slow_ms)
+                sched = ds.serve()
+                lat, hits, wall = run_clients(
+                    lambda q: len(sched.query("srv", q))
+                )
+                sched.close()
+                runs[mode].append({
+                    "qps": round(len(lat) / wall, 1),
+                    "p50_ms": round(float(np.percentile(lat * 1e3, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat * 1e3, 99)), 3),
+                    "traces_retained": len(obs.tracer().traces()),
+                })
+                hits_by_mode[mode] = hits
+        for mode in modes:
+            ordered = sorted(runs[mode], key=lambda r: r["qps"])
+            results[mode] = dict(ordered[len(ordered) // 2])
+            results[mode]["qps_runs"] = [r["qps"] for r in runs[mode]]
+            log(
+                f"[obs] {mode}: {results[mode]['qps']} qps median of "
+                f"{results[mode]['qps_runs']}"
+            )
+
+        # -- live histogram p99 vs offline percentile (same latencies) --
+        arm(0, 0.0)
+        hreg = MetricsRegistry()
+        ds.metrics = hreg
+        offline: list[float] = []
+        for q in pool:
+            plan = ds.planner.plan("srv", q)
+            t0 = time.perf_counter()
+            ds.planner.execute(plan)
+            offline.append(time.perf_counter() - t0)
+        hist_p99 = hreg.histogram_quantile("geomesa.query.scan", 0.99)
+        off_p99 = float(np.percentile(offline, 99))
+        from bisect import bisect_left
+
+        bucket_delta = abs(
+            bisect_left(HIST_EDGES, hist_p99) - bisect_left(HIST_EDGES, off_p99)
+        )
+        ds.metrics = reg
+
+        # -- slow-query capture of a fused batched query ----------------
+        arm(0, 0.0001)  # always-slow threshold: every root captures
+        sched = ds.serve()
+        burst = pool[:32]
+        futs = [sched.submit("srv", q) for q in burst]
+        for f in futs:
+            f.result(60)
+        sched.close()
+        slow = obs.tracer().slow_queries()
+        serving = [
+            e for e in slow
+            if any(
+                s["name"] == "dispatch" for s in e["trace"]["spans"]
+            )
+        ]
+        entry = serving[-1]
+        top = [
+            s for s in entry["trace"]["spans"]
+            if s["parent_id"] is not None and any(
+                r["span_id"] == s["parent_id"] and r["parent_id"] is None
+                for r in entry["trace"]["spans"]
+            )
+        ]
+        phase_names = {s["name"] for s in top}
+        cover = sum(s["dur_ms"] for s in top) / max(entry["wall_ms"], 1e-9)
+        slow_trace = {
+            "n_phases": len(phase_names),
+            "phases": sorted(phase_names),
+            "wall_ms": entry["wall_ms"],
+            "phase_cover": round(min(cover, 1.0), 4),
+            "fingerprint_strategy": entry["fingerprint"].get("strategy"),
+        }
+        log(
+            f"[obs] slow trace: {slow_trace['n_phases']} phases, "
+            f"cover {slow_trace['phase_cover']:.3f}"
+        )
+    finally:
+        conf.OBS_TRACE_SAMPLE.clear()
+        conf.OBS_SLOW_MS.clear()
+        obs.install(obs.Tracer())
+
+    identical = hits_by_mode["off"] == hits_by_mode["sampled"] == hits_by_mode["full"]
+    row = {
+        "scenario": "serving_obs",
+        "clients": clients,
+        "queries": total_q,
+        "hits_total": int(hits_by_mode["off"]),
+        "identical": bool(identical),
+        "off": results["off"],
+        "sampled": results["sampled"],
+        "full": results["full"],
+        "sampled_over_off": round(
+            results["sampled"]["qps"] / max(results["off"]["qps"], 1e-9), 4
+        ),
+        "full_over_off": round(
+            results["full"]["qps"] / max(results["off"]["qps"], 1e-9), 4
+        ),
+        "hist_p99": {
+            "live_ms": round(hist_p99 * 1e3, 3),
+            "offline_ms": round(off_p99 * 1e3, 3),
+            "bucket_delta": int(bucket_delta),
+        },
+        "slow_trace": slow_trace,
+    }
+    # disarmed overhead vs the committed serving baseline, when the
+    # scales match (same points, a row at the same client count)
+    try:
+        base = json.load(open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
+        )))
+        if base.get("n_points") == n:
+            for brow in base.get("rows", []):
+                if brow.get("clients") == clients:
+                    row["off_over_serving_baseline"] = round(
+                        results["off"]["qps"]
+                        / max(brow["scheduler"]["qps"], 1e-9), 4
+                    )
+    except (OSError, ValueError, KeyError):
+        pass
+
+    import jax
+
+    payload = {
+        "n_points": n,
+        "platform": jax.default_backend(),
+        "rows": [row],
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_OBS.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "obs_sampled_over_off_qps_ratio",
+        "value": row["sampled_over_off"],
+        "unit": "ratio",
+        "off_qps": results["off"]["qps"],
+        "sampled_qps": results["sampled"]["qps"],
+        "full_qps": results["full"]["qps"],
+        "hist_p99_bucket_delta": row["hist_p99"]["bucket_delta"],
+        "slow_trace_phases": slow_trace["n_phases"],
+        "slow_trace_cover": slow_trace["phase_cover"],
+        "n_points": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ----------------------------------------------------- fused scenario
 
 
@@ -2373,6 +2633,7 @@ def child_main():
         "serving": config_serving, "ingest": config_ingest,
         "fused": config_fused, "pip_join": config_pip_join,
         "stream": config_stream, "wal": config_wal, "knn": config_knn,
+        "obs": config_obs,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -153,9 +153,21 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
        fields=("hits", "fired", "log"),
        doc="seeded chaos schedule state; consulted at fault points, "
            "which fire under arbitrary outer locks"),
+    _d("Tracer._lock", "geomesa_tpu/obs/trace.py", 76,
+       hot=True,
+       fields=("buffer", "slow", "_n_roots"),
+       doc="trace retention rings + sampling counter: taken once per "
+           "root begin/end, never per child span; nothing blocking "
+           "runs under it and it acquires no other lock"),
+    _d("SloTracker._lock", "geomesa_tpu/obs/slo.py", 78,
+       hot=True,
+       fields=("_windows",),
+       doc="SLO sliding windows: observations arrive via the registry "
+           "observer hook (invoked OUTSIDE the registry lock) under "
+           "arbitrary store locks, so it nests innermost-but-one"),
     _d("MetricsRegistry._lock", "geomesa_tpu/metrics.py", 80,
        hot=True,
-       fields=("counters", "gauges", "timers"),
+       fields=("counters", "gauges", "timers", "histograms"),
        doc="innermost by design: instruments are recorded under every "
            "other lock in the tree"),
 ]}
@@ -218,6 +230,20 @@ DECLARED_EDGES: list[tuple[str, str, str]] = [
      "queue-full shed/backpressure counters record under the condition"),
     ("BulkLoader._cv", "MetricsRegistry._lock",
      "writer-loop stage accounting records under the condition"),
+    ("DataStore._write_lock", "SloTracker._lock",
+     "the sliced fold's per-slice histogram observation fans out to "
+     "the attached SLO tracker through the registry observer hook "
+     "(invoked after the registry lock releases, write lock still "
+     "held)"),
+    ("QueryScheduler._cond", "Tracer._lock",
+     "a shed or closed-scheduler admission finishes the caller's trace "
+     "root (Tracer.end retains it) while the condition is held"),
+    ("DataStore._write_lock", "Tracer._lock",
+     "maintenance ops that query inside their write-locked section "
+     "(modify_features) begin/end the query's trace root there"),
+    ("StreamingFeatureCache._lock", "SloTracker._lock",
+     "the hook path's WAL fsync histogram observation reaches the SLO "
+     "windows through the registry observer hook under the hot lock"),
 ]
 
 #: hot-lock blocking the design ACCEPTS, with its justification — the
@@ -241,7 +267,7 @@ DECLARED_BLOCKING: list[tuple[str, str, str]] = [
 ENFORCED_SCOPES = (
     "geomesa_tpu/streaming/", "geomesa_tpu/serving/", "geomesa_tpu/cache/",
     "geomesa_tpu/ingest/", "geomesa_tpu/metrics.py", "geomesa_tpu/fault.py",
-    "geomesa_tpu/datastore.py",
+    "geomesa_tpu/datastore.py", "geomesa_tpu/obs/",
 )
 
 #: attribute-name type hints for cross-class call resolution where the
@@ -254,6 +280,7 @@ ATTR_TYPE_HINTS = {
     "flusher": "StreamFlusher",
     "wal": "WriteAheadLog",
     "scheduler": "QueryScheduler",
+    "slo": "SloTracker",
 }
 
 # the model's presence marker (the FaultPointRule convention: staged

@@ -108,6 +108,11 @@ INSTRUMENT_METHODS = {
     "gauge": "gauge",
     "timer_update": "timer",
     "time": "timer",
+    # the live-quantile instrument (docs/observability.md): observe()
+    # records, histogram_quantile() reads — both name a histogram, so
+    # convention/type-conflict/doc rules cover the family
+    "observe": "histogram",
+    "histogram_quantile": "histogram",
 }
 
 # reference-GeoMesa names the migration guide legitimately cites while

@@ -307,6 +307,61 @@ STREAM_INCREMENTAL = SystemProperty(
 )
 
 
+# -- observability: tracing / slow-query log / SLOs (geomesa_tpu.obs;
+# docs/observability.md) ---------------------------------------------------
+
+OBS_TRACE_SAMPLE = SystemProperty(
+    "geomesa.obs.trace.sample", 0, int,
+    "structured-tracing sample rate: 0 disarms tracing entirely (span "
+    "entry is a no-op thread-local check), 1 traces every root "
+    "operation, N retains every Nth root's span tree in the trace "
+    "buffer (slow queries are captured regardless — see "
+    "geomesa.obs.slow.ms)",
+)
+OBS_TRACE_BUFFER = SystemProperty(
+    "geomesa.obs.trace.buffer", 256, int,
+    "bounded in-memory trace ring: completed sampled traces retained "
+    "for DataStore.dump_trace (oldest evicted first)",
+)
+OBS_SLOW_MS = SystemProperty(
+    "geomesa.obs.slow.ms", 1000.0, float,
+    "always-on slow-query log threshold: a root operation slower than "
+    "this captures its full span tree + plan fingerprint into the "
+    "slow-query ring (DataStore.slow_queries); 0 disables the slow log "
+    "(and, with geomesa.obs.trace.sample=0, disarms tracing outright)",
+)
+OBS_SLOW_MAX = SystemProperty(
+    "geomesa.obs.slow.max", 64, int,
+    "slow-query ring capacity (oldest captures evicted first)",
+)
+OBS_SLO_WINDOW_S = SystemProperty(
+    "geomesa.obs.slo.window.s", 300.0, float,
+    "sliding evaluation window for SLO objectives (DataStore.slo_report)",
+)
+OBS_SLO_SLICES = SystemProperty(
+    "geomesa.obs.slo.slices", 30, int,
+    "sub-slices per SLO window: observations rotate through this many "
+    "interval sub-histograms, so the window slides with bounded memory "
+    "and at most window/slices staleness",
+)
+OBS_SLO_QUERY_P99_MS = SystemProperty(
+    "geomesa.obs.slo.query.p99.ms", 250.0, float,
+    "default query-latency objective: geomesa.query.scan p99 over the "
+    "sliding window must stay at or under this (SloTracker."
+    "default_objectives; 0 drops the objective)",
+)
+OBS_SLO_FOLD_P99_MS = SystemProperty(
+    "geomesa.obs.slo.fold.p99.ms", 150.0, float,
+    "default fold-pause objective: geomesa.stream.fold.slice p99 must "
+    "stay at or under this (the round-11 pause-kill SLO; 0 drops it)",
+)
+OBS_SLO_WAL_P99_MS = SystemProperty(
+    "geomesa.obs.slo.wal.p99.ms", 50.0, float,
+    "default durability objective: geomesa.stream.wal.fsync p99 must "
+    "stay at or under this (0 drops it)",
+)
+
+
 # -- lock-witness runtime (geomesa_tpu.lockwitness; docs/concurrency.md) --
 
 LOCK_WITNESS = SystemProperty(

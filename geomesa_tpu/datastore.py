@@ -748,6 +748,7 @@ class DataStore:
         so removals only ever land in the surviving original chunk,
         which stays chunk 0 throughout)."""
         from geomesa_tpu.metrics import resolve
+        from geomesa_tpu.obs.trace import span as _ospan
 
         metrics = resolve(self.metrics)
         n_batch = len(features)
@@ -764,39 +765,46 @@ class DataStore:
                 e = min(s + sr, n_batch)
                 fault.fault_point("stream.fold.slice")
                 t0 = time.perf_counter()
-                sub_fc = features.take(np.arange(s, e, dtype=np.int64))
-                sub_keys = {
-                    name: _slice_keys(k, s, stop=e) for name, k in keys.items()
-                }
-                sub_pre = None
-                if presorted:
-                    sub_pre = {}
-                    for name, perm in presorted.items():
-                        perm = np.asarray(perm)
-                        sel = (perm >= s) & (perm < e)
-                        sub_pre[name] = perm[sel] - s
-                sub_found = found[s:e]
-                rep = np.sort(sub_found[sub_found >= 0])
-                # pre-fold ordinal -> current ordinal: subtract the rank
-                # of earlier slices' removals (appends land after the
-                # original chunk and never shift it)
-                cur = rep - np.searchsorted(removed_cum, rep, side="left")
-                self._fold_slice_locked(
-                    type_name, sub_fc, sub_keys, cur,
-                    stats if e == n_batch else None,  # merge the batch
-                    # sketch ONCE, like the monolithic fold
-                    sub_pre,
-                )
-                removed_cum = np.union1d(removed_cum, rep)
-                self._fold_progress[type_name] = (si + 1, n_slices)
-                metrics.gauge(
-                    "geomesa.stream.fold.progress", (si + 1) / n_slices
-                )
-                metrics.counter("geomesa.stream.fold.slices")
-                slice_s.append(time.perf_counter() - t0)
-                metrics.timer_update("geomesa.stream.fold.slice", slice_s[-1])
-                if on_slice is not None:
-                    on_slice([str(i) for i in ids[s:e].tolist()])
+                with _ospan("fold.slice", index=si, rows=e - s):
+                    sub_fc = features.take(np.arange(s, e, dtype=np.int64))
+                    sub_keys = {
+                        name: _slice_keys(k, s, stop=e)
+                        for name, k in keys.items()
+                    }
+                    sub_pre = None
+                    if presorted:
+                        sub_pre = {}
+                        for name, perm in presorted.items():
+                            perm = np.asarray(perm)
+                            sel = (perm >= s) & (perm < e)
+                            sub_pre[name] = perm[sel] - s
+                    sub_found = found[s:e]
+                    rep = np.sort(sub_found[sub_found >= 0])
+                    # pre-fold ordinal -> current ordinal: subtract the
+                    # rank of earlier slices' removals (appends land after
+                    # the original chunk and never shift it)
+                    cur = rep - np.searchsorted(removed_cum, rep, side="left")
+                    self._fold_slice_locked(
+                        type_name, sub_fc, sub_keys, cur,
+                        stats if e == n_batch else None,  # merge the batch
+                        # sketch ONCE, like the monolithic fold
+                        sub_pre,
+                    )
+                    removed_cum = np.union1d(removed_cum, rep)
+                    self._fold_progress[type_name] = (si + 1, n_slices)
+                    metrics.gauge(
+                        "geomesa.stream.fold.progress", (si + 1) / n_slices
+                    )
+                    metrics.counter("geomesa.stream.fold.slices")
+                    slice_s.append(time.perf_counter() - t0)
+                    # the per-slice pause is a live histogram: the fold-
+                    # window p99 the round-11 campaign pinned offline is
+                    # now a registry read (and an SLO objective)
+                    metrics.observe(
+                        "geomesa.stream.fold.slice", slice_s[-1]
+                    )
+                    if on_slice is not None:
+                        on_slice([str(i) for i in ids[s:e].tolist()])
                 if pacer is not None and e < n_batch:
                     pacer()
         finally:
@@ -1509,9 +1517,29 @@ class DataStore:
         hints=None,
     ) -> FeatureCollection:
         """Run a query; returns the matching features as a collection.
-        ``hints`` is an optional geomesa_tpu.planning.hints.QueryHints."""
-        plan = self.planner.plan(type_name, f, limit=limit, explain=explain)
-        return self.planner.execute(plan, explain=explain, hints=hints)
+        ``hints`` is an optional geomesa_tpu.planning.hints.QueryHints.
+
+        When tracing is armed (docs/observability.md) the whole call is
+        one trace — plan/probe/scan/decode phases — retained per the
+        sampling knob, captured into the slow-query ring when over
+        ``geomesa.obs.slow.ms``, and appended to ``explain`` as a
+        per-phase breakdown."""
+        from geomesa_tpu.obs.trace import phase_breakdown, tracer
+
+        with tracer().trace("query", type=type_name) as trace:
+            plan = self.planner.plan(type_name, f, limit=limit, explain=explain)
+            if trace is not None:
+                trace.fingerprint = {
+                    "type": type_name,
+                    "strategy": plan.strategy,
+                    "filter": str(plan.filter),
+                }
+            out = self.planner.execute(plan, explain=explain, hints=hints)
+        if explain is not None and trace is not None:
+            for line in phase_breakdown(trace):
+                explain(line)
+            explain.trace = trace
+        return out
 
     def query_many(
         self,
@@ -1541,11 +1569,15 @@ class DataStore:
                 # degraded-mode answer: results excluded quarantined data
                 self.metrics.counter("geomesa.query.degraded")
             self.metrics.timer_update("geomesa.query.plan", plan.planning_s)
-            self.metrics.timer_update("geomesa.query.scan", scan_s)
+            # query latency is a live HISTOGRAM (docs/observability.md):
+            # p50/p99 read straight off the registry instead of offline
+            # bench post-processing; the attached SLO tracker consumes
+            # the same observation through the registry observer hook
+            self.metrics.observe("geomesa.query.scan", scan_s)
             if getattr(plan, "queue_wait_s", 0.0):
                 # serving-tier attribution: time queued behind the
                 # micro-batch window, SEPARATE from scan time
-                self.metrics.timer_update(
+                self.metrics.observe(
                     "geomesa.serving.queue_wait", plan.queue_wait_s
                 )
             if self.cache is not None and plan.cache_status in (None, "miss"):
@@ -1555,7 +1587,7 @@ class DataStore:
                 self.cache.tiles.note_scan(plan.type_name, scan_s)
             if plan.cache_status is not None:
                 # probe time attributes cache overhead separately from
-                # scan time (the scan timer above still covers the whole
+                # scan time (the scan histogram above still covers the whole
                 # execute, so a hit shows scan ~= probe)
                 self.metrics.timer_update(
                     "geomesa.query.cache_probe", plan.cache_probe_s
@@ -2091,6 +2123,69 @@ class DataStore:
         if plan.config is not None and not plan.config.disjoint:
             exp(f"Ranges: {plan.config.n_ranges}")
         return exp.render()
+
+    # -- observability surfaces (geomesa_tpu.obs; docs/observability.md) --
+    # SLO tracker attached by attach_slo(); the CLASS-level default makes
+    # `ds.slo` resolvable via hasattr (the test_docs doc-honesty pattern)
+    slo = None
+
+    def dump_trace(self, path: str) -> str:
+        """Write every retained trace (sampled buffer + slow-query ring)
+        as Chrome trace-event JSON — open in chrome://tracing or
+        Perfetto — and return the path. Tracing arms via
+        ``geomesa.obs.trace.sample`` / ``geomesa.obs.slow.ms``."""
+        from geomesa_tpu.obs.trace import tracer
+
+        return tracer().dump(path)
+
+    def slow_queries(self) -> list:
+        """The slow-query ring (newest last): operations over
+        ``geomesa.obs.slow.ms``, each with wall time, plan fingerprint
+        and full span tree — "where did the slow query spend its time"
+        without reproducing it."""
+        from geomesa_tpu.obs.trace import tracer
+
+        return tracer().slow_queries()
+
+    def attach_slo(self, objectives=None):
+        """Attach an SLO tracker (docs/observability.md): declarative
+        latency objectives evaluated over sliding windows. ``objectives``
+        is a sequence of :class:`~geomesa_tpu.obs.slo.SloObjective`
+        (default: the knob-configured
+        :func:`~geomesa_tpu.obs.slo.default_objectives`) or an already-
+        built SloTracker. A store without a metrics registry gets one —
+        the tracker subscribes to the registry's histogram observations.
+        Returns the tracker."""
+        from geomesa_tpu.metrics import MetricsRegistry
+        from geomesa_tpu.obs.slo import SloTracker
+
+        if self.metrics is None:
+            self.metrics = MetricsRegistry()
+        tracker = (
+            objectives if isinstance(objectives, SloTracker)
+            else SloTracker(objectives)
+        )
+        # replacing this store's tracker DETACHES the old one first —
+        # otherwise every re-attach would chain another fan-out layer
+        # onto the registry observer (SloTracker.attach fans out only
+        # for trackers it does not know about, i.e. other stores
+        # sharing the registry)
+        if (
+            self.slo is not None
+            and getattr(self.metrics, "observer", None) == self.slo.observe
+        ):
+            self.metrics.observer = None
+        self.slo = tracker.attach(self.metrics)
+        return self.slo
+
+    def slo_report(self) -> dict:
+        """The attached SLO tracker's report — the payload a ``/health``
+        endpoint serves verbatim (status, per-objective windowed
+        quantiles, burn rates). An unattached store reports ok with no
+        objectives."""
+        if self.slo is None:
+            return {"status": "ok", "window_s": 0.0, "objectives": []}
+        return self.slo.report()
 
 
 def _sketch_index(indexes) -> str:

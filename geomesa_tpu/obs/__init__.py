@@ -1,0 +1,45 @@
+"""geomesa_tpu.obs: the observability layer (docs/observability.md).
+
+Three surfaces over one substrate:
+
+- **structured tracing** (:mod:`~geomesa_tpu.obs.trace`): a ``Span``
+  context with thread-local propagation threaded through the full query
+  path (planner cache probe → z-range decomposition → scheduler
+  admission/queue/fused dispatch → kernel scan → decode/residue) and
+  the write path (micro-flush stages, WAL append/fsync, fold slices),
+  retained in a bounded ``TraceBuffer`` and exportable as Chrome
+  trace-event JSON (``DataStore.dump_trace``). An always-on slow-query
+  log captures span trees over ``geomesa.obs.slow.ms``.
+- **live histograms** (:class:`~geomesa_tpu.metrics.Histogram`): the
+  hot-path latencies record into fixed-log-bucket histograms, so "query
+  p99 right now" reads straight off ``MetricsRegistry``.
+- **SLO tracking** (:mod:`~geomesa_tpu.obs.slo`): declarative
+  objectives over sliding windows with burn-rate counters, served by
+  ``DataStore.slo_report()``.
+"""
+
+from geomesa_tpu.obs.slo import SloObjective, SloTracker, default_objectives
+from geomesa_tpu.obs.trace import (
+    Span,
+    Trace,
+    TraceBuffer,
+    Tracer,
+    install,
+    phase_breakdown,
+    span,
+    tracer,
+)
+
+__all__ = [
+    "Span",
+    "Trace",
+    "TraceBuffer",
+    "Tracer",
+    "SloObjective",
+    "SloTracker",
+    "default_objectives",
+    "install",
+    "phase_breakdown",
+    "span",
+    "tracer",
+]

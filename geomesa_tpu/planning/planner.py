@@ -25,6 +25,8 @@ from geomesa_tpu.filter import ecql
 from geomesa_tpu.filter.extract import extract_ids
 from geomesa_tpu.filter.predicates import Filter, Include
 from geomesa_tpu.index.api import ScanConfig
+from geomesa_tpu.obs.trace import span as _ospan
+from geomesa_tpu.obs.trace import tracer as _otracer
 from geomesa_tpu.planning.explain import Explainer, ExplainNull
 
 # index selection priority when multiple indexes can serve a filter;
@@ -70,7 +72,7 @@ class QueryPlan:
     # serving-tier attribution (geomesa_tpu.serving): wall-clock this plan
     # spent queued behind the micro-batch window before its fused dispatch
     # — kept SEPARATE from scan time so queue wait is attributable in
-    # explain traces and the geomesa.serving.queue_wait timer
+    # explain traces and the geomesa.serving.queue_wait histogram
     queue_wait_s: float = 0.0
 
     @property
@@ -231,13 +233,15 @@ class QueryPlanner:
         from geomesa_tpu.filter.predicates import canonical_key
 
         key = (idx, canonical_key(f))
-        with self._memo_lock:
-            memo = self._config_memo
-            if key in memo:
-                memo.move_to_end(key)
-                return memo[key]
-            epoch = self._memo_epoch
-        cfg = idx.scan_config(f)
+        with _ospan("plan.probe", index=idx.name):
+            with self._memo_lock:
+                memo = self._config_memo
+                if key in memo:
+                    memo.move_to_end(key)
+                    return memo[key]
+                epoch = self._memo_epoch
+        with _ospan("plan.decompose", index=idx.name):
+            cfg = idx.scan_config(f)
         with self._memo_lock:
             if self._memo_epoch != epoch:
                 # a mutation invalidated mid-compute: this decomposition
@@ -269,32 +273,33 @@ class QueryPlanner:
             guard = intercept
         t0 = time.perf_counter()
         exp = explain or ExplainNull()
-        if isinstance(f, str):
-            f = ecql.parse(f)
-        from geomesa_tpu.filter.predicates import normalize_antimeridian
+        with _ospan("plan", type=type_name):
+            if isinstance(f, str):
+                f = ecql.parse(f)
+            from geomesa_tpu.filter.predicates import normalize_antimeridian
 
-        f = normalize_antimeridian(f)
-        if intercept:
-            f = self.store.apply_interceptors(type_name, f)
-            # attribute-level visibility closes at PLAN depth: a predicate
-            # over a hidden attribute would evaluate against the hidden
-            # values during scan/refinement, letting unauthorized auths
-            # reconstruct them by probing (the reference's cell-level
-            # visibility makes the cell unreadable to the scan itself)
-            self._check_attr_visibility(type_name, f)
-        exp(f"Planning query on '{type_name}': {type(f).__name__}")
+            f = normalize_antimeridian(f)
+            if intercept:
+                f = self.store.apply_interceptors(type_name, f)
+                # attribute-level visibility closes at PLAN depth: a predicate
+                # over a hidden attribute would evaluate against the hidden
+                # values during scan/refinement, letting unauthorized auths
+                # reconstruct them by probing (the reference's cell-level
+                # visibility makes the cell unreadable to the scan itself)
+                self._check_attr_visibility(type_name, f)
+            exp(f"Planning query on '{type_name}': {type(f).__name__}")
 
-        plan = self._select(type_name, f, limit, exp)
-        if guard:
-            self.store.apply_guards(plan)
-        # degraded mode: a store that quarantined damaged partitions at
-        # load answers from the survivors and WARNS instead of raising
-        health = getattr(self.store, "health", None)
-        if health is not None:
-            w = health.warning_for(type_name)
-            if w is not None:
-                plan.warnings = [w]
-                exp.warn(w)
+            plan = self._select(type_name, f, limit, exp)
+            if guard:
+                self.store.apply_guards(plan)
+            # degraded mode: a store that quarantined damaged partitions at
+            # load answers from the survivors and WARNS instead of raising
+            health = getattr(self.store, "health", None)
+            if health is not None:
+                w = health.warning_for(type_name)
+                if w is not None:
+                    plan.warnings = [w]
+                    exp.warn(w)
         plan.planning_s = time.perf_counter() - t0
         return plan
 
@@ -461,11 +466,20 @@ class QueryPlanner:
             value = self._execute(plan, explain, hints, deadline=deadline)
             return value, time.perf_counter() - s0
 
+        t_probe = time.perf_counter()
         out, status, probe_s = cache.result.get_or_compute(
             key, plan.type_name, key_range, compute, pinned=(mode == "pin")
         )
         plan.cache_status = status
         plan.cache_probe_s = probe_s
+        # the probe phase is the get_or_compute prefix BEFORE any scan:
+        # recorded retroactively from the measured probe_s so a hit's
+        # trace shows probe ~= the whole execute
+        tr = _otracer()
+        tr.add_span(
+            tr.current(), "probe", t0=t_probe, end=t_probe + probe_s,
+            status=status,
+        )
         exp(f"cache: {status} (probe {probe_s * 1e3:.3f}ms, key {key[:12]})")
         return out
 
@@ -522,10 +536,14 @@ class QueryPlanner:
         elif plan.index is None:  # full host scan
             fc = self.store.features(plan.type_name)
             check_deadline(deadline, "full-table scan start")
-            with exp.span("Full-table host scan"):
-                mask = plan.filter.evaluate(fc.batch)
+            with _ospan("scan", index="full"):
+                with exp.span("Full-table host scan"):
+                    mask = plan.filter.evaluate(fc.batch)
             check_deadline(deadline, "full-table scan")
-            return self._post(fc.mask(mask), plan, hints, exp, skip_visibility)
+            with _ospan("decode", candidates=int(mask.sum())):
+                return self._post(
+                    fc.mask(mask), plan, hints, exp, skip_visibility
+                )
         elif plan.index is not None and self.store.row_count(plan.type_name) == 0:
             # schema exists but nothing written yet: no index tables
             candidates = self.store.features(plan.type_name)
@@ -566,28 +584,32 @@ class QueryPlanner:
         ``chunks``: the chunk snapshot captured when that scan was
         dispatched (submit_many); default captures one here."""
         if finish_scan is None:
-            table, chunks = self.store.pin_scan_state(
-                plan.type_name, plan.index
-            )
-            finish_scan = table.scan_submit(plan.config, deadline=None)
+            with _ospan("dispatch", index=plan.index):
+                table, chunks = self.store.pin_scan_state(
+                    plan.type_name, plan.index
+                )
+                finish_scan = table.scan_submit(plan.config, deadline=None)
         elif chunks is None:
             chunks = self.store.chunk_snapshot(plan.type_name)
 
         def finish(deadline=deadline) -> FeatureCollection:
             if deadline is None:
                 deadline = self._deadline(hints)
-            with exp.span(f"Device scan [{plan.index}]"):
-                # single-chip and distributed tables share one engine and
-                # one contract: (ordinals, certainty vector)
-                ordinals, certain = finish_scan()
-            check_deadline(deadline, "scan result pull")
+            with _ospan("scan", index=plan.index):
+                with exp.span(f"Device scan [{plan.index}]"):
+                    # single-chip and distributed tables share one engine
+                    # and one contract: (ordinals, certainty vector)
+                    ordinals, certain = finish_scan()
+                check_deadline(deadline, "scan result pull")
             exp(f"Candidates: {len(ordinals)}")
-            candidates = self.store.gather(
-                plan.type_name, ordinals, chunks=chunks
-            )
-            return self._refine_and_post(
-                plan, candidates, certain, hints, exp, deadline, skip_visibility
-            )
+            with _ospan("decode", candidates=len(ordinals)):
+                candidates = self.store.gather(
+                    plan.type_name, ordinals, chunks=chunks
+                )
+                return self._refine_and_post(
+                    plan, candidates, certain, hints, exp, deadline,
+                    skip_visibility,
+                )
 
         return finish
 

@@ -36,7 +36,8 @@ TPU build gets it from an admission layer in front of the device:
 Metrics: counters geomesa.serving.submitted / .shed / .coalesced /
 .batches / .batched_queries (mean fused batch size =
 batched_queries/batches); gauge geomesa.serving.window_ms (current
-adaptive window); timer geomesa.serving.queue_wait (via record_query).
+adaptive window); histogram geomesa.serving.queue_wait (via
+record_query — live queue-wait quantiles, docs/observability.md).
 
 Results are byte-identical to sequential ``DataStore.query()``: the
 scheduler reuses the planner's plan/refine/post pipeline end to end
@@ -107,7 +108,7 @@ class _Item:
 
     __slots__ = (
         "plan", "hints", "future", "key", "key_range", "epoch", "timeout",
-        "deadline", "t_enqueue", "explain",
+        "deadline", "t_enqueue", "t_admit", "explain", "trace",
     )
 
     def __init__(self, plan, hints, future, explain):
@@ -115,6 +116,9 @@ class _Item:
         self.hints = hints
         self.future = future
         self.explain = explain
+        self.trace = None      # obs trace root (None when disarmed): the
+        #                        query's span tree follows the item across
+        #                        the submit -> dispatcher thread hop
         self.key = None        # cache fingerprint
         self.key_range = None  # cache invalidation range (cache-enabled)
         self.epoch = 0         # store mutation epoch at admission: the
@@ -124,6 +128,9 @@ class _Item:
         self.timeout = None    # resolved budget in seconds
         self.deadline = None   # monotonic cutoff from submit time
         self.t_enqueue = 0.0
+        self.t_admit = 0.0     # perf_counter after planning: the admit
+        #                        phase (fingerprint/peek/backpressure) is
+        #                        t_admit -> t_enqueue on the trace
 
 
 class QueryScheduler:
@@ -185,6 +192,10 @@ class QueryScheduler:
             pending, self._queue = self._queue, []
         for it in pending:
             if not it.future.done():
+                if it.trace is not None:
+                    from geomesa_tpu.obs.trace import tracer
+
+                    tracer().end(it.trace)
                 _resolve(it.future, exc=RuntimeError("scheduler closed"))
 
     def __enter__(self) -> "QueryScheduler":
@@ -211,18 +222,38 @@ class QueryScheduler:
         or sheds immediately with ServingRejected."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
+        from geomesa_tpu.obs.trace import tracer
+
         planner = self.store.planner
         # captured BEFORE planning: the submitter's own completed writes
         # have already bumped it, so read-your-writes holds at admission
         epoch = planner.mutation_epoch
-        plan = planner.plan(type_name, f, limit=limit, explain=explain)
-        if hints is not None:
-            # validate in the CALLER's thread: one submitter's bad hints
-            # must raise here, not fail the whole co-batched dispatch
-            hints.validate()
+        # the query's trace roots HERE, in the caller's thread: planning
+        # spans land now; queue/dispatch/scan phases attach later from
+        # the dispatcher thread (the item carries the root across)
+        otr = tracer()
+        trace = otr.begin("query", type=type_name, serving=True)
+        try:
+            with otr.activate(trace.root if trace is not None else None):
+                plan = planner.plan(type_name, f, limit=limit, explain=explain)
+                if hints is not None:
+                    # validate in the CALLER's thread: one submitter's bad
+                    # hints must raise here, not fail the co-batched dispatch
+                    hints.validate()
+        except BaseException:
+            otr.end(trace)  # plan-time error: the trace still closes
+            raise
+        if trace is not None:
+            trace.fingerprint = {
+                "type": type_name,
+                "strategy": plan.strategy,
+                "filter": str(plan.filter),
+            }
         fut: Future = Future()
         it = _Item(plan, hints, fut, explain)
         it.epoch = epoch
+        it.trace = trace
+        it.t_admit = time.perf_counter()
         it.timeout = getattr(hints, "timeout", None) if hints is not None else None
         if it.timeout is None:
             it.timeout = self.store.query_timeout
@@ -244,12 +275,19 @@ class QueryScheduler:
                 it.key_range = cache.key_range(plan.filter, sft)
                 if cache.result.enabled and cache.result.peek(it.key) is not None:
                     try:
-                        _resolve(
-                            fut,
-                            planner.execute(plan, explain=explain, hints=hints),
-                        )
+                        with otr.activate(
+                            trace.root if trace is not None else None
+                        ):
+                            _resolve(
+                                fut,
+                                planner.execute(
+                                    plan, explain=explain, hints=hints
+                                ),
+                            )
                     except BaseException as exc:
                         _resolve(fut, exc=exc)
+                    finally:
+                        otr.end(trace)
                     return fut
             else:
                 from geomesa_tpu.cache.fingerprint import fingerprint_plan
@@ -280,6 +318,7 @@ class QueryScheduler:
                         return fut
                 self._cond.wait(rem if rem is not None else 0.1)
             if self._closed:
+                otr.end(trace)
                 _resolve(fut, exc=RuntimeError("scheduler closed"))
                 return fut
             it.t_enqueue = time.perf_counter()
@@ -332,6 +371,11 @@ class QueryScheduler:
             )
         if it.explain is not None:
             it.explain.warn(f"serving: shed ({why})")
+        if it.trace is not None:
+            from geomesa_tpu.obs.trace import tracer
+
+            it.trace.root.annotate(shed=why)
+            tracer().end(it.trace)
         _resolve(it.future, exc=exc)
 
     # -- dispatcher ------------------------------------------------------
@@ -420,6 +464,9 @@ class QueryScheduler:
         self.metrics.counter("geomesa.serving.batches")
         self.metrics.counter("geomesa.serving.batched_queries", len(leaders))
 
+        from geomesa_tpu.obs.trace import phase_breakdown, tracer
+
+        otr = tracer()
         try:
             # per-leader explains (fused members trace their device scan
             # like sequential execution) and ADMISSION-anchored deadlines:
@@ -428,6 +475,7 @@ class QueryScheduler:
             # leader's deadline and fate (single-flight semantics).
             from geomesa_tpu.planning.errors import Deadline
 
+            t_sm0 = time.perf_counter()
             finishes = self.store.planner.submit_many(
                 [it.plan for it in leaders],
                 hints=[it.hints for it in leaders],
@@ -444,21 +492,53 @@ class QueryScheduler:
         except BaseException as exc:
             for it in live:
                 if not it.future.done():
+                    if it.trace is not None:
+                        otr.end(it.trace)
                     _resolve(it.future, exc=exc)
             return
 
         t_dispatch = time.perf_counter()
+        for it in live:
+            if it.trace is not None:
+                # the cross-thread phases, recorded retroactively onto the
+                # caller's trace: admission (fingerprint/peek/backpressure
+                # in the caller thread), time queued behind the window,
+                # then the shared fused-dispatch staging
+                root = it.trace.root
+                otr.add_span(root, "admit", t0=it.t_admit, end=it.t_enqueue)
+                otr.add_span(root, "queue", t0=it.t_enqueue, end=t_sm0)
+                otr.add_span(
+                    root, "dispatch", t0=t_sm0, end=t_dispatch,
+                    batch=len(leaders),
+                )
         for j, (it, fin) in enumerate(zip(leaders, finishes)):
             group = [it] + followers.get(j, [])
             for g in group:
                 # queue wait lands on the plan BEFORE finish() so the
-                # leader's record_query picks it up (the queue_wait timer)
+                # leader's record_query picks it up (the queue_wait
+                # histogram)
                 g.plan.queue_wait_s = t_dispatch - g.t_enqueue
             t0 = time.perf_counter()
+            for g in group:
+                if g.trace is not None:
+                    # time between the fused dispatch and THIS member's
+                    # turn in the pull loop: attributed as batch wait so
+                    # a co-batched query's trace explains its whole wall
+                    otr.add_span(
+                        g.trace.root, "batch.wait",
+                        t0=t_dispatch, end=t0, position=j,
+                    )
             try:
-                value = fin()
+                # the leader's span tree continues in THIS thread: the
+                # device pull's scan/decode phases attach under its root
+                with otr.activate(
+                    it.trace.root if it.trace is not None else None
+                ):
+                    value = fin()
             except BaseException as exc:
                 for g in group:
+                    if g.trace is not None:
+                        otr.end(g.trace)
                     _resolve(g.future, exc=exc)
                 continue
             cost_s = time.perf_counter() - t0
@@ -482,10 +562,18 @@ class QueryScheduler:
                 g.plan.cache_status = "coalesced"
                 self.store.record_query(g.plan, len(value), cost_s)
             for g in group:
+                if g.trace is not None:
+                    if g is not it:
+                        g.trace.root.annotate(coalesced=True)
+                    otr.end(g.trace)
                 if g.explain is not None:
                     g.explain(
                         f"serving: queue wait {g.plan.queue_wait_s * 1e3:.3f}ms, "
                         f"scan {cost_s * 1e3:.3f}ms, "
                         f"fused batch of {len(leaders)}"
                     )
+                    if g.trace is not None:
+                        for line in phase_breakdown(g.trace):
+                            g.explain(line)
+                        g.explain.trace = g.trace
                 _resolve(g.future, value)

@@ -56,6 +56,8 @@ import numpy as np
 from geomesa_tpu import fault
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.ingest import sort as shsort
+from geomesa_tpu.obs.trace import span as _ospan
+from geomesa_tpu.obs.trace import tracer as _otracer
 
 STAGES = ("parse", "keys", "sort", "commit")
 
@@ -168,11 +170,13 @@ class StreamFlusher:
 
     # -- stages -----------------------------------------------------------
     def _stage_time(self, stage: str, seconds: float) -> None:
-        self.metrics.timer_update(f"geomesa.stream.{stage}", seconds)
+        # live histograms, not mean-only timers (docs/observability.md):
+        # per-stage p99s read straight off the registry
+        self.metrics.observe(f"geomesa.stream.{stage}", seconds)
 
     def _run_chunk(
         self, ch: _FlushChunk, incremental: bool = True,
-        retain: bool = False, sort: bool = True,
+        retain: bool = False, sort: bool = True, tspan=None,
     ) -> None:
         """parse -> keys -> sort for one micro-chunk (one pool task:
         chunks overlap across workers; stages attribute separately).
@@ -182,38 +186,48 @@ class StreamFlusher:
         ``retain`` keeps the source row references + ids for the
         pre-stage identity check; ``sort=False`` defers the shard sort
         (a pre-staged chunk's batch offset is unknown until the fold
-        assigns final chunk order — :meth:`_sort_chunk` runs then)."""
-        sft = self.store.get_schema(self.type_name)
-        fault.fault_point("stream.flush.parse")
-        t0 = time.perf_counter()
-        ch.fc = FeatureCollection.from_rows(sft, ch.rows, ids=ch.ids)
-        if retain:
-            ch.src_rows, ch.rows = ch.rows, None
-        else:
-            ch.rows = ch.ids = None  # staged scratch: release as consumed
-        t1 = time.perf_counter()
-        self._stage_time("parse", t1 - t0)
-        if not incremental:
-            return
-        fault.fault_point("stream.flush.keys")
-        _, ch.keys, ch.stats = self.store._encode_batch(self.type_name, ch.fc)
-        t2 = time.perf_counter()
-        self._stage_time("keys", t2 - t1)
-        if sort:
-            self._sort_chunk(ch)
+        assigns final chunk order — :meth:`_sort_chunk` runs then).
+        ``tspan``: the submitting flush's active span, re-activated in
+        this pool thread so the chunk's stage spans join its trace."""
+        with _otracer().activate(tspan):
+            sft = self.store.get_schema(self.type_name)
+            fault.fault_point("stream.flush.parse")
+            t0 = time.perf_counter()
+            with _ospan("flush.parse", rows=len(ch.ids or ())):
+                ch.fc = FeatureCollection.from_rows(sft, ch.rows, ids=ch.ids)
+            if retain:
+                ch.src_rows, ch.rows = ch.rows, None
+            else:
+                ch.rows = ch.ids = None  # staged scratch: release as consumed
+            t1 = time.perf_counter()
+            self._stage_time("parse", t1 - t0)
+            if not incremental:
+                return
+            fault.fault_point("stream.flush.keys")
+            with _ospan("flush.keys"):
+                _, ch.keys, ch.stats = self.store._encode_batch(
+                    self.type_name, ch.fc
+                )
+            t2 = time.perf_counter()
+            self._stage_time("keys", t2 - t1)
+            if sort:
+                self._sort_chunk(ch)
 
-    def _sort_chunk(self, ch: _FlushChunk) -> None:
+    def _sort_chunk(self, ch: _FlushChunk, tspan=None) -> None:
         """Shard-radix-sort one chunk's (bin, z) keys at its assigned
         batch offset (the 'sort' stage; split out so pre-staged chunks
         can sort once their final base is known)."""
-        fault.fault_point("stream.flush.sort")
-        t0 = time.perf_counter()
-        for name, k in ch.keys.items():
-            if len(k.zs) and k.sub is None:
-                ch.runs[name] = shsort.shard_runs(
-                    k.bins, k.zs, ch.base, max(self.config.chunk_rows, 1)
-                )
-        self._stage_time("sort", time.perf_counter() - t0)
+        with _otracer().activate(tspan):
+            fault.fault_point("stream.flush.sort")
+            t0 = time.perf_counter()
+            with _ospan("flush.sort"):
+                for name, k in ch.keys.items():
+                    if len(k.zs) and k.sub is None:
+                        ch.runs[name] = shsort.shard_runs(
+                            k.bins, k.zs, ch.base,
+                            max(self.config.chunk_rows, 1),
+                        )
+            self._stage_time("sort", time.perf_counter() - t0)
 
     # -- pre-staging (round 11: parse/keys leave the fold window) ---------
     def stage(self, pairs: Sequence[tuple]) -> int:
@@ -387,70 +401,82 @@ class StreamFlusher:
             return 0
         if incremental is None:
             incremental = self.config.incremental
-        pool = self._ensure_pool()
-        chunk_rows = max(int(self.config.chunk_rows), 1)
-        if incremental and self.config.prestage:
-            chunks, rest = self._take_staged(snapshot)
-        else:
-            if not incremental:
-                # the legacy path re-publishes the whole hot state; any
-                # staged scratch is superseded by this full drain
-                self._discard_staged()
-            chunks, rest = [], list(snapshot)
-        base = 0
-        for ch in chunks:  # final batch order: staged first, then fresh
-            ch.base = base
-            base += len(ch.fc)
-        futures = []
-        error: "BaseException | None" = None
-        try:
-            if incremental:
-                for ch in chunks:
-                    # pre-staged chunks deferred their shard sort until
-                    # this flush assigned their batch offsets
-                    futures.append(pool.submit(self._sort_chunk, ch))
-            for s in range(0, len(rest), chunk_rows):
-                part = rest[s : s + chunk_rows]
-                if not self._sem.acquire(blocking=False):
-                    # bounded admission window: backpressures staging so
-                    # at most queue_depth chunks sit in the pool at once
-                    # (see the module docstring for what is and is NOT
-                    # bounded)
-                    self.metrics.counter("geomesa.stream.queue_full")
-                    self._sem.acquire()
-                ch = _FlushChunk(
-                    base + s, [r for _, r in part], [fid for fid, _ in part]
-                )
-                chunks.append(ch)
-                try:
-                    fut = pool.submit(self._run_chunk, ch, incremental)
-                except BaseException:
-                    # submit failed (e.g. close() raced the flush and shut
-                    # the pool): the permit has no completion callback to
-                    # release it — leaking it here would wedge every
-                    # future flush once the window drains to zero
-                    self._sem.release()
-                    raise
-                fut.add_done_callback(lambda _f: self._sem.release())
-                futures.append(fut)
-        except BaseException as e:
-            error = e
-        for fut in futures:
+        # one trace per flush (sampling decides retention): stage spans
+        # from the pool workers re-attach via the captured parent span
+        with _otracer().trace(
+            "flush", type=self.type_name, rows=n
+        ) as trace:
+            tspan = trace.root if trace is not None else None
+            pool = self._ensure_pool()
+            chunk_rows = max(int(self.config.chunk_rows), 1)
+            if incremental and self.config.prestage:
+                chunks, rest = self._take_staged(snapshot)
+            else:
+                if not incremental:
+                    # the legacy path re-publishes the whole hot state; any
+                    # staged scratch is superseded by this full drain
+                    self._discard_staged()
+                chunks, rest = [], list(snapshot)
+            base = 0
+            for ch in chunks:  # final batch order: staged first, then fresh
+                ch.base = base
+                base += len(ch.fc)
+            futures = []
+            error: "BaseException | None" = None
             try:
-                fut.result()
-            except BaseException as e:  # first stage failure wins
-                if error is None:
-                    error = e
-        if error is not None:
-            raise error
+                if incremental:
+                    for ch in chunks:
+                        # pre-staged chunks deferred their shard sort until
+                        # this flush assigned their batch offsets
+                        futures.append(
+                            pool.submit(self._sort_chunk, ch, tspan=tspan)
+                        )
+                for s in range(0, len(rest), chunk_rows):
+                    part = rest[s : s + chunk_rows]
+                    if not self._sem.acquire(blocking=False):
+                        # bounded admission window: backpressures staging so
+                        # at most queue_depth chunks sit in the pool at once
+                        # (see the module docstring for what is and is NOT
+                        # bounded)
+                        self.metrics.counter("geomesa.stream.queue_full")
+                        self._sem.acquire()
+                    ch = _FlushChunk(
+                        base + s, [r for _, r in part], [fid for fid, _ in part]
+                    )
+                    chunks.append(ch)
+                    try:
+                        fut = pool.submit(
+                            self._run_chunk, ch, incremental, tspan=tspan
+                        )
+                    except BaseException:
+                        # submit failed (e.g. close() raced the flush and
+                        # shut the pool): the permit has no completion
+                        # callback to release it — leaking it here would
+                        # wedge every future flush once the window drains
+                        # to zero
+                        self._sem.release()
+                        raise
+                    fut.add_done_callback(lambda _f: self._sem.release())
+                    futures.append(fut)
+            except BaseException as e:
+                error = e
+            for fut in futures:
+                try:
+                    fut.result()
+                except BaseException as e:  # first stage failure wins
+                    if error is None:
+                        error = e
+            if error is not None:
+                raise error
 
-        t0 = time.perf_counter()
-        out = self._commit(chunks, incremental, pacer, on_slice)
-        self._stage_time("commit", time.perf_counter() - t0)
-        self.flushes += 1
-        self.metrics.counter("geomesa.stream.flushes")
-        self.metrics.counter("geomesa.stream.rows", out)
-        return out
+            t0 = time.perf_counter()
+            with _ospan("flush.commit", chunks=len(chunks)):
+                out = self._commit(chunks, incremental, pacer, on_slice)
+            self._stage_time("commit", time.perf_counter() - t0)
+            self.flushes += 1
+            self.metrics.counter("geomesa.stream.flushes")
+            self.metrics.counter("geomesa.stream.rows", out)
+            return out
 
     def _commit(
         self, chunks: list, incremental: bool, pacer=None, on_slice=None

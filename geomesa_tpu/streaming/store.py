@@ -121,24 +121,29 @@ class LambdaStore:
         is logged (ids resolved, auto-ids consumed) and made durable to
         the sync policy's guarantee BEFORE it applies — the return is
         the acknowledgment: under ``sync=always`` an acknowledged batch
-        survives ``kill -9``."""
-        if self.wal is not None:
-            ids, next_id = self.hot.assign_ids(rows, ids)
-            seq = self.wal.log_upsert(ids, rows, next_id)
-            try:
-                n = self.hot.upsert(rows, ids)
-            finally:
-                # logged -> applied: the checkpoint cover (applied
-                # horizon) may now pass this record — before this, a
-                # concurrent checkpoint's snapshot could miss the rows
-                # while its cover skipped the record at replay (the
-                # acknowledged-loss race the chaos harness caught)
-                self.wal.applied(seq)
+        survives ``kill -9``. When tracing is armed the acknowledged
+        write is one trace (WAL append/fsync spans under it), sampled
+        like queries (docs/observability.md)."""
+        from geomesa_tpu.obs.trace import tracer
+
+        with tracer().trace("write", type=self.type_name, rows=len(rows)):
+            if self.wal is not None:
+                ids, next_id = self.hot.assign_ids(rows, ids)
+                seq = self.wal.log_upsert(ids, rows, next_id)
+                try:
+                    n = self.hot.upsert(rows, ids)
+                finally:
+                    # logged -> applied: the checkpoint cover (applied
+                    # horizon) may now pass this record — before this, a
+                    # concurrent checkpoint's snapshot could miss the rows
+                    # while its cover skipped the record at replay (the
+                    # acknowledged-loss race the chaos harness caught)
+                    self.wal.applied(seq)
+                self._gauge_hot()
+                return n
+            n = self.hot.upsert(rows, ids)
             self._gauge_hot()
             return n
-        n = self.hot.upsert(rows, ids)
-        self._gauge_hot()
-        return n
 
     def delete(self, ids: Sequence[str]) -> int:
         """Remove live hot rows by id (the Kafka cache's delete

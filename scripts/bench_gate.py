@@ -59,6 +59,10 @@ SCENARIO_SPECS = {
     "stream_wal": [("wal_interval_rows_per_s", "higher", ())],
     "wal_replay": [("replay_rows_per_s", "higher", ())],
     "knn_batched": [("batched_qps", "higher", ())],
+    "serving_obs": [
+        ("off.qps", "higher", ()),
+        ("sampled.qps", "higher", ()),
+    ],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -80,6 +84,21 @@ FRESH_BOUNDS = {
         "batched_qps", 60.0, "min",
         "batched kNN must clear the 60 q/s bar (VERDICT weak #5)",
     )],
+    # the ISSUE 13 observability acceptance: sampled (1/64) tracing
+    # keeps >=95% of tracing-off serving QPS within the same run; the
+    # live histogram p99 agrees with the offline percentile within one
+    # log bucket; a captured slow-query trace explains >=90% of its
+    # wall through >=5 top-level phases
+    "serving_obs": [
+        ("sampled_over_off", 0.95, "min",
+         "sampled (1/64) tracing must keep >=95% of tracing-off QPS"),
+        ("hist_p99.bucket_delta", 1.0, "max",
+         "live histogram p99 must agree with offline p99 within 1 bucket"),
+        ("slow_trace.phase_cover", 0.90, "min",
+         "slow-query trace phases must cover >=90% of the root wall"),
+        ("slow_trace.n_phases", 5.0, "min",
+         "a fused batched slow query must show >=5 distinct phases"),
+    ],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -87,6 +106,7 @@ BASELINES = {
     "BENCH_STREAM": "BENCH_STREAM.json",
     "BENCH_WAL": "BENCH_WAL.json",
     "BENCH_KNN": "BENCH_KNN.json",
+    "BENCH_OBS": "BENCH_OBS.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

@@ -1,0 +1,33 @@
+"""KNOWN-BAD fixture: the histogram instrument is registry-covered.
+
+An unregistered histogram — one whose name the metric registries cannot
+accept — must fail the build exactly like a bad counter (ISSUE 13: the
+``observe``/``histogram_quantile`` instrument methods joined
+INSTRUMENT_METHODS). Two seeded defects:
+
+- ``geomesa.Fixture-Hist.latency`` breaks the geomesa.<area>.<name>
+  convention through ``observe()`` -> `metric-convention` (proves the
+  registry extraction sees the NEW instrument kind);
+- ``geomesa.fixture.wait`` is observed as a histogram AND incremented
+  as a counter -> `metric-type-conflict` (one name, two exposition
+  families).
+"""
+
+
+class HistProbe:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def record_latency(self, seconds):
+        self.metrics.observe("geomesa.Fixture-Hist.latency", seconds)
+
+    def read_latency(self):
+        return self.metrics.histogram_quantile(
+            "geomesa.Fixture-Hist.latency", 0.99
+        )
+
+    def record_wait_histogram(self, seconds):
+        self.metrics.observe("geomesa.fixture.wait", seconds)
+
+    def record_wait_counter(self):
+        self.metrics.counter("geomesa.fixture.wait")

@@ -218,7 +218,7 @@ def test_serving_doc_apis_exist():
     _assert_runtime_declared(knobs)
     _assert_documented("serving.md", knobs + metrics)
     # the documented instrument kinds render through the registry,
-    # including the _seconds_max exposition the doc points operators at
+    # including the histogram exposition the doc points operators at
     reg = MetricsRegistry()
     by_name = _registries().metrics.by_name()
     for n in metrics:
@@ -227,11 +227,16 @@ def test_serving_doc_apis_exist():
             reg.counter(n)
         elif kind == "gauge":
             reg.gauge(n, 0.0)
+        elif kind == "histogram":
+            reg.observe(n, 0.01)
         else:
             reg.timer_update(n, 0.01)
     text = reg.render_prometheus()
     assert "geomesa_serving_shed 1" in text
-    assert "geomesa_serving_queue_wait_seconds_max" in text
+    # queue wait is a live histogram (docs/observability.md): proper
+    # _bucket{le=...}/_sum/_count families
+    assert 'geomesa_serving_queue_wait_seconds_bucket{le="' in text
+    assert "geomesa_serving_queue_wait_seconds_count 1" in text
     # every `ds.X` / `sched.X` the guide mentions in backticks resolves
     path = os.path.join(os.path.dirname(__file__), "..", "docs", "serving.md")
     text = open(path).read()
@@ -614,6 +619,64 @@ def test_concurrency_doc_honest():
         assert why and a in LOCKS and b in LOCKS
     for lock, pat, why in DECLARED_BLOCKING:
         assert why and lock in LOCKS and pat
+
+
+def test_observability_doc_honest():
+    """docs/observability.md stays honest the registry way: every
+    obs/tracing/SLO API it names is real, every geomesa.obs.* knob and
+    metric is declared at runtime and cited by the doc (and the knobs
+    by config.md), and the documented histogram exposition renders."""
+    import inspect
+
+    import pytest
+
+    from geomesa_tpu import obs
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.metrics import HIST_EDGES, Histogram, MetricsRegistry
+    from geomesa_tpu.obs.trace import NULL_SPAN  # noqa: F401
+
+    for name in ("Span", "Trace", "TraceBuffer", "Tracer", "SloObjective",
+                 "SloTracker", "default_objectives", "install",
+                 "phase_breakdown", "span", "tracer"):
+        assert hasattr(obs, name), name
+    for m in ("dump_trace", "slow_queries", "attach_slo", "slo_report"):
+        assert hasattr(DataStore, m), m
+    assert hasattr(DataStore, "slo")
+    for m in ("begin", "end", "trace", "span", "activate", "add_span",
+              "dump", "slow_queries", "traces", "reset", "armed"):
+        assert hasattr(obs.Tracer, m), m
+    for m in ("observe", "histogram_quantile"):
+        assert hasattr(MetricsRegistry, m), m
+    for f in ("name", "metric", "quantile", "threshold_s", "budget"):
+        assert f in obs.SloObjective.__dataclass_fields__, f
+    assert "objectives" in inspect.signature(
+        DataStore.attach_slo
+    ).parameters
+    # the documented bucket ladder: sqrt-2 growth from 1 µs, 64 buckets
+    assert len(HIST_EDGES) == 64 and HIST_EDGES[0] == 1e-6
+    assert HIST_EDGES[2] / HIST_EDGES[0] == pytest.approx(2.0)
+    assert Histogram().quantile(0.99) == 0.0
+    # every geomesa.obs.* knob/metric resolves at runtime and is cited
+    knobs, metrics = _area_names("geomesa.obs.")
+    assert len(knobs) >= 9 and len(metrics) >= 2, (knobs, metrics)
+    _assert_runtime_declared(knobs)
+    _assert_documented("observability.md", knobs + metrics)
+    _assert_documented("config.md", knobs)
+    # the histogram metrics the doc tables promise render as histograms
+    reg = MetricsRegistry()
+    for n in ("geomesa.query.scan", "geomesa.serving.queue_wait",
+              "geomesa.stream.fold.slice", "geomesa.stream.wal.fsync"):
+        reg.observe(n, 0.01)
+    text = reg.render_prometheus()
+    for base in ("geomesa_query_scan", "geomesa_serving_queue_wait",
+                 "geomesa_stream_fold_slice", "geomesa_stream_wal_fsync"):
+        assert f"# TYPE {base}_seconds histogram" in text
+        assert f'{base}_seconds_bucket{{le="+Inf"}} 1' in text
+    # every `ds.X` the guide mentions in backticks resolves
+    path = os.path.join(_ROOT, "docs", "observability.md")
+    doc = open(path).read()
+    for name in re.findall(r"`ds\.(\w+)", doc):
+        assert hasattr(DataStore, name), f"ds.{name}"
 
 
 def test_config_doc_lists_every_knob():

@@ -115,7 +115,7 @@ class TestAuditMetrics:
         ds.query("g", Q_OK)
         snap = reg.snapshot()
         assert snap["counters"]["geomesa.query.count"] == 1
-        assert snap["timers"]["geomesa.query.scan"]["count"] == 1
+        assert snap["histograms"]["geomesa.query.scan"]["count"] == 1
         text = reg.render_prometheus()
         assert "geomesa_query_count 1" in text
 

@@ -191,15 +191,22 @@ def test_fault_points_record_blocking_events():
 def _workload(tmp_path, metrics=None):
     """One pass over every concurrent tier; returns nothing — the point
     is which locks it crosses (construction happens INSIDE, so an armed
-    witness wraps everything)."""
+    witness wraps everything). The observability tier runs armed too:
+    a fresh Tracer (sampling every root) and an attached SLO tracker,
+    so Tracer._lock and SloTracker._lock are witnessed under the same
+    concurrent serving load as the store locks."""
+    from geomesa_tpu import conf, obs
     from geomesa_tpu.ingest import BulkLoader, PipelineConfig
     from geomesa_tpu.metrics import MetricsRegistry
 
+    conf.OBS_TRACE_SAMPLE.set(1)
+    obs.install(obs.Tracer())  # constructed armed: its lock is wrapped
     ds = DataStore(cache=CacheConfig(max_bytes=1 << 22, tile_bits=4))
     # a store-level registry (constructed under the armed witness):
     # without one, record_query skips the tile tier's cost gate and
     # TileAggregateCache._lock would never be crossed
     ds.metrics = metrics if metrics is not None else MetricsRegistry()
+    ds.attach_slo()  # SLO windows fed through the registry observer hook
     sft = FeatureType.from_spec("t", SPEC)
     ds.create_schema(sft)
     ds.write("t", _fc(sft, 200, seed=0))
@@ -239,6 +246,8 @@ def _workload(tmp_path, metrics=None):
     finally:
         lam.close()
         sched.close()
+        conf.OBS_TRACE_SAMPLE.clear()
+        obs.install(obs.Tracer())  # drop the witness-wrapped tracer
 
 
 def test_every_registry_lock_witnessed_graph_acyclic_and_subgraph(tmp_path):

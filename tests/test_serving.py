@@ -352,15 +352,15 @@ def test_close_fails_pending_and_refuses_new():
 
 
 def test_queue_wait_attribution(ds):
-    """Queue wait is attributed separately from scan time: the timer
-    lands in metrics and the explain trace carries both."""
+    """Queue wait is attributed separately from scan time: the live
+    histogram lands in metrics and the explain trace carries both."""
     reg = ds.metrics
     sched = ds.serve()
     exp = Explainer()
     sched.submit("ev", Q, explain=exp).result(10)
     sched.close()
     snap = reg.snapshot()
-    assert snap["timers"]["geomesa.serving.queue_wait"]["count"] >= 1
+    assert snap["histograms"]["geomesa.serving.queue_wait"]["count"] >= 1
     line = next(l for l in exp.lines if l.strip().startswith("serving:"))
     assert "queue wait" in line and "scan" in line and "fused batch" in line
     # the device-scan trace reaches the caller's explainer even through

@@ -305,6 +305,20 @@ def test_metric_convention_and_type_conflict_are_caught(fixture_result):
     assert "counter" in dup[0].message and "gauge" in dup[0].message
 
 
+def test_unregistered_histogram_is_caught(fixture_result):
+    """ISSUE 13 must-fail: the observe()/histogram_quantile() instrument
+    methods are registry extraction sites, so a histogram outside the
+    naming registry fails metric-convention (both the write AND read
+    sites), and a histogram/counter name collision fails
+    metric-type-conflict."""
+    conv = _at(fixture_result, "hist_bad.py", "metric-convention")
+    assert len(conv) == 2, _render(conv)  # observe + histogram_quantile
+    assert all("geomesa.Fixture-Hist.latency" in f.message for f in conv)
+    dup = _at(fixture_result, "hist_bad.py", "metric-type-conflict")
+    assert len(dup) == 1 and "geomesa.fixture.wait" in dup[0].message
+    assert "histogram" in dup[0].message and "counter" in dup[0].message
+
+
 def test_kernel_purity_hazards_are_caught(fixture_result):
     coerce = _at(fixture_result, "kernel_bad.py", "kernel-traced-coercion")
     # float(x) only: neither int(n_pad) (tuple static form) nor the
