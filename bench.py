@@ -2396,11 +2396,16 @@ def config_wal(out_path: "str | None" = None):
     lam.wal.sync()  # sync=off: drains the app buffer (no fsync)
     lam.wal.crash()
     lam.flusher.close()
-    t0 = time.perf_counter()
-    rec = LambdaStore.recover(root)
-    recover_s = time.perf_counter() - t0
-    replayed = len(rec.cold.features("mv")) + len(rec.hot) - n_cold
-    rec.close()
+    # best-of-N like the stream modes: recovery is idempotent off the
+    # same on-disk root, and a neighbor's burst during the one measured
+    # window would otherwise read as replay cost
+    recover_s = float("inf")
+    for _ in range(max(repeat, 1)):
+        t0 = time.perf_counter()
+        rec = LambdaStore.recover(root)
+        recover_s = min(recover_s, time.perf_counter() - t0)
+        replayed = len(rec.cold.features("mv")) + len(rec.hot) - n_cold
+        rec.close()
     shutil.rmtree(tmp, ignore_errors=True)
 
     interval_over_nowal = results["interval"] / results["nowal"]
@@ -2450,6 +2455,276 @@ def config_wal(out_path: "str | None" = None):
         "interval_over_nowal": row["interval_over_nowal"],
         "identical": identical,
         "replay_rows_per_s": replay_row["replay_rows_per_s"],
+    }
+    print(json.dumps(rec_line), flush=True)
+    return rec_line
+
+
+# ------------------------------------------------- config standing
+
+
+def config_standing(out_path: "str | None" = None):
+    """Standing-query matching scenario (ISSUE 14, docs/standing.md):
+    >= 1M persistent geofence subscriptions indexed by the inverted
+    SubscriptionIndex, probed by a sustained ingest stream.
+
+    Within ONE run it measures: (1) sustained ingest rows/s with the
+    matcher OFF vs ON (the matcher rides the write ack path — the gate
+    holds the ON rate at >= 0.9x OFF); (2) pure per-event matching cost
+    through the inverted index vs a NAIVE all-subscription evaluation
+    (vectorized bbox prefilter over every registered subscription +
+    exact ragged PIP on the bbox hits — not a strawman) on a sampled
+    event set, the >= 50x algorithmic-win floor; (3) match-set
+    exactness vs a per-event shapely oracle over bbox-candidate pairs
+    (complete: truth and matches are both subsets of the bbox
+    candidates) — the ``identical`` flag; (4) the alert-latency p99
+    off the live ``geomesa.standing.latency`` histogram.
+
+    The subscription population is deliberately mixed: ~1M tiny squares
+    (the routing-scale test — most register 1-4 PARTIAL cells), a
+    dense-polygon hotspot (jagged stars across the FUSED_E_BUCKETS
+    ladder, where 20% of events cluster, so the fused kernel path
+    engages), and large convex fences whose interiors classify FULL
+    (zero-geometry-work matches).
+
+    Emits BENCH_GEOFENCE.json (or ``out_path`` /
+    GEOMESA_BENCH_GEOFENCE_OUT — use a scratch path for the fresh side
+    of a gate run). Env knobs: GEOMESA_BENCH_GEOFENCE_SUBS,
+    GEOMESA_BENCH_GEOFENCE_N, GEOMESA_BENCH_GEOFENCE_BATCH,
+    GEOMESA_BENCH_GEOFENCE_ORACLE (sampled oracle events),
+    GEOMESA_BENCH_GEOFENCE_NAIVE (sampled naive events)."""
+    from shapely.geometry import Point as SPoint
+    from shapely.geometry import Polygon as SPolygon
+
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.scan import block_kernels as bk
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.streaming import LambdaStore, StreamConfig
+    from geomesa_tpu.streaming.standing import _ragged_pip
+
+    import shutil
+    import tempfile
+
+    n_subs = int(os.environ.get("GEOMESA_BENCH_GEOFENCE_SUBS", 1_000_000))
+    n_events = int(os.environ.get("GEOMESA_BENCH_GEOFENCE_N", 200_000))
+    batch = int(os.environ.get("GEOMESA_BENCH_GEOFENCE_BATCH", 20_000))
+    n_oracle = int(os.environ.get("GEOMESA_BENCH_GEOFENCE_ORACLE", 1_500))
+    n_naive = int(os.environ.get("GEOMESA_BENCH_GEOFENCE_NAIVE", 16))
+    t0_ms = 1_717_200_000_000
+    spec = "name:String,dtg:Date,*geom:Point:srid=4326"
+    rng = np.random.default_rng(SEED + 140)
+
+    # -- the subscription population -------------------------------------
+    log(f"[standing] building {n_subs:,} tiny geofences ...")
+    cx = rng.uniform(-170, 170, n_subs)
+    cy = rng.uniform(-80, 80, n_subs)
+    w = rng.uniform(0.005, 0.03, n_subs)
+    tiny = [
+        geo.Polygon([
+            (cx[i] - w[i], cy[i] - w[i]), (cx[i] + w[i], cy[i] - w[i]),
+            (cx[i] + w[i], cy[i] + w[i]), (cx[i] - w[i], cy[i] + w[i]),
+            (cx[i] - w[i], cy[i] - w[i]),
+        ])
+        for i in range(n_subs)
+    ]
+
+    def star(scx, scy, r, n_arms, seed):
+        srng = np.random.default_rng(seed)
+        a = np.linspace(0, 2 * np.pi, 2 * n_arms + 1)[:-1]
+        rad = np.where(np.arange(2 * n_arms) % 2 == 0, r,
+                       r * srng.uniform(0.3, 0.7, 2 * n_arms))
+        return geo.Polygon([
+            (scx + rr * np.cos(t), scy + rr * np.sin(t))
+            for t, rr in zip(a, rad)
+        ])
+
+    def ring(scx, scy, r, n=24):
+        a = np.linspace(0, 2 * np.pi, n + 1)
+        return geo.Polygon([
+            (scx + r * np.cos(t), scy + r * np.sin(t)) for t in a
+        ])
+
+    # the hotspot: dense stars across the E ladder + FULL-cell fences
+    HOT = (0.0, 10.0, 12.0, 22.0)  # x0, y0, x1, y1
+    dense = []
+    for k in range(96):
+        arms = int(rng.integers(8, 121))  # E buckets 32..256
+        dense.append((f"dense{k}", star(
+            float(rng.uniform(HOT[0] + 2, HOT[2] - 2)),
+            float(rng.uniform(HOT[1] + 2, HOT[3] - 2)),
+            float(rng.uniform(0.8, 2.5)), arms, seed=SEED + k,
+        )))
+    for k in range(16):
+        dense.append((f"fence{k}", ring(
+            float(rng.uniform(-160, 160)), float(rng.uniform(-70, 70)),
+            float(rng.uniform(2.0, 4.0)),
+        )))
+    all_ids = [f"s{i}" for i in range(n_subs)] + [i for i, _ in dense]
+    all_geoms = tiny + [g for _, g in dense]
+
+    # -- the event stream (identical across every mode) -------------------
+    n_hot = n_events // 5
+    ex = np.concatenate([
+        rng.uniform(-170, 170, n_events - n_hot),
+        rng.uniform(HOT[0], HOT[2], n_hot),
+    ])
+    ey = np.concatenate([
+        rng.uniform(-80, 80, n_events - n_hot),
+        rng.uniform(HOT[1], HOT[3], n_hot),
+    ])
+    order = rng.permutation(n_events)
+    ex, ey = ex[order], ey[order]
+    batches = []
+    for s in range(0, n_events, batch):
+        k = min(batch, n_events - s)
+        batches.append((
+            [f"e{s + j}" for j in range(k)],
+            [{"name": "e", "dtg": t0_ms + s + j,
+              "geom": geo.Point(float(ex[s + j]), float(ey[s + j]))}
+             for j in range(k)],
+        ))
+
+    def ingest_run(engine_on: bool):
+        """One full streamed run over the prebuilt batches — DURABLE
+        (WAL-backed, default sync policy): the production configuration
+        this tier rides on, for both modes, so the ingest ratio isolates
+        the matcher's cost; returns (rows/s, engine|None)."""
+        ds = DataStore()
+        ds.metrics = MetricsRegistry()
+        ds.create_schema(FeatureType.from_spec("ev", spec))
+        root = tempfile.mkdtemp(prefix="bench_standing_")
+        tmp_roots.append(root)
+        lam = LambdaStore(
+            ds, "ev", config=StreamConfig(),
+            wal_dir=os.path.join(root, "_wal"),
+        )
+        eng = None
+        if engine_on:
+            eng = lam.standing()
+            eng.index.register_geofences(all_ids, all_geoms)
+            for e in bk.FUSED_E_BUCKETS:
+                eng.matcher.warmup(e, n_rows=batch, gate=eng.gate)
+        # warmup (compiles the fold/scan paths outside the window)
+        wids, wrows = batches[0]
+        lam.write(wrows, ids=[f"w{j}" for j in range(len(wids))])
+        lam.flush()
+        t0 = time.perf_counter()
+        for ids, rows in batches:
+            lam.write(rows, ids=ids)
+            lam.flush()
+        dt = time.perf_counter() - t0
+        rate = n_events / dt
+        label = "matcher-on" if engine_on else "matcher-off"
+        log(f"[standing] ingest {label}: {rate:,.0f} rows/s")
+        if not engine_on:
+            lam.close()
+        return rate, eng, lam
+
+    tmp_roots: list = []
+    off_rate, _, _ = ingest_run(False)
+    on_rate, eng, lam = ingest_run(True)
+    reg = lam.cold.metrics
+    alerts = reg.counter_value("geomesa.standing.alerts")
+    fused = reg.counter_value("geomesa.standing.fused")
+    p99_ms = reg.histogram_quantile("geomesa.standing.latency", 0.99) * 1e3
+
+    # -- pure matcher cost per event (inverted) ---------------------------
+    t0 = time.perf_counter()
+    for s in range(0, n_events, batch):
+        k = min(batch, n_events - s)
+        eng.match_points(ex[s : s + k], ey[s : s + k])
+    inverted_us = (time.perf_counter() - t0) / n_events * 1e6
+
+    # -- naive all-subscription evaluation on a sample --------------------
+    kind, eoff, segs, bbox, _rect = eng.index._ensure_arrays()
+    sample = rng.choice(n_events, size=n_naive, replace=False)
+    t0 = time.perf_counter()
+    naive_pairs = 0
+    for e in sample.tolist():
+        px, py = float(ex[e]), float(ey[e])
+        cand = np.flatnonzero(
+            (bbox[:, 0] <= px) & (bbox[:, 2] >= px)
+            & (bbox[:, 1] <= py) & (bbox[:, 3] >= py)
+        )
+        if len(cand):
+            inside = _ragged_pip(
+                np.full(len(cand), px), np.full(len(cand), py),
+                cand.astype(np.int64), eoff, segs,
+            )
+            naive_pairs += int(inside.sum())
+    naive_us = (time.perf_counter() - t0) / n_naive * 1e6
+    speedup = naive_us / max(inverted_us, 1e-9)
+    log(
+        f"[standing] naive {naive_us:,.0f} us/event vs inverted "
+        f"{inverted_us:,.1f} us/event = {speedup:,.0f}x "
+        f"(alerts {alerts:,}, fused {fused}, p99 {p99_ms:.2f} ms)"
+    )
+
+    # -- per-event shapely oracle (complete over bbox candidates) ---------
+    osample = rng.choice(n_events, size=n_oracle, replace=False)
+    opt, oords = eng.match_points(ex[osample], ey[osample])
+    got = set(zip(opt.tolist(), oords.tolist()))
+    shp_cache: dict = {}
+    identical = True
+    for row, e in enumerate(osample.tolist()):
+        px, py = float(ex[e]), float(ey[e])
+        cand = np.flatnonzero(
+            (bbox[:, 0] <= px) & (bbox[:, 2] >= px)
+            & (bbox[:, 1] <= py) & (bbox[:, 3] >= py)
+        )
+        pt = SPoint(px, py)
+        for o in cand.tolist():
+            sp = shp_cache.get(o)
+            if sp is None:
+                g = all_geoms[o]
+                sp = shp_cache[o] = SPolygon(
+                    g.shell, [h for h in g.holes]
+                )
+            if sp.covers(pt) != ((row, o) in got):
+                if sp.boundary.distance(pt) <= 1e-9:
+                    continue  # exact-boundary tie: either answer exact
+                identical = False
+                log(f"[standing] ORACLE MISMATCH event {e} sub {o}")
+    lam.close()
+    for r in tmp_roots:
+        shutil.rmtree(r, ignore_errors=True)
+
+    row = {
+        "scenario": "standing_geofence",
+        "subscriptions": len(all_ids), "events": n_events, "batch": batch,
+        "matcher_off_rows_per_s": round(off_rate, 1),
+        "matcher_on_rows_per_s": round(on_rate, 1),
+        "ingest_ratio": round(on_rate / off_rate, 4),
+        "naive_us_per_event": round(naive_us, 1),
+        "inverted_us_per_event": round(inverted_us, 2),
+        "speedup_vs_naive": round(speedup, 1),
+        "alerts": int(alerts), "fused_dispatches": int(fused),
+        "alert_p99_ms": round(p99_ms, 3),
+        "oracle_events": int(n_oracle),
+        "identical": bool(identical),
+    }
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": [row]}
+    if out_path is None:
+        out_path = os.environ.get(
+            "GEOMESA_BENCH_GEOFENCE_OUT"
+        ) or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_GEOFENCE.json",
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec_line = {
+        "metric": "speedup_vs_naive", "value": row["speedup_vs_naive"],
+        "unit": "x", "ingest_ratio": row["ingest_ratio"],
+        "alert_p99_ms": row["alert_p99_ms"], "identical": identical,
     }
     print(json.dumps(rec_line), flush=True)
     return rec_line
@@ -2633,7 +2908,7 @@ def child_main():
         "serving": config_serving, "ingest": config_ingest,
         "fused": config_fused, "pip_join": config_pip_join,
         "stream": config_stream, "wal": config_wal, "knn": config_knn,
-        "obs": config_obs,
+        "obs": config_obs, "standing": config_standing,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -115,7 +115,7 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
     _d("StreamingFeatureCache._lock", "geomesa_tpu/streaming/cache.py", 30,
        hot=True,
        fields=("index", "_rows", "_ingest_ms", "_next_id", "_ids_version",
-               "_live_cache"),
+               "_live_cache", "_replaying"),
        doc="THE hot-tier lock: every streaming write, snapshot and "
            "query serializes here; WAL/unstage hooks run under it"),
     _d("StreamFlusher._stage_lock", "geomesa_tpu/streaming/flush.py", 34,
@@ -125,6 +125,14 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
     _d("StreamFlusher._pool_lock", "geomesa_tpu/streaming/flush.py", 36,
        fields=("_pool",),
        doc="flush worker-pool lifecycle"),
+    _d("LambdaStore._sub_lock", "geomesa_tpu/streaming/store.py", 38,
+       fields=("_sub_records",),
+       doc="standing-subscription registry vs checkpoint re-log "
+           "(docs/standing.md): subscribe/unsubscribe and the "
+           "checkpoint's live-set re-log serialize so an acknowledged "
+           "unsubscribe's rm record can never be outrun by a re-logged "
+           "registration on replay; held AROUND the WAL appends and "
+           "SubscriptionIndex mutations those paths make (rank above)"),
     _d("WriteAheadLog._sync_lock", "geomesa_tpu/streaming/wal.py", 40,
        fields=("_synced_seq", "_last_sync_t"),
        doc="commit (write+fsync) order; fsync happens HERE, never under "
@@ -135,6 +143,34 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
                "_active_start", "_active_bytes", "_last_seq"),
        doc="append buffer/seqno/fd state: every acknowledged write "
            "crosses it, so nothing may block while holding it"),
+    _d("SubscriptionIndex._lock", "geomesa_tpu/streaming/standing.py", 44,
+       hot=True,
+       fields=("_ids", "_by_id", "_alive", "_alive_arr", "_kind_l",
+               "_attrs", "_edges_l", "_bbox_l", "_rect_l", "_prox",
+               "_tube", "_rast", "_csr", "_overlay", "_overlay_n",
+               "_bulk", "_arrays", "_kernel_blocks"),
+       doc="the inverted subscription index: registrations, the CSR "
+           "routing tables and the kernel-block memo; route() snapshots "
+           "under it then expands candidates outside (pure numpy only "
+           "while held — it sits on every batch's match path)"),
+    _d("_MatchGate._lock", "geomesa_tpu/streaming/standing.py", 45,
+       hot=True,
+       fields=("host_s", "fused_s"),
+       doc="fused/host cost-gate EWMAs: read by every batch's candidate "
+           "pick and updated after every matcher path runs — pure "
+           "arithmetic under it, no other lock ever held"),
+    _d("WindowedAggregator._lock", "geomesa_tpu/streaming/standing.py", 46,
+       hot=True,
+       fields=("_panes",),
+       doc="continuous-window pane partials: folded per batch on the "
+           "match path, and under the hot-tier lock when the aggregator "
+           "is wired as a FeatureStream sink (listeners fire under it)"),
+    _d("AlertQueue._lock", "geomesa_tpu/streaming/standing.py", 48,
+       hot=True,
+       fields=("_q", "_n", "_dropped"),
+       doc="bounded alert queue: producers enqueue on the match path, "
+           "consumers drain concurrently; overflow drops under the "
+           "lock, counters record after it releases"),
     _d("ResultCache._lock", "geomesa_tpu/cache/result.py", 50,
        hot=True,
        fields=("_entries", "_inflight", "_bytes"),
@@ -244,6 +280,22 @@ DECLARED_EDGES: list[tuple[str, str, str]] = [
     ("StreamingFeatureCache._lock", "SloTracker._lock",
      "the hook path's WAL fsync histogram observation reaches the SLO "
      "windows through the registry observer hook under the hot lock"),
+    ("StreamingFeatureCache._lock", "WindowedAggregator._lock",
+     "a WindowedAggregator wired as a FeatureStream sink folds rows "
+     "inside the hot tier's listener callback, which fires under the "
+     "hot lock (docs/standing.md 'Windows over a FeatureStream')"),
+    ("LambdaStore._sub_lock", "SubscriptionIndex._lock",
+     "subscribe/unsubscribe mutate the inverted index (register/"
+     "unregister) while holding the subscription-registry lock — the "
+     "lazily-attached engine is behind self.standing(), one hop past "
+     "the AST's one-level attr inference"),
+    ("LambdaStore._sub_lock", "ChaosSpec._lock",
+     "the WAL append/sync fault points consult an armed chaos schedule "
+     "inside log_subscribe/log_unsubscribe under the registry lock"),
+    ("LambdaStore._sub_lock", "SloTracker._lock",
+     "the subscribe-path WAL fsync histogram observation reaches the "
+     "SLO windows through the registry observer hook under the "
+     "registry lock"),
 ]
 
 #: hot-lock blocking the design ACCEPTS, with its justification — the

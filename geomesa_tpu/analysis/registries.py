@@ -99,6 +99,9 @@ FAULT_POINTS: dict[str, str] = {
     "stream.wal.rotate": "before sealing/rotating the active segment",
     "stream.wal.truncate": "before cutting a torn WAL tail",
     "stream.wal.replay": "before replaying a WAL segment on recovery",
+    # standing-query matching (streaming/standing.py; docs/standing.md)
+    "standing.match": "before a batch's route+match pipeline runs",
+    "standing.deliver": "before a batch's alerts enqueue/windows fold",
 }
 
 # metric instrument methods on MetricsRegistry, by instrument kind

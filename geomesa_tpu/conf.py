@@ -297,6 +297,15 @@ STREAM_WAL_SEGMENT_BYTES = SystemProperty(
     "bytes; sealed segments retire only once a checkpoint watermark "
     "covers them (LambdaStore.checkpoint — the durable cold publish)",
 )
+STREAM_WAL_REPLAY_BATCH = SystemProperty(
+    "geomesa.stream.wal.replay.batch.rows", 262_144, int,
+    "recovery-side replay batching: contiguous WAL upsert records "
+    "coalesce into one bulk hot-tier apply of up to this many rows "
+    "(single lock hold, vectorized grid-index insert) instead of one "
+    "apply per record — recovery is single-threaded, so the live tier's "
+    "reader-interleaving lock chunking buys nothing there; 0 replays "
+    "record-at-a-time (the round-10 behavior)",
+)
 STREAM_INCREMENTAL = SystemProperty(
     "geomesa.stream.incremental", True, _parse_bool,
     "fold flushes into the cold tables incrementally "
@@ -359,6 +368,71 @@ OBS_SLO_WAL_P99_MS = SystemProperty(
     "geomesa.obs.slo.wal.p99.ms", 50.0, float,
     "default durability objective: geomesa.stream.wal.fsync p99 must "
     "stay at or under this (0 drops it)",
+)
+OBS_SLO_STANDING_P99_MS = SystemProperty(
+    "geomesa.obs.slo.standing.p99.ms", 250.0, float,
+    "default standing-query alert objective: geomesa.standing.latency "
+    "p99 (batch arrival -> alerts delivered, docs/standing.md) must "
+    "stay at or under this (0 drops it)",
+)
+
+
+# -- standing queries: the inverted subscription index
+# (geomesa_tpu.streaming.standing; docs/standing.md) ----------------------
+
+STANDING_GRID_LEVEL = SystemProperty(
+    "geomesa.standing.grid.level", 12, int,
+    "Z2 routing-grid level of the SubscriptionIndex (2^level cells per "
+    "axis): arriving points route to the subscriptions covering their "
+    "cell; finer levels shrink candidate sets but grow each "
+    "subscription's registered cell count",
+)
+STANDING_CLASSIFY_CELLS = SystemProperty(
+    "geomesa.standing.classify.cells", 16384, int,
+    "per-subscription cell budget for FULL/PARTIAL registration-time "
+    "classification (the PR 6 raster machinery): geofences whose bbox "
+    "window exceeds it register every bbox cell PARTIAL — a superset, "
+    "never wrong, just no zero-geometry full-cell matches",
+)
+STANDING_FUSED_MIN_POINTS = SystemProperty(
+    "geomesa.standing.fused.min.points", 64, int,
+    "routed candidate rows a boundary geofence needs in one batch "
+    "before it joins a fused block_scan_multi dispatch; sparser "
+    "candidates take the vectorized host ray cast (<= 0 keeps "
+    "everything on the host path)",
+)
+STANDING_RASTER_CELLS = SystemProperty(
+    "geomesa.standing.raster.cells", 1_048_576, int,
+    "per-subscription cell budget for the MATCH-TIME raster grid built "
+    "for dense (>= 16-edge, non-rectangle) geofences at registration: "
+    "each candidate point classifies by one cell lookup — FULL cells "
+    "match, OUT cells miss, only the boundary residue pays the exact "
+    "ray cast (the PR 6 raster-interval economics, inverted); much "
+    "finer than the routing grid, so jagged polygons' residue shrinks "
+    "~10x; 0 disables (every boundary pair pays edges)",
+)
+STANDING_FUSED_GATE = SystemProperty(
+    "geomesa.standing.fused.gate", True, _parse_bool,
+    "measured-cost gate on the standing matcher's fused kernel path "
+    "(the tile cache's adaptive-gate pattern): per-unit EWMAs of the "
+    "host ray cast and the fused dispatch — seeded by one bounded "
+    "probe chunk — keep each eligible geofence on whichever path "
+    "measures cheaper on THIS host (counted by "
+    "geomesa.standing.gate.host); false always fuses past "
+    "geomesa.standing.fused.min.points (differential tests, kernel "
+    "debugging)",
+)
+STANDING_QUEUE_MAX = SystemProperty(
+    "geomesa.standing.queue.max", 65_536, int,
+    "bounded alert-queue capacity: past it the OLDEST alerts drop "
+    "(counted by geomesa.standing.dropped) — delivery never blocks the "
+    "write ack path",
+)
+STANDING_WINDOW_PANES = SystemProperty(
+    "geomesa.standing.window.panes", 512, int,
+    "retained panes per continuous-window aggregate: panes older than "
+    "the newest this-many drop (counted by "
+    "geomesa.standing.window.dropped), bounding window state",
 )
 
 

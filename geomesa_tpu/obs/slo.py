@@ -54,7 +54,8 @@ class SloObjective:
 def default_objectives() -> list[SloObjective]:
     """The knob-configured default objectives (a 0 ms knob drops its
     objective): query latency p99, fold-slice pause p99, WAL fsync p99
-    — the three tail surfaces the streaming campaign pinned."""
+    — the three tail surfaces the streaming campaign pinned — plus the
+    standing-query alert-latency p99 (docs/standing.md)."""
     out = []
     q = float(conf.OBS_SLO_QUERY_P99_MS.get())
     if q > 0:
@@ -68,6 +69,11 @@ def default_objectives() -> list[SloObjective]:
     if w > 0:
         out.append(SloObjective(
             "wal_fsync_p99", "geomesa.stream.wal.fsync", 0.99, w / 1e3
+        ))
+    s = float(conf.OBS_SLO_STANDING_P99_MS.get())
+    if s > 0:
+        out.append(SloObjective(
+            "standing_alert_p99", "geomesa.standing.latency", 0.99, s / 1e3
         ))
     return out
 

@@ -8,11 +8,18 @@ enumeration, attribute joins, track transforms (point2point,
 track_label, date_offset), BIN/Arrow conversion, and thin
 query/sampling/minmax wrappers; density/stats wrap the DataStore
 push-downs directly. All window-building processes wrap the
-antimeridian."""
+antimeridian.
+
+Proximity and tube select also come in STANDING form
+(:func:`standing_proximity` / :func:`standing_tube`, round 14): instead
+of one query over stored features, they register persistent
+subscriptions on a LambdaStore's inverted SubscriptionIndex — every
+arriving batch is matched and alerts deliver continuously
+(docs/standing.md)."""
 
 from geomesa_tpu.process.join import join_search
 from geomesa_tpu.process.knn import knn_many, knn_search
-from geomesa_tpu.process.proximity import proximity_search
+from geomesa_tpu.process.proximity import proximity_search, standing_proximity
 from geomesa_tpu.process.route import heading_diff, route_search
 from geomesa_tpu.process.transforms import (
     arrow_conversion,
@@ -24,7 +31,7 @@ from geomesa_tpu.process.transforms import (
     sampling_process,
     track_label,
 )
-from geomesa_tpu.process.tube import tube_select
+from geomesa_tpu.process.tube import standing_tube, tube_select
 from geomesa_tpu.process.unique import unique_values
 
 __all__ = [
@@ -41,6 +48,8 @@ __all__ = [
     "query_process",
     "route_search",
     "sampling_process",
+    "standing_proximity",
+    "standing_tube",
     "track_label",
     "tube_select",
     "unique_values",

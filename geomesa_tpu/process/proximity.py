@@ -52,3 +52,29 @@ def proximity_search(
 
 def _empty(store, type_name: str) -> FeatureCollection:
     return store.features(type_name).take(np.zeros(0, dtype=np.int64))
+
+
+def standing_proximity(
+    lam,
+    sub_id: str,
+    points: "np.ndarray | list",
+    distance_m: float,
+    attrs: "dict | None" = None,
+):
+    """:func:`proximity_search`, STANDING (docs/standing.md): instead of
+    one query over stored features, register a persistent subscription
+    on a :class:`~geomesa_tpu.streaming.LambdaStore` — every arriving
+    batch routes through the inverted SubscriptionIndex and events
+    within ``distance_m`` of any input point deliver alerts. Same
+    refinement semantics as the one-shot process (haversine min-distance
+    to any input). Returns the registered
+    :class:`~geomesa_tpu.streaming.Subscription`."""
+    from geomesa_tpu.streaming.standing import Subscription
+
+    sub = Subscription(
+        str(sub_id), "proximity",
+        points=np.asarray(points, np.float64).reshape(-1, 2),
+        distance_m=float(distance_m), attrs=dict(attrs or {}),
+    )
+    lam.subscribe(sub)
+    return sub

@@ -94,3 +94,35 @@ def tube_select(
     py = np.interp(ht, ts, xy[:, 1])
     d = haversine_m(hx, hy, px, py)
     return out.mask(d <= buffer_m)
+
+
+def standing_tube(
+    lam,
+    sub_id: str,
+    track_xy: "np.ndarray | list",
+    track_times_ms: "np.ndarray | list",
+    buffer_m: float,
+    attrs: "dict | None" = None,
+):
+    """:func:`tube_select`, STANDING (docs/standing.md): register the
+    corridor as a persistent subscription on a
+    :class:`~geomesa_tpu.streaming.LambdaStore` — every arriving batch
+    routes through the inverted SubscriptionIndex and events within
+    ``buffer_m`` of the interpolated track position AT THE EVENT'S OWN
+    TIME deliver alerts (events without a usable time never match, the
+    TubeSelectProcess refinement). Returns the registered
+    :class:`~geomesa_tpu.streaming.Subscription`."""
+    from geomesa_tpu.streaming.standing import Subscription
+
+    xy = np.asarray(track_xy, np.float64).reshape(-1, 2)
+    ts = np.asarray(track_times_ms, np.int64)
+    if len(xy) != len(ts) or len(xy) < 2:
+        raise ValueError("track needs >= 2 (point, time) pairs")
+    if not (np.diff(ts) >= 0).all():
+        raise ValueError("track times must be ascending")
+    sub = Subscription(
+        str(sub_id), "tube", track_xy=xy, track_times_ms=ts,
+        buffer_m=float(buffer_m), attrs=dict(attrs or {}),
+    )
+    lam.subscribe(sub)
+    return sub

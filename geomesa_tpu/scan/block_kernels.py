@@ -348,9 +348,17 @@ def pack_edges(geom) -> "np.ndarray | None":
         segs.append(np.stack([c[:-1, 0], c[:-1, 1], c[1:, 0], c[1:, 1]], axis=1))
     if not segs:
         return None
-    e = np.concatenate(segs)  # [n, 4] = (x0, y0, x1, y1)
+    return pack_edge_segments(np.concatenate(segs))
+
+
+def pack_edge_segments(e: np.ndarray) -> "np.ndarray | None":
+    """:func:`pack_edges` from raw segments: ``e`` is [n, 4] =
+    (x0, y0, x1, y1) over all rings already concatenated. The standing
+    subscription matcher (streaming/standing.py) keeps per-subscription
+    edge lists in flat arrays instead of Geometry objects, so it packs
+    kernel blocks from segments directly — one packing, no drift."""
     n = len(e)
-    if n > E_BUCKETS[-1]:
+    if n == 0 or n > E_BUCKETS[-1]:
         return None
     E = next(b for b in E_BUCKETS if n <= b)
     out = np.zeros((E, LANES), np.float32)

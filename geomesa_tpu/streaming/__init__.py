@@ -19,11 +19,28 @@ ingest meeting live queries:
   checksummed write-ahead log under the hot tier (round 10;
   docs/durability.md "Streaming WAL");
 - :class:`FeatureStream` — derived-view topologies over a change
-  stream (the geomesa-kafka streams analogue).
+  stream (the geomesa-kafka streams analogue);
+- :class:`Subscription` / :class:`SubscriptionIndex` /
+  :class:`StandingQueryEngine` / :class:`WindowSpec` /
+  :class:`WindowedAggregator` / :class:`AlertQueue` — standing queries
+  at subscription scale: the inverted index that routes every arriving
+  batch to a tiny candidate set over millions of persistent
+  geofence/proximity/tube subscriptions, matched in fused kernel
+  dispatches with windowed continuous aggregation and bounded alert
+  delivery (round 14; docs/standing.md).
 """
 
 from geomesa_tpu.streaming.cache import StreamingFeatureCache
 from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
+from geomesa_tpu.streaming.standing import (
+    AlertQueue,
+    StandingConfig,
+    StandingQueryEngine,
+    Subscription,
+    SubscriptionIndex,
+    WindowSpec,
+    WindowedAggregator,
+)
 from geomesa_tpu.streaming.store import LambdaStore
 from geomesa_tpu.streaming.stream import FeatureStream
 from geomesa_tpu.streaming.wal import WalConfig, WriteAheadLog
@@ -31,4 +48,7 @@ from geomesa_tpu.streaming.wal import WalConfig, WriteAheadLog
 __all__ = [
     "StreamingFeatureCache", "StreamConfig", "StreamFlusher",
     "LambdaStore", "FeatureStream", "WalConfig", "WriteAheadLog",
+    "Subscription", "SubscriptionIndex", "StandingConfig",
+    "StandingQueryEngine", "WindowSpec", "WindowedAggregator",
+    "AlertQueue",
 ]

@@ -60,6 +60,12 @@ class StreamingFeatureCache:
         self.index = BucketIndex(*grid)           # guarded-by: _lock
         self._rows: dict[str, dict] = {}          # guarded-by: _lock
         self._ingest_ms: dict[str, int] = {}      # guarded-by: _lock
+        # WAL-replay mode (docs/durability.md "Replay batching"): while
+        # set, grid-index maintenance is DEFERRED — most replayed rows
+        # are published and evicted again by later flush-watermark
+        # records, so indexing them is pure waste; end_replay() rebuilds
+        # the index from the rows that actually survived
+        self._replaying = False                   # guarded-by: _lock
         self._next_id = 0                         # guarded-by: _lock
         # live-id set cache for query_shadow: rebuilding a frozenset of
         # every live id per query is O(hot) and dominated read latency
@@ -179,12 +185,102 @@ class StreamingFeatureCache:
                     self._ids_version += 1
                 self._rows[fid] = row
                 self._ingest_ms[fid] = now
-                self.index.insert(fid, self._bbox(row))
+                if not self._replaying:
+                    self.index.insert(fid, self._bbox(row))
                 self._notify(event, fid, row)
                 applied.append(row)
             if applied:
                 self._bump_gen(applied)
             return len(rows)
+
+    def replay_upsert(self, rows: Sequence[Mapping], ids: Sequence[str],
+                      xy=None) -> int:
+        """Recovery-side BULK apply (docs/durability.md "Replay
+        batching"): identical end state to :meth:`upsert` over the same
+        ``(rows, ids)`` — latest message per id wins — but in ONE lock
+        hold with a vectorized grid-index pass. Recovery is
+        single-threaded (there are no readers to interleave with), so
+        the live tier's reader-friendly ``_LOCK_CHUNK`` chunking buys
+        nothing here, and the per-record apply loop was the WAL replay
+        bottleneck (BENCH_WAL ``wal_replay``). ``xy``: the batch's
+        decoded [n, 2] point coordinates when the WAL record carried
+        the geometry column packed (``unpack_upsert_xy``) — skips
+        per-row Point attribute reads. Falls back to :meth:`upsert`
+        when listeners are attached (events must fire per message)."""
+        if self.listeners or not len(rows):
+            return self.upsert(rows, ids)
+        gf = self.sft.geom_field
+        now = int(_time.time() * 1000)
+        with self._lock:
+            parsed = []
+            for row in rows:
+                if "__id__" in row:
+                    row = {k: v for k, v in row.items() if k != "__id__"}
+                g = row.get(gf)
+                if isinstance(g, str):
+                    row = dict(row)
+                    row[gf] = geo.from_wkt(g)
+                parsed.append(row)
+            sids = [str(i) for i in ids]
+            self._rows.update(zip(sids, parsed))
+            self._ingest_ms.update((fid, now) for fid in sids)
+            self._ids_version += 1
+            if self._replaying:
+                pass  # end_replay() rebuilds from survivors
+            elif xy is not None and len(xy) == len(parsed):
+                self.index.bulk_insert_points(sids, xy[:, 0], xy[:, 1])
+            else:
+                for fid, row in zip(sids, parsed):
+                    self.index.insert(fid, self._bbox(row))
+            if self.generations is not None and self.gen_type is not None:
+                if xy is not None and len(xy):
+                    self.generations.bump(self.gen_type, bounds=(
+                        float(xy[:, 0].min()), float(xy[:, 1].min()),
+                        float(xy[:, 0].max()), float(xy[:, 1].max()),
+                    ), time_range=None)
+                else:
+                    self._bump_gen(parsed)
+        return len(rows)
+
+    def begin_replay(self) -> None:
+        """Enter WAL-replay mode: grid-index maintenance is suspended
+        until :meth:`end_replay` rebuilds it from the surviving rows.
+        Replay interleaves bulk upserts with flush-watermark evictions
+        that drain most of them right back out — at 1M replayed rows
+        the per-row index insert/remove churn was the single largest
+        recovery cost (BENCH_WAL ``wal_replay``), all of it for entries
+        that never serve a query (recovery is single-threaded; the
+        store is not visible until ``recover`` returns)."""
+        with self._lock:
+            self._replaying = True
+
+    def end_replay(self) -> None:
+        """Leave replay mode and rebuild the grid index from the rows
+        that survived — identical to the index a never-crashed store
+        holds (it is purely derived state: exactly one entry per
+        resident row, keyed by that row's bbox). Point rows go through
+        the vectorized bulk insert; anything else falls back to per-row
+        inserts. Safe to call after a partial replay (crash-prefix
+        semantics): the rebuilt index reflects whatever prefix applied."""
+        with self._lock:
+            if not self._replaying:
+                return
+            self._replaying = False
+            self.index = BucketIndex(self.index.nx, self.index.ny)
+            gf = self.sft.geom_field
+            pk: list = []
+            px: list = []
+            py: list = []
+            for fid, row in self._rows.items():
+                g = row.get(gf)
+                if type(g) is geo.Point:
+                    pk.append(fid)
+                    px.append(g.x)
+                    py.append(g.y)
+                else:
+                    self.index.insert(fid, self._bbox(row))
+            if pk:
+                self.index.bulk_insert_points(pk, px, py)
 
     def assign_ids(self, rows: Sequence[Mapping],
                    ids: Sequence[str] | None) -> tuple[list, int]:
@@ -214,10 +310,11 @@ class StreamingFeatureCache:
         replay's input (same shared-row contract as
         :meth:`snapshot_rows`)."""
         with self._lock:
+            get = self._rows.get
             return [
-                (fid, self._rows[fid])
-                for fid in (str(i) for i in ids)
-                if fid in self._rows
+                (fid, row)
+                for fid in map(str, ids)
+                if (row := get(fid)) is not None
             ]
 
     def delete(self, ids: Sequence[str],
@@ -241,7 +338,8 @@ class StreamingFeatureCache:
                 if row is not None:
                     self._ids_version += 1
                     self._ingest_ms.pop(fid, None)
-                    self.index.remove(fid)
+                    if not self._replaying:
+                        self.index.remove(fid)
                     self._notify("removed", fid, row)
                     removed.append(row)
                     removed_ids.append(fid)
@@ -297,7 +395,8 @@ class StreamingFeatureCache:
                 self._rows.pop(fid)
                 self._ids_version += 1
                 self._ingest_ms.pop(fid, None)
-                self.index.remove(fid)
+                if not self._replaying:
+                    self.index.remove(fid)
                 self._notify("removed", fid, row)
                 removed.append(row)
                 n += 1
@@ -332,7 +431,8 @@ class StreamingFeatureCache:
                 row = self._rows.pop(fid)
                 self._ids_version += 1
                 self._ingest_ms.pop(fid)
-                self.index.remove(fid)
+                if not self._replaying:
+                    self.index.remove(fid)
                 self._notify("expired", fid, row, guard=True)
                 expired.append(row)
             if expired:

@@ -13,7 +13,10 @@ historical name for the same operation.
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
+import time
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
@@ -24,6 +27,8 @@ from geomesa_tpu.filter.predicates import INCLUDE
 from geomesa_tpu.streaming.cache import StreamingFeatureCache
 from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
 from geomesa_tpu.streaming.wal import WalConfig, WriteAheadLog, unpack_upsert
+
+log = logging.getLogger(__name__)
 
 WAL_DIR = "_wal"  # default WAL location under a store root
 
@@ -110,6 +115,21 @@ class LambdaStore:
         # direct cold delete removed) only downgrades that id's fold to
         # an append inside fold_upsert.
         self._known_cold: set = set()
+        # standing-query engine (docs/standing.md): attached lazily by
+        # standing()/subscribe(); write() feeds it every acknowledged
+        # batch. _sub_records retains WAL-logged registration bodies so
+        # checkpoint() can re-log the live set above its cover (segment
+        # retirement must never drop an acknowledged registration).
+        # _sub_lock serializes subscribe/unsubscribe against that
+        # re-log: without it, checkpoint could snapshot a subscription,
+        # lose the race to an acknowledged unsubscribe's rm record, and
+        # re-log the registration ABOVE it — recovery would resurrect
+        # an acknowledged removal.
+        from geomesa_tpu.lockwitness import witness
+
+        self._standing = None
+        self._sub_lock = witness(threading.Lock(), "LambdaStore._sub_lock")
+        self._sub_records: dict[str, dict] = {}  # guarded-by: _sub_lock
         cache = getattr(cold, "cache", None)
         if cache is not None:
             self.hot.generations = cache.generations
@@ -126,9 +146,15 @@ class LambdaStore:
         like queries (docs/observability.md)."""
         from geomesa_tpu.obs.trace import tracer
 
+        eng = self._standing
+        t0 = time.perf_counter() if eng is not None else None
         with tracer().trace("write", type=self.type_name, rows=len(rows)):
-            if self.wal is not None:
+            if self.wal is not None or eng is not None:
+                # the standing matcher needs the batch's RESOLVED ids
+                # for its alerts, exactly as the WAL needs them for
+                # replay — one resolution, shared
                 ids, next_id = self.hot.assign_ids(rows, ids)
+            if self.wal is not None:
                 seq = self.wal.log_upsert(ids, rows, next_id)
                 try:
                     n = self.hot.upsert(rows, ids)
@@ -139,10 +165,14 @@ class LambdaStore:
                     # while its cover skipped the record at replay (the
                     # acknowledged-loss race the chaos harness caught)
                     self.wal.applied(seq)
-                self._gauge_hot()
-                return n
-            n = self.hot.upsert(rows, ids)
+            else:
+                n = self.hot.upsert(rows, ids)
             self._gauge_hot()
+            if eng is not None:
+                # AFTER the ack path: a matcher fault never
+                # un-acknowledges the applied batch (on_batch never
+                # raises — at-most-once alerts, docs/standing.md)
+                eng.on_batch(ids, rows, t0)
             return n
 
     def delete(self, ids: Sequence[str]) -> int:
@@ -194,6 +224,67 @@ class LambdaStore:
         metrics = getattr(self.cold, "metrics", None)
         if metrics is not None:
             metrics.gauge("geomesa.stream.hot_rows", len(self.hot))
+
+    # -- standing queries (docs/standing.md) ------------------------------
+    def standing(self, config=None):
+        """The store's :class:`~geomesa_tpu.streaming.standing.
+        StandingQueryEngine` (created on first use): once attached,
+        every acknowledged :meth:`write` batch routes through its
+        inverted SubscriptionIndex, matches, and delivers alerts —
+        see :meth:`subscribe`."""
+        if self._standing is None:
+            from geomesa_tpu.streaming.standing import StandingQueryEngine
+
+            # double-checked under _sub_lock: two concurrent first
+            # subscribes must not build two engines — the loser's
+            # (acknowledged, WAL-logged) registration would land in an
+            # orphaned engine that write() never feeds
+            with self._sub_lock:
+                if self._standing is None:
+                    self._standing = StandingQueryEngine(
+                        self.cold.get_schema(self.type_name), config,
+                        metrics=getattr(self.cold, "metrics", None),
+                    )
+        return self._standing
+
+    def subscribe(self, sub) -> None:
+        """Register one standing subscription (a
+        :class:`~geomesa_tpu.streaming.standing.Subscription`). With a
+        WAL attached the registration logs an ``s`` record BEFORE it is
+        acknowledged — like :meth:`write`, the return IS the durability
+        guarantee: an acknowledged registration survives ``kill -9``
+        (``recover`` rebuilds the SubscriptionIndex from the log)."""
+        eng = self.standing()
+        # validate BEFORE the record lands: a body that cannot register
+        # must never reach the log — replay re-registers every 's'
+        # record, so a poison body would abort all future recoveries
+        sub.validate()
+        with self._sub_lock:
+            if self.wal is not None:
+                rec = sub.to_record()
+                seq = self.wal.log_subscribe(rec)
+                try:
+                    eng.register(sub)
+                    self._sub_records[sub.sub_id] = rec
+                finally:
+                    self.wal.applied(seq)
+            else:
+                eng.register(sub)
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        """Remove a standing subscription (apply-then-record, like
+        :meth:`delete`: a failed append describes a removal that really
+        happened — recovery can only resurrect an unacknowledged
+        unsubscribe, never lose an acknowledged registration)."""
+        if self._standing is None:
+            return False
+        with self._sub_lock:
+            ok = self._standing.unregister(str(sub_id))
+            if ok:
+                self._sub_records.pop(str(sub_id), None)
+                if self.wal is not None:
+                    self.wal.log_unsubscribe(str(sub_id))
+        return ok
 
     # -- flush -----------------------------------------------------------
     def flush(self, incremental: "bool | None" = None, full: bool = False) -> int:
@@ -359,6 +450,20 @@ class LambdaStore:
         n = self.flush(full=True)
         persist.save(self.cold, root)
         if self.wal is not None:
+            # re-log the live subscription set ABOVE the cover before the
+            # watermark lands: the checkpoint retires the segments their
+            # original records live in, and subscriptions (unlike rows)
+            # are not part of the persisted cold store — without this, a
+            # post-checkpoint recovery would silently forget every
+            # acknowledged registration (docs/standing.md). Under
+            # _sub_lock so an unsubscribe cannot land its rm record
+            # between our snapshot and our re-logged registration (a
+            # racing subscribe/unsubscribe serializes to before the
+            # snapshot or after every re-log — either order replays to
+            # the acknowledged state)
+            with self._sub_lock:
+                for rec in self._sub_records.values():
+                    self.wal.append("s", {"sub": rec})
             self.wal.checkpoint(cover)
         return n
 
@@ -415,24 +520,110 @@ class LambdaStore:
         deletes/expiry sweeps rebuild the hot tier; flush watermarks
         re-publish exactly the batch the live store published (through
         the same flusher + fold), so hot/cold placement matches the
-        never-crashed store. Idempotent: replaying records whose effects
+        never-crashed store; subscription records rebuild the
+        SubscriptionIndex. Idempotent: replaying records whose effects
         are already in the loaded cold store converges to the same
-        query results (latest-wins upserts, identity-checked evicts)."""
-        for rec in self.wal.replay():
-            kind = rec.get("k")
-            if kind == "u":
-                self.hot.upsert(unpack_upsert(rec), rec["ids"])
-                self.hot.bump_next_id(rec.get("nid", 0))
-            elif kind in ("d", "x"):  # delete / expiry sweep: same effect
-                self.hot.delete(rec["ids"])
-            elif kind == "w":
-                pairs = self.hot.snapshot_pairs(rec["ids"])
-                if pairs:
-                    self.flusher.flush(
-                        pairs, incremental=bool(rec.get("inc", True))
-                    )
-                    self._known_cold.update(fid for fid, _ in pairs)
-                    self.hot.evict(pairs)
+        query results (latest-wins upserts, identity-checked evicts).
+
+        CONTIGUOUS upsert records coalesce into bulk hot-tier applies
+        of up to ``geomesa.stream.wal.replay.batch.rows`` rows
+        (``StreamingFeatureCache.replay_upsert``: one lock hold, one
+        vectorized grid-index pass) — record-at-a-time application was
+        the replay bottleneck (BENCH_WAL ``wal_replay``); ordering
+        semantics are unchanged because the pending batch always drains
+        before any non-upsert record applies. The whole replay runs in
+        the hot tier's replay mode (``begin_replay``/``end_replay``):
+        grid-index churn for rows a later flush watermark evicts again
+        is skipped, and the index rebuilds once from the survivors."""
+        from geomesa_tpu import conf
+        from geomesa_tpu.streaming.wal import unpack_upsert_xy
+
+        batch_rows = int(conf.STREAM_WAL_REPLAY_BATCH.get())
+        pend_rows: list = []
+        pend_ids: list = []
+        pend_xy: list = []
+        pend_nid = 0
+
+        def drain_pending() -> None:
+            nonlocal pend_rows, pend_ids, pend_xy, pend_nid
+            if not pend_ids:
+                return
+            xy = None
+            if pend_xy and all(a is not None for a in pend_xy):
+                xy = (
+                    pend_xy[0] if len(pend_xy) == 1
+                    else np.concatenate(pend_xy)
+                )
+            self.hot.replay_upsert(pend_rows, pend_ids, xy=xy)
+            self.hot.bump_next_id(pend_nid)
+            pend_rows, pend_ids, pend_xy, pend_nid = [], [], [], 0
+
+        geom_field = self.hot.sft.geom_field
+        self.hot.begin_replay()
+        try:
+            for rec in self.wal.replay():
+                kind = rec.get("k")
+                if kind == "u":
+                    if batch_rows <= 0:  # round-10 record-at-a-time path
+                        self.hot.upsert(unpack_upsert(rec), rec["ids"])
+                        self.hot.bump_next_id(rec.get("nid", 0))
+                        continue
+                    rows, xy = unpack_upsert_xy(rec, geom_field)
+                    pend_rows.extend(rows)
+                    pend_ids.extend(rec["ids"])
+                    pend_xy.append(xy)
+                    pend_nid = max(pend_nid, int(rec.get("nid", 0)))
+                    if len(pend_ids) >= batch_rows:
+                        drain_pending()
+                    continue
+                drain_pending()
+                if kind in ("d", "x"):  # delete/expiry sweep: same effect
+                    self.hot.delete(rec["ids"])
+                elif kind == "w":
+                    pairs = self.hot.snapshot_pairs(rec["ids"])
+                    if pairs:
+                        self.flusher.flush(
+                            pairs, incremental=bool(rec.get("inc", True))
+                        )
+                        self._known_cold.update(fid for fid, _ in pairs)
+                        self.hot.evict(pairs)
+                elif kind == "s":
+                    rm = rec.get("rm")
+                    if rm is not None:
+                        if self._standing is not None:
+                            self._standing.unregister(str(rm))
+                        with self._sub_lock:
+                            self._sub_records.pop(str(rm), None)
+                    else:
+                        from geomesa_tpu.streaming.standing import (
+                            Subscription,
+                        )
+
+                        try:
+                            self.standing().register(
+                                Subscription.from_record(rec["sub"])
+                            )
+                        except (ValueError, TypeError, KeyError):
+                            # a body that cannot register was never
+                            # acknowledged (subscribe() validates before
+                            # logging; an old/hand-written WAL may still
+                            # carry one) — skipping loses nothing, while
+                            # raising would poison every recovery
+                            log.warning(
+                                "skipping unregistrable WAL subscription "
+                                "record %r", rec.get("sub", {}).get("id"),
+                                exc_info=True,
+                            )
+                            continue
+                        with self._sub_lock:
+                            self._sub_records[str(rec["sub"]["id"])] = (
+                                rec["sub"]
+                            )
+            drain_pending()
+        finally:
+            # rebuild even after a partial replay (a chaos fault mid-
+            # replay): the index must reflect the applied prefix
+            self.hot.end_replay()
         self._gauge_hot()
 
     # -- serving ---------------------------------------------------------

@@ -24,8 +24,12 @@ with kind one of ``u`` (upsert batch: ids + rows), ``d`` (delete),
 ``x`` (expiry sweep), ``w`` (flush watermark: the ids one hot->cold
 publish covered, so replay re-folds exactly what the live store folded
 and the WAL agrees with the LSM flush policy on what is cold-resident),
-``c`` (checkpoint watermark: the cold store was durably saved through
-the crash-safe v3 path — the ONLY record that retires segments).
+``s`` (standing-query subscription registration/removal — replay
+rebuilds the SubscriptionIndex, docs/standing.md; checkpoints re-log
+the live subscription set above their cover so segment retirement
+never drops a registration), ``c`` (checkpoint watermark: the cold
+store was durably saved through the crash-safe v3 path — the ONLY
+record that retires segments).
 Geometry values serialize as WKB (bit-exact; WKT's fixed decimal
 formatting is not), everything else as tagged JSON.
 
@@ -204,16 +208,41 @@ def pack_upsert(rows: Sequence) -> dict:
 
 def unpack_upsert(rec: dict) -> list:
     """Inverse of :func:`pack_upsert` (the replay side)."""
+    return unpack_upsert_xy(rec, None)[0]
+
+
+def unpack_upsert_xy(rec: dict, geom_field: "str | None") -> tuple:
+    """``(rows, xy)``: :func:`unpack_upsert` plus the geometry column's
+    raw decoded [n, 2] f64 coordinates when the batch packed it columnar
+    — the replay bulk path (``StreamingFeatureCache.replay_upsert``)
+    feeds them straight into the vectorized grid-index insert instead of
+    re-reading a million Point attributes. ``xy`` is None for per-row
+    (mixed-shape) records or when the geometry column was not packed."""
     if "rows" in rec:
-        return decode_rows(rec["rows"])
+        return decode_rows(rec["rows"]), None
     n = int(rec["n"])
+    # tagged values are always dicts — a column with none (plain
+    # strings/numbers, the common case) skips the per-value decode calls
+    # and keeps the json-decoded list as-is (BENCH_WAL wal_replay)
     cols = {
-        k: [_dec_value(v) for v in vs] for k, vs in rec["cols"].items()
+        k: (
+            [_dec_value(v) for v in vs]
+            if any(type(v) is dict for v in vs) else vs
+        )
+        for k, vs in rec["cols"].items()
     }
+    xy = None
     for k, blob in rec.get("pts", {}).items():
         a = np.frombuffer(bytes.fromhex(blob), np.float64).reshape(-1, 2)
-        cols[k] = [geo.Point(a[i, 0], a[i, 1]) for i in range(n)]
-    return [{k: vs[i] for k, vs in cols.items()} for i in range(n)]
+        if k == geom_field:
+            xy = a
+        # flat per-axis tolist() feeds the million Point constructors
+        # native floats without allocating an [x, y] list per row
+        # (measured ~1.15x over scalar indexing; BENCH_WAL wal_replay)
+        xs = a[:, 0].tolist()
+        ys = a[:, 1].tolist()
+        cols[k] = [geo.Point(px, py) for px, py in zip(xs, ys)]
+    return [{k: vs[i] for k, vs in cols.items()} for i in range(n)], xy
 
 
 def _frame(payload: bytes) -> bytes:
@@ -417,7 +446,8 @@ class WriteAheadLog:
                 if r.get("k") == "c":
                     cover = int(r.get("cover", r.get("s", -1)))
             self.needs_recovery = not clean or any(
-                int(r.get("s", -1)) > cover and r.get("k") in ("u", "d", "x")
+                int(r.get("s", -1)) > cover
+                and r.get("k") in ("u", "d", "x", "s")
                 for r in scan
             )
         with self._sync_lock:
@@ -904,3 +934,18 @@ class WriteAheadLog:
         return self.append(
             "w", {"ids": [str(i) for i in ids], "inc": bool(incremental)}
         )
+
+    def log_subscribe(self, rec: dict) -> int:
+        """One standing-query subscription registration (the ``s``
+        record; docs/standing.md): logged BEFORE the registration
+        applies — pending like :meth:`log_upsert`, so a checkpoint
+        cover never skips a logged-but-unapplied registration."""
+        return self.append("s", {"sub": rec}, pending=True)
+
+    def log_unsubscribe(self, sub_id: str) -> int:
+        """A subscription removal (``s`` record with ``rm``): logged
+        after the removal applies, like :meth:`log_delete` — a failed
+        append leaves a removal that really happened; recovery can only
+        resurrect an unacknowledged unsubscribe, never lose an
+        acknowledged registration."""
+        return self.append("s", {"rm": str(sub_id)})

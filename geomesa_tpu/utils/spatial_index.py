@@ -65,6 +65,56 @@ class BucketIndex:
             self._buckets.setdefault(c, set()).add(key)
         self._entries[key] = (tuple(float(v) for v in bbox), cells)
 
+    def bulk_insert_points(self, keys, xs, ys) -> None:
+        """Vectorized insert/replace of many POINT entries: one numpy
+        pass computes every entry's cell and per-cell groups land in
+        their bucket sets with C-level ``set.update`` slices (the
+        per-entry scalar floors, allocs and set adds of :meth:`insert`
+        dominated WAL replay — docs/durability.md "Replay batching").
+        Later duplicates win, exactly like sequential :meth:`insert`
+        calls."""
+        import numpy as np
+
+        entries, buckets = self._entries, self._buckets
+        kset = set(keys)
+        stale = kset & entries.keys() if entries else ()
+        for k in stale:
+            self.remove(k)
+        if len(keys) != len(kset):
+            # in-batch duplicate ids: keep only the LAST occurrence (the
+            # replay batch coalesces many records; latest message wins)
+            last: dict = {}
+            for pos, k in enumerate(keys):
+                last[k] = pos
+            keep = sorted(last.values())
+            keys = [keys[p] for p in keep]
+            xs = np.asarray(xs, np.float64)[keep]
+            ys = np.asarray(ys, np.float64)[keep]
+        i = np.minimum(np.maximum(
+            np.floor((np.asarray(xs, np.float64) - self.x0) * self._fx)
+            .astype(np.int64), 0), self.nx - 1)
+        j = np.minimum(np.maximum(
+            np.floor((np.asarray(ys, np.float64) - self.y0) * self._fy)
+            .astype(np.int64), 0), self.ny - 1)
+        cells = j * self.nx + i
+        cl = cells.tolist()
+        xs_l = np.asarray(xs, np.float64).tolist()
+        ys_l = np.asarray(ys, np.float64).tolist()
+        entries.update(
+            (k, ((x, y, x, y), [c]))
+            for k, c, x, y in zip(keys, cl, xs_l, ys_l)
+        )
+        order = np.argsort(cells, kind="stable")
+        sorted_keys = [keys[p] for p in order.tolist()]
+        sc = cells[order]
+        uniq, first = np.unique(sc, return_index=True)
+        starts = np.append(first, len(sc)).tolist()
+        for t, c in enumerate(uniq.tolist()):
+            b = buckets.get(c)
+            if b is None:
+                b = buckets[c] = set()
+            b.update(sorted_keys[starts[t] : starts[t + 1]])
+
     def remove(self, key) -> bool:
         entry = self._entries.pop(key, None)
         if entry is None:

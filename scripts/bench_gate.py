@@ -14,6 +14,10 @@ Usage:
         GEOMESA_BENCH_STREAM_OUT=/tmp/BENCH_STREAM.json python bench.py
     python scripts/bench_gate.py --fresh /tmp/BENCH_STREAM.json
 
+    GEOMESA_BENCH_CONFIGS=standing \
+        GEOMESA_BENCH_GEOFENCE_OUT=/tmp/BENCH_GEOFENCE.json python bench.py
+    python scripts/bench_gate.py --fresh /tmp/BENCH_GEOFENCE.json
+
 The default --baseline is inferred from the fresh file's name
 (BENCH_STREAM* gates against the committed BENCH_STREAM.json, everything
 else against BENCH_PIP_JOIN.json). The gate refuses to compare a file
@@ -63,6 +67,11 @@ SCENARIO_SPECS = {
         ("off.qps", "higher", ()),
         ("sampled.qps", "higher", ()),
     ],
+    "standing_geofence": [
+        ("speedup_vs_naive", "higher", ()),
+        ("inverted_us_per_event", "lower", ()),
+        ("matcher_on_rows_per_s", "higher", ()),
+    ],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -99,6 +108,19 @@ FRESH_BOUNDS = {
         ("slow_trace.n_phases", 5.0, "min",
          "a fused batched slow query must show >=5 distinct phases"),
     ],
+    # the ISSUE 14 standing-query acceptance: >=1M registered geofences
+    # under sustained ingest; inverted matching >=50x cheaper per event
+    # than the naive all-subscription evaluation measured in the SAME
+    # run; the matcher riding the ack path may not cost ingest more
+    # than 10% of the matcher-off rate (also within-run)
+    "standing_geofence": [
+        ("subscriptions", 1_000_000.0, "min",
+         "the bench must register >=1M standing geofences"),
+        ("speedup_vs_naive", 50.0, "min",
+         "inverted matching must be >=50x below naive per-event cost"),
+        ("ingest_ratio", 0.9, "min",
+         "matcher-on ingest must hold >=0.9x the matcher-off rate"),
+    ],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -107,6 +129,7 @@ BASELINES = {
     "BENCH_WAL": "BENCH_WAL.json",
     "BENCH_KNN": "BENCH_KNN.json",
     "BENCH_OBS": "BENCH_OBS.json",
+    "BENCH_GEOFENCE": "BENCH_GEOFENCE.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

@@ -679,6 +679,97 @@ def test_observability_doc_honest():
         assert hasattr(DataStore, name), f"ds.{name}"
 
 
+def test_standing_doc_honest():
+    """docs/standing.md stays honest the registry way: every standing
+    API it names is real, every geomesa.standing.* knob and metric is
+    declared at runtime and cited by the doc (knobs by config.md too),
+    the fault points exist in the source, and the documented bench +
+    gate wiring is real."""
+    import inspect
+
+    from geomesa_tpu import process as P
+    from geomesa_tpu import streaming as S
+    from geomesa_tpu.metrics import MetricsRegistry
+
+    for name in ("Subscription", "SubscriptionIndex", "StandingConfig",
+                 "StandingQueryEngine", "WindowSpec", "WindowedAggregator",
+                 "AlertQueue"):
+        assert hasattr(S, name), name
+    for m in ("standing", "subscribe", "unsubscribe"):
+        assert hasattr(S.LambdaStore, m), m
+    for m in ("register", "unregister", "route", "kernel_block",
+              "register_geofences", "subscription_ids"):
+        assert hasattr(S.SubscriptionIndex, m), m
+    for m in ("on_batch", "match_points", "register", "add_window",
+              "attach_flusher"):
+        assert hasattr(S.StandingQueryEngine, m), m
+    for m in ("accept_rows", "value", "windows", "partials"):
+        assert hasattr(S.WindowedAggregator, m), m
+    for m in ("put_many", "drain"):
+        assert hasattr(S.AlertQueue, m), m
+    for fn in ("standing_proximity", "standing_tube"):
+        assert hasattr(P, fn), fn
+    # the kernel seam the doc names: segment-level packing + the fused
+    # multi-scan's PIP leg
+    from geomesa_tpu.scan import block_kernels as bk
+
+    assert hasattr(bk, "pack_edge_segments")
+    sig = inspect.signature(bk.block_scan_multi).parameters
+    for p in ("edges", "spip", "n_edges"):
+        assert p in sig, p
+    # every geomesa.standing.* knob/metric resolves at runtime and is
+    # cited by the doc; knobs ride config.md's complete index too
+    knobs, metrics = _area_names("geomesa.standing.")
+    assert len(knobs) >= 5 and len(metrics) >= 10, (knobs, metrics)
+    _assert_runtime_declared(knobs)
+    _assert_documented("standing.md", knobs + metrics)
+    _assert_documented("config.md", knobs)
+    # the SLO knob the delivery section leans on
+    _assert_runtime_declared(["geomesa.obs.slo.standing.p99.ms"])
+    _assert_documented("standing.md", ["geomesa.obs.slo.standing.p99.ms"])
+    # documented fault points exist at source level (the registry is
+    # pattern-based, like the ingest fault points)
+    import geomesa_tpu.streaming.standing as st
+
+    src = inspect.getsource(st)
+    for point in ("standing.match", "standing.deliver"):
+        assert point in src, point
+    for span in ("standing.route", "standing.match", "standing.deliver"):
+        assert span in src, span
+    # the documented metric kinds render through the registry
+    by_name = _registries().metrics.by_name()
+    reg = MetricsRegistry()
+    for n in metrics:
+        kind = by_name[n][0].instrument
+        if kind == "counter":
+            reg.counter(n)
+        elif kind == "gauge":
+            reg.gauge(n, 1.0)
+        elif kind == "histogram":
+            reg.observe(n, 0.01)
+        else:
+            reg.timer_update(n, 0.01)
+    text = reg.render_prometheus()
+    assert "geomesa_standing_subscriptions 1" in text
+    assert 'geomesa_standing_latency_seconds_bucket{le="' in text
+    # bench + gate wiring (source-level contract, like config_fused)
+    bench_src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert "def config_standing" in bench_src
+    assert '"standing": config_standing' in bench_src
+    assert "BENCH_GEOFENCE.json" in bench_src
+    gate_src = open(
+        os.path.join(_ROOT, "scripts", "bench_gate.py")
+    ).read()
+    assert "standing_geofence" in gate_src
+    doc = open(os.path.join(_ROOT, "docs", "standing.md")).read()
+    assert "BENCH_GEOFENCE.json" in doc
+    # every `lam.X` / `engine.X` the doc mentions in backticks resolves
+    for name in re.findall(r"`lam\.(\w+)", doc):
+        assert hasattr(S.LambdaStore, name), f"lam.{name}"
+    for name in re.findall(r"`engine\.(\w+)", doc):
+        assert hasattr(S.StandingQueryEngine, name), f"engine.{name}"
+
+
 def test_config_doc_lists_every_knob():
     """docs/config.md is the complete operator-facing knob index (the
     knob-undocumented rule's backstop): every declared SystemProperty

@@ -31,6 +31,7 @@ import numpy as np
 import pytest
 
 from geomesa_tpu import fault, lockwitness
+from geomesa_tpu import geometry as geo
 from geomesa_tpu.analysis.core import Project
 from geomesa_tpu.analysis.lockmodel import LOCKS, LockModel
 from geomesa_tpu.cache import CacheConfig
@@ -235,13 +236,33 @@ def _workload(tmp_path, metrics=None):
         wal_config=WalConfig(sync="always", segment_bytes=4 << 10),
     )
     try:
-        with fault.chaos(seed=3, rate=0.0, points="stream.*,streaming.*"):
+        with fault.chaos(
+            seed=3, rate=0.0, points="stream.*,streaming.*,standing.*"
+        ):
+            # standing tier (docs/standing.md), constructed armed: the
+            # subscription index, a continuous window and the alert
+            # queue all cross their locks on every write below
+            from geomesa_tpu.streaming.standing import (
+                Subscription, WindowSpec,
+            )
+
+            lam.subscribe(Subscription("w", "geofence", geom=geo.Polygon(
+                [(-30, -30), (30, -30), (30, 30), (-30, 30), (-30, -30)]
+            )))
+            # a non-rectangular geofence so matching crosses the host
+            # ray cast and the _MatchGate cost EWMAs (the rect above
+            # takes the box fast path, which touches neither)
+            lam.subscribe(Subscription("t", "geofence", geom=geo.Polygon(
+                [(-30, -30), (30, -30), (0.0, 30), (-30, -30)]
+            )))
+            lam.standing().add_window("m", WindowSpec(size_ms=60_000))
             lam.write(_rows(150, seed=2))
             lam.flush()
             lam.write(_rows(150, seed=3))          # updates: fold path
             lam.delete([f"r{i}" for i in range(10)])  # hot-lock WAL hook
             lam.flush()
             lam.query("BBOX(geom, -30, -30, 30, 30)")
+            lam.standing().alerts.drain()
             lam.checkpoint(str(root))
     finally:
         lam.close()
