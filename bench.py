@@ -27,7 +27,8 @@ Prints one JSON line per config, config 1 first. Env knobs:
 GEOMESA_BENCH_N (config-1 points), GEOMESA_BENCH_N2, GEOMESA_BENCH_N3,
 GEOMESA_BENCH_N4, GEOMESA_BENCH_N5, GEOMESA_BENCH_QUERIES,
 GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"; named scenarios "cache",
-"serving", "ingest", "fused", "pip_join", "stream", "wal", "knn"),
+"serving", "ingest", "fused", "pip_join", "stream", "wal", "knn",
+"obs", "ops", "standing"),
 GEOMESA_BENCH_PLATFORM
 (e.g. "cpu" for off-TPU verification). Supervisor knobs (see main()):
 GEOMESA_BENCH_INIT_TIMEOUT (child device-init watchdog, s),
@@ -1284,6 +1285,269 @@ def config_obs(out_path: "str | None" = None):
         "hist_p99_bucket_delta": row["hist_p99"]["bucket_delta"],
         "slow_trace_phases": slow_trace["n_phases"],
         "slow_trace_cover": slow_trace["phase_cover"],
+        "n_points": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ----------------------------------------------------- ops-plane scenario
+
+
+def config_ops(out_path: "str | None" = None):
+    """Ops-plane scenario (docs/observability.md "The ops plane"):
+    sustained serving QPS with and without a 1 Hz ``/metrics`` +
+    ``/health`` HTTP scraper attached (interleaved reps, median), the
+    estimate-vs-actual recording coverage over every executed scan,
+    and the stale-stats loop demonstrated end to end on a store
+    mutated through the accumulate-only fold path WITHOUT re-analyzing
+    (flag raised), then cleared by ``analyze_stats``. Emits
+    BENCH_OPS_PLANE.json (or ``out_path``; env
+    GEOMESA_BENCH_OPS_PLANE_OUT), gated by scripts/bench_gate.py.
+    CPU-runnable. Env knobs: GEOMESA_BENCH_OPS_PLANE_N (points),
+    GEOMESA_BENCH_OPS_PLANE_CLIENTS, GEOMESA_BENCH_OPS_PLANE_Q
+    (queries per rep)."""
+    import threading
+    import urllib.request
+
+    from geomesa_tpu import conf as _conf
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.obs.ops import HealthMonitor
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_OPS_PLANE_N", 1_000_000))
+    clients = int(os.environ.get("GEOMESA_BENCH_OPS_PLANE_CLIENTS", 4))
+    total_q = int(os.environ.get("GEOMESA_BENCH_OPS_PLANE_Q", 768))
+    out_path = out_path or os.environ.get("GEOMESA_BENCH_OPS_PLANE_OUT")
+    rng = np.random.default_rng(SEED + 95)
+    log(f"[ops] building {n:,} point store ...")
+    x, y = gdelt_points(n, rng)
+    sft = FeatureType.from_spec("srv", "*geom:Point:srid=4326")
+    sft.user_data["geomesa.indices.enabled"] = "z2"
+    reg = MetricsRegistry()
+    ds = DataStore(metrics=reg)
+    ds.create_schema(sft)
+    ds.write("srv", FeatureCollection.from_columns(
+        sft, np.arange(n), {"geom": (x, y)}), check_ids=False)
+
+    qrng = np.random.default_rng(SEED + 96)
+
+    def qbox():
+        w = float(qrng.choice([0.5, 1.0, 2.0]))
+        qx = qrng.uniform(-175, 175 - w)
+        qy = qrng.uniform(-85, 85 - w / 2)
+        return f"bbox(geom, {qx:.4f}, {qy:.4f}, {qx + w:.4f}, {qy + w / 2:.4f})"
+
+    pool = [qbox() for _ in range(total_q)]
+    for q in pool[:8]:
+        ds.query("srv", q)
+    ds.query_many("srv", pool[:8])
+    for q in pool:
+        ds.planner.plan("srv", q)
+
+    def run_clients(sched):
+        per = max(1, total_q // clients)
+        hits = [0]
+        lock = threading.Lock()
+        start = threading.Barrier(clients + 1)
+
+        def worker(qs):
+            h = 0
+            start.wait()
+            for q in qs:
+                h += len(sched.query("srv", q))
+            with lock:
+                hits[0] += h
+
+        threads = [
+            threading.Thread(target=worker, args=(pool[i * per:(i + 1) * per],))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return clients * per / wall, hits[0], wall
+
+    # untimed warm pass: compiles every fused batch-size variant
+    sched = ds.serve()
+    run_clients(sched)
+    sched.close()
+
+    srv = ds.serve_ops()
+    scrapes_before = reg.counter_value("geomesa.obs.ops.scrapes")
+
+    def run_mode(scraped: bool):
+        sched = ds.serve()
+        stop = threading.Event()
+        scraper = None
+        scrape_errs: list = []
+        if scraped:
+            def scrape_loop():
+                # the 1 Hz operator: one /metrics + /health round per
+                # second while the serving load runs (at least one
+                # round even on a sub-second rep). Errors propagate —
+                # a silently dead scraper would measure an UNSCRAPED
+                # run and pass the overhead gate vacuously.
+                try:
+                    while True:
+                        for path in ("/metrics", "/health"):
+                            urllib.request.urlopen(
+                                srv.url + path, timeout=30
+                            ).read()
+                        if stop.wait(1.0):
+                            return
+                except BaseException as e:
+                    scrape_errs.append(e)
+
+            scraper = threading.Thread(target=scrape_loop)
+            scraper.start()
+        try:
+            qps, hits, wall = run_clients(sched)
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join()
+            sched.close()
+        if scrape_errs:
+            raise RuntimeError(f"ops scraper died: {scrape_errs[0]!r}")
+        return {"qps": round(qps, 1), "wall_s": round(wall, 2)}, hits
+
+    # interleaved reps, median by qps (the config_obs convention: slow
+    # host drift hits both modes equally)
+    runs = {"unscraped": [], "scraped": []}
+    hits_by_mode = {}
+    for _rep in range(5):
+        for mode, scraped in (("unscraped", False), ("scraped", True)):
+            r, hits = run_mode(scraped)
+            runs[mode].append(r)
+            hits_by_mode[mode] = hits
+    results = {}
+    for mode in runs:
+        ordered = sorted(runs[mode], key=lambda r: r["qps"])
+        results[mode] = dict(ordered[len(ordered) // 2])
+        results[mode]["qps_runs"] = [r["qps"] for r in runs[mode]]
+        log(f"[ops] {mode}: {results[mode]['qps']} qps median of "
+            f"{results[mode]['qps_runs']}")
+    n_scrapes = reg.counter_value("geomesa.obs.ops.scrapes") - scrapes_before
+    # belt + braces on top of the scraper error propagation: every
+    # scraped rep makes at least one /metrics + /health round
+    if n_scrapes < 2 * len(runs["scraped"]):
+        raise RuntimeError(
+            f"only {n_scrapes} scrapes over {len(runs['scraped'])} scraped "
+            "reps — the scraped mode did not actually scrape"
+        )
+
+    # -- estimate coverage over the whole serving phase ------------------
+    executed = reg.counter_value("geomesa.query.count")
+    recorded = ds.accuracy.sample_count()
+    coverage = recorded / max(executed, 1)
+    log(f"[ops] estimates recorded for {recorded}/{executed} scans "
+        f"({coverage:.4f})")
+
+    # -- the stale-stats loop, demonstrated ------------------------------
+    # a deliberately mutated-WITHOUT-analyze store: every row moves far
+    # away through the accumulate-only fold path (docs/streaming.md's
+    # documented sketch drift), so the sketches keep claiming the old
+    # region is dense while scans there come back empty
+    _conf.PLAN_ESTIMATE_MIN_COUNT.set(16)
+    mut = np.random.default_rng(SEED + 97)
+    move_n = 100_000
+    mds = DataStore(metrics=MetricsRegistry())
+    msft = FeatureType.from_spec("mut", "*geom:Point:srid=4326")
+    msft.user_data["geomesa.indices.enabled"] = "z2"
+    mds.create_schema(msft)
+    mds.write("mut", FeatureCollection.from_columns(
+        msft, np.arange(move_n),
+        {"geom": (mut.uniform(-50, 50, move_n), mut.uniform(-50, 50, move_n))},
+    ), check_ids=False)
+    mds.fold_upsert("mut", FeatureCollection.from_columns(
+        msft, np.arange(move_n),
+        {"geom": (mut.uniform(100, 140, move_n), mut.uniform(60, 85, move_n))},
+    ))
+    mon = HealthMonitor(mds)
+    stale_probe = [
+        f"bbox(geom, {qx:.2f}, {qy:.2f}, {qx + 4:.2f}, {qy + 4:.2f})"
+        for qx, qy in zip(
+            mut.uniform(-48, 44, 24), mut.uniform(-48, 44, 24)
+        )
+    ]  # the vacated region: estimates stay high, scans come back empty
+    for q in stale_probe:
+        mds.query("mut", q)
+    report = mon.evaluate()
+    stale_demonstrated = int(any(
+        r["reason"] == "stats.stale" for r in report["reasons"]
+    ))
+    log(f"[ops] stale flagged: {bool(stale_demonstrated)} "
+        f"({[r['reason'] for r in report['reasons']]})")
+    # the documented remedy clears it
+    mds.analyze_stats("mut")
+    mds.accuracy.reset("mut")
+    for q in stale_probe:
+        mds.query("mut", q)
+    report = mon.evaluate()
+    stale_cleared = int(not any(
+        r["reason"] == "stats.stale" for r in report["reasons"]
+    ))
+    log(f"[ops] stale cleared by analyze_stats: {bool(stale_cleared)}")
+    _conf.PLAN_ESTIMATE_MIN_COUNT.clear()
+    srv.close()
+
+    row = {
+        "scenario": "ops_plane",
+        "clients": clients,
+        "queries_per_rep": total_q,
+        "identical": bool(
+            hits_by_mode["unscraped"] == hits_by_mode["scraped"]
+        ),
+        "unscraped": results["unscraped"],
+        "scraped": results["scraped"],
+        "qps_unscraped": results["unscraped"]["qps"],
+        "qps_scraped": results["scraped"]["qps"],
+        "scraped_over_unscraped": round(
+            results["scraped"]["qps"]
+            / max(results["unscraped"]["qps"], 1e-9), 4
+        ),
+        "scrapes": int(n_scrapes),
+        "estimate_coverage": round(coverage, 4),
+        "estimates_recorded": int(recorded),
+        "scans_executed": int(executed),
+        "stale_demonstrated": stale_demonstrated,
+        "stale_cleared": stale_cleared,
+    }
+
+    import jax
+
+    payload = {
+        "n_points": n,
+        "platform": jax.default_backend(),
+        "rows": [row],
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_OPS_PLANE.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "ops_scraped_over_unscraped_qps_ratio",
+        "value": row["scraped_over_unscraped"],
+        "unit": "ratio",
+        "unscraped_qps": row["qps_unscraped"],
+        "scraped_qps": row["qps_scraped"],
+        "scrapes": row["scrapes"],
+        "estimate_coverage": row["estimate_coverage"],
+        "stale_demonstrated": row["stale_demonstrated"],
+        "stale_cleared": row["stale_cleared"],
         "n_points": n,
     }
     print(json.dumps(rec), flush=True)
@@ -2909,6 +3173,7 @@ def child_main():
         "fused": config_fused, "pip_join": config_pip_join,
         "stream": config_stream, "wal": config_wal, "knn": config_knn,
         "obs": config_obs, "standing": config_standing,
+        "ops": config_ops,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -189,12 +189,25 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
        fields=("hits", "fired", "log"),
        doc="seeded chaos schedule state; consulted at fault points, "
            "which fire under arbitrary outer locks"),
+    _d("EstimateAccuracy._lock", "geomesa_tpu/obs/accuracy.py", 74,
+       hot=True,
+       fields=("_windows", "_analyzing"),
+       doc="per-(type, index) estimate-vs-actual error windows: fed on "
+           "every query's record path (possibly under the store write "
+           "lock — modify_features queries in-lock), read by /health; "
+           "only arithmetic runs under it and it acquires no other "
+           "lock"),
     _d("Tracer._lock", "geomesa_tpu/obs/trace.py", 76,
        hot=True,
        fields=("buffer", "slow", "_n_roots"),
        doc="trace retention rings + sampling counter: taken once per "
            "root begin/end, never per child span; nothing blocking "
            "runs under it and it acquires no other lock"),
+    _d("TelemetryRecorder._lock", "geomesa_tpu/obs/ops.py", 79,
+       fields=("_rings",),
+       doc="telemetry history rings: the 1 Hz sampler appends points "
+           "computed BEFORE the lock (the registry snapshot never runs "
+           "under it), /debug/vars scrapes copy under it"),
     _d("SloTracker._lock", "geomesa_tpu/obs/slo.py", 78,
        hot=True,
        fields=("_windows",),
@@ -296,6 +309,10 @@ DECLARED_EDGES: list[tuple[str, str, str]] = [
      "the subscribe-path WAL fsync histogram observation reaches the "
      "SLO windows through the registry observer hook under the "
      "registry lock"),
+    ("DataStore._write_lock", "EstimateAccuracy._lock",
+     "maintenance ops that query inside their write-locked section "
+     "(modify_features) reach record_query's estimate-accountability "
+     "record while the write lock is held"),
 ]
 
 #: hot-lock blocking the design ACCEPTS, with its justification — the
@@ -333,6 +350,8 @@ ATTR_TYPE_HINTS = {
     "wal": "WriteAheadLog",
     "scheduler": "QueryScheduler",
     "slo": "SloTracker",
+    "accuracy": "EstimateAccuracy",
+    "recorder": "TelemetryRecorder",
 }
 
 # the model's presence marker (the FaultPointRule convention: staged

@@ -16,7 +16,11 @@ from dataclasses import dataclass, field
 
 @dataclass
 class AuditedEvent:
-    """One query's audit record (reference QueryEvent)."""
+    """One query's audit record (reference QueryEvent). ``trace_id``
+    cross-references the observability tier (docs/observability.md):
+    when tracing is armed it carries the query's trace id, the same id
+    the slow-query ring and the Chrome export (``pid``) use — so an
+    audit row, a slow capture and a trace lane join on one key."""
 
     type_name: str
     filter: str
@@ -26,6 +30,7 @@ class AuditedEvent:
     planning_ms: float
     scanning_ms: float
     timestamp: float = field(default_factory=time.time)
+    trace_id: "int | None" = None
 
     def to_json(self) -> dict:
         return {
@@ -37,6 +42,7 @@ class AuditedEvent:
             "planTimeMillis": round(self.planning_ms, 3),
             "scanTimeMillis": round(self.scanning_ms, 3),
             "date": self.timestamp,
+            "traceId": self.trace_id,
         }
 
 
@@ -48,6 +54,24 @@ class AuditWriter:
 
     def write(self, event: AuditedEvent) -> None:
         self.events.append(event)
+
+    def peek(self, type_name: "str | None" = None) -> list[dict]:
+        """Non-destructive read of the ring (oldest first), optionally
+        filtered by schema — the ops plane's ``/debug/audit`` body
+        (``drain`` clears; a monitoring scrape must not). Safe against
+        concurrent writers: iterating a deque a query thread is
+        appending to raises RuntimeError, so the snapshot retries until
+        it lands between appends (appends themselves are atomic)."""
+        while True:
+            try:
+                events = list(self.events)
+                break
+            except RuntimeError:  # resized mid-iteration: retry
+                continue
+        return [
+            e.to_json() for e in events
+            if type_name is None or e.type_name == type_name
+        ]
 
     def drain(self) -> list[dict]:
         out = [e.to_json() for e in self.events]

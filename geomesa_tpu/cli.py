@@ -425,6 +425,58 @@ def cmd_stats_analyze(args) -> int:
     return 0
 
 
+def cmd_ops(args) -> int:
+    """One-shot ops report (reference `stats-analyze`-style maintenance
+    command; docs/observability.md "The ops plane"): health verdict +
+    machine-readable reasons, the SLO report, top-N slow queries and
+    per-index estimate accuracy — human text, or `--json` for scripts.
+    Runs over the loaded catalog; a live serving process exposes the
+    same payloads over HTTP via `DataStore.serve_ops()`."""
+    from geomesa_tpu.obs.ops import ops_report
+
+    ds = _load(args)
+    report = ops_report(ds, slow_n=args.slow)
+    if args.json:
+        print(json.dumps(report, default=str))
+        return 0
+    health = report["health"]
+    print(f"status: {health['status']}")
+    if health["reasons"]:
+        for r in health["reasons"]:
+            print(f"  [{r['severity']}] {r['reason']}: {r['detail']}")
+    else:
+        print("  no reasons — all checks clean")
+    slo = health["slo"]
+    print(f"slo ({slo['window_s']:g}s window): {slo['status']}")
+    for row in slo["objectives"]:
+        mark = "ok " if row["ok"] else "BREACH"
+        print(
+            f"  {mark} {row['objective']}: p{int(row['quantile'] * 100)} "
+            f"{row['value_ms']}ms / {row['threshold_ms']}ms "
+            f"(n={row['count']}, burn {row['burn_rate']})"
+        )
+    est = health.get("estimates") or {"indexes": []}
+    print("estimate accuracy (error factor, 1.0 = perfect):")
+    if not est["indexes"]:
+        print("  no estimate-vs-actual samples recorded")
+    for row in est["indexes"]:
+        print(
+            f"  {row['type']}/{row['index']}: n={row['count']} "
+            f"p50 {row['p50_error']}x p90 {row['p90_error']}x "
+            f"worst {row['worst_error']}x"
+        )
+    print(f"slow queries (top {args.slow}):")
+    if not report["slow_queries"]:
+        print("  none captured")
+    for e in report["slow_queries"]:
+        fp = e["fingerprint"]
+        print(
+            f"  {e['wall_ms']}ms {fp.get('type')}/{fp.get('strategy')} "
+            f"{fp.get('filter', '')[:60]} (trace {e['trace_id']})"
+        )
+    return 0
+
+
 def cmd_playback(args) -> int:
     """Replay a store's features in time order into a streaming cache at a
     rate multiplier (reference geomesa-tools `playback` command, which
@@ -542,6 +594,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-q", "--cql")
 
     add("stats-analyze", cmd_stats_analyze, feature=True)
+
+    sp = add("ops", cmd_ops)
+    sp.add_argument("--json", action="store_true", help="machine-readable output")
+    sp.add_argument(
+        "--slow", type=int, default=10,
+        help="slow-query captures to include (default 10)",
+    )
 
     sp = add("playback", cmd_playback, feature=True)
     sp.add_argument("-q", "--cql")

@@ -377,6 +377,61 @@ OBS_SLO_STANDING_P99_MS = SystemProperty(
 )
 
 
+# -- the ops plane: /health + /metrics endpoints, telemetry history
+# (geomesa_tpu.obs.ops; docs/observability.md "The ops plane") ------------
+
+OBS_OPS_HOST = SystemProperty(
+    "geomesa.obs.ops.host", "127.0.0.1", str,
+    "bind address of the ops endpoint (DataStore.serve_ops): loopback "
+    "by default — exposing /metrics//health beyond the host is an "
+    "explicit operator decision",
+)
+OBS_OPS_SAMPLE_MS = SystemProperty(
+    "geomesa.obs.ops.sample.ms", 1000.0, float,
+    "TelemetryRecorder sampling cadence: every tick snapshots the "
+    "metrics registry's gauges, counters and histogram p50/p99 into "
+    "bounded time-series rings (/debug/vars), so operators get history "
+    "between scrapes, not just the instantaneous value",
+)
+OBS_OPS_HISTORY = SystemProperty(
+    "geomesa.obs.ops.history", 512, int,
+    "points retained per telemetry ring (oldest evicted first): at the "
+    "default 1 Hz cadence, ~8.5 minutes of history per series",
+)
+
+
+# -- planner estimate accountability (geomesa_tpu.obs.accuracy;
+# docs/observability.md "Estimate accountability") ------------------------
+
+PLAN_ESTIMATE = SystemProperty(
+    "geomesa.plan.estimate.enabled", True, _parse_bool,
+    "record the stats-sketch row estimate on every plan and compare it "
+    "against the rows the executed scan actually produced (the "
+    "geomesa.plan.estimate.error histogram + per-index accuracy in "
+    "/health); False skips the plan-time sketch probe entirely",
+)
+PLAN_ESTIMATE_STALE_P90 = SystemProperty(
+    "geomesa.plan.estimate.stale.p90", 4.0, float,
+    "misestimate threshold: when a (type, index)'s p90 estimate error "
+    "factor exceeds this, /health carries a 'stats stale — re-analyze' "
+    "reason (and the auto-analyze hook may fire); 0 disables staleness "
+    "detection",
+)
+PLAN_ESTIMATE_MIN_COUNT = SystemProperty(
+    "geomesa.plan.estimate.min.count", 64, int,
+    "recorded estimate-vs-actual samples a (type, index) window needs "
+    "before its p90 can trip the staleness threshold (a handful of "
+    "unlucky queries must not flag a whole store stale)",
+)
+PLAN_ESTIMATE_AUTO_ANALYZE = SystemProperty(
+    "geomesa.plan.estimate.auto.analyze", False, _parse_bool,
+    "when the staleness threshold trips, re-run DataStore.analyze_stats "
+    "for the offending type automatically (once per trip; the accuracy "
+    "window resets after). Off by default: a full re-sketch on a large "
+    "store is a deliberate maintenance op",
+)
+
+
 # -- standing queries: the inverted subscription index
 # (geomesa_tpu.streaming.standing; docs/standing.md) ----------------------
 

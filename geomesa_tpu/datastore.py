@@ -111,6 +111,13 @@ class DataStore:
     # per-slice pause histogram source. None until a fold runs.
     last_fold_report = None
 
+    # ops plane (docs/observability.md "The ops plane"): the attached
+    # OpsServer, or None — class-level defaults for the same
+    # hasattr-resolvable doc-honesty reason as `scheduler` above;
+    # __init__ replaces `accuracy` with a fresh EstimateAccuracy
+    ops = None
+    accuracy = None
+
     def __init__(
         self,
         block_full_table_scans: bool = False,
@@ -216,12 +223,20 @@ class DataStore:
 
         self.health = StoreHealth()
         self.planner = QueryPlanner(self)
+        # estimate accountability (docs/observability.md): per-(type,
+        # index) estimate-vs-actual windows fed by record_query, served
+        # by /health and `geomesa ops`
+        from geomesa_tpu.obs.accuracy import EstimateAccuracy
+
+        self.accuracy = EstimateAccuracy()
         # query/aggregation cache tier (docs/caching.md)
         self.cache = None
         if cache is not None and cache is not False:
             self.attach_cache(cache)
         # concurrent-serving tier (docs/serving.md): attached by serve()
         self.scheduler = None
+        # ops plane (docs/observability.md): attached by serve_ops()
+        self.ops = None
 
     def serve(self, config=None):
         """Attach (or return) the micro-batch serving tier
@@ -1562,6 +1577,44 @@ class DataStore:
         this from execute(), and the aggregation fast paths call it
         directly, so density/stats scans are audited like row queries
         (reference AuditWriter covers all query types)."""
+        # estimate accountability (docs/observability.md): the sketch
+        # estimate vs the rows the scan actually produced, recorded per
+        # (type, index) and into the error histogram; a misestimate past
+        # the staleness threshold re-checks the window (and, with the
+        # auto-analyze knob on, re-sketches the type once per trip)
+        if plan.estimated_rows is not None and plan.cache_status not in (
+            "hit", "coalesced"
+        ):
+            actual = plan.actual_rows if plan.actual_rows is not None else hits
+            err = self.accuracy.record(
+                plan.type_name, plan.index, plan.estimated_rows, actual
+            )
+            if self.metrics is not None:
+                self.metrics.observe("geomesa.plan.estimate.error", err)
+            from geomesa_tpu.conf import (
+                PLAN_ESTIMATE_AUTO_ANALYZE, PLAN_ESTIMATE_STALE_P90,
+            )
+
+            if (
+                err > float(PLAN_ESTIMATE_STALE_P90.get() or 0)
+                and PLAN_ESTIMATE_AUTO_ANALYZE.get()
+                and any(
+                    t == plan.type_name for t, _, _ in self.accuracy.stale()
+                )
+                # one trip fires ONE analyze, not a storm: concurrent
+                # serving threads all past the stale check race to this
+                # atomic claim — exactly one wins; reset releases it
+                and self.accuracy.claim_analyze(plan.type_name)
+            ):
+                if self.metrics is not None:
+                    self.metrics.counter("geomesa.plan.estimate.analyze")
+                try:
+                    self.analyze_stats(plan.type_name)
+                finally:
+                    # the fresh sketches must earn their own record
+                    # (also releases the claim, even on a failed
+                    # analyze — the next trip may retry)
+                    self.accuracy.reset(plan.type_name)
         if self.metrics is not None:
             self.metrics.counter("geomesa.query.count")
             self.metrics.counter("geomesa.query.hits", max(hits, 0))
@@ -1594,7 +1647,12 @@ class DataStore:
                 )
         if self.audit is not None:
             from geomesa_tpu.audit import AuditedEvent
+            from geomesa_tpu.obs.trace import tracer
 
+            # cross-reference key (docs/observability.md): the active
+            # trace's id, shared with the slow-query ring and the Chrome
+            # export — None when tracing is disarmed
+            cur = tracer().current()
             self.audit.write(
                 AuditedEvent(
                     type_name=plan.type_name,
@@ -1604,6 +1662,7 @@ class DataStore:
                     hits=hits,
                     planning_ms=plan.planning_s * 1e3,
                     scanning_ms=scan_s * 1e3,
+                    trace_id=cur.trace.trace_id if cur is not None else None,
                 )
             )
 
@@ -2138,14 +2197,48 @@ class DataStore:
 
         return tracer().dump(path)
 
-    def slow_queries(self) -> list:
+    def slow_queries(self, type_name: "str | None" = None) -> list:
         """The slow-query ring (newest last): operations over
         ``geomesa.obs.slow.ms``, each with wall time, plan fingerprint
         and full span tree — "where did the slow query spend its time"
-        without reproducing it."""
+        without reproducing it. ``type_name`` filters by the captured
+        fingerprint's schema (the ops plane's ``/debug/slow?type=``)."""
         from geomesa_tpu.obs.trace import tracer
 
-        return tracer().slow_queries()
+        return tracer().slow_queries(type_name=type_name)
+
+    def serve_ops(self, port: int = 0, host: "str | None" = None, lam=None):
+        """Attach (or return) the ops plane (docs/observability.md "The
+        ops plane"): a threaded loopback HTTP endpoint serving
+        ``/metrics``, the composite ``/health`` verdict, ``/stats`` and
+        the debug surfaces, with a background TelemetryRecorder writing
+        bounded history rings. ``port=0`` binds an ephemeral port (read
+        it back from ``ds.ops.port``); ``host`` defaults to the
+        ``geomesa.obs.ops.host`` knob (loopback). ``lam``: the
+        LambdaStore whose hot tier / WAL join the health surface
+        (``LambdaStore.serve_ops`` passes itself). Idempotent while the
+        attached server is open; a closed one is replaced."""
+        from geomesa_tpu.obs.ops import OpsServer
+
+        with self._write_lock:
+            ops = self.ops
+            if ops is not None and not ops.closed:
+                return ops
+            self.ops = OpsServer(self, lam=lam, host=host, port=port).start()
+            return self.ops
+
+    def close(self) -> None:
+        """Release attached background services: the serving scheduler
+        (drained) and the ops endpoint (socket closed, serve + telemetry
+        threads joined bounded). Idempotent; the store itself stays
+        queryable — this is the lifecycle hook tests and embedding
+        servers call so no thread or socket outlives the store."""
+        sched = self.scheduler
+        if sched is not None:
+            sched.close()
+        ops = self.ops
+        if ops is not None:
+            ops.close()
 
     def attach_slo(self, objectives=None):
         """Attach an SLO tracker (docs/observability.md): declarative

@@ -16,8 +16,26 @@ Three surfaces over one substrate:
 - **SLO tracking** (:mod:`~geomesa_tpu.obs.slo`): declarative
   objectives over sliding windows with burn-rate counters, served by
   ``DataStore.slo_report()``.
+- **the ops plane** (:mod:`~geomesa_tpu.obs.ops`): a dependency-free
+  threaded HTTP endpoint (``DataStore.serve_ops``) exposing
+  ``/metrics``, the composite ``/health`` state machine, ``/stats``,
+  the debug surfaces, and a :class:`~geomesa_tpu.obs.ops.
+  TelemetryRecorder` writing bounded time-series rings of key gauges
+  and histogram quantiles.
+- **estimate accountability** (:mod:`~geomesa_tpu.obs.accuracy`):
+  every executed plan records the cost model's estimated rows next to
+  the rows actually scanned; per-index error windows flag stale stats
+  in ``/health`` and optionally trigger an automatic ``analyze_stats``.
 """
 
+from geomesa_tpu.obs.accuracy import EstimateAccuracy, error_factor
+from geomesa_tpu.obs.ops import (
+    HealthMonitor,
+    OpsServer,
+    TelemetryRecorder,
+    ops_report,
+    stats_payload,
+)
 from geomesa_tpu.obs.slo import SloObjective, SloTracker, default_objectives
 from geomesa_tpu.obs.trace import (
     Span,
@@ -35,11 +53,18 @@ __all__ = [
     "Trace",
     "TraceBuffer",
     "Tracer",
+    "EstimateAccuracy",
+    "HealthMonitor",
+    "OpsServer",
     "SloObjective",
     "SloTracker",
+    "TelemetryRecorder",
     "default_objectives",
+    "error_factor",
     "install",
+    "ops_report",
     "phase_breakdown",
     "span",
+    "stats_payload",
     "tracer",
 ]

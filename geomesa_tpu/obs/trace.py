@@ -344,11 +344,19 @@ class Tracer:
         with self._lock:
             return self.buffer.items()
 
-    def slow_queries(self) -> list[dict]:
+    def slow_queries(self, type_name: "str | None" = None) -> list[dict]:
         """The slow-query ring, newest last: each entry carries the
-        wall, the plan fingerprint and the full span tree."""
+        wall, the plan fingerprint and the full span tree.
+        ``type_name`` filters to captures whose fingerprint names that
+        schema (ops-plane ``/debug/slow?type=``)."""
         with self._lock:
-            return [dict(e) for e in self.slow]
+            out = [dict(e) for e in self.slow]
+        if type_name is not None:
+            out = [
+                e for e in out
+                if e.get("fingerprint", {}).get("type") == type_name
+            ]
+        return out
 
     def reset(self) -> None:
         with self._lock:
@@ -356,10 +364,10 @@ class Tracer:
             self.slow = []
             self._n_roots = 0
 
-    def dump(self, path: str) -> str:
-        """Write every retained trace (buffer + slow ring) as Chrome
-        trace-event JSON — openable in chrome://tracing or Perfetto —
-        and return the path."""
+    def chrome_payload(self) -> dict:
+        """Every retained trace (buffer + slow ring, deduped by trace
+        id) as a Chrome trace-event payload — the ``/debug/trace``
+        body, and what :meth:`dump` writes."""
         with self._lock:
             traces = self.buffer.items()
             slow = [e["trace"] for e in self.slow]
@@ -370,8 +378,14 @@ class Tracer:
         for td in slow:
             if td["trace_id"] not in seen:
                 events.extend(_chrome_events(td))
+        return {"traceEvents": events}
+
+    def dump(self, path: str) -> str:
+        """Write every retained trace (buffer + slow ring) as Chrome
+        trace-event JSON — openable in chrome://tracing or Perfetto —
+        and return the path."""
         with open(path, "w", encoding="utf-8") as fh:
-            json.dump({"traceEvents": events}, fh, indent=1)
+            json.dump(self.chrome_payload(), fh, indent=1)
         return path
 
 

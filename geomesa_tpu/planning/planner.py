@@ -74,6 +74,13 @@ class QueryPlan:
     # — kept SEPARATE from scan time so queue wait is attributable in
     # explain traces and the geomesa.serving.queue_wait histogram
     queue_wait_s: float = 0.0
+    # estimate accountability (docs/observability.md): the stats-sketch
+    # row estimate resolved at plan time (None = no sketch covered the
+    # filter, or geomesa.plan.estimate.enabled off) and the rows the
+    # executed scan actually produced — record_query feeds the pair into
+    # the geomesa.plan.estimate.error histogram + per-index accuracy
+    estimated_rows: Optional[float] = None
+    actual_rows: Optional[int] = None
 
     @property
     def strategy(self) -> str:
@@ -290,6 +297,7 @@ class QueryPlanner:
             exp(f"Planning query on '{type_name}': {type(f).__name__}")
 
             plan = self._select(type_name, f, limit, exp)
+            self._estimate_rows(plan, exp)
             if guard:
                 self.store.apply_guards(plan)
             # degraded mode: a store that quarantined damaged partitions at
@@ -302,6 +310,36 @@ class QueryPlanner:
                     exp.warn(w)
         plan.planning_s = time.perf_counter() - t0
         return plan
+
+    def _estimate_rows(self, plan: QueryPlan, exp) -> None:
+        """Resolve the stats-sketch row estimate for a finished plan
+        (docs/observability.md "Estimate accountability"): the marginal-
+        histogram selectivity product first, the z-prefix sketch of the
+        chosen index as the fallback — the same two tiers
+        ``estimate_count`` trusts. Skipped for id lookups (exact by
+        construction — they would dilute the staleness signal with
+        perfect scores) and disjoint plans (nothing scans)."""
+        from geomesa_tpu import conf
+
+        if not conf.PLAN_ESTIMATE.get():
+            return
+        if plan.ids is not None or (
+            plan.config is not None and plan.config.disjoint
+        ):
+            return
+        stats = self.store.stats_for(plan.type_name)
+        if stats is None:
+            return
+        if isinstance(plan.filter, Include):
+            est = float(stats.total_count())
+        else:
+            sft = self.store.get_schema(plan.type_name)
+            est = stats.estimate_filter(sft, plan.filter)
+            if est is None and plan.index is not None and plan.config is not None:
+                est = stats.estimate_scan(plan.index, plan.config)
+        if est is not None:
+            plan.estimated_rows = float(est)
+            exp(f"Estimated rows: ~{est:.0f} (stats sketch)")
 
     def _check_attr_visibility(self, type_name: str, f: Filter) -> None:
         auths = getattr(self.store, "auths", None)
@@ -540,7 +578,8 @@ class QueryPlanner:
                 with exp.span("Full-table host scan"):
                     mask = plan.filter.evaluate(fc.batch)
             check_deadline(deadline, "full-table scan")
-            with _ospan("decode", candidates=int(mask.sum())):
+            self._note_actual(plan, int(mask.sum()), exp)
+            with _ospan("decode", candidates=plan.actual_rows):
                 return self._post(
                     fc.mask(mask), plan, hints, exp, skip_visibility
                 )
@@ -650,7 +689,29 @@ class QueryPlanner:
             if not bool(np.all(mask)):  # see all-true note above
                 candidates = candidates.mask(mask)
         check_deadline(deadline, "refinement")
+        # estimate accountability: the POST-refinement row count — what
+        # the sketch estimate actually predicts (filter selectivity) —
+        # before _post's limit/visibility stages distort it. The
+        # pre-refinement candidate count would charge index
+        # over-selection (a z2 scan serving a temporal filter) to the
+        # sketches, flagging fresh stats stale forever.
+        self._note_actual(plan, len(candidates), exp)
         return self._post(candidates, plan, hints, exp, skip_visibility)
+
+    @staticmethod
+    def _note_actual(plan, actual: int, exp) -> None:
+        """Record one executed plan's matched-row count next to its
+        sketch estimate (explain line; record_query feeds the pair to
+        the error histogram and the per-index accuracy windows)."""
+        plan.actual_rows = actual
+        if plan.estimated_rows is not None:
+            from geomesa_tpu.obs.accuracy import error_factor
+
+            exp(
+                f"Estimate vs actual: ~{plan.estimated_rows:.0f} est / "
+                f"{actual} matched "
+                f"({error_factor(plan.estimated_rows, actual):.2f}x)"
+            )
 
     # -- pipelined multi-query execution ---------------------------------
     def _is_simple(self, plan: QueryPlan) -> bool:
@@ -807,12 +868,17 @@ class QueryPlanner:
         check_deadline(deadline, "union merge")
         nonempty = [p for p in parts if len(p)]
         if not nonempty:
+            self._note_actual(plan, 0, exp)
             return self._post(parts[0], plan, hints, exp)
         out = nonempty[0] if len(nonempty) == 1 else FeatureCollection.concat(nonempty)
         _, first = np.unique(np.asarray(out.ids), return_index=True)
         if len(first) != len(out):
             exp(f"Union dedup: {len(out)} -> {len(first)} rows")
             out = out.take(np.sort(first))
+        # the union's matched rows BEFORE _post's limit/visibility
+        # stages: record_query's hits fallback would compare the sketch
+        # estimate against a truncated result (see _note_actual)
+        self._note_actual(plan, len(out), exp)
         return self._post(out, plan, hints, exp)
 
     def _post(self, out, plan, hints, exp, skip_visibility: bool = False):

@@ -166,6 +166,13 @@ class QueryScheduler:
         """The current adaptive micro-batch window in seconds."""
         return self._window_s
 
+    @property
+    def queue_depth(self) -> int:
+        """Queries currently waiting in the admission queue (locked
+        read — the ops plane's ``/health`` scheduler check)."""
+        with self._cond:
+            return len(self._queue)
+
     def start(self) -> "QueryScheduler":
         with self._cond:
             if self._closed:

@@ -635,6 +635,14 @@ class LambdaStore:
         pressure while the flush loop runs. Returns the scheduler."""
         return self.cold.serve(config)
 
+    def serve_ops(self, port: int = 0, host: "str | None" = None):
+        """Attach (or return) the ops plane on the cold store with THIS
+        store's streaming surfaces joined in (docs/observability.md):
+        ``/health`` then also watches the hot tier's occupancy against
+        the fold threshold and the WAL's recovery state. Returns the
+        :class:`~geomesa_tpu.obs.ops.OpsServer`."""
+        return self.cold.serve_ops(port=port, host=host, lam=self)
+
     def _cold_query(self, f, hints=None) -> FeatureCollection:
         sched = getattr(self.cold, "scheduler", None)
         if sched is not None and not sched.closed:

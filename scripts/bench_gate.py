@@ -67,6 +67,10 @@ SCENARIO_SPECS = {
         ("off.qps", "higher", ()),
         ("sampled.qps", "higher", ()),
     ],
+    "ops_plane": [
+        ("qps_unscraped", "higher", ()),
+        ("qps_scraped", "higher", ()),
+    ],
     "standing_geofence": [
         ("speedup_vs_naive", "higher", ()),
         ("inverted_us_per_event", "lower", ()),
@@ -108,6 +112,23 @@ FRESH_BOUNDS = {
         ("slow_trace.n_phases", 5.0, "min",
          "a fused batched slow query must show >=5 distinct phases"),
     ],
+    # the ISSUE 15 ops-plane acceptance: a 1 Hz /metrics+/health
+    # scraper costs the serving tier <=5% QPS within the same run;
+    # estimate-vs-actual is recorded for >=99% of executed scans; the
+    # stale-stats trigger fires on a mutated-without-analyze store and
+    # clears after analyze_stats
+    "ops_plane": [
+        ("scraped_over_unscraped", 0.95, "min",
+         "a 1 Hz /metrics+/health scraper must keep >=95% of unscraped QPS"),
+        ("scrapes", 10.0, "min",
+         "the scraped mode must actually have scraped (>=2 per rep)"),
+        ("estimate_coverage", 0.99, "min",
+         "estimate-vs-actual must be recorded for >=99% of executed scans"),
+        ("stale_demonstrated", 1.0, "min",
+         "the stale-stats health reason must fire on the mutated store"),
+        ("stale_cleared", 1.0, "min",
+         "analyze_stats must clear the stale-stats reason"),
+    ],
     # the ISSUE 14 standing-query acceptance: >=1M registered geofences
     # under sustained ingest; inverted matching >=50x cheaper per event
     # than the naive all-subscription evaluation measured in the SAME
@@ -129,6 +150,7 @@ BASELINES = {
     "BENCH_WAL": "BENCH_WAL.json",
     "BENCH_KNN": "BENCH_KNN.json",
     "BENCH_OBS": "BENCH_OBS.json",
+    "BENCH_OPS_PLANE": "BENCH_OPS_PLANE.json",
     "BENCH_GEOFENCE": "BENCH_GEOFENCE.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"

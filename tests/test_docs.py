@@ -658,10 +658,53 @@ def test_observability_doc_honest():
     assert Histogram().quantile(0.99) == 0.0
     # every geomesa.obs.* knob/metric resolves at runtime and is cited
     knobs, metrics = _area_names("geomesa.obs.")
-    assert len(knobs) >= 9 and len(metrics) >= 2, (knobs, metrics)
+    assert len(knobs) >= 12 and len(metrics) >= 3, (knobs, metrics)
     _assert_runtime_declared(knobs)
     _assert_documented("observability.md", knobs + metrics)
     _assert_documented("config.md", knobs)
+    # the ops plane (docs/observability.md "The ops plane"): the APIs
+    # and endpoints the doc tables promise are real
+    for name in ("OpsServer", "TelemetryRecorder", "HealthMonitor",
+                 "EstimateAccuracy", "ops_report", "stats_payload",
+                 "error_factor"):
+        assert hasattr(obs, name), name
+    for m in ("serve_ops", "close", "ops", "accuracy"):
+        assert hasattr(DataStore, m), m
+    for m in ("start", "close", "handle", "port", "url", "closed"):
+        assert hasattr(obs.OpsServer, m), m
+    for m in ("sample", "series", "start", "stop"):
+        assert hasattr(obs.TelemetryRecorder, m), m
+    assert hasattr(obs.HealthMonitor, "evaluate")
+    for m in ("record", "report", "stale", "reset", "sample_count"):
+        assert hasattr(obs.EstimateAccuracy, m), m
+    assert hasattr(obs.Tracer, "chrome_payload")
+    import geomesa_tpu.obs.ops as ops_mod
+
+    doc_text = open(os.path.join(_ROOT, "docs", "observability.md")).read()
+    for endpoint in ("/metrics", "/health", "/stats", "/debug/slow",
+                     "/debug/trace", "/debug/vars", "/debug/audit"):
+        assert endpoint in doc_text, endpoint
+        assert endpoint in inspect.getsource(ops_mod.OpsServer.handle), endpoint
+    # every documented health reason code is a literal the monitor adds
+    monitor_src = inspect.getsource(ops_mod.HealthMonitor.evaluate)
+    for code in ("store.quarantine", "wal.needs_recovery", "slo.breach",
+                 "hot.occupancy", "scheduler.shedding", "scheduler.queue",
+                 "scheduler.saturated", "standing.drops", "stats.stale"):
+        assert code in doc_text, code
+        assert code in monitor_src, code
+    # estimate accountability: the geomesa.plan.* namespace is complete
+    # both directions in both docs
+    plan_knobs, plan_metrics = _area_names("geomesa.plan.")
+    assert len(plan_knobs) == 4 and len(plan_metrics) >= 2, (
+        plan_knobs, plan_metrics,
+    )
+    _assert_runtime_declared(plan_knobs)
+    _assert_documented("observability.md", plan_knobs + plan_metrics)
+    _assert_documented("config.md", plan_knobs)
+    from geomesa_tpu.planning.planner import QueryPlan
+
+    for f in ("estimated_rows", "actual_rows"):
+        assert f in QueryPlan.__dataclass_fields__, f
     # the histogram metrics the doc tables promise render as histograms
     reg = MetricsRegistry()
     for n in ("geomesa.query.scan", "geomesa.serving.queue_wait",

@@ -220,9 +220,24 @@ def _workload(tmp_path, metrics=None):
     loader = BulkLoader(ds, "t", config=PipelineConfig(workers=2))
     loader.put(_fc(sft, 64, seed=1, prefix="b"))
     loader.close()
-    # serving tier: admitted queries cross the scheduler condition
+    # serving tier: admitted queries cross the scheduler condition;
+    # the ops plane mounts alongside (constructed armed) and scrapes
+    # /metrics + /health + /debug/vars WHILE a query is in flight, so
+    # TelemetryRecorder._lock is witnessed under concurrent
+    # scrape+serve (EstimateAccuracy._lock is crossed by every query's
+    # record path — the store has sketches from the write above)
+    import urllib.request
+
     sched = ds.serve()
-    sched.submit("t", "BBOX(geom, -10, -10, 10, 10)").result(30)
+    srv = ds.serve_ops()
+    try:
+        fut = sched.submit("t", "BBOX(geom, -10, -10, 10, 10)")
+        srv.recorder.sample()
+        for path in ("/metrics", "/health", "/debug/vars?window=60"):
+            urllib.request.urlopen(srv.url + path, timeout=10).read()
+        fut.result(30)
+    finally:
+        srv.close()
     # streaming tier over a durably saved cold store, WAL attached,
     # tiny segments so rotation happens (the fixed seal-fsync path),
     # chaos armed at rate=0 so every stream.* fault point consults the
