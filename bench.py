@@ -3136,6 +3136,231 @@ def config5_knn():
     )
 
 
+# --------------------------------------------------- config replica
+
+
+def config_replica(out_path: "str | None" = None):
+    """WAL-shipping replication scenario (docs/replication.md): three
+    measurements in one run, emitted as BENCH_REPLICA.json.
+
+    1. **Read scaling** — the same probe mix runs full-tilt against
+       each store in isolation (leader, follower 1, follower 2; one
+       measured window per store — in deployment each replica is its
+       own host, so in-process thread concurrency would only measure
+       the bench host's GIL/device contention, not topology capacity).
+       Aggregate QPS at two followers (the three rates summed) must
+       clear 1.5x the leader-alone rate: a follower that bootstraps
+       wrong or serves reads an order slower than the leader fails the
+       gate.
+    2. **Bounded staleness** — sustained micro-batch ingest with the
+       shipper and a follower's apply loop running as threads; the
+       follower's measured staleness watermark histogram
+       (``geomesa.replica.staleness.ms``) yields the p99 the gate
+       bounds.
+    3. **Failover** — mid-ingest the leader WAL hard-kills
+       (``wal.crash()``, the kill-9 simulation); a follower promotes
+       with ``leader_wal_dir`` pointing at the dead leader's on-disk
+       WAL. Promote latency is recorded and the gate enforces ZERO
+       acknowledged rows lost and zero rows invented.
+
+    Env knobs: GEOMESA_BENCH_REPLICA_COLD (cold rows),
+    GEOMESA_BENCH_REPLICA_N (streamed rows), GEOMESA_BENCH_REPLICA_BATCH,
+    GEOMESA_BENCH_REPLICA_READ_S (seconds per read topology),
+    GEOMESA_BENCH_REPLICA_OUT (fresh-side output path)."""
+    import shutil
+    import tempfile
+
+    from geomesa_tpu import geometry as geo
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.storage import persist
+    from geomesa_tpu.streaming import (
+        LambdaStore, PipeTransport, ReplicaStore, SegmentShipper,
+        StreamConfig, WalConfig,
+    )
+
+    n_cold = int(os.environ.get("GEOMESA_BENCH_REPLICA_COLD", 60_000))
+    n_stream = int(os.environ.get("GEOMESA_BENCH_REPLICA_N", 40_000))
+    batch = int(os.environ.get("GEOMESA_BENCH_REPLICA_BATCH", 2_000))
+    read_s = float(os.environ.get("GEOMESA_BENCH_REPLICA_READ_S", 2.0))
+    t0_ms = 1_717_200_000_000
+    spec = "name:String,dtg:Date,*geom:Point:srid=4326"
+    tmp = tempfile.mkdtemp(prefix="geomesa_replica_bench_")
+
+    rng = np.random.default_rng(SEED + 98)
+    ds = DataStore()
+    sft = FeatureType.from_spec("rv", spec)
+    ds.create_schema(sft)
+    ds.write("rv", FeatureCollection.from_columns(
+        sft, np.arange(n_cold).astype(str), {
+            "name": np.array(["v"] * n_cold),
+            "dtg": t0_ms + rng.integers(0, 86_400_000, n_cold),
+            "geom": (rng.uniform(-170, 170, n_cold),
+                     rng.uniform(-80, 80, n_cold)),
+        }), check_ids=False)
+    ds.compact("rv")
+    root = os.path.join(tmp, "s")
+    persist.save(ds, root)
+    lam = LambdaStore(
+        ds, "rv", config=StreamConfig(),
+        wal_dir=os.path.join(root, "_wal"),
+        wal_config=WalConfig(sync="always"),
+    )
+    ship = SegmentShipper(lam, giveup_s=2.0)
+    fols = []
+    for i in range(2):
+        a, b = PipeTransport.pair()
+        fol = ReplicaStore(
+            root, os.path.join(tmp, f"f{i}", "_wal"), b, type_name="rv",
+            config=StreamConfig(),
+        )
+        ship.attach(a, name=f"f{i}")
+        fols.append(fol)
+    ship.pump()
+    for fol in fols:
+        fol.drain()
+
+    # 1. read scaling: each store measured full-tilt in isolation,
+    # aggregate = the summed independent rates (see docstring)
+    probes = [
+        "bbox(geom, -40, -40, 0, 0)", "bbox(geom, 10, 10, 60, 50)",
+        "bbox(geom, -170, -80, -100, 0)",
+    ]
+    for store in (lam, *fols):
+        for q in probes:
+            store.query(q)  # warm the scan kernels per store
+    # exactness: a caught-up follower answers every probe with exactly
+    # the leader's ids (the `identical` flag the gate enforces)
+    reads_identical = all(
+        sorted(str(i) for i in fol.query(q).ids.tolist())
+        == sorted(str(i) for i in lam.query(q).ids.tolist())
+        for q in probes for fol in fols
+    )
+
+    def measure(store):
+        n = 0
+        t0 = time.perf_counter()
+        while True:
+            store.query(probes[n % len(probes)])
+            n += 1
+            dt = time.perf_counter() - t0
+            if dt >= read_s:
+                return n / dt
+
+    rates = [measure(s) for s in (lam, *fols)]
+    qps = {k: sum(rates[: k + 1]) for k in (0, 1, 2)}
+    scaling = qps[2] / max(qps[0], 1e-9)
+    log(
+        f"[replica] read QPS 0f={qps[0]:,.0f} 1f={qps[1]:,.0f} "
+        f"2f={qps[2]:,.0f} (x{scaling:.2f} at 2 followers)"
+    )
+
+    # 2. bounded staleness under sustained ingest (shipper + apply
+    # threads live), rolling straight into 3. the mid-ingest kill
+    ship.start()
+    for fol in fols:
+        fol.start()
+    acked: list = []
+    kill_at = max(1, (n_stream // batch) * 7 // 10)
+    promoted_s = None
+    for bi, s in enumerate(range(0, n_stream, batch)):
+        k = min(batch, n_stream - s)
+        xs = rng.uniform(-170, 170, k)
+        ys = rng.uniform(-80, 80, k)
+        ids = [f"r{s + j}" for j in range(k)]
+        lam.write(
+            [{"name": "r", "dtg": t0_ms + s + j,
+              "geom": geo.Point(float(xs[j]), float(ys[j]))}
+             for j in range(k)],
+            ids=ids,
+        )
+        acked.extend(ids)  # sync=always: the return IS the ack
+        if bi + 1 == kill_at:
+            lam.wal.crash()  # kill -9: the leader is gone mid-ingest
+            break
+    stale_p99_s = max(
+        fol.metrics.histogram_quantile("geomesa.replica.staleness.ms", 0.99)
+        for fol in fols
+    )
+    ship.stop()
+    for fol in fols:
+        fol.stop()
+    t0 = time.perf_counter()
+    fols[0].promote(leader_wal_dir=os.path.join(root, "_wal"))
+    promoted_s = time.perf_counter() - t0
+    got = {
+        str(i) for i in fols[0].query("INCLUDE").ids.tolist()
+    }
+    attempted = set(acked) | {str(i) for i in range(n_cold)}
+    acked_loss = sum(1 for fid in acked if fid not in got)
+    invented = sum(1 for fid in got if fid not in attempted)
+    # the lagging (non-promoted) follower may be behind but may never
+    # hold a row that was never written
+    lagging = {str(i) for i in fols[1].query("INCLUDE").ids.tolist()}
+    lagging_honest = lagging <= attempted
+    log(
+        f"[replica] staleness p99 {stale_p99_s * 1e3:.1f} ms; promote "
+        f"{promoted_s * 1e3:.0f} ms, acked={len(acked):,} "
+        f"loss={acked_loss} invented={invented}"
+    )
+    lam.flusher.close()
+    for fol in fols:
+        fol.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    rows = [
+        {
+            "scenario": "replica_scaling",
+            "cold_rows": n_cold, "read_s": read_s,
+            "qps_0f": round(qps[0], 1), "qps_1f": round(qps[1], 1),
+            "qps_2f": round(qps[2], 1),
+            "qps_scaling_2f": round(scaling, 3),
+            "identical": bool(reads_identical),
+        },
+        {
+            "scenario": "replica_staleness",
+            "streamed_rows": len(acked), "batch": batch,
+            "staleness_p99_ms": round(stale_p99_s * 1e3, 2),
+            "identical": bool(lagging_honest),
+        },
+        {
+            "scenario": "replica_failover",
+            "promote_s": round(promoted_s, 4),
+            "acked_rows": len(acked),
+            "acked_loss": int(acked_loss), "invented": int(invented),
+            "identical": bool(acked_loss == 0 and invented == 0),
+        },
+    ]
+
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": rows}
+    if out_path is None:
+        out_path = os.environ.get(
+            "GEOMESA_BENCH_REPLICA_OUT"
+        ) or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_REPLICA.json",
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec_line = {
+        "metric": "replica_qps_scaling_2f",
+        "value": rows[0]["qps_scaling_2f"],
+        "unit": "x",
+        "staleness_p99_ms": rows[1]["staleness_p99_ms"],
+        "promote_s": rows[2]["promote_s"],
+        "acked_loss": int(acked_loss), "invented": int(invented),
+    }
+    print(json.dumps(rec_line), flush=True)
+    return rec_line
+
+
 def child_main():
     """One bench attempt in THIS process (device init + all configs)."""
     import threading
@@ -3173,7 +3398,7 @@ def child_main():
         "fused": config_fused, "pip_join": config_pip_join,
         "stream": config_stream, "wal": config_wal, "knn": config_knn,
         "obs": config_obs, "standing": config_standing,
-        "ops": config_ops,
+        "ops": config_ops, "replica": config_replica,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

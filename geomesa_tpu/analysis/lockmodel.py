@@ -101,6 +101,17 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
     _d("DataStore._id_lock", "geomesa_tpu/datastore.py", 12,
        doc="per-chunk id-index entry cache only; readers skip the "
            "write lock"),
+    _d("SegmentShipper._lock", "geomesa_tpu/streaming/replica.py", 14,
+       fields=("_followers", "_gave_up", "_seq"),
+       doc="shipper bookkeeping only (follower table, give-up report, "
+           "attach ids); never held across WAL reads, transport sends "
+           "or metrics — the pump snapshots under it then ships "
+           "outside"),
+    _d("ReplicaStore._apply_lock", "geomesa_tpu/streaming/replica.py", 16,
+       fields=("_replayed", "_term", "_marks"),
+       doc="follower watermark state (replayed seqno, witnessed term, "
+           "staleness marks); pure bookkeeping — apply/promote do all "
+           "store, WAL and file work OUTSIDE it"),
     _d("QueryScheduler._cond", "geomesa_tpu/serving/scheduler.py", 20,
        hot=True,
        fields=("_queue", "_closed", "_thread"),
@@ -134,13 +145,13 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
            "registration on replay; held AROUND the WAL appends and "
            "SubscriptionIndex mutations those paths make (rank above)"),
     _d("WriteAheadLog._sync_lock", "geomesa_tpu/streaming/wal.py", 40,
-       fields=("_synced_seq", "_last_sync_t"),
+       fields=("_synced_seq", "_last_sync_t", "_durable_bytes"),
        doc="commit (write+fsync) order; fsync happens HERE, never under "
            "the append lock"),
     _d("WriteAheadLog._lock", "geomesa_tpu/streaming/wal.py", 42,
        hot=True,
        fields=("_buffer", "_pending", "_closed", "_fd", "_active_path",
-               "_active_start", "_active_bytes", "_last_seq"),
+               "_active_start", "_active_bytes", "_last_seq", "_term"),
        doc="append buffer/seqno/fd state: every acknowledged write "
            "crosses it, so nothing may block while holding it"),
     _d("SubscriptionIndex._lock", "geomesa_tpu/streaming/standing.py", 44,

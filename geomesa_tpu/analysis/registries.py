@@ -102,6 +102,11 @@ FAULT_POINTS: dict[str, str] = {
     # standing-query matching (streaming/standing.py; docs/standing.md)
     "standing.match": "before a batch's route+match pipeline runs",
     "standing.deliver": "before a batch's alerts enqueue/windows fold",
+    # WAL shipping / replication (streaming/replica.py; docs/replication.md)
+    "replica.ship.segment": "before the shipper reads a segment chunk",
+    "replica.apply": "before a follower appends+applies a shipped chunk",
+    "replica.promote": "at the entry of a follower's promotion",
+    "replica.fence": "before a stale-term shipment is refused",
 }
 
 # metric instrument methods on MetricsRegistry, by instrument kind

@@ -316,6 +316,38 @@ STREAM_INCREMENTAL = SystemProperty(
 )
 
 
+# -- replication: WAL shipping, read replicas, failover
+# (geomesa_tpu.streaming.replica; docs/replication.md) ---------------------
+
+REPLICA_SHIP_CHUNK_BYTES = SystemProperty(
+    "geomesa.replica.ship.chunk.bytes", 256 << 10, int,
+    "SegmentShipper transfer granularity: WAL segment bytes stream to "
+    "followers in frames of at most this many payload bytes (each "
+    "length-prefixed + checksummed), so one huge sealed segment never "
+    "monopolizes the transport between staleness marks",
+)
+REPLICA_SHIP_INTERVAL_MS = SystemProperty(
+    "geomesa.replica.ship.interval.ms", 25.0, float,
+    "SegmentShipper pump cadence: every tick ships newly durable WAL "
+    "bytes to each follower and broadcasts a staleness mark (the "
+    "leader's applied horizon + wall clock) — the floor of follower "
+    "staleness under an idle leader",
+)
+REPLICA_STALENESS_MAX_MS = SystemProperty(
+    "geomesa.replica.staleness.max.ms", 5000.0, float,
+    "follower health threshold: a ReplicaStore whose measured staleness "
+    "watermark exceeds this degrades /health with a replica.staleness "
+    "reason (docs/replication.md); 0 disables the check",
+)
+REPLICA_GIVEUP_S = SystemProperty(
+    "geomesa.replica.giveup.s", 10.0, float,
+    "SegmentShipper retry budget per pump, in seconds (fault."
+    "with_retries max_elapsed_s): past it the shipper stops retrying "
+    "that follower for the tick and trips the replica.ship.giveup "
+    "/health reason instead of spinning in backoff forever",
+)
+
+
 # -- observability: tracing / slow-query log / SLOs (geomesa_tpu.obs;
 # docs/observability.md) ---------------------------------------------------
 
@@ -374,6 +406,12 @@ OBS_SLO_STANDING_P99_MS = SystemProperty(
     "default standing-query alert objective: geomesa.standing.latency "
     "p99 (batch arrival -> alerts delivered, docs/standing.md) must "
     "stay at or under this (0 drops it)",
+)
+OBS_SLO_REPLICA_STALENESS_P99_MS = SystemProperty(
+    "geomesa.obs.slo.replica.staleness.p99.ms", 2000.0, float,
+    "default replication objective: geomesa.replica.staleness.ms p99 "
+    "(a follower's measured staleness watermark, docs/replication.md) "
+    "must stay at or under this (0 drops it)",
 )
 
 

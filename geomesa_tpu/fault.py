@@ -402,6 +402,7 @@ def with_retries(
     sleep: Callable = time.sleep,
     metrics=None,
     rng: Optional[Callable] = None,
+    max_elapsed_s: Optional[float] = None,
 ):
     """Run ``fn()`` with bounded decorrelated-jitter retries on transient
     IO errors (the reference's client retry policies around region-server
@@ -415,10 +416,21 @@ def with_retries(
     lockstep (the thundering-herd fix). ``rng(lo, hi)`` overrides the
     draw for deterministic tests (default: ``random.uniform``).
 
+    ``max_elapsed_s`` is a TOTAL elapsed-time budget on top of the
+    attempt count: once ``fn()`` has been failing for that long, the
+    next transient failure re-raises immediately instead of sleeping —
+    an io_error storm can no longer spin a caller in backoff far past
+    its deadline (the replication SegmentShipper's bounded give-up,
+    docs/replication.md). The budget is checked between attempts, never
+    mid-``fn()``.
+
     Observability: ``geomesa.fault.retry`` counts every absorbed
     transient failure, ``geomesa.fault.retries_exhausted`` every
-    operation re-raised past its budget; ``metrics`` is a
-    MetricsRegistry (None = the process-global fallback)."""
+    operation re-raised past its attempt budget;
+    ``geomesa.fault.retry.giveup.ms`` records (in seconds, histogram
+    convention) the total time burned whenever EITHER budget gives up;
+    ``metrics`` is a MetricsRegistry (None = the process-global
+    fallback)."""
     from geomesa_tpu.metrics import resolve
 
     if attempts is None:
@@ -432,12 +444,19 @@ def with_retries(
     attempts = max(1, attempts)
     cap = backoff_s * (2 ** (attempts - 1))
     prev = backoff_s
+    t0 = time.monotonic()
     for attempt in range(attempts):
         try:
             return fn()
         except retry_on:
-            if attempt == attempts - 1:
+            elapsed = time.monotonic() - t0
+            if attempt == attempts - 1 or (
+                max_elapsed_s is not None and elapsed >= max_elapsed_s
+            ):
                 resolve(metrics).counter("geomesa.fault.retries_exhausted")
+                resolve(metrics).observe(
+                    "geomesa.fault.retry.giveup.ms", elapsed
+                )
                 raise
             resolve(metrics).counter("geomesa.fault.retry")
             prev = rng(backoff_s, max(min(cap, prev * 3), backoff_s))

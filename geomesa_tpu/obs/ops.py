@@ -239,6 +239,36 @@ class HealthMonitor:
                     f"{HOT_OCCUPANCY_FACTOR:g}x the {fold_rows}-row fold "
                     "threshold — flushes are falling behind ingest",
                 )
+            # replication (docs/replication.md): a follower's measured
+            # staleness watermark vs its bound, and the leader-side
+            # shipper's bounded give-up
+            replica = getattr(lam, "replica", None)
+            if replica is not None:
+                limit = float(conf.REPLICA_STALENESS_MAX_MS.get())
+                st = replica.staleness_ms()
+                if limit > 0 and (st is None or st > limit):
+                    detail = (
+                        "staleness unmeasured — no leader mark received "
+                        "yet" if st is None
+                        else f"measured staleness {st:.0f}ms > {limit:g}ms"
+                    )
+                    add(
+                        "replica.staleness", "degraded",
+                        f"{detail} (geomesa.replica.staleness.max.ms): "
+                        f"replayed seqno {replica.replayed} lags the "
+                        "leader — reads here answer from the past",
+                    )
+            shipper = getattr(lam, "shipper", None)
+            if shipper is not None:
+                stuck = shipper.gave_up_report()
+                if stuck:
+                    add(
+                        "replica.ship.giveup", "degraded",
+                        "segment shipping exhausted its retry budget "
+                        f"(geomesa.replica.giveup.s) for follower(s) "
+                        f"{sorted(stuck)} — they fall stale until the "
+                        "transport recovers",
+                    )
         # SLO objectives (the fsync-lag burn surface rides here)
         slo = store.slo_report()
         for row in slo["objectives"]:
@@ -313,6 +343,13 @@ class HealthMonitor:
                 "rows": len(lam.hot),
                 "fold_rows": int(lam.config.fold_rows),
             }
+            replica = getattr(lam, "replica", None)
+            if replica is not None:
+                out["replica"] = {
+                    "staleness_ms": replica.staleness_ms(),
+                    "replayed": replica.replayed,
+                    "term": replica.term,
+                }
         return out
 
 

@@ -55,7 +55,8 @@ def default_objectives() -> list[SloObjective]:
     """The knob-configured default objectives (a 0 ms knob drops its
     objective): query latency p99, fold-slice pause p99, WAL fsync p99
     — the three tail surfaces the streaming campaign pinned — plus the
-    standing-query alert-latency p99 (docs/standing.md)."""
+    standing-query alert-latency p99 (docs/standing.md) and the
+    replica staleness p99 (docs/replication.md)."""
     out = []
     q = float(conf.OBS_SLO_QUERY_P99_MS.get())
     if q > 0:
@@ -74,6 +75,12 @@ def default_objectives() -> list[SloObjective]:
     if s > 0:
         out.append(SloObjective(
             "standing_alert_p99", "geomesa.standing.latency", 0.99, s / 1e3
+        ))
+    r = float(conf.OBS_SLO_REPLICA_STALENESS_P99_MS.get())
+    if r > 0:
+        out.append(SloObjective(
+            "replica_staleness_p99", "geomesa.replica.staleness.ms",
+            0.99, r / 1e3,
         ))
     return out
 

@@ -27,11 +27,23 @@ ingest meeting live queries:
   batch to a tiny candidate set over millions of persistent
   geofence/proximity/tube subscriptions, matched in fused kernel
   dispatches with windowed continuous aggregation and bounded alert
-  delivery (round 14; docs/standing.md).
+  delivery (round 14; docs/standing.md);
+- :class:`SegmentShipper` / :class:`ReplicaStore` /
+  :class:`PipeTransport` / :class:`SocketTransport` — WAL shipping to
+  read replicas with a measured staleness watermark and term-fenced
+  kill-the-leader failover (round 16; docs/replication.md).
 """
 
 from geomesa_tpu.streaming.cache import StreamingFeatureCache
 from geomesa_tpu.streaming.flush import StreamConfig, StreamFlusher
+from geomesa_tpu.streaming.replica import (
+    PipeTransport,
+    ReplicaError,
+    ReplicaStore,
+    SegmentShipper,
+    SocketTransport,
+    StaleRead,
+)
 from geomesa_tpu.streaming.standing import (
     AlertQueue,
     StandingConfig,
@@ -50,5 +62,6 @@ __all__ = [
     "LambdaStore", "FeatureStream", "WalConfig", "WriteAheadLog",
     "Subscription", "SubscriptionIndex", "StandingConfig",
     "StandingQueryEngine", "WindowSpec", "WindowedAggregator",
-    "AlertQueue",
+    "AlertQueue", "SegmentShipper", "ReplicaStore", "PipeTransport",
+    "SocketTransport", "StaleRead", "ReplicaError",
 ]

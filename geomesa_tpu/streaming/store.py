@@ -33,6 +33,113 @@ log = logging.getLogger(__name__)
 WAL_DIR = "_wal"  # default WAL location under a store root
 
 
+class RecordApplier:
+    """Incremental WAL-record applier: ONE implementation of the record
+    semantics (upsert/delete/expire/watermark/subscription), shared by
+    open-time recovery (:meth:`LambdaStore._replay`) and a follower's
+    continuous replay (:class:`~geomesa_tpu.streaming.replica.
+    ReplicaStore`, docs/replication.md) — the follower really is
+    "recovery that never stops", byte-for-byte the same apply path.
+
+    Stateful so records can arrive in chunks over time: contiguous
+    upsert records coalesce into bulk hot-tier applies of up to
+    ``geomesa.stream.wal.replay.batch.rows`` rows
+    (``StreamingFeatureCache.replay_upsert``: one lock hold, one
+    vectorized grid-index pass — the PR 14 replay speedup); the pending
+    batch always drains before any non-upsert record applies, so
+    ordering semantics match record-at-a-time application exactly.
+    Callers that stop feeding records MUST call :meth:`drain` to flush
+    the trailing upsert batch."""
+
+    def __init__(self, store: "LambdaStore"):
+        from geomesa_tpu import conf
+
+        self.store = store
+        self.batch_rows = int(conf.STREAM_WAL_REPLAY_BATCH.get())
+        self._geom_field = store.hot.sft.geom_field
+        self._pend_rows: list = []
+        self._pend_ids: list = []
+        self._pend_xy: list = []
+        self._pend_nid = 0
+
+    def drain(self) -> None:
+        """Apply the pending coalesced upsert batch (bulk one-lock
+        apply + next-id bump). Idempotent when empty."""
+        if not self._pend_ids:
+            return
+        xy = None
+        if self._pend_xy and all(a is not None for a in self._pend_xy):
+            xy = (
+                self._pend_xy[0] if len(self._pend_xy) == 1
+                else np.concatenate(self._pend_xy)
+            )
+        self.store.hot.replay_upsert(self._pend_rows, self._pend_ids, xy=xy)
+        self.store.hot.bump_next_id(self._pend_nid)
+        self._pend_rows, self._pend_ids = [], []
+        self._pend_xy, self._pend_nid = [], 0
+
+    def apply(self, rec: Mapping) -> None:
+        """Apply one WAL record to the store (coalescing upserts —
+        see :meth:`drain`). Unknown kinds are ignored, matching
+        ``WriteAheadLog.replay``'s forward-compatibility contract."""
+        store = self.store
+        kind = rec.get("k")
+        if kind == "u":
+            if self.batch_rows <= 0:  # round-10 record-at-a-time path
+                store.hot.upsert(unpack_upsert(rec), rec["ids"])
+                store.hot.bump_next_id(rec.get("nid", 0))
+                return
+            from geomesa_tpu.streaming.wal import unpack_upsert_xy
+
+            rows, xy = unpack_upsert_xy(rec, self._geom_field)
+            self._pend_rows.extend(rows)
+            self._pend_ids.extend(rec["ids"])
+            self._pend_xy.append(xy)
+            self._pend_nid = max(self._pend_nid, int(rec.get("nid", 0)))
+            if len(self._pend_ids) >= self.batch_rows:
+                self.drain()
+            return
+        self.drain()
+        if kind in ("d", "x"):  # delete/expiry sweep: same effect
+            store.hot.delete(rec["ids"])
+        elif kind == "w":
+            pairs = store.hot.snapshot_pairs(rec["ids"])
+            if pairs:
+                store.flusher.flush(
+                    pairs, incremental=bool(rec.get("inc", True))
+                )
+                store._known_cold.update(fid for fid, _ in pairs)
+                store.hot.evict(pairs)
+        elif kind == "s":
+            rm = rec.get("rm")
+            if rm is not None:
+                if store._standing is not None:
+                    store._standing.unregister(str(rm))
+                with store._sub_lock:
+                    store._sub_records.pop(str(rm), None)
+            else:
+                from geomesa_tpu.streaming.standing import Subscription
+
+                try:
+                    store.standing().register(
+                        Subscription.from_record(rec["sub"])
+                    )
+                except (ValueError, TypeError, KeyError):
+                    # a body that cannot register was never
+                    # acknowledged (subscribe() validates before
+                    # logging; an old/hand-written WAL may still
+                    # carry one) — skipping loses nothing, while
+                    # raising would poison every recovery
+                    log.warning(
+                        "skipping unregistrable WAL subscription "
+                        "record %r", rec.get("sub", {}).get("id"),
+                        exc_info=True,
+                    )
+                    return
+                with store._sub_lock:
+                    store._sub_records[str(rec["sub"]["id"])] = rec["sub"]
+
+
 class LambdaStore:
     """Hot/cold hybrid: transient streaming cache + persistent DataStore
     (reference LambdaDataStore). Writes land hot; ``flush()`` (alias
@@ -474,7 +581,10 @@ class LambdaStore:
                 expiry_ms: Optional[int] = None,
                 config: "StreamConfig | None" = None,
                 wal_config: "WalConfig | None" = None,
-                on_damage: str = "quarantine", **load_kwargs) -> "LambdaStore":
+                on_damage: str = "quarantine",
+                on_progress=None,
+                quarantine_root: "str | None" = None,
+                **load_kwargs) -> "LambdaStore":
         """Open-time crash recovery: load the cold store from ``root``
         (the verified v3 path — quarantine + degraded health on damage),
         open the WAL at ``wal_dir`` (default ``<root>/_wal``), and
@@ -486,7 +596,13 @@ class LambdaStore:
         same cold tables). Torn WAL tails truncate; checksum-damaged
         tails quarantine under ``<root>/_quarantine/_wal/`` and surface
         on ``cold.store_health``. The returned store continues logging
-        to the same WAL."""
+        to the same WAL.
+
+        ``on_progress(seqno, segment, bytes)`` (optional) fires after
+        each replayed segment so long catch-ups report instead of going
+        dark; replay progress also lands on the
+        ``geomesa.replica.replay.progress`` gauge (auto-sampled into
+        ``/debug/vars`` by the TelemetryRecorder — docs/replication.md)."""
         from geomesa_tpu.storage import persist
 
         cold = persist.load(root, on_damage=on_damage, **load_kwargs)
@@ -503,11 +619,15 @@ class LambdaStore:
         wal = WriteAheadLog(
             wal_dir, config=wal_config,
             metrics=getattr(cold, "metrics", None),
-            quarantine_root=str(root),
+            # a replica replaying a SHARED checkpoint root quarantines
+            # into its own directory, not the leader's (docs/replication.md)
+            quarantine_root=(
+                str(root) if quarantine_root is None else str(quarantine_root)
+            ),
         )
         store = cls(cold, type_name, expiry_ms=expiry_ms, config=config,
                     wal=wal)
-        store._replay()
+        store._replay(on_progress=on_progress)
         if wal.damage:
             # WAL damage joins the store's health surface (type "_wal"):
             # the operator sees ONE degraded-status report for disk and
@@ -515,111 +635,42 @@ class LambdaStore:
             cold.health.damage.extend(wal.damage)
         return store
 
-    def _replay(self) -> None:
-        """Apply the WAL's post-checkpoint records in order: upserts/
-        deletes/expiry sweeps rebuild the hot tier; flush watermarks
-        re-publish exactly the batch the live store published (through
-        the same flusher + fold), so hot/cold placement matches the
-        never-crashed store; subscription records rebuild the
-        SubscriptionIndex. Idempotent: replaying records whose effects
-        are already in the loaded cold store converges to the same
-        query results (latest-wins upserts, identity-checked evicts).
+    def _replay(self, on_progress=None) -> None:
+        """Apply the WAL's post-checkpoint records in order through the
+        shared :class:`RecordApplier`: upserts/deletes/expiry sweeps
+        rebuild the hot tier; flush watermarks re-publish exactly the
+        batch the live store published (through the same flusher +
+        fold), so hot/cold placement matches the never-crashed store;
+        subscription records rebuild the SubscriptionIndex. Idempotent:
+        replaying records whose effects are already in the loaded cold
+        store converges to the same query results (latest-wins upserts,
+        identity-checked evicts).
 
-        CONTIGUOUS upsert records coalesce into bulk hot-tier applies
-        of up to ``geomesa.stream.wal.replay.batch.rows`` rows
-        (``StreamingFeatureCache.replay_upsert``: one lock hold, one
-        vectorized grid-index pass) — record-at-a-time application was
-        the replay bottleneck (BENCH_WAL ``wal_replay``); ordering
-        semantics are unchanged because the pending batch always drains
-        before any non-upsert record applies. The whole replay runs in
-        the hot tier's replay mode (``begin_replay``/``end_replay``):
-        grid-index churn for rows a later flush watermark evicts again
-        is skipped, and the index rebuilds once from the survivors."""
-        from geomesa_tpu import conf
-        from geomesa_tpu.streaming.wal import unpack_upsert_xy
+        The whole replay runs in the hot tier's replay mode
+        (``begin_replay``/``end_replay``): grid-index churn for rows a
+        later flush watermark evicts again is skipped, and the index
+        rebuilds once from the survivors. (A follower's CONTINUOUS
+        replay uses the same applier WITHOUT replay mode — it serves
+        reads while applying, so the index must stay live.)
 
-        batch_rows = int(conf.STREAM_WAL_REPLAY_BATCH.get())
-        pend_rows: list = []
-        pend_ids: list = []
-        pend_xy: list = []
-        pend_nid = 0
+        Per-segment progress lands on the
+        ``geomesa.replica.replay.progress`` gauge (latest replayed
+        seqno) and the optional ``on_progress(seqno, segment, bytes)``
+        callback."""
+        applier = RecordApplier(self)
+        metrics = getattr(self.cold, "metrics", None)
 
-        def drain_pending() -> None:
-            nonlocal pend_rows, pend_ids, pend_xy, pend_nid
-            if not pend_ids:
-                return
-            xy = None
-            if pend_xy and all(a is not None for a in pend_xy):
-                xy = (
-                    pend_xy[0] if len(pend_xy) == 1
-                    else np.concatenate(pend_xy)
-                )
-            self.hot.replay_upsert(pend_rows, pend_ids, xy=xy)
-            self.hot.bump_next_id(pend_nid)
-            pend_rows, pend_ids, pend_xy, pend_nid = [], [], [], 0
+        def progress(seq: int, segment: str, read: int) -> None:
+            if metrics is not None:
+                metrics.gauge("geomesa.replica.replay.progress", seq)
+            if on_progress is not None:
+                on_progress(seq, segment, read)
 
-        geom_field = self.hot.sft.geom_field
         self.hot.begin_replay()
         try:
-            for rec in self.wal.replay():
-                kind = rec.get("k")
-                if kind == "u":
-                    if batch_rows <= 0:  # round-10 record-at-a-time path
-                        self.hot.upsert(unpack_upsert(rec), rec["ids"])
-                        self.hot.bump_next_id(rec.get("nid", 0))
-                        continue
-                    rows, xy = unpack_upsert_xy(rec, geom_field)
-                    pend_rows.extend(rows)
-                    pend_ids.extend(rec["ids"])
-                    pend_xy.append(xy)
-                    pend_nid = max(pend_nid, int(rec.get("nid", 0)))
-                    if len(pend_ids) >= batch_rows:
-                        drain_pending()
-                    continue
-                drain_pending()
-                if kind in ("d", "x"):  # delete/expiry sweep: same effect
-                    self.hot.delete(rec["ids"])
-                elif kind == "w":
-                    pairs = self.hot.snapshot_pairs(rec["ids"])
-                    if pairs:
-                        self.flusher.flush(
-                            pairs, incremental=bool(rec.get("inc", True))
-                        )
-                        self._known_cold.update(fid for fid, _ in pairs)
-                        self.hot.evict(pairs)
-                elif kind == "s":
-                    rm = rec.get("rm")
-                    if rm is not None:
-                        if self._standing is not None:
-                            self._standing.unregister(str(rm))
-                        with self._sub_lock:
-                            self._sub_records.pop(str(rm), None)
-                    else:
-                        from geomesa_tpu.streaming.standing import (
-                            Subscription,
-                        )
-
-                        try:
-                            self.standing().register(
-                                Subscription.from_record(rec["sub"])
-                            )
-                        except (ValueError, TypeError, KeyError):
-                            # a body that cannot register was never
-                            # acknowledged (subscribe() validates before
-                            # logging; an old/hand-written WAL may still
-                            # carry one) — skipping loses nothing, while
-                            # raising would poison every recovery
-                            log.warning(
-                                "skipping unregistrable WAL subscription "
-                                "record %r", rec.get("sub", {}).get("id"),
-                                exc_info=True,
-                            )
-                            continue
-                        with self._sub_lock:
-                            self._sub_records[str(rec["sub"]["id"])] = (
-                                rec["sub"]
-                            )
-            drain_pending()
+            for rec in self.wal.replay(on_progress=progress):
+                applier.apply(rec)
+            applier.drain()
         finally:
             # rebuild even after a partial replay (a chaos fault mid-
             # replay): the index must reflect the applied prefix

@@ -27,9 +27,14 @@ and the WAL agrees with the LSM flush policy on what is cold-resident),
 ``s`` (standing-query subscription registration/removal — replay
 rebuilds the SubscriptionIndex, docs/standing.md; checkpoints re-log
 the live subscription set above their cover so segment retirement
-never drops a registration), ``c`` (checkpoint watermark: the cold
-store was durably saved through the crash-safe v3 path — the ONLY
-record that retires segments).
+never drops a registration), ``t`` (a leadership **term** bump —
+monotonic fencing for replication failover, docs/replication.md: a
+promoted follower durably records its new term before accepting
+writes, and a deposed leader's late shipments are refused by term),
+``c`` (checkpoint watermark: the cold store was durably saved through
+the crash-safe v3 path — the ONLY record that retires segments; it
+also carries the current term, so retiring the segment holding a
+``t`` record never loses the fence).
 Geometry values serialize as WKB (bit-exact; WKT's fixed decimal
 formatting is not), everything else as tagged JSON.
 
@@ -338,8 +343,14 @@ class WriteAheadLog:
         self._active_start = 0       # guarded-by: _lock
         self._active_bytes = 0       # guarded-by: _lock
         self._last_seq = -1          # guarded-by: _lock
+        self._term = 0               # guarded-by: _lock
         self._synced_seq = -1        # guarded-by: _sync_lock
         self._last_sync_t = time.monotonic()  # guarded-by: _sync_lock
+        # fsync'd byte length of the ACTIVE segment — the shipping
+        # horizon (docs/replication.md): a follower only ever receives
+        # bytes the leader has made durable, so a leader crash can never
+        # leave a follower holding records the restarted leader lost
+        self._durable_bytes = 0      # guarded-by: _sync_lock
         self.damage: list = []  # DamageRecords found while scanning
         #: records past the last checkpoint cover exist on disk — the
         #: store must be opened through recover() (replay), not the
@@ -442,9 +453,14 @@ class WriteAheadLog:
                     break
             scan = sealed + records  # append order across segments
             cover = -1
+            term = 0
             for r in scan:
                 if r.get("k") == "c":
                     cover = int(r.get("cover", r.get("s", -1)))
+                if r.get("k") in ("t", "c") and "term" in r:
+                    term = max(term, int(r["term"]))
+            with self._lock:
+                self._term = term
             self.needs_recovery = not clean or any(
                 int(r.get("s", -1)) > cover
                 and r.get("k") in ("u", "d", "x", "s")
@@ -461,6 +477,9 @@ class WriteAheadLog:
                     self._fd = os.open(
                         self._active_path, os.O_WRONLY | os.O_APPEND
                     )
+                # open-time content is on disk by definition — it is the
+                # durable prefix the shipper may stream
+                self._durable_bytes = self._active_bytes
             self._synced_seq = next_seq - 1
 
     def _open_segment_locked(self, start_seq: int) -> None:
@@ -668,11 +687,13 @@ class WriteAheadLog:
                     self._flush_buffer_locked()
                     end = self._last_seq
                     fd, path = self._fd, self._active_path
+                    abytes = self._active_bytes
                 fault.fault_point("stream.wal.sync", path)
                 if (force or self.config.sync != "off") and fd is not None:
                     t0 = time.perf_counter()
                     os.fsync(fd)
                     fsync_s.append(time.perf_counter() - t0)
+                    self._durable_bytes = abytes
                 self._synced_seq = end
                 self._last_sync_t = time.monotonic()
                 self.metrics.counter("geomesa.stream.wal.syncs")
@@ -736,6 +757,7 @@ class WriteAheadLog:
             # sync=always). Records buffered during the fsync have
             # seqnos > end and stay uncovered until their own sync.
             self._synced_seq = end
+            self._durable_bytes = 0  # the fresh active segment
             self._last_sync_t = time.monotonic()
         self.metrics.counter("geomesa.stream.wal.rotations")
 
@@ -777,7 +799,7 @@ class WriteAheadLog:
         single-threaded case)."""
         if cover is None:
             cover = self.last_seq
-        seq = self.append("c", {"cover": int(cover)})
+        seq = self.append("c", {"cover": int(cover), "term": self.term})
         # forced fsync even under sync=off: segments are deleted next —
         # retiring durable records while the watermark (and the active
         # tail) sits in the page cache would turn a power loss into a
@@ -786,14 +808,83 @@ class WriteAheadLog:
         self.retire(cover)
         return seq
 
+    # -- shipping (docs/replication.md) ------------------------------------
+    def ship_state(self) -> dict:
+        """The leader-side shipping snapshot a :class:`~geomesa_tpu.
+        streaming.replica.SegmentShipper` pump reads: the current term,
+        the applied horizon (the staleness reference a follower measures
+        against), a wall-clock stamp, and per segment ``(name,
+        shippable_bytes, sealed)``. The active segment's shippable
+        length is its **durable** (fsync'd) prefix — a follower never
+        receives bytes the leader could still lose (under ``sync=off``
+        the horizon only advances on forced syncs, so followers lag to
+        checkpoints; docs/replication.md's loss-window table)."""
+        with self._sync_lock:
+            with self._lock:
+                active = os.path.basename(self._active_path)
+                horizon = (
+                    min(self._pending) - 1 if self._pending
+                    else self._last_seq
+                )
+                term = self._term
+                durable = int(self._durable_bytes)
+        segments = []
+        for name in self._segments():
+            if name == active:
+                segments.append((name, durable, False))
+            else:
+                try:
+                    size = os.path.getsize(self._seg_path(name))
+                except OSError:
+                    continue
+                segments.append((name, int(size), True))
+        return {
+            "term": term,
+            "horizon": horizon,
+            "wall_ms": int(time.time() * 1000),
+            "segments": segments,
+        }
+
+    @property
+    def term(self) -> int:
+        """The highest leadership term durably recorded in this log
+        (``t`` records, plus the term each checkpoint watermark
+        carries). 0 until a promotion ever happened."""
+        with self._lock:
+            return self._term
+
+    def log_term(self, term: int) -> int:
+        """Durably record a leadership term bump (the promotion fence,
+        docs/replication.md): appended and force-fsync'd BEFORE the
+        promoted store accepts its first write, so a deposed leader's
+        late shipments are refused by every future reopen of this log.
+        Terms are monotonic; a lower value is a promotion-protocol bug."""
+        with self._lock:
+            if int(term) <= self._term:
+                raise WalError(
+                    f"term must be monotonic: have {self._term}, "
+                    f"got {int(term)}"
+                )
+        seq = self.append("t", {"term": int(term)})
+        self.sync(upto=seq, force=True)
+        with self._lock:
+            self._term = max(self._term, int(term))
+        return seq
+
     # -- replay ------------------------------------------------------------
-    def replay(self) -> Iterator[dict]:
+    def replay(self, on_progress=None) -> Iterator[dict]:
         """Yield the decoded records a recovery must apply, in order:
         everything AFTER the last checkpoint watermark (records at or
         before it are already in the durably saved cold store; replaying
         them would be idempotent but wasted). Damage handling per the
         module docstring: torn active tail truncated, checksum tails
-        quarantined, later segments orphaned."""
+        quarantined, later segments orphaned.
+
+        ``on_progress(seqno, segment, bytes)`` — when given — is called
+        once per scanned segment with the highest seqno parsed so far,
+        the segment's file name, and the cumulative bytes read: long
+        catch-ups report instead of going dark
+        (``geomesa.replica.replay.progress``; docs/replication.md)."""
         # records the last checkpoint's save is known to reflect (its
         # COVER seqno, not its position: a record acknowledged between
         # the checkpoint's flush snapshot and its watermark is in
@@ -804,6 +895,7 @@ class WriteAheadLog:
         kept: list[dict] = []
         segs = self._segments()
         damaged = False
+        read_bytes = 0
         for i, name in enumerate(segs):
             path = self._seg_path(name)
             is_active = path == self._active_path
@@ -827,13 +919,20 @@ class WriteAheadLog:
                 continue
             fault.fault_point("stream.wal.replay", path)
             data = self._read_segment(path)
+            read_bytes += len(data)
             recs, bad = _parse_frames(data)
             for r in recs:
-                if r.get("k") == "c":
+                k = r.get("k")
+                if k in ("t", "c") and "term" in r:
+                    with self._lock:
+                        self._term = max(self._term, int(r["term"]))
+                if k == "c":
                     cov = int(r.get("cover", r.get("s", -1)))
                     kept = [q for q in kept if int(q.get("s", -1)) > cov]
-                else:
+                elif k != "t":  # term records carry no store effect
                     kept.append(r)
+            if recs and on_progress is not None:
+                on_progress(int(recs[-1].get("s", -1)), name, read_bytes)
             if bad is not None:
                 offset, reason, detail = bad
                 if reason == "torn" and i == len(segs) - 1:
@@ -864,11 +963,13 @@ class WriteAheadLog:
                 fd, self._fd = self._fd, None
                 self._closed = True
                 end = self._last_seq
+                abytes = self._active_bytes
             if fd is not None:
                 try:
                     os.fsync(fd)
                 finally:
                     os.close(fd)
+                self._durable_bytes = abytes
             self._synced_seq = end
 
     def crash(self) -> None:

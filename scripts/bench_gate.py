@@ -76,6 +76,15 @@ SCENARIO_SPECS = {
         ("inverted_us_per_event", "lower", ()),
         ("matcher_on_rows_per_s", "higher", ()),
     ],
+    # replication: the baseline-compared metric is the SCALING RATIO
+    # (host-speed cancels out; absolute QPS and staleness wall-clock
+    # swing >20% run-to-run on a shared host) — the teeth for
+    # staleness/loss live in FRESH_BOUNDS, which run on every fresh
+    # file; the deterministic row counts pin the bench shape and keep
+    # the scenarios in the identical-flag sweep
+    "replica_scaling": [("qps_scaling_2f", "higher", ())],
+    "replica_staleness": [("streamed_rows", "higher", ())],
+    "replica_failover": [("acked_rows", "higher", ())],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -142,6 +151,24 @@ FRESH_BOUNDS = {
         ("ingest_ratio", 0.9, "min",
          "matcher-on ingest must hold >=0.9x the matcher-off rate"),
     ],
+    # the replication acceptance (docs/replication.md): two followers
+    # must add real aggregate read capacity; the measured staleness
+    # watermark stays bounded under sustained ingest; kill-the-leader
+    # failover loses ZERO acknowledged rows and invents none
+    "replica_scaling": [(
+        "qps_scaling_2f", 1.5, "min",
+        "aggregate read QPS at 2 followers must be >=1.5x leader-alone",
+    )],
+    "replica_staleness": [(
+        "staleness_p99_ms", 2000.0, "max",
+        "follower staleness p99 must stay bounded (the SLO default)",
+    )],
+    "replica_failover": [
+        ("acked_loss", 0.0, "max",
+         "kill-the-leader failover may lose ZERO acknowledged rows"),
+        ("invented", 0.0, "max",
+         "failover may not invent rows that were never written"),
+    ],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -152,6 +179,7 @@ BASELINES = {
     "BENCH_OBS": "BENCH_OBS.json",
     "BENCH_OPS_PLANE": "BENCH_OPS_PLANE.json",
     "BENCH_GEOFENCE": "BENCH_GEOFENCE.json",
+    "BENCH_REPLICA": "BENCH_REPLICA.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

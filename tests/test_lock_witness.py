@@ -39,7 +39,14 @@ from geomesa_tpu.datastore import DataStore
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.sft import FeatureType
 from geomesa_tpu.storage import persist
-from geomesa_tpu.streaming import LambdaStore, StreamConfig, WalConfig
+from geomesa_tpu.streaming import (
+    LambdaStore,
+    PipeTransport,
+    ReplicaStore,
+    SegmentShipper,
+    StreamConfig,
+    WalConfig,
+)
 
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
@@ -252,7 +259,8 @@ def _workload(tmp_path, metrics=None):
     )
     try:
         with fault.chaos(
-            seed=3, rate=0.0, points="stream.*,streaming.*,standing.*"
+            seed=3, rate=0.0,
+            points="stream.*,streaming.*,standing.*,replica.*",
         ):
             # standing tier (docs/standing.md), constructed armed: the
             # subscription index, a continuous window and the alert
@@ -278,6 +286,25 @@ def _workload(tmp_path, metrics=None):
             lam.flush()
             lam.query("BBOX(geom, -30, -30, 30, 30)")
             lam.standing().alerts.drain()
+            # replication tier (docs/replication.md), constructed
+            # armed: the shipper's bookkeeping lock crosses on
+            # attach/pump, the follower's watermark lock on every
+            # applied record
+            end_a, end_b = PipeTransport.pair()
+            ship = SegmentShipper(lam, chunk_bytes=4096)
+            fid = ship.attach(end_a)
+            fol = ReplicaStore(
+                str(root), str(tmp_path / "fw" / "_wal"), end_b,
+                type_name="t",
+                config=StreamConfig(chunk_rows=64, fold_rows=4096),
+            )
+            try:
+                ship.pump()
+                fol.drain()
+                fol.staleness_ms()
+            finally:
+                ship.detach(fid)
+                fol.close()
             lam.checkpoint(str(root))
     finally:
         lam.close()

@@ -1,0 +1,914 @@
+"""WAL shipping: read replicas, bounded staleness, kill-the-leader
+failover (ISSUE 16; docs/replication.md).
+
+The invariants under test:
+
+- **deterministic catch-up**: an empty follower, a mid-log-checkpoint
+  bootstrap, and a restarted follower all converge to the leader's
+  exact row set through the shipped-segment replay path;
+- **damage stays local**: a checksum-damaged shipped chunk quarantines
+  the FOLLOWER's segment copy (the leader stays intact) and the resync
+  protocol re-converges;
+- **fencing**: a promoted follower's term is durable, and a deposed
+  leader's late shipments (lower term) are refused without applying a
+  byte;
+- **zero acked-row loss**: under ``sync=always``, killing the leader at
+  any moment and promoting a follower (finishing replay from the dead
+  leader's durable WAL) loses nothing acknowledged and invents nothing
+  — proven deterministically and under the seeded chaos schedule with
+  a leader + 2-follower topology;
+- **bounded staleness**: the watermark is measured (None = unmeasured,
+  which is NOT fresh), surfaces as the ``replica.staleness`` /health
+  reason, and gates reads via ``max_staleness_ms``.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import conf, fault, geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.obs.ops import HealthMonitor
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.storage import persist
+from geomesa_tpu.streaming import (
+    LambdaStore,
+    PipeTransport,
+    ReplicaStore,
+    SegmentShipper,
+    SocketTransport,
+    StreamConfig,
+    WalConfig,
+)
+from geomesa_tpu.streaming.replica import ReplicaError, StaleRead, _encode_msg
+from geomesa_tpu.streaming.wal import WalError
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64))
+DAY = 86_400_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    fault.injector().reset()
+
+
+def _cold(n=100, seed=0):
+    ds = DataStore()
+    sft = FeatureType.from_spec("t", SPEC)
+    ds.create_schema(sft)
+    if n:
+        rng = np.random.default_rng(seed)
+        ds.write("t", FeatureCollection.from_columns(
+            sft, [f"c{i}" for i in range(n)],
+            {"name": np.array(["n"] * n),
+             "dtg": T0 + rng.integers(0, 30 * DAY, n),
+             "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+        ))
+        ds.compact("t")
+    return ds
+
+
+def _leader(tmp_path, n=100, seed=0, sync="always", seg=1 << 14,
+            fold_rows=4096, metrics=None):
+    """(root, leader LambdaStore) over a durably saved cold store with
+    tiny segments so shipping crosses rotations."""
+    ds = _cold(n=n, seed=seed)
+    ds.metrics = metrics if metrics is not None else MetricsRegistry()
+    root = tmp_path / "s"
+    persist.save(ds, root)
+    lam = LambdaStore(
+        ds, "t",
+        config=StreamConfig(chunk_rows=64, fold_rows=fold_rows),
+        wal_dir=str(root / "_wal"),
+        wal_config=WalConfig(
+            sync=sync, segment_bytes=seg, sync_interval_ms=1e9,
+        ),
+    )
+    return root, lam
+
+
+def _follower(root, tmp_path, name="f1", **kw):
+    """(ReplicaStore, leader-side transport endpoint) over its own
+    replica directory."""
+    kw.setdefault("config", StreamConfig(chunk_rows=64, fold_rows=4096))
+    a, b = PipeTransport.pair()
+    fol = ReplicaStore(
+        str(root), str(tmp_path / name / "_wal"), b, type_name="t", **kw
+    )
+    return fol, a
+
+
+def _rows(k, n=20):
+    rng = np.random.default_rng(k)
+    return [
+        {"name": f"w{k}-{i}", "dtg": T0 + i,
+         "geom": geo.Point(float(rng.uniform(-50, 50)),
+                           float(rng.uniform(-50, 50)))}
+        for i in range(n)
+    ]
+
+
+def _ids(k, n=20):
+    return [f"w{k}-{i}" for i in range(n)]
+
+
+def _names(store) -> dict:
+    fc = store.query("INCLUDE")
+    return dict(zip(
+        (str(i) for i in fc.ids.tolist()),
+        (str(v) for v in np.asarray(fc.columns["name"]).tolist()),
+    ))
+
+
+def _reasons(report) -> set:
+    return {r["reason"] for r in report["reasons"]}
+
+
+# -- the transport SPI ------------------------------------------------------
+
+
+class TestTransport:
+    def test_pipe_roundtrip_and_close(self):
+        a, b = PipeTransport.pair()
+        a.send({"m": "x", "v": 1})
+        assert b.recv() == {"m": "x", "v": 1}
+        assert b.recv() is None
+        b.send({"m": "y"})
+        assert a.recv() == {"m": "y"}
+        a.close()
+        with pytest.raises(OSError):
+            b.send({"m": "z"})
+
+    def test_socket_roundtrip(self):
+        s0, s1 = socket.socketpair()
+        a, b = SocketTransport(s0), SocketTransport(s1)
+        try:
+            a.send({"m": "seg", "off": 0, "data": "QUJD"})
+            assert b.recv(timeout=5.0) == {
+                "m": "seg", "off": 0, "data": "QUJD",
+            }
+            assert b.recv(timeout=0.01) is None
+        finally:
+            a.close(), b.close()
+
+    def test_socket_reassembles_partial_frames(self):
+        s0, s1 = socket.socketpair()
+        b = SocketTransport(s1)
+        try:
+            wire = _encode_msg({"m": "state", "horizon": 7})
+            s0.sendall(wire[:3])
+            assert b.recv(timeout=0.05) is None  # frame still arriving
+            s0.sendall(wire[3:] + _encode_msg({"m": "state", "horizon": 8}))
+            assert b.recv(timeout=5.0) == {"m": "state", "horizon": 7}
+            assert b.recv(timeout=5.0) == {"m": "state", "horizon": 8}
+        finally:
+            s0.close(), b.close()
+
+    def test_socket_damaged_frame_poisons_stream(self):
+        s0, s1 = socket.socketpair()
+        b = SocketTransport(s1)
+        try:
+            wire = bytearray(_encode_msg({"m": "state", "horizon": 7}))
+            wire[-1] ^= 0xFF  # corrupt the checksum
+            s0.sendall(bytes(wire))
+            with pytest.raises(ReplicaError):
+                b.recv(timeout=5.0)
+            # the stream is closed: frame boundaries past damage are
+            # unrecoverable
+            assert b.recv(timeout=0.01) is None
+        finally:
+            s0.close(), b.close()
+
+    def test_listener_accept_connect(self):
+        srv = SocketTransport.listen()
+        try:
+            done: list = []
+
+            def follower_side():
+                end = srv.accept(timeout=5.0)
+                done.append(end.recv(timeout=5.0))
+                end.close()
+
+            t = threading.Thread(target=follower_side)
+            t.start()
+            leader = SocketTransport.connect("127.0.0.1", srv.port)
+            leader.send({"m": "hello", "offsets": {}})
+            t.join(10)
+            leader.close()
+            assert done == [{"m": "hello", "offsets": {}}]
+        finally:
+            srv.close()
+
+
+# -- deterministic catch-up matrix ------------------------------------------
+
+
+class TestCatchUp:
+    def test_empty_follower_catches_up(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        lam.write(_rows(1), ids=_ids(1))
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=512)
+        ship.attach(end)
+        lam.write(_rows(2), ids=_ids(2))
+        ship.pump()
+        fol.drain()
+        assert fol.replayed == lam.wal.last_seq
+        assert _names(fol) == _names(lam)
+        assert fol.staleness_ms() is not None
+        assert fol.metrics.counter_value(
+            "geomesa.replica.applied.records") > 0
+        fol.close(), lam.close()
+
+    def test_midlog_checkpoint_bootstrap(self, tmp_path):
+        """A follower bootstrapping from a checkpoint taken mid-log
+        replays only the live suffix and still converges."""
+        root, lam = _leader(tmp_path, seg=2 << 10)
+        lam.write(_rows(1), ids=_ids(1))
+        lam.checkpoint(root)  # retires covered segments
+        lam.write(_rows(2), ids=_ids(2))
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=512)
+        ship.attach(end)
+        ship.pump()
+        fol.drain()
+        assert _names(fol) == _names(lam)
+        assert fol.replayed == lam.wal.last_seq
+        fol.close(), lam.close()
+
+    def test_restarted_follower_resumes_from_offsets(self, tmp_path):
+        """A restarted follower's hello carries its local segment sizes:
+        the shipper re-sends nothing it already holds."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=512)
+        fid = ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        fol.drain()
+        wal_dir = fol.wal_dir
+        fol.stop()
+        fol.store.close()  # keep the local segment copies on disk
+        ship.detach(fid)
+        fol2, end2 = _follower(root, tmp_path)  # same replica dir
+        assert fol2.wal_dir == wal_dir
+        ship.attach(end2)
+        shipped = ship.pump()  # hello drained, offsets match: 0 payload
+        assert shipped == 0
+        fol2.drain()
+        assert _names(fol2) == _names(lam)
+        fol2.close(), lam.close()
+
+    def test_gap_triggers_resync_and_heals(self, tmp_path):
+        """A seg chunk past the local size (a lost message) must not be
+        applied across the hole: the follower truncates, asks for a
+        re-ship, and converges."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=1 << 20)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        # swallow the first shipped chunk: the follower sees a gap next
+        ship.pump()
+        dropped = fol.transport._inbox.popleft()
+        lam.write(_rows(2), ids=_ids(2))
+        ship.pump()
+        fol.drain()
+        assert fol.metrics.counter_value("geomesa.replica.resync") >= 1
+        ship.pump()  # the resync request re-ships from byte 0
+        fol.drain()
+        assert _names(fol) == _names(lam)
+        assert dropped  # the swallowed bytes were really withheld
+        fol.close(), lam.close()
+
+    def test_duplicate_chunk_is_idempotent(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=1 << 20)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        msgs = list(fol.transport._inbox)
+        fol.drain()
+        before = _names(fol)
+        fol.transport._inbox.extend(msgs)  # replay the whole pump
+        fol.drain()
+        assert _names(fol) == before == _names(lam)
+        fol.close(), lam.close()
+
+    def test_damaged_chunk_quarantines_follower_leader_intact(
+            self, tmp_path):
+        """Checksum damage in a shipped chunk quarantines the FOLLOWER's
+        local copy (its own ``_quarantine/_wal/``, a DamageRecord on its
+        health) and resyncs from the intact leader."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=1 << 20)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        fol.drain()
+        # forge the next chunk: right offset, corrupted frame bytes
+        import base64 as b64
+
+        state = lam.wal.ship_state()
+        name = state["segments"][-1][0]
+        cur = fol._sizes[name]
+        garbage = bytearray(_encode_msg({"k": "u", "s": 10 ** 6}))
+        garbage[-1] ^= 0xFF  # checksum damage, not torn
+        fol._handle({
+            "m": "seg", "term": int(state["term"]), "name": name,
+            "off": int(cur),
+            "data": b64.b64encode(bytes(garbage)).decode("ascii"),
+            "sealed": False,
+        })
+        qdir = os.path.join(fol.replica_root, "_quarantine", "_wal")
+        assert os.path.isdir(qdir) and os.listdir(qdir)
+        assert any(
+            d.type_name == "_wal" and "shipped chunk" in d.detail
+            for d in fol.store.cold.health.damage
+        )
+        assert fol.metrics.counter_value(
+            "geomesa.stream.wal.quarantined") >= 1
+        # the leader never saw the damage; the resync re-converges
+        assert lam.wal.ship_state()["segments"]  # leader WAL intact
+        ship.pump()
+        fol.drain()
+        assert _names(fol) == _names(lam)
+        fol.close(), lam.close()
+
+    def test_checkpoint_manifest_drops_follower_segments(self, tmp_path):
+        """The state mark's segment manifest retires follower-local
+        copies the leader checkpointed away."""
+        root, lam = _leader(tmp_path, seg=2 << 10)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=4096)
+        ship.attach(end)
+        for k in range(1, 5):
+            lam.write(_rows(k), ids=_ids(k))
+        ship.pump()
+        fol.drain()
+        before = set(fol._sizes)
+        assert len(before) > 1, "shrink segment_bytes: no rotation"
+        lam.checkpoint(root)
+        ship.pump()
+        fol.drain()
+        live = {n for n, _, _ in lam.wal.ship_state()["segments"]}
+        retired = before - live
+        assert retired, "the checkpoint retired nothing"
+        after = set(fol._sizes)
+        assert after <= live and not (after & retired)
+        assert sorted(os.listdir(fol.wal_dir)) == sorted(after)
+        fol.close(), lam.close()
+
+
+# -- staleness --------------------------------------------------------------
+
+
+class TestStaleness:
+    def test_unmeasured_until_first_mark(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        assert fol.staleness_ms() is None
+        ship = SegmentShipper(lam)
+        ship.attach(end)
+        ship.pump()
+        fol.drain()
+        st = fol.staleness_ms()
+        assert st is not None and st < 60_000
+        fol.close(), lam.close()
+
+    def test_watermark_semantics_deterministic(self, tmp_path):
+        """Caught-up: staleness measures from the NEWEST fully-replayed
+        mark. Behind every mark: at least as stale as the oldest."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        r = fol.replayed
+        fol._handle({"m": "state", "term": 0, "horizon": r,
+                     "wall_ms": 1_000.0, "segments": []})
+        fol._handle({"m": "state", "term": 0, "horizon": r,
+                     "wall_ms": 2_000.0, "segments": []})
+        fol._handle({"m": "state", "term": 0, "horizon": r + 10,
+                     "wall_ms": 3_000.0, "segments": []})
+        # newest replayed mark is wall=2000; the horizon-ahead mark at
+        # 3000 is pending
+        assert fol.staleness_ms(now_ms=2_500.0) == 500.0
+        with fol._apply_lock:
+            fol._marks.clear()
+            fol._marks.append((r + 10, 4_000.0))
+        # behind even the oldest retained mark: at LEAST that stale
+        assert fol.staleness_ms(now_ms=5_000.0) == 1_000.0
+        fol.close(), lam.close()
+
+    def test_staleness_histogram_observed(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        fol.drain()
+        h = fol.metrics.histograms.get("geomesa.replica.staleness.ms")
+        assert h is not None and h.count >= 1
+        fol.close(), lam.close()
+
+    def test_slo_default_objective_follows_knob(self):
+        from geomesa_tpu.obs.slo import default_objectives
+
+        names = {o.name for o in default_objectives()}
+        assert "replica_staleness_p99" in names
+        obj = next(
+            o for o in default_objectives()
+            if o.name == "replica_staleness_p99"
+        )
+        assert obj.metric == "geomesa.replica.staleness.ms"
+        conf.OBS_SLO_REPLICA_STALENESS_P99_MS.set(0)
+        try:
+            names = {o.name for o in default_objectives()}
+            assert "replica_staleness_p99" not in names
+        finally:
+            conf.OBS_SLO_REPLICA_STALENESS_P99_MS.clear()
+
+    def test_bounded_staleness_read(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        # unmeasured is NOT fresh: the bounded read refuses
+        with pytest.raises(StaleRead):
+            fol.query("INCLUDE", max_staleness_ms=60_000)
+        ship = SegmentShipper(lam)
+        ship.attach(end)
+        ship.pump()
+        fol.drain()
+        assert len(fol.query("INCLUDE", max_staleness_ms=60_000)) == 100
+        # an old watermark refuses a tight bound
+        with fol._apply_lock:
+            fol._marks.clear()
+            fol._marks.append((0, time.time() * 1e3 - 50_000.0))
+        with pytest.raises(StaleRead):
+            fol.query("INCLUDE", max_staleness_ms=10_000)
+        fol.close(), lam.close()
+
+
+# -- /health ----------------------------------------------------------------
+
+
+class TestHealth:
+    def test_staleness_health_reason(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        mon = HealthMonitor(fol.store.cold, lam=fol.store)
+        report = mon.evaluate()
+        assert "replica.staleness" in _reasons(report)
+        assert report["status"] == "degraded"
+        assert "unmeasured" in next(
+            r for r in report["reasons"]
+            if r["reason"] == "replica.staleness"
+        )["detail"]
+        # catch up: the reason clears and the explain line surfaces
+        ship = SegmentShipper(lam)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        fol.drain()
+        report = mon.evaluate()
+        assert "replica.staleness" not in _reasons(report)
+        assert report["replica"]["replayed"] == fol.replayed
+        assert report["replica"]["term"] == fol.term
+        assert report["replica"]["staleness_ms"] is not None
+        # an old watermark degrades again, with the knob in the detail
+        with fol._apply_lock:
+            fol._marks.clear()
+            fol._marks.append((0, time.time() * 1e3 - 60_000.0))
+        report = mon.evaluate()
+        assert "replica.staleness" in _reasons(report)
+        assert any(
+            "geomesa.replica.staleness.max.ms" in r["detail"]
+            for r in report["reasons"]
+        )
+        # knob 0 disables the check entirely
+        conf.REPLICA_STALENESS_MAX_MS.set(0)
+        try:
+            assert "replica.staleness" not in _reasons(mon.evaluate())
+        finally:
+            conf.REPLICA_STALENESS_MAX_MS.clear()
+        fol.close(), lam.close()
+
+    def test_ship_giveup_health_reason(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, giveup_s=0.0)
+        fid = ship.attach(end)
+        mon = HealthMonitor(lam.cold, lam=lam)
+        assert "replica.ship.giveup" not in _reasons(mon.evaluate())
+        fol.transport.close()  # kills both pipe ends
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        assert fid in ship.gave_up_report()
+        assert lam.cold.metrics.counter_value(
+            "geomesa.replica.ship.giveup") >= 1
+        report = mon.evaluate()
+        assert "replica.ship.giveup" in _reasons(report)
+        assert any(
+            "geomesa.replica.giveup.s" in r["detail"]
+            for r in report["reasons"]
+        )
+        ship.detach(fid)
+        assert "replica.ship.giveup" not in _reasons(mon.evaluate())
+        fol.store.close(), lam.close()
+
+
+# -- the retry budget (fault.with_retries max_elapsed_s) --------------------
+
+
+class TestRetryBudget:
+    def test_elapsed_budget_gives_up_immediately(self):
+        m = MetricsRegistry()
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise OSError("transient storm")
+
+        with pytest.raises(OSError):
+            fault.with_retries(
+                fn, attempts=50, backoff_s=0.001, metrics=m,
+                sleep=lambda s: None, max_elapsed_s=0.0,
+            )
+        assert calls[0] == 1  # budget consumed before any retry
+        assert m.counter_value("geomesa.fault.retries_exhausted") == 1
+        h = m.histograms.get("geomesa.fault.retry.giveup.ms")
+        assert h is not None and h.count == 1
+
+    def test_attempt_budget_also_observes_giveup(self):
+        m = MetricsRegistry()
+
+        def fn():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            fault.with_retries(
+                fn, attempts=3, backoff_s=0.0, metrics=m,
+                sleep=lambda s: None,
+            )
+        assert m.counter_value("geomesa.fault.retry") == 2
+        assert m.counter_value("geomesa.fault.retries_exhausted") == 1
+        assert m.histograms["geomesa.fault.retry.giveup.ms"].count == 1
+
+    def test_budget_not_charged_on_success(self):
+        m = MetricsRegistry()
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise OSError("blip")
+            return "ok"
+
+        assert fault.with_retries(
+            fn, attempts=5, backoff_s=0.0, metrics=m,
+            sleep=lambda s: None, max_elapsed_s=30.0,
+        ) == "ok"
+        assert m.counter_value("geomesa.fault.retries_exhausted") == 0
+        assert "geomesa.fault.retry.giveup.ms" not in m.histograms
+
+    def test_shipper_transient_fault_absorbed(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=512)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        with fault.inject("replica.ship.segment", kind="io_error", times=1):
+            ship.pump()
+        fol.drain()
+        assert not ship.gave_up_report()
+        assert _names(fol) == _names(lam)
+        assert lam.cold.metrics.counter_value("geomesa.fault.retry") >= 1
+        fol.close(), lam.close()
+
+    def test_shipper_bounded_giveup_then_recovers(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=512, giveup_s=0.0)
+        fid = ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        with fault.inject(
+            "replica.ship.segment", kind="io_error", times=None,
+        ):
+            ship.pump()
+            assert fid in ship.gave_up_report()
+        # the storm passes: the next tick retries fresh and clears
+        ship.pump()
+        fol.drain()
+        assert not ship.gave_up_report()
+        assert _names(fol) == _names(lam)
+        fol.close(), lam.close()
+
+
+# -- replay progress (recover on_progress) ----------------------------------
+
+
+class TestReplayProgress:
+    def test_recover_reports_progress_and_gauge(self, tmp_path):
+        reg = MetricsRegistry()
+        root, lam = _leader(tmp_path, seg=2 << 10)
+        for k in range(1, 5):
+            lam.write(_rows(k), ids=_ids(k))
+        last = lam.wal.last_seq
+        lam.wal.crash()
+        events: list = []
+        rec = LambdaStore.recover(
+            root, on_progress=lambda s, seg, b: events.append((s, seg, b)),
+            metrics=reg,
+        )
+        assert len(events) >= 2, "shrink segment_bytes: one segment only"
+        seqs = [e[0] for e in events]
+        assert seqs == sorted(seqs) and seqs[-1] == last
+        assert all(e[1].startswith("wal-") for e in events)
+        reads = [e[2] for e in events]
+        assert reads == sorted(reads) and reads[-1] > 0  # cumulative
+        assert reg.gauges["geomesa.replica.replay.progress"] == last
+        assert rec.count() == 180  # 100 cold + 4x20 replayed
+        rec.close()
+
+
+# -- fencing + failover -----------------------------------------------------
+
+
+class TestFailover:
+    def test_follower_is_read_only(self, tmp_path):
+        root, lam = _leader(tmp_path)
+        fol, _end = _follower(root, tmp_path)
+        with pytest.raises(ReplicaError):
+            fol.write(_rows(9), ids=_ids(9))
+        fol.close(), lam.close()
+
+    def test_kill_leader_promote_zero_acked_loss(self, tmp_path):
+        """THE tentpole invariant: every acknowledged write survives a
+        hard leader kill with an UNSHIPPED tail — promotion finishes
+        replay from the dead leader's durable WAL."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=4096)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        fol.drain()
+        # acked but never shipped: the failover must recover these
+        lam.write(_rows(2), ids=_ids(2))
+        acked = _names(lam)
+        lam.wal.crash()  # kill -9
+        with fault.inject("replica.promote", kind="io_error", times=1):
+            with pytest.raises(OSError):
+                fol.promote(leader_wal_dir=str(root / "_wal"))
+        term = fol.promote(leader_wal_dir=str(root / "_wal"))
+        assert term == 1 and fol.term == 1
+        assert _names(fol) == acked  # zero loss, nothing invented
+        assert fol.metrics.counter_value("geomesa.replica.promotions") == 1
+        # the promoted store accepts and logs writes
+        fol.write(_rows(3), ids=_ids(3))
+        assert len(fol.query("INCLUDE")) == len(acked) + 20
+        # the fence is durable: a plain recover sees the term
+        fol.store.wal.close()
+        rec = LambdaStore.recover(
+            root, type_name="t", wal_dir=fol.wal_dir,
+        )
+        assert rec.wal.term == 1
+        assert len(rec.query("INCLUDE")) == len(acked) + 20
+        rec.close()
+
+    def test_stale_term_shipment_refused(self, tmp_path):
+        """The deposed-leader case: after promotion, messages carrying a
+        lower term are refused — no bytes applied, no marks taken."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=4096)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        fol.drain()
+        fol.promote()  # no disk catch-up needed: fully shipped
+        assert fol.term == 1
+        before = _names(fol)
+        sizes = dict(fol._sizes)
+        # the deposed leader (term 0) ships a late segment + mark
+        stale_seg = {
+            "m": "seg", "term": 0, "name": "wal-" + "0" * 20,
+            "off": 0, "data": "QUJD", "sealed": False,
+        }
+        fol._handle(stale_seg)
+        fol._handle({"m": "state", "term": 0, "horizon": 10 ** 6,
+                     "wall_ms": 0.0, "segments": []})
+        assert fol.metrics.counter_value("geomesa.replica.fenced") == 2
+        assert _names(fol) == before and dict(fol._sizes) == sizes
+        # the fence fault point is reachable (chaos kill-anywhere)
+        with fault.inject("replica.fence", kind="io_error", times=1):
+            with pytest.raises(OSError):
+                fol._handle(stale_seg)
+        fol.close(), lam.close()
+
+    def test_apply_fault_then_resync_converges(self, tmp_path):
+        """An io_error at the follower's apply point loses that chunk;
+        the gap protocol (resync) re-converges on the next pumps."""
+        root, lam = _leader(tmp_path)
+        fol, end = _follower(root, tmp_path)
+        ship = SegmentShipper(lam, chunk_bytes=1 << 20)
+        ship.attach(end)
+        lam.write(_rows(1), ids=_ids(1))
+        ship.pump()
+        with fault.inject("replica.apply", kind="io_error", times=1):
+            with pytest.raises(OSError):
+                fol.drain()
+        lam.write(_rows(2), ids=_ids(2))
+        ship.pump()
+        fol.drain()  # gap detected -> resync requested
+        ship.pump()  # re-ship from byte 0
+        fol.drain()
+        assert _names(fol) == _names(lam)
+        fol.close(), lam.close()
+
+
+# -- the chaos harness: leader + 2 followers, kill anywhere -----------------
+
+
+def _replica_chaos_round(tmp_path, seconds, seed, rate=0.02):
+    """Closed-loop leader ingest + shipping + two replaying followers
+    under a seeded chaos schedule over replica.* AND stream.* points,
+    ending in a hard mid-ingest leader kill and a follower promotion.
+    Returns (oracle, attempted, promoted follower, other follower,
+    spec)."""
+    root, lam = _leader(tmp_path, n=200, seed=3, seg=8 << 10)
+    fols, ends = [], []
+    for name in ("f1", "f2"):
+        fol, end = _follower(root, tmp_path, name=name)
+        fols.append(fol), ends.append(end)
+    ship = SegmentShipper(lam, chunk_bytes=4096, giveup_s=0.2)
+    for end in ends:
+        ship.attach(end)
+
+    test_lock = threading.Lock()
+    oracle: dict = {}     # id -> name: the ACKED state
+    attempted: dict = {}  # id -> values whose ack never returned
+    base = lam.cold.features("t")
+    bn = np.asarray(base.columns["name"])
+    for i, fid in enumerate(base.ids.tolist()):
+        oracle[str(fid)] = str(bn[i])
+    stop = threading.Event()
+    errors: list = []
+    counter = [0]
+    rng = np.random.default_rng(seed)
+
+    def writer():
+        known = list(oracle)
+        while not stop.is_set():
+            k = int(rng.integers(1, 10))
+            ids, rows, vals = [], [], []
+            for _ in range(k):
+                if rng.random() < 0.4:
+                    fid = known[int(rng.integers(0, len(known)))]
+                else:
+                    counter[0] += 1
+                    fid = f"w{counter[0]}"
+                    known.append(fid)
+                counter[0] += 1
+                v = f"v{counter[0]}"
+                x = float(rng.uniform(-50, 50))
+                y = float(rng.uniform(-50, 50))
+                ids.append(fid), vals.append(v)
+                rows.append(
+                    {"name": v, "dtg": T0, "geom": geo.Point(x, y)}
+                )
+            with test_lock:
+                try:
+                    lam.write(rows, ids=ids)
+                except (fault.InjectedCrash, OSError, WalError):
+                    # unacked (incl. every post-kill attempt)
+                    for fid, v in zip(ids, vals):
+                        attempted.setdefault(fid, set()).add(v)
+                    continue
+                for fid, v in zip(ids, vals):
+                    oracle[fid] = v
+            time.sleep(0.001)
+
+    def pumper():
+        while not stop.is_set():
+            try:
+                ship.pump()
+            except (fault.InjectedCrash, OSError, ReplicaError, WalError):
+                pass
+            time.sleep(0.004)
+
+    def applier(fol):
+        def run():
+            while not stop.is_set():
+                try:
+                    if not fol.poll():
+                        time.sleep(0.002)
+                except (fault.InjectedCrash, OSError, ReplicaError):
+                    continue
+        return run
+
+    def reader():
+        # bounded-staleness reads on both followers: StaleRead is a
+        # legal answer under chaos, invented rows are not
+        while not stop.is_set():
+            for fol in fols:
+                try:
+                    fol.query("INCLUDE", max_staleness_ms=30_000)
+                except (StaleRead, fault.InjectedCrash, OSError):
+                    continue
+                except Exception as e:  # a real bug
+                    errors.append(("reader", repr(e)))
+                    stop.set()
+                    return
+            time.sleep(0.005)
+
+    threads = [
+        threading.Thread(target=writer),
+        threading.Thread(target=pumper),
+        threading.Thread(target=applier(fols[0])),
+        threading.Thread(target=applier(fols[1])),
+        threading.Thread(target=reader),
+    ]
+    with fault.chaos(
+        seed=seed, rate=rate,
+        points="replica.*,stream.wal.*",
+        kinds=("io_error", "latency", "crash"),
+        delay_s=0.002,
+    ) as spec:
+        for t in threads:
+            t.start()
+        time.sleep(seconds * 0.7)
+        lam.wal.crash()  # the mid-ingest leader kill
+        time.sleep(seconds * 0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors[:3]
+    assert spec.fired > 0, "the chaos schedule never fired — dead harness"
+    term = fols[0].promote(leader_wal_dir=str(root / "_wal"))
+    assert term >= 1
+    return oracle, attempted, fols[0], fols[1], spec
+
+
+def _assert_replica_invariants(oracle, attempted, promoted, lagging):
+    got = _names(promoted)
+    # 1. ZERO acknowledged-row loss on the promoted line
+    missing = [fid for fid in oracle if fid not in got]
+    assert not missing, f"acknowledged rows lost: {missing[:5]}"
+    for fid, v in oracle.items():
+        assert got[fid] == v or got[fid] in attempted.get(fid, ()), fid
+    # 2. nothing invented: extras only from attempted (unacked) writes
+    for fid, v in got.items():
+        if fid not in oracle:
+            assert v in attempted.get(fid, ()), fid
+    # 3. a lagging follower may be behind but never invents rows either
+    for fid, v in _names(lagging).items():
+        assert (
+            oracle.get(fid) == v
+            or v in attempted.get(fid, ())
+            or fid in oracle
+        ), fid
+
+
+class TestReplicaChaos:
+    def test_replica_chaos_smoke(self, tmp_path):
+        """Tier-1 confidence: a short fixed-seed leader+2-follower run
+        with a mid-ingest kill (the slow soak repeats the kill)."""
+        oracle, attempted, promoted, lagging, _spec = _replica_chaos_round(
+            tmp_path, seconds=2.5, seed=47211
+        )
+        _assert_replica_invariants(oracle, attempted, promoted, lagging)
+        promoted.close(), lagging.close()
+
+    @pytest.mark.slow
+    def test_replica_chaos_soak(self, tmp_path):
+        """The acceptance run: >= 60 s of leader+2-follower rounds with
+        REPEATED leader kills (one hard kill + promotion per round),
+        zero acked-row loss and nothing invented after every failover.
+        ``GEOMESA_TPU_CHAOS_SECONDS`` overrides for soak farms."""
+        budget = float(os.environ.get("GEOMESA_TPU_CHAOS_SECONDS", 60.0))
+        t0 = time.monotonic()
+        kills = 0
+        seed = int(os.environ.get("GEOMESA_TPU_CHAOS_SEED", 60042))
+        while time.monotonic() - t0 < budget or kills < 2:
+            oracle, attempted, promoted, lagging, spec = (
+                _replica_chaos_round(
+                    tmp_path / f"r{kills}", seconds=6.0,
+                    seed=seed + kills,
+                )
+            )
+            _assert_replica_invariants(
+                oracle, attempted, promoted, lagging
+            )
+            assert spec.hits > 0
+            promoted.close(), lagging.close()
+            kills += 1
+        assert kills >= 2  # repeated leader kills, not a single failover
