@@ -28,7 +28,7 @@ GEOMESA_BENCH_N (config-1 points), GEOMESA_BENCH_N2, GEOMESA_BENCH_N3,
 GEOMESA_BENCH_N4, GEOMESA_BENCH_N5, GEOMESA_BENCH_QUERIES,
 GEOMESA_BENCH_CONFIGS (e.g. "1" or "1,2,3"; named scenarios "cache",
 "serving", "ingest", "fused", "pip_join", "stream", "wal", "knn",
-"obs", "ops", "standing"),
+"obs", "ops", "standing", "replica", "serve_http"),
 GEOMESA_BENCH_PLATFORM
 (e.g. "cpu" for off-TPU verification). Supervisor knobs (see main()):
 GEOMESA_BENCH_INIT_TIMEOUT (child device-init watchdog, s),
@@ -3361,6 +3361,275 @@ def config_replica(out_path: "str | None" = None):
     return rec_line
 
 
+def config_serve_http(out_path: "str | None" = None):
+    """Data-plane scenario (docs/serving.md "The data plane"): one
+    WAL-backed LambdaStore mounted on a real socket, three measurements
+    emitted as BENCH_SERVE_HTTP.json.
+
+    1. **Mixed closed-loop** — reader threads and an ingest thread in
+       closed loops through the stdlib DataClient; read QPS, ingest
+       rows/s, and the ``identical`` flag: the streamed GeoJSON bytes
+       for a probe query equal the in-process exporter's bytes exactly.
+    2. **Adversarial-tenant fairness** — a compliant tenant's
+       closed-loop read p99 is measured alone, then again under a
+       volumetric flood: an adversarial tenant hammers the same
+       listener from several threads with cheap requests, submitting
+       far beyond its admission quota of 1 (shed retries back off only
+       by the server's own Retry-After hint). The quota bounds the
+       adversary to at most one query in any micro-batch and the 429
+       path answers without touching the dispatch plane, so the
+       compliant tenant's p99 barely moves. The gate bounds the
+       degradation ratio at 1.5x and requires the adversary to have
+       been visibly shed (429s accounted per tenant — never silent
+       queueing).
+    3. **Ack durability** — every HTTP-acked ingest row must survive
+       ``wal.crash()`` (kill -9) + ``LambdaStore.recover``: zero acked
+       rows lost, zero invented.
+
+    Env knobs: GEOMESA_BENCH_SERVE_COLD (cold rows),
+    GEOMESA_BENCH_SERVE_READ_S (seconds per mixed loop),
+    GEOMESA_BENCH_SERVE_FAIR_S (seconds per fairness loop),
+    GEOMESA_BENCH_SERVE_OUT (fresh-side output path)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.io.exporters import _geojson
+    from geomesa_tpu.serving import DataClient, ServeError
+    from geomesa_tpu.sft import FeatureType
+    from geomesa_tpu.storage import persist
+    from geomesa_tpu.streaming import LambdaStore, StreamConfig, WalConfig
+
+    n_cold = int(os.environ.get("GEOMESA_BENCH_SERVE_COLD", 40_000))
+    read_s = float(os.environ.get("GEOMESA_BENCH_SERVE_READ_S", 2.0))
+    fair_s = float(os.environ.get("GEOMESA_BENCH_SERVE_FAIR_S", 6.0))
+    t0_ms = 1_717_200_000_000
+    tmp = tempfile.mkdtemp(prefix="geomesa_serve_bench_")
+    rng = np.random.default_rng(SEED + 99)
+
+    ds = DataStore()
+    sft = FeatureType.from_spec("sv", "name:String,dtg:Date,*geom:Point:srid=4326")
+    ds.create_schema(sft)
+    ds.write("sv", FeatureCollection.from_columns(
+        sft, np.arange(n_cold).astype(str), {
+            "name": np.array(["v"] * n_cold),
+            "dtg": t0_ms + rng.integers(0, 86_400_000, n_cold),
+            "geom": (rng.uniform(-170, 170, n_cold),
+                     rng.uniform(-80, 80, n_cold)),
+        }), check_ids=False)
+    ds.compact("sv")
+    root = os.path.join(tmp, "s")
+    persist.save(ds, root)
+    lam = LambdaStore(
+        ds, "sv", config=StreamConfig(),
+        wal_dir=os.path.join(root, "_wal"),
+        wal_config=WalConfig(sync="always"),
+    )
+    srv = lam.serve(port=0)
+    probes = [
+        "bbox(geom, -40, -40, 0, 0)", "bbox(geom, 10, 10, 60, 50)",
+        "bbox(geom, -170, -80, -100, 0)",
+    ]
+    warm = DataClient(srv.url, keep_alive=True)
+    for q in probes:
+        warm.query("sv", cql=q)  # warm scan kernels through the socket
+
+    # 1. wire == in-process, then the mixed closed loop
+    from urllib.parse import quote
+
+    _, _, raw = warm.request("GET", "/query/sv?cql=" + quote(probes[0]))
+    identical = raw == _geojson(lam.query(probes[0])).encode()
+
+    stop = threading.Event()
+    reads = [0, 0]
+    ing_rows = [0]
+
+    def reader(slot):
+        c = DataClient(srv.url, keep_alive=True)
+        while not stop.is_set():
+            c.query("sv", cql=probes[reads[slot] % len(probes)], limit=256)
+            reads[slot] += 1
+
+    def ingester():
+        c = DataClient(srv.url, keep_alive=True)
+        b = 0
+        while not stop.is_set():
+            k = 200
+            feats = [
+                {"type": "Feature", "id": f"m{b}-{j}",
+                 "geometry": {"type": "Point",
+                              "coordinates": [float(b % 90), float(j % 45)]},
+                 "properties": {"name": "m", "dtg": t0_ms + b * k + j}}
+                for j in range(k)
+            ]
+            ack = c.ingest("sv", {"type": "FeatureCollection",
+                                  "features": feats})
+            ing_rows[0] += ack["acked"]
+            b += 1
+
+    ts = [threading.Thread(target=reader, args=(i,)) for i in range(2)]
+    ts.append(threading.Thread(target=ingester))
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    time.sleep(read_s)
+    stop.set()
+    for t in ts:
+        t.join(30)
+    dt = time.perf_counter() - t0
+    read_qps = sum(reads) / dt
+    ingest_rows_per_s = ing_rows[0] / dt
+    log(
+        f"[serve_http] mixed: {read_qps:,.0f} read q/s, "
+        f"{ingest_rows_per_s:,.0f} ingested rows/s, identical={identical}"
+    )
+
+    # 2. adversarial-tenant fairness: compliant p99 alone vs flooded
+    def compliant_loop(seconds, warm_s=1.0):
+        # the first second is discarded: the adaptive window and the
+        # per-tenant state settle before anything lands in the p99
+        c = DataClient(srv.url, tenant="compliant", keep_alive=True)
+        lats: list = []
+        t0 = time.perf_counter()
+        i = 0
+        while True:
+            q0 = time.perf_counter()
+            c.query("sv", cql=probes[i % len(probes)], limit=256)
+            if q0 - t0 >= warm_s:
+                lats.append(time.perf_counter() - q0)
+            i += 1
+            if time.perf_counter() - t0 >= warm_s + seconds:
+                return lats
+
+    iso = compliant_loop(fair_s)
+    srv.tenants.configure("adversary", queue_max=1)
+    flood_stop = threading.Event()
+
+    def adversary():
+        c = DataClient(srv.url, tenant="adversary", timeout=10.0,
+                       keep_alive=True)
+        cheap = "bbox(geom, 3.0, 3.0, 3.5, 3.5)"  # volumetric: tiny probes
+        while not flood_stop.is_set():
+            try:
+                c.query("sv", cql=cheap, limit=1)
+            except ServeError as e:  # shed 429: back off by the hint only
+                time.sleep(min(e.retry_after or 0.05, 0.25))
+            except OSError:
+                pass
+
+    floods = [threading.Thread(target=adversary) for _ in range(3)]
+    for t in floods:
+        t.start()
+    try:
+        flooded = compliant_loop(fair_s)
+    finally:
+        flood_stop.set()
+        for t in floods:
+            t.join(30)
+    p99_iso = float(np.percentile(np.array(iso) * 1e3, 99))
+    p99_flood = float(np.percentile(np.array(flooded) * 1e3, 99))
+    degradation = p99_flood / max(p99_iso, 1e-9)
+    trep = {r["tenant"]: r for r in srv.tenants.report()["tenants"]}
+    adversary_shed = int(trep.get("adversary", {}).get("shed", 0))
+    log(
+        f"[serve_http] fairness: compliant p99 {p99_iso:.1f} ms alone, "
+        f"{p99_flood:.1f} ms flooded (x{degradation:.2f}); adversary "
+        f"shed {adversary_shed:,} of "
+        f"{trep.get('adversary', {}).get('submitted', 0):,} submitted"
+    )
+
+    # 3. ack durability: HTTP-acked rows survive kill -9 + recover
+    dur = DataClient(srv.url, keep_alive=True)
+    acked: list = []
+    for b in range(10):
+        feats = [
+            {"type": "Feature", "id": f"dur{b}-{j}",
+             "geometry": {"type": "Point",
+                          "coordinates": [float(b), float(j % 80)]},
+             "properties": {"name": "d", "dtg": t0_ms + b * 100 + j}}
+            for j in range(100)
+        ]
+        ack = dur.ingest("sv", {"type": "FeatureCollection",
+                                "features": feats})
+        if ack["acked"] == 100 and ack["durable"]:
+            acked.extend(f"dur{b}-{j}" for j in range(100))
+    srv.close()
+    lam.wal.crash()  # kill -9: no close, no checkpoint
+    rec = LambdaStore.recover(root)
+    got = {str(i) for i in rec.query("INCLUDE").ids.tolist()}
+    acked_loss = sum(1 for fid in acked if fid not in got)
+    # everything the run ever POSTed carries an "m"/"dur" prefix and the
+    # cold rows are plain indices — anything else came from nowhere
+    attempted = {str(i) for i in range(n_cold)}
+    invented = sum(
+        1 for fid in got
+        if fid not in attempted and not fid.startswith(("m", "dur"))
+    )
+    log(
+        f"[serve_http] durability: acked={len(acked):,} loss={acked_loss} "
+        f"invented={invented}"
+    )
+    lam.flusher.close()
+    rec.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    rows = [
+        {
+            "scenario": "serve_http_mixed",
+            "cold_rows": n_cold, "read_s": read_s,
+            "read_qps": round(read_qps, 1),
+            "ingest_rows_per_s": round(ingest_rows_per_s, 1),
+            "ingested_rows": int(ing_rows[0]),
+            "identical": bool(identical),
+        },
+        {
+            "scenario": "serve_http_fairness",
+            "compliant_requests": len(iso) + len(flooded),
+            "compliant_p99_isolated_ms": round(p99_iso, 3),
+            "compliant_p99_flood_ms": round(p99_flood, 3),
+            "degradation": round(degradation, 3),
+            "adversary_shed": adversary_shed,
+            "identical": True,
+        },
+        {
+            "scenario": "serve_http_durability",
+            "acked_rows": len(acked),
+            "acked_loss": int(acked_loss),
+            "invented": int(invented),
+            "identical": bool(acked_loss == 0 and invented == 0),
+        },
+    ]
+
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": rows}
+    if out_path is None:
+        out_path = os.environ.get(
+            "GEOMESA_BENCH_SERVE_OUT"
+        ) or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SERVE_HTTP.json",
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec_line = {
+        "metric": "serve_http_read_qps",
+        "value": rows[0]["read_qps"],
+        "unit": "q/s",
+        "degradation": rows[1]["degradation"],
+        "adversary_shed": adversary_shed,
+        "acked_loss": int(acked_loss), "invented": int(invented),
+    }
+    print(json.dumps(rec_line), flush=True)
+    return rec_line
+
+
 def child_main():
     """One bench attempt in THIS process (device init + all configs)."""
     import threading
@@ -3399,6 +3668,7 @@ def child_main():
         "stream": config_stream, "wal": config_wal, "knn": config_knn,
         "obs": config_obs, "standing": config_standing,
         "ops": config_ops, "replica": config_replica,
+        "serve_http": config_serve_http,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -114,8 +114,16 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
            "store, WAL and file work OUTSIDE it"),
     _d("QueryScheduler._cond", "geomesa_tpu/serving/scheduler.py", 20,
        hot=True,
-       fields=("_queue", "_closed", "_thread"),
-       doc="admission queue condition: every submit/dispatch crosses it"),
+       fields=("_queues", "_depth", "_closed", "_thread"),
+       doc="admission queue condition: every submit/dispatch crosses it "
+           "(per-tenant deques + the shared depth counter)"),
+    _d("TenantRegistry._lock", "geomesa_tpu/serving/tenancy.py", 22,
+       fields=("_tenants",),
+       doc="multi-tenant fairness table (weights, quotas, accounting): "
+           "a LEAF by design — the scheduler reads quotas/weights "
+           "BEFORE taking its condition, accounting lands after locks "
+           "release, and per-tenant SLO observations go through each "
+           "tenant's own SloTracker lock after this one releases"),
     _d("BulkLoader._cv", "geomesa_tpu/ingest/pipeline.py", 24,
        fields=("_chunks", "_rows_staged", "_closed", "_error", "_writer"),
        doc="staged-chunk condition between producers and the ordered "

@@ -477,6 +477,59 @@ def cmd_ops(args) -> int:
     return 0
 
 
+def cmd_serve(args, hold: bool = True):
+    """Serve a catalog over HTTP (docs/serving.md "The data plane"):
+    `/query/<type>`, `/ingest/<type>` and `/tenants` plus the ops
+    surfaces (`/health`, `/metrics`, ...) on ONE port, multi-tenant
+    admission through the store's scheduler. `--replica-of <wal-dir>`
+    mounts the catalog as a read replica instead, tailing that leader
+    WAL directory on disk every `--tail-interval` seconds (writes then
+    answer 403 carrying `--leader-url`). `hold=False` (tests, embedding)
+    returns the started server instead of blocking."""
+    import time as _time
+
+    if args.replica_of:
+        from geomesa_tpu.streaming.replica import ReplicaStore
+
+        class _NoTransport:
+            """Disk-tail topology: no live shipper to receive from."""
+
+            def send(self, msg) -> None:
+                pass
+
+            def recv(self, timeout: float = 0.0):
+                return None
+
+            def close(self) -> None:
+                pass
+
+        store = ReplicaStore(
+            args.catalog,
+            args.replica_wal or f"{args.catalog}/_replica_wal",
+            _NoTransport(), type_name=args.feature_name,
+        )
+        store.tail_disk(args.replica_of)
+        srv = store.serve(
+            port=args.port, host=args.host, leader_url=args.leader_url
+        )
+    else:
+        store = _load(args)
+        srv = store.serve(port=args.port, host=args.host)
+    print(f"serving {args.catalog} at {srv.url}")
+    if not hold:
+        return srv
+    try:
+        while True:
+            _time.sleep(max(args.tail_interval, 0.05))
+            if args.replica_of:
+                store.tail_disk(args.replica_of)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        store.close()
+    return 0
+
+
 def cmd_playback(args) -> int:
     """Replay a store's features in time order into a streaming cache at a
     rate multiplier (reference geomesa-tools `playback` command, which
@@ -600,6 +653,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "--slow", type=int, default=10,
         help="slow-query captures to include (default 10)",
+    )
+
+    sp = add("serve", cmd_serve)
+    sp.add_argument("-f", "--feature-name", help="replica type name")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--host", default=None, help="bind address (knob default)")
+    sp.add_argument(
+        "--replica-of", default=None, metavar="WAL_DIR",
+        help="serve as a read replica tailing this leader WAL directory",
+    )
+    sp.add_argument(
+        "--replica-wal", default=None,
+        help="replica-local WAL copy dir (default <catalog>/_replica_wal)",
+    )
+    sp.add_argument(
+        "--leader-url", default=None,
+        help="advertised on 403 replica writes (X-Geomesa-Leader)",
+    )
+    sp.add_argument(
+        "--tail-interval", type=float, default=1.0,
+        help="seconds between replica disk-tail passes",
     )
 
     sp = add("playback", cmd_playback, feature=True)

@@ -565,6 +565,53 @@ SERVING_BATCH_MAX = SystemProperty(
 )
 
 
+# -- the data plane (geomesa_tpu.serving.http; docs/serving.md) -----------
+
+SERVE_HOST = SystemProperty(
+    "geomesa.serve.host", "127.0.0.1", str,
+    "bind address for DataStore.serve(port) — loopback by default "
+    "(sandbox- and laptop-friendly, same posture as the ops plane)",
+)
+SERVE_PAGE_ROWS = SystemProperty(
+    "geomesa.serve.page.rows", 4096, int,
+    "rows per chunked-transfer page on the query endpoints: one big "
+    "result streams as bounded pages instead of materializing the whole "
+    "payload (one Arrow record batch per page on fmt=arrow)",
+)
+SERVE_MAX_BODY_BYTES = SystemProperty(
+    "geomesa.serve.max.body.bytes", 64 << 20, int,
+    "cap on an ingest request body; a larger Content-Length is refused "
+    "with HTTP 413 before any bytes are read",
+)
+SERVE_RETRY_AFTER_MS = SystemProperty(
+    "geomesa.serve.retry.after.ms", 50.0, float,
+    "Retry-After hint (milliseconds, rendered as ceil seconds) on a 429 "
+    "shed or a 503 stale-replica read — the client backoff the admission "
+    "layer suggests",
+)
+
+
+# -- multi-tenant fairness (geomesa_tpu.serving.tenancy; docs/serving.md) --
+
+TENANT_QUEUE_MAX = SystemProperty(
+    "geomesa.tenant.queue.max", 256, int,
+    "per-tenant admission quota: one tenant's queued queries past this "
+    "shed with 429 while other tenants' queues stay open — the bound "
+    "that keeps a flooding tenant from filling the shared queue",
+)
+TENANT_DEFAULT_WEIGHT = SystemProperty(
+    "geomesa.tenant.default.weight", 1.0, float,
+    "deficit-round-robin weight for tenants without an explicit "
+    "TenantRegistry.configure() entry: each drained micro-batch takes "
+    "from backlogged tenants in proportion to weight",
+)
+TENANT_SLO_P99_MS = SystemProperty(
+    "geomesa.tenant.slo.p99.ms", 500.0, float,
+    "per-tenant SLO objective: served-query wall p99 threshold for each "
+    "tenant's own SloTracker window (0 disables per-tenant objectives)",
+)
+
+
 def describe() -> str:
     """One line per registered property with its current value (CLI env)."""
     out = []

@@ -118,6 +118,10 @@ class DataStore:
     ops = None
     accuracy = None
 
+    # data plane (docs/serving.md "The data plane"): the attached
+    # DataServer, or None — mounted by serve(port=...)
+    server = None
+
     def __init__(
         self,
         block_full_table_scans: bool = False,
@@ -237,8 +241,11 @@ class DataStore:
         self.scheduler = None
         # ops plane (docs/observability.md): attached by serve_ops()
         self.ops = None
+        # data plane (docs/serving.md): attached by serve(port=...)
+        self.server = None
 
-    def serve(self, config=None):
+    def serve(self, config=None, port: "int | None" = None,
+              host: "str | None" = None, **server_kwargs):
         """Attach (or return) the micro-batch serving tier
         (geomesa_tpu.serving; docs/serving.md): concurrent callers
         ``submit()`` through the returned QueryScheduler and compatible
@@ -248,9 +255,27 @@ class DataStore:
         scheduler is open; a closed one is replaced. Thread-safe: lazy
         attachment from concurrent request handlers must not race two
         schedulers into existence (the loser's dispatcher thread would
-        leak and split traffic across two queues, defeating fusion)."""
+        leak and split traffic across two queues, defeating fusion).
+
+        With ``port`` (0 = ephemeral), ALSO mounts the network data
+        plane (docs/serving.md "The data plane") and returns the started
+        :class:`~geomesa_tpu.serving.http.DataServer` instead — query +
+        ingest + ops endpoints over this store, multi-tenant admission
+        through the scheduler. ``server_kwargs`` pass through to it."""
         from geomesa_tpu.serving import QueryScheduler, ServingConfig
 
+        if port is not None:
+            from geomesa_tpu.serving.http import DataServer
+
+            with self._write_lock:
+                srv = self.server
+                if srv is not None and not srv.closed:
+                    return srv
+                self.server = DataServer(
+                    self, host=host, port=port, config=config,
+                    **server_kwargs
+                ).start()
+                return self.server
         with self._write_lock:
             sched = self.scheduler
             if sched is not None and not sched.closed:
@@ -2233,6 +2258,9 @@ class DataStore:
         threads joined bounded). Idempotent; the store itself stays
         queryable — this is the lifecycle hook tests and embedding
         servers call so no thread or socket outlives the store."""
+        srv = self.server
+        if srv is not None:
+            srv.close()
         sched = self.scheduler
         if sched is not None:
             sched.close()

@@ -128,11 +128,13 @@ def _quote(v: str, sep: str) -> str:
     return v
 
 
-def _geojson(fc: FeatureCollection) -> str:
+def geojson_features(fc: FeatureCollection):
+    """Per-feature GeoJSON dicts, in result order — the shared core of
+    :func:`_geojson` and the served data plane's streamed writer
+    (serving/http.py), so a paged network response is bit-identical to
+    the one-shot export by construction."""
     geom_field = fc.sft.geom_field
-    crs = str(fc.sft.user_data.get("geomesa.crs", "EPSG:4326"))
     date_fields = {a.name for a in fc.sft.attributes if a.type == "Date"}
-    feats = []
     for row in fc.to_rows():
         fid = row.pop("__id__")
         g = row.pop(geom_field, None)  # to_rows already decoded the geometry
@@ -140,24 +142,33 @@ def _geojson(fc: FeatureCollection) -> str:
             k: (date_str(v) if k in date_fields and v is not None else _jsonable(v))
             for k, v in row.items()
         }
-        feats.append(
-            {
-                "type": "Feature",
-                "id": fid,
-                "geometry": _geojson_geom(g) if g is not None else None,
-                "properties": props,
-            }
-        )
-    out = {"type": "FeatureCollection", "features": feats}
-    if crs != "EPSG:4326":
-        # RFC 7946 mandates WGS84; reprojected collections carry the
-        # legacy (GeoJSON 2008) named-CRS member so the coordinates are
-        # not silently misread as degrees
-        code = crs.split(":")[-1]
-        out["crs"] = {
-            "type": "name",
-            "properties": {"name": f"urn:ogc:def:crs:EPSG::{code}"},
+        yield {
+            "type": "Feature",
+            "id": fid,
+            "geometry": _geojson_geom(g) if g is not None else None,
+            "properties": props,
         }
+
+
+def geojson_crs(fc: FeatureCollection) -> "dict | None":
+    """The legacy named-CRS member for non-WGS84 collections (None for
+    EPSG:4326). RFC 7946 mandates WGS84; reprojected collections carry
+    the GeoJSON-2008 member so coordinates are not misread as degrees."""
+    crs = str(fc.sft.user_data.get("geomesa.crs", "EPSG:4326"))
+    if crs == "EPSG:4326":
+        return None
+    code = crs.split(":")[-1]
+    return {
+        "type": "name",
+        "properties": {"name": f"urn:ogc:def:crs:EPSG::{code}"},
+    }
+
+
+def _geojson(fc: FeatureCollection) -> str:
+    out = {"type": "FeatureCollection", "features": list(geojson_features(fc))}
+    crs = geojson_crs(fc)
+    if crs is not None:
+        out["crs"] = crs
     return json.dumps(out)
 
 

@@ -387,6 +387,76 @@ def ops_report(store, lam=None, monitor: "HealthMonitor | None" = None,
     }
 
 
+class OpsRoutes:
+    """The ops-plane route table WITHOUT a socket: monitor + telemetry
+    recorder + the ``handle()`` dispatch. :class:`OpsServer` wraps one
+    for the standalone ops port; the data plane (serving/http.py) mounts
+    one on ITS port so a single listener serves data + ops."""
+
+    #: paths this table answers (the data server's dispatch check)
+    PATHS = (
+        "/metrics", "/health", "/stats", "/debug/slow", "/debug/trace",
+        "/debug/vars", "/debug/audit",
+    )
+
+    def __init__(self, store, lam=None, audit=None):
+        self.store = store
+        self.lam = lam
+        self.audit = audit if audit is not None else getattr(store, "audit", None)
+        self.monitor = HealthMonitor(store, lam=lam)
+        self.recorder = TelemetryRecorder(getattr(store, "metrics", None))
+
+    # -- endpoint bodies (one branch per route; the handler dispatches) --
+    def handle(self, path: str, query: dict):
+        """Route one GET: returns (http status, content type, payload
+        bytes/str). Unknown paths 404."""
+        metrics = resolve(getattr(self.store, "metrics", None))
+        metrics.counter("geomesa.obs.ops.scrapes")
+        if path == "/metrics":
+            # Render the same registry the serving path counts into: a store
+            # without its own registry instruments the process-global one.
+            return 200, "text/plain; version=0.0.4", metrics.render_prometheus()
+        if path == "/health":
+            report = self.monitor.evaluate()
+            code = 503 if report["status"] == "unhealthy" else 200
+            return code, "application/json", _json_dump(report)
+        if path == "/stats":
+            return 200, "application/json", _json_dump(
+                stats_payload(self.store)
+            )
+        if path == "/debug/slow":
+            tname = _first(query, "type")
+            n = int(_first(query, "n") or 0)
+            slow = self.store.slow_queries(type_name=tname)
+            if n > 0:
+                slow = slow[-n:]
+            return 200, "application/json", _json_dump(slow)
+        if path == "/debug/trace":
+            from geomesa_tpu.obs.trace import tracer
+
+            return 200, "application/json", _json_dump(
+                tracer().chrome_payload()
+            )
+        if path == "/debug/vars":
+            window = _first(query, "window")
+            return 200, "application/json", _json_dump(
+                self.recorder.series(
+                    window_s=float(window) if window else None
+                )
+            )
+        if path == "/debug/audit":
+            if self.audit is None:
+                return 200, "application/json", "[]"
+            events = self.audit.peek()
+            n = int(_first(query, "n") or 0)
+            if n > 0:
+                events = events[-n:]
+            return 200, "application/json", _json_dump(events)
+        return 404, "application/json", _json_dump(
+            {"error": f"unknown path {path!r}"}
+        )
+
+
 class OpsServer:
     """The threaded HTTP ops endpoint over one store (module docstring).
     ``DataStore.serve_ops()`` builds, starts and attaches one; close()
@@ -397,9 +467,10 @@ class OpsServer:
                  port: int = 0, audit=None):
         self.store = store
         self.lam = lam
-        self.audit = audit if audit is not None else getattr(store, "audit", None)
-        self.monitor = HealthMonitor(store, lam=lam)
-        self.recorder = TelemetryRecorder(getattr(store, "metrics", None))
+        self.routes = OpsRoutes(store, lam=lam, audit=audit)
+        self.audit = self.routes.audit
+        self.monitor = self.routes.monitor
+        self.recorder = self.routes.recorder
         self.host = host if host is not None else str(conf.OBS_OPS_HOST.get())
         self._httpd = _Httpd((self.host, int(port)), _handler_class(self))
         self._thread: "threading.Thread | None" = None
@@ -447,55 +518,9 @@ class OpsServer:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    # -- endpoint bodies (one method per route; the handler dispatches) --
     def handle(self, path: str, query: dict):
-        """Route one GET: returns (http status, content type, payload
-        bytes/str). Unknown paths 404."""
-        metrics = resolve(getattr(self.store, "metrics", None))
-        metrics.counter("geomesa.obs.ops.scrapes")
-        if path == "/metrics":
-            reg = getattr(self.store, "metrics", None)
-            text = reg.render_prometheus() if reg is not None else "\n"
-            return 200, "text/plain; version=0.0.4", text
-        if path == "/health":
-            report = self.monitor.evaluate()
-            code = 503 if report["status"] == "unhealthy" else 200
-            return code, "application/json", _json_dump(report)
-        if path == "/stats":
-            return 200, "application/json", _json_dump(
-                stats_payload(self.store)
-            )
-        if path == "/debug/slow":
-            tname = _first(query, "type")
-            n = int(_first(query, "n") or 0)
-            slow = self.store.slow_queries(type_name=tname)
-            if n > 0:
-                slow = slow[-n:]
-            return 200, "application/json", _json_dump(slow)
-        if path == "/debug/trace":
-            from geomesa_tpu.obs.trace import tracer
-
-            return 200, "application/json", _json_dump(
-                tracer().chrome_payload()
-            )
-        if path == "/debug/vars":
-            window = _first(query, "window")
-            return 200, "application/json", _json_dump(
-                self.recorder.series(
-                    window_s=float(window) if window else None
-                )
-            )
-        if path == "/debug/audit":
-            if self.audit is None:
-                return 200, "application/json", "[]"
-            events = self.audit.peek()
-            n = int(_first(query, "n") or 0)
-            if n > 0:
-                events = events[-n:]
-            return 200, "application/json", _json_dump(events)
-        return 404, "application/json", _json_dump(
-            {"error": f"unknown path {path!r}"}
-        )
+        """Route one GET (delegates to the route table)."""
+        return self.routes.handle(path, query)
 
 
 class _Httpd(ThreadingHTTPServer):

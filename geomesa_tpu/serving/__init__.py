@@ -11,11 +11,21 @@ continuous batching in an inference-serving stack.
 - :class:`QueryScheduler` — bounded admission queue + adaptive
   micro-batch window + dispatcher thread; callers get futures;
 - :class:`ServingConfig` — the knobs (conf.py property tier defaults);
-- :class:`ServingRejected` — a full queue shed a non-blocking submit.
+- :class:`ServingRejected` — a full queue shed a non-blocking submit;
+- :class:`TenantRegistry` — per-tenant quotas, DRR weights, SLO windows
+  and accounting (serving/tenancy.py);
+- :class:`DataServer` / :class:`DataClient` / :class:`ServeError` — the
+  network data plane and its stdlib client (serving/http.py,
+  docs/serving.md "The data plane").
 """
 
+from geomesa_tpu.serving.http import DataClient, DataServer, ServeError
 from geomesa_tpu.serving.scheduler import (
     QueryScheduler, ServingConfig, ServingRejected,
 )
+from geomesa_tpu.serving.tenancy import TenantRegistry
 
-__all__ = ["QueryScheduler", "ServingConfig", "ServingRejected"]
+__all__ = [
+    "DataClient", "DataServer", "QueryScheduler", "ServeError",
+    "ServingConfig", "ServingRejected", "TenantRegistry",
+]

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
 from typing import Optional
@@ -108,7 +109,7 @@ class _Item:
 
     __slots__ = (
         "plan", "hints", "future", "key", "key_range", "epoch", "timeout",
-        "deadline", "t_enqueue", "t_admit", "explain", "trace",
+        "deadline", "t_enqueue", "t_admit", "explain", "trace", "tenant",
     )
 
     def __init__(self, plan, hints, future, explain):
@@ -116,6 +117,7 @@ class _Item:
         self.hints = hints
         self.future = future
         self.explain = explain
+        self.tenant = None     # fairness queue key (None = default pool)
         self.trace = None      # obs trace root (None when disarmed): the
         #                        query's span tree follows the item across
         #                        the submit -> dispatcher thread hop
@@ -139,7 +141,8 @@ class QueryScheduler:
     attaches one; standalone construction + ``start()`` works too (tests
     construct unstarted schedulers to stage deterministic queues)."""
 
-    def __init__(self, store, config: "ServingConfig | None" = None, metrics=None):
+    def __init__(self, store, config: "ServingConfig | None" = None,
+                 metrics=None, tenants=None):
         from geomesa_tpu.metrics import resolve
 
         from geomesa_tpu.lockwitness import witness
@@ -147,9 +150,19 @@ class QueryScheduler:
         self.store = store
         self.conf = config or ServingConfig.from_properties()
         self.metrics = resolve(metrics if metrics is not None else store.metrics)
+        # multi-tenant fairness (serving/tenancy.py): per-tenant quota +
+        # DRR weights. The registry's lock is NEVER touched under _cond —
+        # quotas read before admission, weights snapshot before each drain
+        self.tenants = tenants
         self._cond = witness(threading.Condition(), "QueryScheduler._cond")
-        self._queue: list[_Item] = []  # guarded-by: _cond
+        # per-tenant FIFO queues (None key = the default pool when no
+        # tenant was named); a single populated queue drains as plain
+        # FIFO, several drain by weighted deficit round-robin
+        self._queues: "dict[Optional[str], deque[_Item]]" = {}  # guarded-by: _cond
+        self._depth = 0                # guarded-by: _cond
         self._closed = False           # guarded-by: _cond
+        # DRR credit per backlogged tenant — dispatcher-thread-only state
+        self._deficit: "dict[Optional[str], float]" = {}
         # adaptive window: grows under load, 0 when idle. Single-writer
         # (only the dispatcher thread mutates it); submit()'s lock-free
         # read of a slightly stale value only mistimes one shed decision
@@ -168,10 +181,11 @@ class QueryScheduler:
 
     @property
     def queue_depth(self) -> int:
-        """Queries currently waiting in the admission queue (locked
-        read — the ops plane's ``/health`` scheduler check)."""
+        """Queries currently waiting in the admission queue, across all
+        tenants (locked read — the ops plane's ``/health`` scheduler
+        check)."""
         with self._cond:
-            return len(self._queue)
+            return self._depth
 
     def start(self) -> "QueryScheduler":
         with self._cond:
@@ -196,7 +210,9 @@ class QueryScheduler:
         if self._thread is not None:
             self._thread.join(timeout)
         with self._cond:
-            pending, self._queue = self._queue, []
+            pending = [it for q in self._queues.values() for it in q]
+            self._queues.clear()
+            self._depth = 0
         for it in pending:
             if not it.future.done():
                 if it.trace is not None:
@@ -220,13 +236,16 @@ class QueryScheduler:
         hints=None,
         explain=None,
         block: bool = True,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Admit one query; returns a Future resolving to its
         FeatureCollection. Plan-time errors (ECQL parse, guards,
         visibility) raise HERE, in the caller's thread; execution errors
         (QueryTimeout, scan failures) land on the future. ``block``:
         whether a full admission queue blocks the caller (backpressure)
-        or sheds immediately with ServingRejected."""
+        or sheds immediately with ServingRejected. ``tenant`` routes the
+        query into that tenant's fairness queue (per-tenant quota + DRR
+        share when a TenantRegistry is attached; None = default pool)."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
         from geomesa_tpu.obs.trace import tracer
@@ -267,6 +286,14 @@ class QueryScheduler:
         if it.timeout is not None:
             it.deadline = time.monotonic() + it.timeout
         self.metrics.counter("geomesa.serving.submitted")
+        # tenant resolution + quota read happen HERE, before the
+        # condition is ever taken: TenantRegistry._lock must never nest
+        # under QueryScheduler._cond (docs/concurrency.md rank order)
+        it.tenant = tenant
+        tcap = None
+        if self.tenants is not None and tenant is not None:
+            tcap = self.tenants.queue_cap(tenant)
+            self.tenants.note_submitted(tenant)
 
         # cache-aware admission: fingerprint for in-window coalescing
         # (always, cache or not) and peek the result cache — hits are
@@ -295,6 +322,8 @@ class QueryScheduler:
                         _resolve(fut, exc=exc)
                     finally:
                         otr.end(trace)
+                    if self.tenants is not None and tenant is not None:
+                        self.tenants.note_cache_hit(tenant)
                     return fut
             else:
                 from geomesa_tpu.cache.fingerprint import fingerprint_plan
@@ -310,27 +339,53 @@ class QueryScheduler:
             ))
             return fut
 
+        # backpressure: the shared bound AND (when tenancy is on) the
+        # caller's per-tenant quota — a flooding tenant hits its own
+        # quota and sheds while other tenants' queues stay open. Sheds
+        # resolve OUTSIDE the condition (nothing below takes a lock
+        # under _cond except the tracer end on close)
+        shed_why = shed_exc = None
         with self._cond:
-            while len(self._queue) >= self.conf.queue_max and not self._closed:
+            while not self._closed:
+                tq = self._queues.get(tenant)
+                over_tenant = tcap is not None and (
+                    len(tq) if tq is not None else 0
+                ) >= tcap
+                if self._depth < self.conf.queue_max and not over_tenant:
+                    break
                 if not block:
-                    self._shed(it, "admission queue full", ServingRejected(
-                        f"admission queue full ({self.conf.queue_max})"
-                    ))
-                    return fut
+                    if over_tenant and self._depth < self.conf.queue_max:
+                        shed_why = "tenant admission quota full"
+                        shed_exc = ServingRejected(
+                            f"tenant {tenant!r} admission quota full ({tcap})"
+                        )
+                    else:
+                        shed_why = "admission queue full"
+                        shed_exc = ServingRejected(
+                            f"admission queue full ({self.conf.queue_max})"
+                        )
+                    break
                 rem = None
                 if it.deadline is not None:
                     rem = it.deadline - time.monotonic()
                     if rem <= 0:
-                        self._shed(it, "admission queue full past the deadline")
-                        return fut
+                        shed_why = "admission queue full past the deadline"
+                        break
                 self._cond.wait(rem if rem is not None else 0.1)
-            if self._closed:
-                otr.end(trace)
-                _resolve(fut, exc=RuntimeError("scheduler closed"))
-                return fut
-            it.t_enqueue = time.perf_counter()
-            self._queue.append(it)
-            self._cond.notify_all()
+            if shed_why is None:
+                if self._closed:
+                    otr.end(trace)
+                    _resolve(fut, exc=RuntimeError("scheduler closed"))
+                    return fut
+                it.t_enqueue = time.perf_counter()
+                q = self._queues.get(tenant)
+                if q is None:
+                    q = self._queues[tenant] = deque()
+                q.append(it)
+                self._depth += 1
+                self._cond.notify_all()
+        if shed_why is not None:
+            self._shed(it, shed_why, shed_exc)
         return fut
 
     def admission_gap(self, max_wait_s: float = 0.05) -> bool:
@@ -345,7 +400,7 @@ class QueryScheduler:
         stalling the fold forever."""
         deadline = time.monotonic() + max(max_wait_s, 0.0)
         with self._cond:
-            while self._queue and not self._closed:
+            while self._depth and not self._closed:
                 rem = deadline - time.monotonic()
                 if rem <= 0:
                     return False
@@ -370,6 +425,8 @@ class QueryScheduler:
 
     def _shed(self, it: _Item, why: str, exc: Optional[BaseException] = None) -> None:
         self.metrics.counter("geomesa.serving.shed")
+        if self.tenants is not None and it.tenant is not None:
+            self.tenants.note_shed(it.tenant)
         if exc is None:
             from geomesa_tpu.planning.errors import QueryTimeout
 
@@ -389,9 +446,9 @@ class QueryScheduler:
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._closed:
+                while not self._depth and not self._closed:
                     self._cond.wait()
-                if not self._queue and self._closed:
+                if not self._depth and self._closed:
                     return
             # micro-batch window: linger for more arrivals, up to the
             # adaptive window or the batch cap (skipped when idle-shrunk
@@ -401,16 +458,20 @@ class QueryScheduler:
                 end = time.monotonic() + w
                 with self._cond:
                     while (
-                        len(self._queue) < self.conf.batch_max
+                        self._depth < self.conf.batch_max
                         and not self._closed
                     ):
                         rem = end - time.monotonic()
                         if rem <= 0:
                             break
                         self._cond.wait(rem)
+            # DRR weights snapshot BEFORE the condition: the registry's
+            # lock never nests under _cond
+            weights = (
+                self.tenants.weights() if self.tenants is not None else None
+            )
             with self._cond:
-                batch = self._queue[: self.conf.batch_max]
-                del self._queue[: self.conf.batch_max]
+                batch = self._take_locked(weights)
                 self._cond.notify_all()  # wake producers blocked on space
             self._adapt(len(batch))
             try:
@@ -419,6 +480,52 @@ class QueryScheduler:
                 for it in batch:
                     if not it.future.done():
                         _resolve(it.future, exc=exc)
+
+    def _take_locked(self, weights: "dict | None") -> list:
+        """Drain up to ``batch_max`` items under ``_cond``. One
+        backlogged tenant drains plain FIFO (the pre-tenancy behavior,
+        bit for bit); several interleave by weighted deficit round-robin
+        — each pass grants every backlogged tenant ``weight/w_min``
+        credit (>= 1, so every pass progresses) and takes that many of
+        its items, so a compliant tenant's queries always ride the next
+        batch regardless of how deep a flooding tenant's queue is."""
+        nmax = self.conf.batch_max
+        batch: "list[_Item]" = []
+        live = [t for t, q in self._queues.items() if q]
+        if not live:
+            return batch
+        if len(live) == 1:
+            q = self._queues[live[0]]
+            while q and len(batch) < nmax:
+                batch.append(q.popleft())
+            self._deficit.clear()
+            self._depth -= len(batch)
+            return batch
+        live.sort(key=lambda t: (t is None, t))  # deterministic order
+        w_min = 1.0
+        if weights:
+            w_min = min(
+                max(weights.get(t, 1.0), 1e-3) for t in live
+            )
+        while live and len(batch) < nmax:
+            for t in list(live):
+                q = self._queues[t]
+                w = max(weights.get(t, 1.0), 1e-3) if weights else 1.0
+                cred = min(self._deficit.get(t, 0.0) + w / w_min, float(nmax))
+                take = min(int(cred), len(q), nmax - len(batch))
+                for _ in range(take):
+                    batch.append(q.popleft())
+                if q:
+                    self._deficit[t] = cred - take
+                else:
+                    # an emptied queue forfeits leftover credit: deficit
+                    # only accumulates while backlogged (classic DRR)
+                    self._deficit.pop(t, None)
+                    live.remove(t)
+                if len(batch) >= nmax:
+                    break
+        self._depth -= len(batch)
+        return batch
 
     def _adapt(self, drained: int) -> None:
         """Grow the window under load, shrink it when idle: a drain that
@@ -546,6 +653,8 @@ class QueryScheduler:
                 for g in group:
                     if g.trace is not None:
                         otr.end(g.trace)
+                    if self.tenants is not None and g.tenant is not None:
+                        self.tenants.note_error(g.tenant)
                     _resolve(g.future, exc=exc)
                 continue
             cost_s = time.perf_counter() - t0
@@ -569,6 +678,13 @@ class QueryScheduler:
                 g.plan.cache_status = "coalesced"
                 self.store.record_query(g.plan, len(value), cost_s)
             for g in group:
+                if self.tenants is not None and g.tenant is not None:
+                    # per-tenant attribution (no scheduler lock held
+                    # here): queue wait at dispatch, full wall at answer
+                    self.tenants.note_wait(g.tenant, g.plan.queue_wait_s)
+                    self.tenants.note_served(
+                        g.tenant, time.perf_counter() - g.t_admit
+                    )
                 if g.trace is not None:
                     if g is not it:
                         g.trace.root.annotate(coalesced=True)

@@ -526,6 +526,7 @@ class ReplicaStore:
         self._tails: dict = {}           # segment -> unparsed byte tail
         self._hole_retries: dict = {}    # (segment, seq) -> resyncs tried
         self.writable = False
+        self.server = None  # data plane (serve(port=...))
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
         # resume handshake: tell the shipper where our local copies end
@@ -915,14 +916,40 @@ class ReplicaStore:
                 self._apply_record(rec)
         self.applier.drain()
 
+    def tail_disk(self, leader_wal_dir: str, mark: bool = True) -> int:
+        """Catch up from the leader's on-disk WAL directory directly —
+        the shared-filesystem topology behind the CLI's ``--replica-of``
+        flag: copy each segment's unseen suffix into the local copies
+        and apply it, WITHOUT promoting (the replica stays a follower;
+        call this periodically to tail the leader). With ``mark``, a
+        staleness mark is stamped at the caught-up horizon so
+        bounded-staleness reads can be answered with no live shipper
+        attached. Returns the records applied."""
+        before = self.replayed
+        self._catch_up_from_disk(str(leader_wal_dir))
+        if mark:
+            with self._apply_lock:
+                self._marks.append((self._replayed, time.time() * 1e3))
+                while (
+                    len(self._marks) > 1
+                    and self._marks[1][0] <= self._replayed
+                ) or len(self._marks) > 4096:
+                    self._marks.popleft()
+        return self.replayed - before
+
     # -- reads / writes ----------------------------------------------------
     def query(self, f=INCLUDE, hints=None,
-              max_staleness_ms: "float | None" = None):
+              max_staleness_ms: "float | None" = None,
+              tenant=None, block: bool = True):
         """The follower's exact hot+cold merge (scheduler-admitted when
         a serving tier is attached — ``serve()``). With
         ``max_staleness_ms``, the read is BOUNDED-STALENESS: it raises
         :class:`StaleRead` unless the measured watermark proves the
-        answer is at most that far behind the leader."""
+        answer is at most that far behind the leader. ``tenant`` and
+        ``block`` route the admitted cold half exactly as on
+        :meth:`LambdaStore.query
+        <geomesa_tpu.streaming.store.LambdaStore.query>` (the served
+        data plane submits non-blocking, per-tenant)."""
         if max_staleness_ms is not None:
             st = self.staleness_ms()
             if st is None or st > float(max_staleness_ms):
@@ -931,7 +958,7 @@ class ReplicaStore:
                     f"{'unmeasured' if st is None else f'{st:.0f}ms'} "
                     f"exceeds the {float(max_staleness_ms):g}ms bound"
                 )
-        return self.store.query(f, hints=hints)
+        return self.store.query(f, hints=hints, tenant=tenant, block=block)
 
     def count(self, f=INCLUDE) -> int:
         return len(self.query(f))
@@ -945,13 +972,31 @@ class ReplicaStore:
             )
         return self.store.write(rows, ids)
 
-    def serve(self, config=None):
+    def serve(self, config=None, port: "int | None" = None,
+              host: "str | None" = None, **server_kwargs):
+        """The follower's serving tier; with ``port``, mounts the
+        read-only data plane over this replica (writes answer 403 with
+        the leader's address; reads honor the staleness-bound header —
+        docs/serving.md "The data plane")."""
+        if port is not None:
+            from geomesa_tpu.serving.http import DataServer
+
+            srv = self.server
+            if srv is not None and not srv.closed:
+                return srv
+            self.server = DataServer(
+                self, host=host, port=port, config=config, **server_kwargs
+            ).start()
+            return self.server
         return self.store.serve(config)
 
     def serve_ops(self, port: int = 0, host: "str | None" = None):
         return self.store.serve_ops(port=port, host=host)
 
     def close(self) -> None:
+        srv = self.server
+        if srv is not None:
+            srv.close()
         self.stop()
         try:
             self.transport.close()

@@ -237,6 +237,8 @@ class LambdaStore:
         self._standing = None
         self._sub_lock = witness(threading.Lock(), "LambdaStore._sub_lock")
         self._sub_records: dict[str, dict] = {}  # guarded-by: _sub_lock
+        # data plane (docs/serving.md): attached by serve(port=...)
+        self.server = None
         cache = getattr(cold, "cache", None)
         if cache is not None:
             self.hot.generations = cache.generations
@@ -678,12 +680,29 @@ class LambdaStore:
         self._gauge_hot()
 
     # -- serving ---------------------------------------------------------
-    def serve(self, config=None):
+    def serve(self, config=None, port: "int | None" = None,
+              host: "str | None" = None, **server_kwargs):
         """Attach (or return) the cold store's serving tier
         (docs/serving.md): with a scheduler attached, the cold half of
         every :meth:`query` is admitted through it — concurrent readers
         fuse into shared fused-kernel dispatches and shed under
-        pressure while the flush loop runs. Returns the scheduler."""
+        pressure while the flush loop runs. Returns the scheduler.
+
+        With ``port``, mounts the network data plane (docs/serving.md
+        "The data plane") over THIS store instead and returns the
+        started :class:`~geomesa_tpu.serving.http.DataServer` — its
+        ingest acks then ride :meth:`write`'s WAL path, so a 200 means
+        durable to the sync policy's guarantee."""
+        if port is not None:
+            from geomesa_tpu.serving.http import DataServer
+
+            srv = self.server
+            if srv is not None and not srv.closed:
+                return srv
+            self.server = DataServer(
+                self, host=host, port=port, config=config, **server_kwargs
+            ).start()
+            return self.server
         return self.cold.serve(config)
 
     def serve_ops(self, port: int = 0, host: "str | None" = None):
@@ -694,14 +713,18 @@ class LambdaStore:
         :class:`~geomesa_tpu.obs.ops.OpsServer`."""
         return self.cold.serve_ops(port=port, host=host, lam=self)
 
-    def _cold_query(self, f, hints=None) -> FeatureCollection:
+    def _cold_query(self, f, hints=None, tenant=None,
+                    block: bool = True) -> FeatureCollection:
         sched = getattr(self.cold, "scheduler", None)
         if sched is not None and not sched.closed:
-            return sched.submit(self.type_name, f, hints=hints).result()
+            return sched.submit(
+                self.type_name, f, hints=hints, block=block, tenant=tenant
+            ).result()
         return self.cold.query(self.type_name, f, hints=hints)
 
     # -- reads -----------------------------------------------------------
-    def query(self, f=INCLUDE, hints=None) -> FeatureCollection:
+    def query(self, f=INCLUDE, hints=None, tenant=None,
+              block: bool = True) -> FeatureCollection:
         """Exact hot+cold merge. Ordering matters for exactness under a
         concurrent flush: the hot result + live-id shadow snapshot FIRST
         (atomically), the cold scan after — a row evicted from hot
@@ -714,7 +737,7 @@ class LambdaStore:
         if isinstance(f, str):
             f = ecql.parse(f)
         hot, live = self.hot.query_shadow(f)
-        cold = self._cold_query(f, hints=hints)
+        cold = self._cold_query(f, hints=hints, tenant=tenant, block=block)
         # shadow cold rows by EVERY live hot id, not just the hot hits: a
         # hot update that moved a feature out of the query window must
         # hide the stale persisted row too (hot-wins-by-id). Set probes
@@ -748,8 +771,11 @@ class LambdaStore:
         return len(self.query(f))
 
     def close(self) -> None:
-        """Release the flusher's worker pool and seal the WAL
-        (idempotent)."""
+        """Release the data plane (if mounted), the flusher's worker
+        pool and the WAL (idempotent)."""
+        srv = self.server
+        if srv is not None:
+            srv.close()
         self.flusher.close()
         if self.wal is not None:
             self.wal.close()

@@ -85,6 +85,14 @@ SCENARIO_SPECS = {
     "replica_scaling": [("qps_scaling_2f", "higher", ())],
     "replica_staleness": [("streamed_rows", "higher", ())],
     "replica_failover": [("acked_rows", "higher", ())],
+    # data plane (docs/serving.md "The data plane"): like replication,
+    # absolute QPS/latency swing run-to-run on a shared host, so the
+    # baseline comparison pins only deterministic shape counts (and the
+    # identical-flag sweep); the fairness/durability teeth live in
+    # FRESH_BOUNDS, which run on every fresh file
+    "serve_http_mixed": [("cold_rows", "higher", ())],
+    "serve_http_fairness": [],
+    "serve_http_durability": [("acked_rows", "higher", ())],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -169,6 +177,23 @@ FRESH_BOUNDS = {
         ("invented", 0.0, "max",
          "failover may not invent rows that were never written"),
     ],
+    # the data-plane acceptance (docs/serving.md "The data plane"): an
+    # adversarial tenant flooding the listener costs a compliant
+    # tenant's read p99 at most 1.5x, the adversary is VISIBLY shed
+    # (429s accounted per tenant, never silent queueing), and every
+    # HTTP-acked ingest row survives kill -9 + recover
+    "serve_http_fairness": [
+        ("degradation", 1.5, "max",
+         "compliant-tenant p99 under adversarial flood must stay <=1.5x"),
+        ("adversary_shed", 1.0, "min",
+         "the flooding tenant must have been visibly shed (429s)"),
+    ],
+    "serve_http_durability": [
+        ("acked_loss", 0.0, "max",
+         "HTTP-acked ingest rows may not be lost by kill -9 + recover"),
+        ("invented", 0.0, "max",
+         "recover may not invent rows that were never acked"),
+    ],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -180,6 +205,7 @@ BASELINES = {
     "BENCH_OPS_PLANE": "BENCH_OPS_PLANE.json",
     "BENCH_GEOFENCE": "BENCH_GEOFENCE.json",
     "BENCH_REPLICA": "BENCH_REPLICA.json",
+    "BENCH_SERVE_HTTP": "BENCH_SERVE_HTTP.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

@@ -246,6 +246,58 @@ def test_serving_doc_apis_exist():
         assert hasattr(QueryScheduler, name), f"sched.{name}"
 
 
+def test_data_plane_doc_honest():
+    """docs/serving.md "The data plane" stays honest: the server and
+    client APIs, the request/response headers, the status-code knobs
+    and every geomesa.serve.* / geomesa.tenant.* name it documents are
+    real, declared at runtime, and cited by both serving.md and the
+    config.md knob index."""
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.serving import (
+        DataClient, DataServer, ServeError, TenantRegistry,
+    )
+    from geomesa_tpu.serving import http as serve_http
+    from geomesa_tpu.streaming.replica import ReplicaStore
+    from geomesa_tpu.streaming.store import LambdaStore
+
+    # serve(port=...) mounts the data plane on every tier the doc names
+    for cls in (DataStore, LambdaStore, ReplicaStore):
+        assert "port" in inspect.signature(cls.serve).parameters, cls
+    for m in ("query", "ingest", "tenants", "health", "metrics_text",
+              "request"):
+        assert hasattr(DataClient, m), m
+    for m in ("handle_get", "handle_post", "start", "close", "url",
+              "port", "tenants"):
+        assert hasattr(DataServer, m), m
+    for m in ("tenant_of", "configure", "report", "weights", "queue_cap"):
+        assert hasattr(TenantRegistry, m), m
+    err = ServeError(429, "shed", retry_after=0.05)
+    assert err.status == 429 and err.retry_after == 0.05
+    assert hasattr(ReplicaStore, "tail_disk")
+    # the documented headers are the module's constants, verbatim
+    text = open(
+        os.path.join(_ROOT, "docs", "serving.md")
+    ).read()
+    for h in (serve_http.AUTHS_HEADER, serve_http.TENANT_HEADER,
+              serve_http.STALENESS_HEADER, serve_http.LEADER_HEADER,
+              serve_http.ROWS_HEADER):
+        assert h in text, h
+    # knob/metric completeness, both directions, from the analyzer
+    # registries (the single source of truth)
+    serve_knobs, serve_metrics = _area_names("geomesa.serve.")
+    tenant_knobs, tenant_metrics = _area_names("geomesa.tenant.")
+    assert len(serve_knobs) == 4, serve_knobs
+    assert len(tenant_knobs) == 3, tenant_knobs
+    assert len(serve_metrics) >= 3, serve_metrics
+    assert len(tenant_metrics) >= 4, tenant_metrics
+    _assert_runtime_declared(serve_knobs + tenant_knobs)
+    _assert_documented(
+        "serving.md",
+        serve_knobs + tenant_knobs + serve_metrics + tenant_metrics,
+    )
+    _assert_documented("config.md", serve_knobs + tenant_knobs)
+
+
 def test_caching_doc_apis_exist():
     """docs/caching.md stays honest the same way: every cache API,
     knob, and metric name it documents is real."""
@@ -684,7 +736,12 @@ def test_observability_doc_honest():
     for endpoint in ("/metrics", "/health", "/stats", "/debug/slow",
                      "/debug/trace", "/debug/vars", "/debug/audit"):
         assert endpoint in doc_text, endpoint
-        assert endpoint in inspect.getsource(ops_mod.OpsServer.handle), endpoint
+        assert endpoint in inspect.getsource(ops_mod.OpsRoutes.handle), endpoint
+    # the route table is shared: both the ops server and the data plane
+    # mount it (docs/serving.md "The data plane")
+    assert hasattr(ops_mod.OpsServer, "routes") or "OpsRoutes" in (
+        inspect.getsource(ops_mod.OpsServer.__init__)
+    )
     # every documented health reason code is a literal the monitor adds
     monitor_src = inspect.getsource(ops_mod.HealthMonitor.evaluate)
     for code in ("store.quarantine", "wal.needs_recovery", "slo.breach",

@@ -237,13 +237,30 @@ def _workload(tmp_path, metrics=None):
 
     sched = ds.serve()
     srv = ds.serve_ops()
+    # data plane (docs/serving.md "The data plane"), mounted on the
+    # same scheduler: tenant-tagged HTTP query + ingest traffic crosses
+    # TenantRegistry._lock under concurrent handler threads, alongside
+    # the scheduler condition and the store write lock
+    dsv = ds.serve(port=0)
     try:
         fut = sched.submit("t", "BBOX(geom, -10, -10, 10, 10)")
         srv.recorder.sample()
         for path in ("/metrics", "/health", "/debug/vars?window=60"):
             urllib.request.urlopen(srv.url + path, timeout=10).read()
         fut.result(30)
+        from geomesa_tpu.serving import DataClient
+
+        dsv.tenants.configure("wl", queue_max=8)
+        client = DataClient(dsv.url, tenant="wl")
+        client.query("t", cql="BBOX(geom, -10, -10, 10, 10)")
+        client.ingest("t", {"type": "FeatureCollection", "features": [{
+            "type": "Feature", "id": "wl-ingest-1",
+            "geometry": {"type": "Point", "coordinates": [0.5, 0.5]},
+            "properties": {"name": "wl", "dtg": 1704067200000},
+        }]})
+        client.tenants()
     finally:
+        dsv.close()
         srv.close()
     # streaming tier over a durably saved cold store, WAL attached,
     # tiny segments so rotation happens (the fixed seal-fsync path),
