@@ -3630,6 +3630,296 @@ def config_serve_http(out_path: "str | None" = None):
     return rec_line
 
 
+def config_tiles(out_path: "str | None" = None):
+    """Live map-tile scenario (docs/tiles.md): one cache-backed
+    DataStore mounted on a real socket, two measurements emitted as
+    BENCH_TILES.json.
+
+    1. **Precomposed vs from-scratch at matched workload** — a reader
+       fetches a fixed tile working set (zooms 1..3, Arrow grid
+       format) in a closed loop through the stdlib DataClient while an
+       ingest thread POSTs paced localized batches; then the SAME tile
+       set is served with ``mode=fresh`` (the from-scratch oracle)
+       under the same sustained ingest. Per-zoom speedup = fresh p50 /
+       warm p50 over the steady-state tiles outside the write
+       footprint; the gate requires >=5x at every measured zoom, plus
+       a p99 ceiling over EVERY fetch (recomposes and ingest stalls
+       included) and a cache-hit floor. The ``identical`` flag is the
+       in-bench oracle: after the loops, every sampled tile's warm
+       Arrow bytes equal its ``mode=fresh`` bytes at zooms 0..3.
+    2. **Scoped invalidation, both directions** — with the pyramid
+       fully warm, one localized ingest batch lands; a tile far from
+       the write must keep answering 304 to its old ETag (still warm,
+       zero aggregation work) while the touched tile recomposes under
+       a new ETag.
+
+    Env knobs: GEOMESA_BENCH_TILES_COLD (cold rows),
+    GEOMESA_BENCH_TILES_S (seconds for the warm closed loop),
+    GEOMESA_BENCH_TILES_OUT (fresh-side output path)."""
+    import threading
+
+    from geomesa_tpu import conf
+    from geomesa_tpu.cache import CacheConfig
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.serving import DataClient
+    from geomesa_tpu.sft import FeatureType
+
+    n_cold = int(os.environ.get("GEOMESA_BENCH_TILES_COLD", 60_000))
+    read_s = float(os.environ.get("GEOMESA_BENCH_TILES_S", 2.0))
+    t0_ms = 1_717_200_000_000
+    rng = np.random.default_rng(SEED + 123)
+
+    ds = DataStore(metrics=MetricsRegistry(),
+                   cache=CacheConfig(max_bytes=1 << 24))
+    sft = FeatureType.from_spec(
+        "tl", "name:String,dtg:Date,*geom:Point:srid=4326"
+    )
+    ds.create_schema(sft)
+    ds.write("tl", FeatureCollection.from_columns(
+        sft, np.arange(n_cold).astype(str), {
+            "name": np.array(["t"] * n_cold),
+            "dtg": t0_ms + rng.integers(0, 86_400_000, n_cold),
+            "geom": (rng.uniform(-170, 170, n_cold),
+                     rng.uniform(-80, 80, n_cold)),
+        }), check_ids=False)
+    ds.compact("tl")
+
+    # px=128 bounds the Arrow body at 128 KB/tile so the loop measures
+    # the serving tier, not loopback bulk transfer
+    conf.TILES_PX.set(128)
+    try:
+        srv = ds.serve(port=0)
+        warm = DataClient(srv.url, keep_alive=True)
+        # the tile working set: every z1 tile, 16 each at z2/z3
+        tile_sets: dict = {}
+        for z in (1, 2, 3):
+            allt = [(z, x, y) for x in range(2 ** (z + 1))
+                    for y in range(2 ** z)]
+            if len(allt) > 16:
+                pick = sorted(rng.choice(len(allt), 16, replace=False))
+                allt = [allt[i] for i in pick]
+            tile_sets[z] = allt
+        working = [t for z in (1, 2, 3) for t in tile_sets[z]]
+        # fetching both roots composes the ENTIRE pyramid once
+        for x in (0, 1):
+            warm.tile("tl", "count", 0, x, 0, fmt="arrow")
+
+        # sustained localized ingest: every batch lands in lon
+        # [95, 111] x lat [25, 44] — inside z3 tile (12, 2) and far
+        # from z3 tile (0, 0)
+        stop = threading.Event()
+        ing_rows = [0]
+
+        def ingester():
+            # paced, not closed-loop: each POST costs tens of ms of
+            # host CPU (JSON parse + sorted write + invalidation), so
+            # an unthrottled loop starves the readers and measures the
+            # GIL, not the pyramid; ~1.5k rows/s in 100-row quanta is
+            # sustained ingest that still re-dirties the working set
+            # many times per second, with bounded per-POST stalls
+            c = DataClient(srv.url, keep_alive=True)
+            b = 0
+            while not stop.is_set():
+                k = 100
+                r = np.random.default_rng(SEED + b)
+                xs = r.uniform(95.0, 111.0, k)
+                ys = r.uniform(25.0, 44.0, k)
+                feats = [
+                    {"type": "Feature", "id": f"mt{b}-{j}",
+                     "geometry": {"type": "Point",
+                                  "coordinates": [float(xs[j]),
+                                                  float(ys[j])]},
+                     "properties": {"name": "m", "dtg": t0_ms + b * k + j}}
+                    for j in range(k)
+                ]
+                ack = c.ingest("tl", {"type": "FeatureCollection",
+                                      "features": feats})
+                ing_rows[0] += ack["acked"]
+                b += 1
+                stop.wait(0.05)
+
+        def fetch_loop(seconds, mode=None, passes=None):
+            """Closed loop over the working set; (tile, seconds) samples."""
+            c = DataClient(srv.url, keep_alive=True)
+            lats: list = []
+            t0 = time.perf_counter()
+            i = 0
+            while True:
+                tile = working[i % len(working)]
+                q0 = time.perf_counter()
+                c.tile("tl", "count", *tile, fmt="arrow", mode=mode)
+                lats.append((tile, time.perf_counter() - q0))
+                i += 1
+                if passes is not None:
+                    if i >= passes * len(working):
+                        return lats
+                elif time.perf_counter() - t0 >= seconds:
+                    return lats
+
+        def touches_writes(tile):
+            """Does this tile's bbox intersect the ingest footprint?"""
+            z, x, y = tile
+            w = 360.0 / 2 ** (z + 1)
+            lo_x, lo_y = -180.0 + x * w, 90.0 - (y + 1) * w
+            return not (lo_x + w < 95.0 or lo_x > 111.0
+                        or lo_y + w < 25.0 or lo_y > 44.0)
+
+        ing = threading.Thread(target=ingester)
+        ing.start()
+        try:
+            c0 = ds.metrics.counter_value("geomesa.tiles.compose")
+            t0 = time.perf_counter()
+            warm_lats = fetch_loop(read_s)
+            warm_dt = time.perf_counter() - t0
+            composes = ds.metrics.counter_value(
+                "geomesa.tiles.compose"
+            ) - c0
+            fresh_lats = fetch_loop(0, mode="fresh", passes=2)
+        finally:
+            stop.set()
+            ing.join(30)
+
+        # warm_p99 and hit_ratio cover EVERY fetch — including the
+        # tiles the ingest keeps re-dirtying, whose refetches pay the
+        # recompose (the amortized maintenance cost). The per-zoom
+        # speedup is computed on the steady-state tiles OUTSIDE the
+        # write footprint (same tiles both sides): a recomposing tile's
+        # cost is ~one leaf scan by construction — the same work the
+        # from-scratch path pays on every request — so folding it into
+        # the warm mean would just measure how often this loop happens
+        # to land on the handful of touched tiles, not the serving path
+        warm_ms = np.array([s * 1e3 for _, s in warm_lats])
+        warm_p99 = float(np.percentile(warm_ms, 99))
+        hit_ratio = 1.0 - composes / max(len(warm_lats), 1)
+        # medians, not means: a fetch that lands behind an in-flight
+        # ingest POST stalls for the POST's GIL hold on either side of
+        # the comparison — that tail is real and gated via warm_p99_ms,
+        # but inside the speedup ratio it is multiplicative noise
+        per_zoom = {}
+        for z in (1, 2, 3):
+            steady = [t for t in tile_sets[z] if not touches_writes(t)]
+            w = np.array([s for t, s in warm_lats if t in steady])
+            f = np.array([s for t, s in fresh_lats if t in steady])
+            per_zoom[str(z)] = {
+                "steady_tiles": len(steady),
+                "warm_ms_p50": round(float(np.median(w)) * 1e3, 3),
+                "fresh_ms_p50": round(float(np.median(f)) * 1e3, 3),
+                "speedup": round(float(np.median(f) / np.median(w)), 2),
+            }
+        speedup_min = min(v["speedup"] for v in per_zoom.values())
+        log(
+            f"[tiles] warm {len(warm_lats) / warm_dt:,.0f} fetch/s "
+            f"p99 {warm_p99:.2f} ms, hit ratio {hit_ratio:.3f}, "
+            f"speedup min x{speedup_min:.1f} "
+            f"({ {z: v['speedup'] for z, v in per_zoom.items()} }), "
+            f"{ing_rows[0]:,} rows ingested alongside"
+        )
+
+        # in-bench bit-identity oracle: warm bytes == from-scratch bytes
+        identical = True
+        checked = 0
+        for z in (0, 1, 2, 3):
+            allt = [(x, y) for x in range(2 ** (z + 1))
+                    for y in range(2 ** z)]
+            if len(allt) > 12:
+                pick = sorted(rng.choice(len(allt), 12, replace=False))
+                allt = [allt[i] for i in pick]
+            for x, y in allt:
+                _, _, wb = warm.tile("tl", "count", z, x, y, fmt="arrow")
+                _, _, fb = warm.tile("tl", "count", z, x, y, fmt="arrow",
+                                     mode="fresh")
+                identical = identical and wb == fb
+                checked += 1
+        log(f"[tiles] identity: {checked} tiles swept, "
+            f"identical={identical}")
+
+        # 2. scoped invalidation, both directions
+        for x in (0, 1):  # re-warm everything the loops dirtied
+            warm.tile("tl", "count", 0, x, 0, fmt="arrow")
+        far, touched = (3, 0, 0), (3, 12, 2)
+        _, far_h, _ = warm.tile("tl", "count", *far, fmt="arrow")
+        _, tch_h, _ = warm.tile("tl", "count", *touched, fmt="arrow")
+        k = 64
+        feats = [
+            {"type": "Feature", "id": f"inv-{j}",
+             "geometry": {"type": "Point",
+                          "coordinates": [100.0 + (j % 8), 30.0 + j % 12]},
+             "properties": {"name": "i", "dtg": t0_ms + j}}
+            for j in range(k)
+        ]
+        warm.ingest("tl", {"type": "FeatureCollection", "features": feats})
+        st_far, far_h2, _ = warm.tile("tl", "count", *far, fmt="arrow",
+                                      etag=far_h["ETag"])
+        st_t, tch_h2, _ = warm.tile("tl", "count", *touched, fmt="arrow",
+                                    etag=tch_h["ETag"])
+        far_304 = st_far == 304 and far_h2["ETag"] == far_h["ETag"]
+        touched_recomposed = st_t == 200 and tch_h2["ETag"] != tch_h["ETag"]
+        log(
+            f"[tiles] invalidation: far tile {far} -> {st_far} "
+            f"(etag kept={far_h2['ETag'] == far_h['ETag']}), touched "
+            f"{touched} -> {st_t} (etag moved="
+            f"{tch_h2['ETag'] != tch_h['ETag']})"
+        )
+        srv.close()
+    finally:
+        conf.TILES_PX.clear()
+
+    rows = [
+        {
+            "scenario": "tiles_serving",
+            "cold_rows": n_cold, "read_s": read_s,
+            "zooms_measured": len(per_zoom),
+            "working_set_tiles": len(working),
+            "fetch_per_s": round(len(warm_lats) / warm_dt, 1),
+            "warm_p99_ms": round(warm_p99, 3),
+            "hit_ratio": round(hit_ratio, 4),
+            "per_zoom": per_zoom,
+            "speedup_min": speedup_min,
+            "ingest_rows_alongside": int(ing_rows[0]),
+            "identity_tiles_checked": checked,
+            "identical": bool(identical),
+        },
+        {
+            "scenario": "tiles_invalidation",
+            "warmed_tiles": len(working),
+            "far_304": bool(far_304),
+            "touched_recomposed": bool(touched_recomposed),
+            "identical": bool(far_304 and touched_recomposed),
+        },
+    ]
+
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": rows}
+    if out_path is None:
+        out_path = os.environ.get(
+            "GEOMESA_BENCH_TILES_OUT"
+        ) or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_TILES.json",
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec_line = {
+        "metric": "tiles_speedup_min",
+        "value": speedup_min,
+        "unit": "x",
+        "warm_p99_ms": rows[0]["warm_p99_ms"],
+        "hit_ratio": rows[0]["hit_ratio"],
+        "identical": bool(identical),
+        "far_304": bool(far_304),
+        "touched_recomposed": bool(touched_recomposed),
+    }
+    print(json.dumps(rec_line), flush=True)
+    return rec_line
+
+
 def child_main():
     """One bench attempt in THIS process (device init + all configs)."""
     import threading
@@ -3668,7 +3958,7 @@ def child_main():
         "stream": config_stream, "wal": config_wal, "knn": config_knn,
         "obs": config_obs, "standing": config_standing,
         "ops": config_ops, "replica": config_replica,
-        "serve_http": config_serve_http,
+        "serve_http": config_serve_http, "tiles": config_tiles,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -198,6 +198,12 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
     _d("TileAggregateCache._lock", "geomesa_tpu/cache/tiles.py", 52,
        fields=("_tiles", "_scan_s", "_compose_s", "_compose_n", "_gated"),
        doc="tile LRU + adaptive cost-gate EWMAs"),
+    _d("TilePyramid._lock", "geomesa_tpu/tiles/pyramid.py", 54,
+       fields=("_deltas", "_dirty_leaves", "_leaf_scan_s"),
+       doc="pyramid delta accounting + leaf-scan cost EWMA: taken "
+           "briefly by note_delta (under the store write lock) and "
+           "after a leaf scan completes — never held across a scan or "
+           "another cache tier's lock"),
     _d("GenerationTracker._lock", "geomesa_tpu/cache/generations.py", 60,
        hot=True,
        fields=("_tick", "_types"),
@@ -288,6 +294,10 @@ DECLARED_EDGES: list[tuple[str, str, str]] = [
      "every committed mutation bumps generations"),
     ("DataStore._write_lock", "TileAggregateCache._lock",
      "mutation-side cache sweeps touch the tile tier"),
+    ("DataStore._write_lock", "TilePyramid._lock",
+     "every committed mutation's on_mutation forwards delta-to-tile "
+     "accounting to the attached pyramid (note_delta) under the write "
+     "lock"),
     ("DataStore._write_lock", "ResultCache._lock",
      "mutation-side cache sweeps touch the result tier"),
     ("DataStore._write_lock", "ChaosSpec._lock",

@@ -107,6 +107,9 @@ FAULT_POINTS: dict[str, str] = {
     "replica.apply": "before a follower appends+applies a shipped chunk",
     "replica.promote": "at the entry of a follower's promotion",
     "replica.fence": "before a stale-term shipment is refused",
+    # map-tile pyramid (tiles/pyramid.py; docs/tiles.md)
+    "tiles.compose": "before a pyramid tile composes (leaf scan or child fold)",
+    "tiles.leaf.scan": "before a leaf tile's backing row scan",
 }
 
 # metric instrument methods on MetricsRegistry, by instrument kind

@@ -48,6 +48,7 @@ class CacheConfig:
     max_bytes: int = 256 << 20
     ttl_s: Optional[float] = None
     min_cost_s: float = 0.0
+    ttl_jitter: float = 0.0
     tile_bits: int = 6
     tile_max_entries: int = 65_536
     max_tiles_per_query: int = 1024
@@ -60,6 +61,7 @@ class CacheConfig:
             max_bytes=conf.CACHE_MAX_BYTES.get(),
             ttl_s=conf.CACHE_TTL.get(),
             min_cost_s=conf.CACHE_MIN_COST.get(),
+            ttl_jitter=conf.CACHE_TTL_JITTER.get(),
             tile_bits=conf.CACHE_TILE_BITS.get(),
             tile_max_entries=conf.CACHE_TILE_MAX.get(),
             max_tiles_per_query=conf.CACHE_TILES_PER_QUERY.get(),
@@ -88,10 +90,15 @@ class QueryCache:
                 max_bytes=self.conf.max_bytes,
                 ttl_s=self.conf.ttl_s,
                 min_cost_s=self.conf.min_cost_s,
+                ttl_jitter=self.conf.ttl_jitter,
             ),
             self.generations,
             metrics=self.metrics,
         )
+        #: the tile pyramid's composition seam (geomesa_tpu.tiles;
+        #: docs/tiles.md): attached by TilePyramid so every mutation's
+        #: key range also lands in the pyramid's delta accounting
+        self.pyramid = None
         self.tiles = TileAggregateCache(
             TileCacheConf(
                 tile_bits=self.conf.tile_bits,
@@ -113,6 +120,12 @@ class QueryCache:
         return key_range_of(f, sft)
 
     # -- mutation hooks --------------------------------------------------
+    def attach_pyramid(self, pyramid) -> None:
+        """Register a TilePyramid for mutation forwarding (the flush/
+        fold delta-to-tile-range mapping rides the SAME per-slice
+        on_mutation calls the scoped invalidation does)."""
+        self.pyramid = pyramid
+
     def on_mutation(self, type_name: str, fc=None) -> None:
         """A batch of rows was written/replaced/removed: bump the covered
         key range (``fc=None`` = unknown range, bump everything)."""
@@ -120,11 +133,15 @@ class QueryCache:
         if fc is not None:
             bounds, time_range = mutation_range(fc)
         self.generations.bump(type_name, bounds=bounds, time_range=time_range)
+        if self.pyramid is not None:
+            self.pyramid.note_delta(type_name, bounds)
 
     def on_schema_dropped(self, type_name: str) -> None:
         self.generations.bump_schema(type_name)
         self.result.invalidate_type(type_name)
         self.tiles.invalidate_type(type_name)
+        if self.pyramid is not None:
+            self.pyramid.invalidate_type(type_name)
 
     def on_quarantine(self, type_name: str, time_range=None) -> int:
         """A loaded store quarantined a damaged partition: bump the
@@ -132,12 +149,18 @@ class QueryCache:
         degraded-mode contract — entries over the hole must not linger
         even unservable). Returns entries dropped."""
         self.generations.bump(type_name, bounds=None, time_range=time_range)
-        return self.result.sweep(type_name) + self.tiles.invalidate_type(type_name)
+        dropped = self.result.sweep(type_name) + self.tiles.invalidate_type(type_name)
+        if self.pyramid is not None:
+            dropped += self.pyramid.sweep(type_name)
+        return dropped
 
     # -- introspection ---------------------------------------------------
     def stats(self) -> dict:
-        return {
+        out = {
             "result_entries": len(self.result),
             "result_bytes": self.result.bytes_resident,
             "tile_entries": len(self.tiles),
         }
+        if self.pyramid is not None:
+            out.update(self.pyramid.stats())
+        return out

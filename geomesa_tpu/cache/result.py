@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -37,10 +38,15 @@ from geomesa_tpu.cache.generations import GenerationTracker, KeyRange
 
 
 def collection_nbytes(fc) -> int:
-    """Approximate resident bytes of a FeatureCollection (ids + columns;
-    packed geometry columns sum their buffers)."""
+    """Approximate resident bytes of a cached value: a FeatureCollection
+    (ids + columns; packed geometry columns sum their buffers), or any
+    value that sizes itself via an ``nbytes`` attribute (ndarrays, the
+    tile pyramid's TileGrid)."""
     from geomesa_tpu.filter.predicates import PointColumn
 
+    nb = getattr(fc, "nbytes", None)
+    if nb is not None:
+        return int(nb)
     total = int(np.asarray(fc.ids).nbytes)
     for col in fc.columns.values():
         if isinstance(col, PointColumn):
@@ -85,6 +91,12 @@ class ResultCacheConf:
     max_bytes: int = 256 << 20
     ttl_s: Optional[float] = None
     min_cost_s: float = 0.0
+    #: deterministic per-key TTL spread, as a fraction of ttl_s (0..1):
+    #: a burst of entries admitted together would otherwise all expire
+    #: at the same instant and stampede the store re-filling — the
+    #: synchronized-expiry half of the thundering-herd problem that
+    #: single-flight alone does not fix (geomesa.cache.ttl.jitter)
+    ttl_jitter: float = 0.0
 
 
 class ResultCache:
@@ -161,10 +173,14 @@ class ResultCache:
         if nbytes > self.conf.max_bytes:
             self.metrics.counter("geomesa.cache.reject")
             return
-        expires = (
-            time.monotonic() + self.conf.ttl_s
-            if self.conf.ttl_s is not None else None
-        )
+        ttl = self.conf.ttl_s
+        if ttl is not None and self.conf.ttl_jitter > 0:
+            # deterministic per-key spread (Python's hash() is salted
+            # per process — useless for a reproducible schedule): the
+            # key's crc32 picks a stable fraction of jitter * ttl
+            frac = zlib.crc32(key.encode()) / 2.0 ** 32
+            ttl = ttl * (1.0 + self.conf.ttl_jitter * frac)
+        expires = time.monotonic() + ttl if ttl is not None else None
         with self._lock:
             self._drop_locked(key, "geomesa.cache.replaced")
             self._entries[key] = _Entry(

@@ -569,6 +569,38 @@ def cmd_playback(args) -> int:
     return 0
 
 
+def cmd_tile(args) -> int:
+    """Render one slippy-map tile from a loaded catalog to a file
+    (docs/tiles.md) — the offline twin of the serving tier's
+    `GET /tiles/<type>/<kind>/{z}/{x}/{y}`: same pyramid, same
+    deterministic PNG bytes. `--fresh` uses the from-scratch oracle
+    instead of the precomposed path (a bit-identity spot check)."""
+    from geomesa_tpu.tiles import KINDS, TilePyramid, render
+
+    ds = _load(args)
+    if args.kind not in KINDS:
+        print(f"unknown kind {args.kind!r}; one of {KINDS}", file=sys.stderr)
+        return 1
+    pyramid = TilePyramid(ds)
+    try:
+        fetch = pyramid.fresh if args.fresh else pyramid.fetch
+        g = fetch(args.feature_name, args.z, args.x, args.y)
+    except KeyError:
+        print(f"unknown type {args.feature_name!r}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    out = args.output or f"{args.feature_name}_{args.z}_{args.x}_{args.y}.png"
+    with open(out, "wb") as f:
+        f.write(render(args.kind, g.grid))
+    print(
+        f"wrote {out}: tile {args.z}/{args.x}/{args.y} "
+        f"({args.kind}, {int(g.count)} features, generation tick {g.tick})"
+    )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -683,6 +715,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="data-time speedup factor (0 = as fast as possible)",
     )
     sp.add_argument("--batch-size", type=int, default=1000)
+
+    sp = add("tile", cmd_tile, feature=True)
+    sp.add_argument("z", type=int, help="zoom (0..geomesa.tiles.leaf.zoom)")
+    sp.add_argument("x", type=int)
+    sp.add_argument("y", type=int)
+    sp.add_argument(
+        "--kind", default="density", help="density | count | heat"
+    )
+    sp.add_argument("-o", "--output", help="PNG path (default <t>_z_x_y.png)")
+    sp.add_argument(
+        "--fresh", action="store_true",
+        help="from-scratch oracle instead of the precomposed pyramid",
+    )
 
     return p
 

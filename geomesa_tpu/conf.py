@@ -109,6 +109,12 @@ CACHE_TTL = SystemProperty(
     "geomesa.cache.ttl", None, float,
     "seconds a cached entry stays servable (None = until invalidated)",
 )
+CACHE_TTL_JITTER = SystemProperty(
+    "geomesa.cache.ttl.jitter", 0.0, float,
+    "deterministic per-key TTL spread as a fraction of the TTL (0..1): a "
+    "burst of same-TTL entries admitted together expires staggered "
+    "instead of stampeding the store in lockstep (0 = exact TTLs)",
+)
 CACHE_MIN_COST = SystemProperty(
     "geomesa.cache.min.cost", 0.0, float,
     "cost-aware admission: cache only results whose measured scan took at "
@@ -413,6 +419,12 @@ OBS_SLO_REPLICA_STALENESS_P99_MS = SystemProperty(
     "(a follower's measured staleness watermark, docs/replication.md) "
     "must stay at or under this (0 drops it)",
 )
+OBS_SLO_TILES_P99_MS = SystemProperty(
+    "geomesa.obs.slo.tiles.p99.ms", 100.0, float,
+    "default tile-serving objective: geomesa.tiles.fetch p99 (one "
+    "/tiles request, compose + render included; docs/tiles.md) must "
+    "stay at or under this (0 drops it)",
+)
 
 
 # -- the ops plane: /health + /metrics endpoints, telemetry history
@@ -588,6 +600,38 @@ SERVE_RETRY_AFTER_MS = SystemProperty(
     "Retry-After hint (milliseconds, rendered as ceil seconds) on a 429 "
     "shed or a 503 stale-replica read — the client backoff the admission "
     "layer suggests",
+)
+
+
+# -- live map-tile serving (geomesa_tpu.tiles; docs/tiles.md) -------------
+
+TILES_LEAF_ZOOM = SystemProperty(
+    "geomesa.tiles.leaf.zoom", 3, int,
+    "the pyramid's finest zoom: leaf tiles aggregate rows once at this "
+    "level, every zoom above folds child partials; /tiles serves zooms "
+    "[0, leaf.zoom]",
+)
+TILES_PX = SystemProperty(
+    "geomesa.tiles.px", 256, int,
+    "tile raster edge in pixels (one served tile is px x px)",
+)
+TILES_CACHE_MAX_BYTES = SystemProperty(
+    "geomesa.tiles.cache.max.bytes", 128 << 20, int,
+    "LRU byte budget for composed tile grids (the pyramid's own "
+    "ResultCache instance; 0 recomposes every fetch)",
+)
+TILES_TTL = SystemProperty(
+    "geomesa.tiles.ttl", None, float,
+    "seconds a composed tile grid stays servable past its compose "
+    "(None = until a generation bump invalidates it); spread by "
+    "geomesa.cache.ttl.jitter like every cached result",
+)
+TILES_MAX_AGE_S = SystemProperty(
+    "geomesa.tiles.max.age.s", 0.0, float,
+    "Cache-Control on /tiles responses: > 0 serves 'public, max-age=N' "
+    "(clients may reuse without revalidating for N seconds); 0 serves "
+    "'no-cache' so clients revalidate via the generation-derived ETag "
+    "(a clean tile costs one 304, no compose or render work)",
 )
 
 

@@ -82,6 +82,11 @@ def default_objectives() -> list[SloObjective]:
             "replica_staleness_p99", "geomesa.replica.staleness.ms",
             0.99, r / 1e3,
         ))
+    t = float(conf.OBS_SLO_TILES_P99_MS.get())
+    if t > 0:
+        out.append(SloObjective(
+            "tiles_p99", "geomesa.tiles.fetch", 0.99, t / 1e3
+        ))
     return out
 
 

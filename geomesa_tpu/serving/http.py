@@ -42,14 +42,22 @@ callers do, plus the things only a network boundary needs
   :class:`~geomesa_tpu.obs.ops.OpsRoutes` table mounts alongside the
   data routes, so one listener serves ``/metrics``, ``/health``,
   ``/stats`` and the debug surfaces too (``serve_ops`` remains the
-  standalone loopback variant).
+  standalone loopback variant);
+- **live map tiles** (``GET /tiles/<type>/<kind>/{z}/{x}/{y}``,
+  docs/tiles.md): precomposed density/count/heat tiles off the
+  :class:`~geomesa_tpu.tiles.TilePyramid`, served as deterministic PNG
+  or raw-count Arrow, with generation-derived ETags — an
+  ``If-None-Match`` revalidation that still matches answers **304**
+  with zero aggregation or render work (counted,
+  ``geomesa.tiles.not_modified``).
 
-Status-code contract (also docs/serving.md): 200 served/acked, 400
-malformed request (counted, ``geomesa.serve.badrequest`` — a hostile
-body must never traceback a worker thread), 403 auths/leader, 404
-unknown type or path, 413 body over ``geomesa.serve.max.body.bytes``,
-429 shed (Retry-After set), 503 staleness bound unmet (Retry-After
-set), 504 in-flight query deadline.
+Status-code contract (also docs/serving.md): 200 served/acked, 304
+tile ETag still valid, 400 malformed request (counted,
+``geomesa.serve.badrequest`` — a hostile body must never traceback a
+worker thread), 403 auths/leader, 404 unknown type or path, 413 body
+over ``geomesa.serve.max.body.bytes``, 429 shed (Retry-After set),
+503 staleness bound unmet (Retry-After set), 504 in-flight query
+deadline.
 """
 
 from __future__ import annotations
@@ -115,6 +123,13 @@ class DataServer:
             )
         self.tenants = self.sched.tenants
         self.metrics = resolve(getattr(self.cold, "metrics", None))
+        # the tile pyramid mounts over the cold store (tiles aggregate
+        # committed state; hot-tier writes bump the shared generations,
+        # so flushed rows appear as soon as they fold in). Built
+        # eagerly: handler threads must never race a lazy init.
+        from geomesa_tpu.tiles import TilePyramid
+
+        self.tiles = TilePyramid(self.cold, metrics=self.metrics)
         self.ops = OpsRoutes(self.cold, lam=self.lam, audit=audit)
         self.leader_url = leader_url
         self.host = host if host is not None else str(conf.SERVE_HOST.get())
@@ -226,7 +241,94 @@ class DataServer:
             ), {}
         if path.startswith("/query/"):
             return self._query(path[len("/query/"):], query, headers)
+        if path.startswith("/tiles/"):
+            return self._tile(path[len("/tiles/"):], query, headers)
         return self._client_error(404, f"unknown path {path!r}")
+
+    def _tile(self, rest: str, query: dict, headers):
+        """``/tiles/<type>/<kind>/<z>/<x>/<y>`` — one precomposed tile.
+
+        ``fmt=png`` (default) renders the grid (docs/tiles.md);
+        ``fmt=arrow`` returns the raw float64 count grid as one Arrow
+        IPC stream (kind-independent — kinds only differ in rendering).
+        ``mode=fresh`` bypasses the pyramid and re-aggregates from
+        scratch: the serving-time bit-identity oracle the bench uses.
+        """
+        import time as _time
+
+        from geomesa_tpu.security import VIS_FIELD_KEY
+        from geomesa_tpu.tiles import KINDS, render
+
+        t0 = _time.perf_counter()
+        parts = rest.split("/")
+        if len(parts) != 5:
+            return self._client_error(
+                404, "tile path is /tiles/<type>/<kind>/<z>/<x>/<y>"
+            )
+        type_name, kind = parts[0], parts[1]
+        req_auths, _tenant, err = self._identity(headers)
+        if err is not None:
+            return err
+        if kind not in KINDS:
+            return self._client_error(400, f"unknown tile kind {kind!r}")
+        try:
+            z, x, y = (int(p) for p in parts[2:])
+        except ValueError:
+            return self._client_error(400, "tile z/x/y must be integers")
+        fmt = (_first(query, "fmt") or "png").lower()
+        if fmt not in ("png", "arrow"):
+            return self._client_error(400, f"unknown fmt {fmt!r}")
+        mode = _first(query, "mode")
+        try:
+            sft = self._schema(type_name)
+        except KeyError:
+            return self._client_error(404, f"unknown type {type_name!r}")
+        if req_auths is not None and sft.user_data.get(VIS_FIELD_KEY):
+            # tiles are whole-store aggregates; an auth-narrowed viewer
+            # of a visibility-labeled schema must not read densities it
+            # could not read row-by-row
+            return self._client_error(
+                403, "tiles over a visibility-labeled schema are not "
+                     "auth-maskable; query the rows instead"
+            )
+        max_age = self.tiles.conf.max_age_s
+        cc = (
+            f"public, max-age={int(max_age)}" if max_age > 0 else "no-cache"
+        )
+        inm = (headers.get("If-None-Match") or "").strip()
+        if inm and mode != "fresh":
+            # conditional GET: a still-valid cached tile whose
+            # generation tick matches answers 304 with ZERO aggregation
+            # or render work (peek is read-only — no counters, no drops)
+            g = self.tiles.peek(type_name, z, x, y)
+            if g is not None and inm == f'"t{g.tick}"':
+                self.metrics.counter("geomesa.tiles.not_modified")
+                self.metrics.observe(
+                    "geomesa.tiles.fetch", _time.perf_counter() - t0
+                )
+                return 304, "image/png", b"", {
+                    "ETag": inm, "Cache-Control": cc,
+                }
+        try:
+            if mode == "fresh":
+                g = self.tiles.fresh(type_name, z, x, y)
+            else:
+                g = self.tiles.fetch(type_name, z, x, y)
+        except KeyError:
+            return self._client_error(404, f"unknown type {type_name!r}")
+        except ValueError as e:
+            return self._client_error(400, str(e))
+        extra = {"ETag": f'"t{g.tick}"', "Cache-Control": cc}
+        if fmt == "arrow":
+            try:
+                body, ctype = _grid_arrow(g.grid), ARROW_CTYPE
+            except RuntimeError as e:  # pyarrow not installed
+                return self._client_error(501, str(e))
+        else:
+            body, ctype = render(kind, g.grid), "image/png"
+        self.metrics.observe("geomesa.tiles.fetch", _time.perf_counter() - t0)
+        self.metrics.counter("geomesa.tiles.served")
+        return 200, ctype, body, extra
 
     def _query(self, type_name: str, query: dict, headers):
         from geomesa_tpu.planning.errors import QueryGuardError, QueryTimeout
@@ -475,6 +577,27 @@ def _arrow_chunks(fc, page_rows: int):
     return gen()
 
 
+def _grid_arrow(grid) -> bytes:
+    """One tile grid as one deterministic Arrow IPC stream: a single
+    float64 ``count`` column in row-major order, grid shape in the
+    schema metadata. Raises RuntimeError when pyarrow is missing (the
+    route answers 501, same as the query path's arrow fmt)."""
+    from geomesa_tpu.io.arrow import _pa
+
+    _pa()
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    h, w = grid.shape
+    table = pa.table(
+        {"count": pa.array(grid.reshape(-1), type=pa.float64())}
+    ).replace_schema_metadata({"rows": str(h), "cols": str(w)})
+    sink = pa.BufferOutputStream()
+    with ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
 # -- the HTTP plumbing ----------------------------------------------------
 
 class _Httpd(ThreadingHTTPServer):
@@ -718,6 +841,34 @@ class DataClient:
             headers=headers,
         )
         return json.loads(data)
+
+    def tile(self, type_name: str, kind: str, z: int, x: int, y: int,
+             fmt: str = "png", mode: "str | None" = None,
+             etag: "str | None" = None, auths=None,
+             tenant: "str | None" = None):
+        """Fetch one slippy-map tile: returns ``(status, headers dict,
+        body bytes)`` — 200 with PNG/Arrow bytes, or 304 with an empty
+        body when ``etag`` (a previous response's ETag header) still
+        matches. Raises :class:`ServeError` on any 4xx/5xx."""
+        path = (
+            f"/tiles/{quote(type_name)}/{quote(kind)}"
+            f"/{int(z)}/{int(x)}/{int(y)}?fmt={fmt}"
+        )
+        if mode is not None:
+            path += f"&mode={quote(mode)}"
+        extra = {}
+        if etag is not None:
+            extra["If-None-Match"] = etag
+        status, hdrs, data = self.request(
+            "GET", path, headers=self._headers(auths, tenant, extra)
+        )
+        if status >= 400:
+            try:
+                msg = json.loads(data).get("error", data.decode())
+            except Exception:
+                msg = data.decode(errors="replace")
+            raise ServeError(status, msg, headers=hdrs)
+        return status, hdrs, data
 
     def tenants(self) -> dict:
         _, data = self._checked("GET", "/tenants")

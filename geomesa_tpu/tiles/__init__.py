@@ -1,0 +1,21 @@
+"""geomesa_tpu.tiles: the live map-tile tier (docs/tiles.md).
+
+A slippy-map density pyramid behind the HTTP data plane: leaf tiles
+aggregate rows once on an exact global leaf lattice, parents fold child
+partials, and GenerationTracker's scoped invalidation keeps the whole
+structure incrementally maintained under sustained ingest — the
+GeoBlocks serving story (arXiv:1908.07753) this repo reproduces.
+
+- :class:`TileLattice` — the exact tiling geometry / binning;
+- :class:`TilePyramid` — precomposed grids + the from-scratch oracle;
+- :func:`render` / :func:`encode_png` — deterministic stdlib PNG.
+"""
+
+from geomesa_tpu.tiles.png import KINDS, encode_png, render
+from geomesa_tpu.tiles.pyramid import TileGrid, TilePyramid, TilesConfig
+from geomesa_tpu.tiles.tiling import TileLattice
+
+__all__ = [
+    "TileLattice", "TilePyramid", "TilesConfig", "TileGrid",
+    "KINDS", "encode_png", "render",
+]

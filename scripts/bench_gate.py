@@ -93,6 +93,15 @@ SCENARIO_SPECS = {
     "serve_http_mixed": [("cold_rows", "higher", ())],
     "serve_http_fairness": [],
     "serve_http_durability": [("acked_rows", "higher", ())],
+    # live map tiles (docs/tiles.md): same shared-host reasoning — the
+    # baseline comparison pins the deterministic workload shape (and
+    # the identical-flag sweep); the speedup / p99 / hit-ratio /
+    # invalidation teeth live in FRESH_BOUNDS
+    "tiles_serving": [
+        ("cold_rows", "higher", ()),
+        ("zooms_measured", "higher", ()),
+    ],
+    "tiles_invalidation": [("warmed_tiles", "higher", ())],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -194,6 +203,29 @@ FRESH_BOUNDS = {
         ("invented", 0.0, "max",
          "recover may not invent rows that were never acked"),
     ],
+    # the ISSUE 18 map-tile acceptance (docs/tiles.md): precomposed
+    # serving >=5x the from-scratch path at matched workload across
+    # >=3 zooms with the in-bench bit-identity oracle green (the
+    # identical-flag sweep); warm-hit p99 bounded under sustained
+    # ingest; the pyramid absorbs the warm working set; one localized
+    # write invalidates ONLY touched tiles — dirty tiles recompose
+    # under a new ETag while far tiles keep answering 304
+    "tiles_serving": [
+        ("speedup_min", 5.0, "min",
+         "precomposed tiles must be >=5x from-scratch at every zoom"),
+        ("zooms_measured", 3.0, "min",
+         "the speedup must be measured across >=3 zooms"),
+        ("warm_p99_ms", 75.0, "max",
+         "tile p99 must stay bounded under sustained ingest"),
+        ("hit_ratio", 0.7, "min",
+         "the pyramid must absorb the warm working set (cache hits)"),
+    ],
+    "tiles_invalidation": [
+        ("far_304", 1.0, "min",
+         "a tile far from the write must keep answering 304"),
+        ("touched_recomposed", 1.0, "min",
+         "a tile overlapping the write must recompose with a new ETag"),
+    ],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -206,6 +238,7 @@ BASELINES = {
     "BENCH_GEOFENCE": "BENCH_GEOFENCE.json",
     "BENCH_REPLICA": "BENCH_REPLICA.json",
     "BENCH_SERVE_HTTP": "BENCH_SERVE_HTTP.json",
+    "BENCH_TILES": "BENCH_TILES.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

@@ -967,3 +967,106 @@ def test_config_doc_lists_every_knob():
     text = open(os.path.join(_ROOT, "docs", "config.md")).read()
     missing = [n for n in sorted(regs.knobs.knobs) if n not in text]
     assert not missing, f"docs/config.md does not list: {missing}"
+
+
+def test_tiles_doc_honest():
+    """docs/tiles.md stays honest the registry way: every tile API it
+    names is real, every geomesa.tiles.* knob and metric is declared
+    at runtime and cited by the doc (knobs by config.md too), the
+    fault points exist in the source, and the documented endpoint,
+    CLI, bench and gate wiring is real."""
+    import inspect
+
+    from geomesa_tpu import cli
+    from geomesa_tpu.cache import QueryCache
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.serving.http import DataClient, DataServer
+    from geomesa_tpu.tiles import (
+        KINDS, TileGrid, TileLattice, TilePyramid, TilesConfig,
+        encode_png, render,
+    )
+
+    for m in ("fetch", "fresh", "peek", "note_delta", "invalidate_type",
+              "sweep", "stats"):
+        assert hasattr(TilePyramid, m), m
+    for m in ("leaf_span", "tile_bbox", "bin_leaf", "children_of",
+              "leaf_tiles_overlapping", "n_tiles", "valid"):
+        assert hasattr(TileLattice, m), m
+    for f in ("leaf_zoom", "px", "cache_max_bytes", "ttl_s",
+              "ttl_jitter", "max_age_s"):
+        assert f in TilesConfig.__dataclass_fields__, f
+    for f in ("grid", "tick", "count"):
+        assert f in TileGrid.__dataclass_fields__, f
+    assert KINDS == ("density", "count", "heat")
+    assert callable(encode_png) and callable(render)
+    # the cache-tier seam: mutation hooks forward to an attached
+    # pyramid, and its stats ride the cache tier's stats() payload
+    assert hasattr(QueryCache, "attach_pyramid")
+    assert hasattr(QueryCache, "stats")
+    src = inspect.getsource(QueryCache)
+    assert "pyramid" in src and "note_delta" in src
+    # the documented HTTP surface: the server mounts /tiles/, answers
+    # conditional GETs, and the stdlib client wraps it
+    serve_src = inspect.getsource(DataServer)
+    assert "/tiles/" in serve_src
+    assert "If-None-Match" in serve_src
+    assert "TilePyramid" in serve_src
+    assert hasattr(DataClient, "tile")
+    for p in ("fmt", "mode", "etag"):
+        assert p in inspect.signature(DataClient.tile).parameters, p
+    # the documented CLI command
+    assert hasattr(cli, "cmd_tile")
+    # every geomesa.tiles.* knob/metric resolves at runtime and is
+    # cited by the doc; knobs ride config.md's complete index too
+    knobs, metrics = _area_names("geomesa.tiles.")
+    assert len(knobs) >= 5 and len(metrics) >= 7, (knobs, metrics)
+    _assert_runtime_declared(knobs)
+    _assert_documented("tiles.md", knobs + metrics)
+    _assert_documented("config.md", knobs)
+    # the cross-area knobs the doc leans on: the shared TTL-jitter
+    # spread and the tile-serving SLO objective
+    _assert_runtime_declared(
+        ["geomesa.cache.ttl.jitter", "geomesa.obs.slo.tiles.p99.ms"]
+    )
+    _assert_documented(
+        "tiles.md",
+        ["geomesa.cache.ttl.jitter", "geomesa.obs.slo.tiles.p99.ms"],
+    )
+    # documented fault points exist at source level
+    import geomesa_tpu.tiles.pyramid as pyr
+
+    src = inspect.getsource(pyr)
+    for point in ("tiles.compose", "tiles.leaf.scan"):
+        assert point in src, point
+    # the documented metric kinds render through the registry
+    by_name = _registries().metrics.by_name()
+    reg = MetricsRegistry()
+    for n in metrics:
+        kind = by_name[n][0].instrument
+        if kind == "counter":
+            reg.counter(n)
+        elif kind == "gauge":
+            reg.gauge(n, 1.0)
+        elif kind == "histogram":
+            reg.observe(n, 0.01)
+        else:
+            reg.timer_update(n, 0.01)
+    text = reg.render_prometheus()
+    assert 'geomesa_tiles_fetch_seconds_bucket{le="' in text
+    assert "geomesa_tiles_served 1" in text
+    # bench + gate wiring (source-level contract, like config_replica)
+    bench_src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert "def config_tiles" in bench_src
+    assert '"tiles": config_tiles' in bench_src
+    assert "BENCH_TILES.json" in bench_src
+    gate_src = open(
+        os.path.join(_ROOT, "scripts", "bench_gate.py")
+    ).read()
+    assert "tiles_serving" in gate_src
+    assert "tiles_invalidation" in gate_src
+    assert "BENCH_TILES" in gate_src
+    doc = open(os.path.join(_ROOT, "docs", "tiles.md")).read()
+    assert "BENCH_TILES.json" in doc
+    # every `pyramid.X` the doc mentions in backticks resolves
+    for name in re.findall(r"`pyramid\.(\w+)", doc):
+        assert hasattr(TilePyramid, name), f"pyramid.{name}"

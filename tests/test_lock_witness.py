@@ -253,6 +253,12 @@ def _workload(tmp_path, metrics=None):
         dsv.tenants.configure("wl", queue_max=8)
         client = DataClient(dsv.url, tenant="wl")
         client.query("t", cql="BBOX(geom, -10, -10, 10, 10)")
+        # tile pyramid (docs/tiles.md): a leaf fetch crosses
+        # TilePyramid._lock on the scan-EWMA path; the ingest below
+        # then crosses it AGAIN under the store write lock (the
+        # declared DataStore._write_lock -> TilePyramid._lock edge,
+        # via on_mutation -> note_delta). One LEAF tile: a single scan.
+        client.tile("t", "density", 3, 0, 0)
         client.ingest("t", {"type": "FeatureCollection", "features": [{
             "type": "Feature", "id": "wl-ingest-1",
             "geometry": {"type": "Point", "coordinates": [0.5, 0.5]},

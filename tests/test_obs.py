@@ -461,20 +461,23 @@ def test_default_objectives_follow_knobs():
     objs = {o.name for o in obs.default_objectives()}
     assert objs == {
         "query_p99", "fold_slice_p99", "wal_fsync_p99",
-        "standing_alert_p99", "replica_staleness_p99",
+        "standing_alert_p99", "replica_staleness_p99", "tiles_p99",
     }
     conf.OBS_SLO_WAL_P99_MS.set(0)
     conf.OBS_SLO_STANDING_P99_MS.set(0)
     conf.OBS_SLO_REPLICA_STALENESS_P99_MS.set(0)
+    conf.OBS_SLO_TILES_P99_MS.set(0)
     try:
         objs = {o.name for o in obs.default_objectives()}
         assert "wal_fsync_p99" not in objs
         assert "standing_alert_p99" not in objs
         assert "replica_staleness_p99" not in objs
+        assert "tiles_p99" not in objs
     finally:
         conf.OBS_SLO_WAL_P99_MS.clear()
         conf.OBS_SLO_STANDING_P99_MS.clear()
         conf.OBS_SLO_REPLICA_STALENESS_P99_MS.clear()
+        conf.OBS_SLO_TILES_P99_MS.clear()
 
 
 def test_datastore_slo_report_end_to_end():

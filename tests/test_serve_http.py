@@ -615,3 +615,171 @@ class TestVisibilityFuzz:
         )
         m = security.visibility_mask(labels, frozenset({"a"}))
         assert m.tolist() == [True, True, True, False, True]
+
+
+# -- live map tiles over the wire (docs/tiles.md) ----------------------------
+
+class TestTiles:
+    """`GET /tiles/<type>/<kind>/{z}/{x}/{y}`: PNG/Arrow payloads,
+    generation-derived ETags, 304 revalidation with zero aggregation
+    work, and scoped invalidation observable over the socket."""
+
+    def _tile_store(self, n=400):
+        from geomesa_tpu.cache import CacheConfig
+
+        sft = FeatureType.from_spec("t", SPEC)
+        ds = DataStore(
+            tile=64, metrics=MetricsRegistry(),
+            cache=CacheConfig(max_bytes=1 << 22),
+        )
+        ds.create_schema(sft)
+        rng = np.random.default_rng(21)
+        ds.write("t", FeatureCollection.from_columns(
+            sft, [f"f{i}" for i in range(n)],
+            {"name": np.array([f"n{i}" for i in range(n)]),
+             "dtg": T0 + rng.integers(0, 20 * DAY, n),
+             "geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n))},
+        ))
+        return ds
+
+    def _agg_work(self, ds):
+        """Counter snapshot of every code path that aggregates or
+        composes — the 304 path must move NONE of them."""
+        return tuple(
+            ds.metrics.counter_value(n) for n in (
+                "geomesa.tiles.compose", "geomesa.tiles.leaf.scan",
+                "geomesa.tiles.fresh",
+            )
+        )
+
+    def test_png_etag_304_roundtrip(self):
+        ds = self._tile_store()
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            st, h, body = c.tile("t", "density", 1, 1, 0)
+            assert st == 200
+            assert h["Content-Type"] == "image/png"
+            assert body[:8] == b"\x89PNG\r\n\x1a\n"
+            assert h["Cache-Control"] == "no-cache"
+            etag = h["ETag"]
+            assert etag.startswith('"t') and etag.endswith('"')
+            # revalidation: 304, empty body, same etag, NO aggregation
+            # or render work, counted by geomesa.tiles.not_modified
+            work0 = self._agg_work(ds)
+            nm0 = ds.metrics.counter_value("geomesa.tiles.not_modified")
+            st2, h2, b2 = c.tile("t", "density", 1, 1, 0, etag=etag)
+            assert (st2, b2) == (304, b"")
+            assert h2["ETag"] == etag
+            assert self._agg_work(ds) == work0
+            assert ds.metrics.counter_value(
+                "geomesa.tiles.not_modified"
+            ) == nm0 + 1
+            # a stale etag re-serves the body
+            st3, h3, b3 = c.tile("t", "density", 1, 1, 0, etag='"t999"')
+            assert st3 == 200 and b3 == body
+        ds.close()
+
+    def test_warm_bit_identical_to_fresh_mode(self):
+        pytest.importorskip("pyarrow")
+        ds = self._tile_store()
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            for z, x, y in ((0, 0, 0), (1, 3, 1), (2, 5, 2), (3, 11, 4)):
+                _st, _h, warm = c.tile("t", "count", z, x, y, fmt="arrow")
+                _st, _h, oracle = c.tile(
+                    "t", "count", z, x, y, fmt="arrow", mode="fresh"
+                )
+                assert warm == oracle, (z, x, y)
+        ds.close()
+
+    def test_arrow_grid_decodes(self):
+        pa = pytest.importorskip("pyarrow")
+        ds = self._tile_store(n=100)
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            _st, _h, data = c.tile("t", "count", 0, 0, 0, fmt="arrow")
+            table = pa.ipc.open_stream(data).read_all()
+            meta = table.schema.metadata
+            h_, w_ = int(meta[b"rows"]), int(meta[b"cols"])
+            grid = np.asarray(table["count"]).reshape(h_, w_)
+            assert grid.shape == (256, 256)
+            # the wire grid IS the pyramid grid
+            assert np.array_equal(grid, srv.tiles.fetch("t", 0, 0, 0).grid)
+        ds.close()
+
+    def test_ingest_invalidates_scoped_over_http(self):
+        ds = self._tile_store()
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            z = srv.tiles.lattice.leaf_zoom
+            # two leaf tiles far apart: one will be written into
+            _st, th, _b = c.tile("t", "density", z, 8, 3)   # near (8, 8)
+            _st, fh, _b = c.tile("t", "density", z, 0, 0)   # far west
+            ack = c.ingest("t", _payload(_feature("new-0", "x", 8.0, 8.0)))
+            assert ack["acked"] == 1
+            # touched tile: the old etag misses and a NEW etag arrives
+            st, h2, _b = c.tile("t", "density", z, 8, 3, etag=th["ETag"])
+            assert st == 200 and h2["ETag"] != th["ETag"]
+            # far tile: still 304 off its old etag (stayed warm)
+            st, _h, _b = c.tile("t", "density", z, 0, 0, etag=fh["ETag"])
+            assert st == 304
+        ds.close()
+
+    def test_error_statuses(self):
+        ds = self._tile_store(n=20)
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            for args, kwargs, want in (
+                (("t", "viridis", 0, 0, 0), {}, 400),       # unknown kind
+                (("t", "density", 9, 0, 0), {}, 400),       # beyond leaf zoom
+                (("t", "density", 0, 5, 0), {}, 400),       # x out of range
+                (("zz", "density", 0, 0, 0), {}, 404),      # unknown type
+                (("t", "density", 0, 0, 0), {"fmt": "bmp"}, 400),
+            ):
+                with pytest.raises(ServeError) as ei:
+                    c.tile(*args, **kwargs)
+                assert ei.value.status == want, (args, kwargs)
+            # malformed path shape: 404, counted, no traceback
+            status, _h, _b = c.request("GET", "/tiles/t/density/1/2")
+            assert status == 404
+        ds.close()
+
+    def test_visibility_labeled_schema_narrowed_auths_403(self):
+        from geomesa_tpu.cache import CacheConfig
+
+        sft = FeatureType.from_spec("t", SPEC + ",vis:String")
+        sft.user_data[VIS_FIELD_KEY] = "vis"
+        ds = DataStore(
+            tile=64, auths=("admin", "user"), metrics=MetricsRegistry(),
+            cache=CacheConfig(max_bytes=1 << 22),
+        )
+        ds.create_schema(sft)
+        ds.write("t", FeatureCollection.from_columns(
+            sft, ["a", "b"],
+            {"name": np.array(["x", "y"]),
+             "dtg": np.full(2, T0, dtype=np.int64),
+             "geom": (np.array([1.0, 2.0]), np.array([1.0, 2.0])),
+             "vis": np.array(["admin", "user"])},
+        ))
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            # un-narrowed: tiles serve (the process's full view)
+            st, _h, _b = c.tile("t", "density", 0, 0, 0)
+            assert st == 200
+            # narrowed auths cannot read whole-store densities
+            with pytest.raises(ServeError) as ei:
+                c.tile("t", "density", 0, 0, 0, auths=("user",))
+            assert ei.value.status == 403
+        ds.close()
+
+    def test_tile_latency_histogram_records(self):
+        ds = self._tile_store(n=50)
+        with ds.serve(port=0) as srv:
+            c = DataClient(srv.url)
+            c.tile("t", "heat", 1, 0, 0)
+            st, h, _b = c.tile("t", "heat", 1, 0, 0)
+            c.tile("t", "heat", 1, 0, 0, etag=h["ETag"])
+            text = c.metrics_text()
+            assert "geomesa_tiles_fetch_seconds_bucket" in text
+            assert "geomesa_tiles_served 2" in text
+        ds.close()

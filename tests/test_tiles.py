@@ -1,0 +1,482 @@
+"""Live map tiles (ISSUE 18; docs/tiles.md): the precomposed density
+pyramid and its bit-identity / scoped-invalidation contracts.
+
+The invariants under test:
+
+- **bit-identity everywhere**: every precomposed tile equals the
+  from-scratch oracle (:meth:`TilePyramid.fresh`) exactly — across all
+  zooms, under fuzzed point sets, under sustained flush/fold mutation,
+  and for the adversarial fold whose slices straddle a tile boundary;
+- **exact-once binning**: a point on a shared tile edge lands in
+  exactly one tile, so per-zoom totals always conserve the row count;
+- **scoped invalidation, both directions**: a localized write dirties
+  ONLY the overlapping tile per zoom (they recompose) while far tiles
+  keep serving warm without recomposition;
+- **TTL jitter** (``geomesa.cache.ttl.jitter``): deterministic per-key
+  expiry spread — same key, same schedule, across cache instances;
+- **fault points**: ``tiles.compose`` / ``tiles.leaf.scan`` fire under
+  an armed chaos schedule (points="tiles.*") and the pyramid recovers
+  cleanly once disarmed.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import fault, geometry as geo
+from geomesa_tpu.cache import CacheConfig
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.streaming import LambdaStore, StreamConfig
+from geomesa_tpu.tiles import (
+    KINDS, TileLattice, TilePyramid, TilesConfig, encode_png, render,
+)
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64))
+DAY = 86_400_000
+
+#: small pyramid for fast full-matrix sweeps: 2+8+32 tiles, 32x32 px
+SMALL = TilesConfig(leaf_zoom=2, px=32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    fault.injector().reset()
+
+
+def _store(n=0, seed=0, cache=True):
+    from geomesa_tpu.metrics import MetricsRegistry
+
+    ds = DataStore(
+        cache=CacheConfig(max_bytes=1 << 22) if cache else None
+    )
+    ds.metrics = MetricsRegistry()
+    sft = FeatureType.from_spec("t", SPEC)
+    ds.create_schema(sft)
+    if n:
+        ds.write("t", _fc(sft, [f"c{i}" for i in range(n)], seed=seed))
+    return ds, sft
+
+
+def _fc(sft, ids, seed=0, lon=(-179.9, 179.9), lat=(-89.9, 89.9)):
+    rng = np.random.default_rng(seed)
+    n = len(ids)
+    return FeatureCollection.from_columns(
+        sft, list(ids),
+        {"name": np.array(["n"] * n),
+         "dtg": T0 + rng.integers(0, 30 * DAY, n),
+         "geom": (rng.uniform(*lon, n), rng.uniform(*lat, n))},
+    )
+
+
+def _xy_fc(sft, ids, x, y):
+    n = len(ids)
+    return FeatureCollection.from_columns(
+        sft, list(ids),
+        {"name": np.array(["n"] * n),
+         "dtg": np.full(n, T0, dtype=np.int64),
+         "geom": (np.asarray(x, float), np.asarray(y, float))},
+    )
+
+
+def _assert_identical(pyramid, type_name="t", zooms=None):
+    """Every tile at every zoom equals the from-scratch oracle, and the
+    per-zoom total equals the store's row count (no double-binning)."""
+    total = None
+    for z in zooms or range(pyramid.lattice.leaf_zoom + 1):
+        nx, ny = pyramid.lattice.n_tiles(z)
+        zsum = 0.0
+        for x in range(nx):
+            for y in range(ny):
+                warm = pyramid.fetch(type_name, z, x, y)
+                oracle = pyramid.fresh(type_name, z, x, y)
+                assert np.array_equal(warm.grid, oracle.grid), (z, x, y)
+                zsum += warm.grid.sum()
+        if total is None:
+            total = zsum
+        assert zsum == total, (z, zsum, total)
+    return total
+
+
+# -- the lattice geometry --------------------------------------------------
+
+
+class TestLattice:
+    def test_tile_counts_and_validity(self):
+        lat = TileLattice(leaf_zoom=3, px=256)
+        assert lat.n_tiles(0) == (2, 1)
+        assert lat.n_tiles(3) == (16, 8)
+        assert lat.valid(0, 1, 0) and not lat.valid(0, 2, 0)
+        assert not lat.valid(-1, 0, 0) and not lat.valid(4, 0, 0)
+        assert not lat.valid(1, 0, -1)
+
+    def test_edges_exact_and_partitioning(self):
+        lat = TileLattice(leaf_zoom=2, px=32)
+        assert lat.xe[0] == -180.0 and lat.xe[-1] == 180.0
+        assert lat.ye[0] == -90.0 and lat.ye[-1] == 90.0
+        assert np.all(np.diff(lat.xe) > 0) and np.all(np.diff(lat.ye) > 0)
+        # adjacent tile bboxes share their edge coordinate EXACTLY
+        for z in range(3):
+            nx, ny = lat.n_tiles(z)
+            for x in range(nx - 1):
+                a = lat.tile_bbox(z, x, 0)
+                b = lat.tile_bbox(z, x + 1, 0)
+                assert a[2] == b[0]
+            for y in range(ny - 1):
+                a = lat.tile_bbox(z, 0, y)
+                b = lat.tile_bbox(z, 0, y + 1)
+                # tile y counts from north: y+1 is SOUTH of y
+                assert a[1] == b[3]
+
+    def test_bin_leaf_half_open_and_world_edges(self):
+        lat = TileLattice(leaf_zoom=2, px=32)
+        # a point exactly on an interior pixel edge bins into the pixel
+        # whose LOWER edge it is (half-open [lo, hi))
+        edge = float(lat.xe[7])
+        col, _row, ok = lat.bin_leaf(
+            np.array([edge]), np.array([0.0])
+        )
+        assert ok[0] and col[0] == 7
+        # the world's own closed upper edges clamp into the last pixel
+        col, row, ok = lat.bin_leaf(
+            np.array([180.0, -180.0]), np.array([90.0, -90.0])
+        )
+        assert ok.all()
+        assert col[0] == lat.nx - 1 and col[1] == 0
+        assert row[0] == 0 and row[1] == lat.ny - 1  # row 0 = north
+        # outside the world: masked out
+        _c, _r, ok = lat.bin_leaf(
+            np.array([180.1, -999.0]), np.array([0.0, 0.0])
+        )
+        assert not ok.any()
+
+    def test_children_tile_the_parent_span(self):
+        lat = TileLattice(leaf_zoom=3, px=64)
+        c0, c1, r0, r1 = lat.leaf_span(1, 2, 1)
+        cols = np.zeros(c1 - c0, bool)
+        rows = np.zeros(r1 - r0, bool)
+        for cz, cx, cy in lat.children_of(1, 2, 1):
+            assert cz == 2
+            k0, k1, m0, m1 = lat.leaf_span(cz, cx, cy)
+            assert c0 <= k0 < k1 <= c1 and r0 <= m0 < m1 <= r1
+            cols[k0 - c0:k1 - c0] ^= True
+            rows[m0 - r0:m1 - r0] ^= True
+        # every leaf column/row covered by exactly TWO children (2x2)
+        assert not cols.any() and not rows.any()
+
+    def test_leaf_tiles_overlapping(self):
+        lat = TileLattice(leaf_zoom=2, px=32)
+        cx, cy = lat.n_tiles(2)
+        assert lat.leaf_tiles_overlapping(None) == cx * cy
+        # deep inside one 45-degree leaf tile
+        assert lat.leaf_tiles_overlapping((10.0, 10.0, 20.0, 20.0)) == 1
+        # straddling one vertical tile boundary (lon = 0)
+        assert lat.leaf_tiles_overlapping((-1.0, 10.0, 1.0, 20.0)) == 2
+        # straddling a corner: 2x2 tiles
+        assert lat.leaf_tiles_overlapping((-1.0, -1.0, 1.0, 1.0)) == 4
+        # a box hanging off the world clips, not crashes
+        assert lat.leaf_tiles_overlapping((170.0, 80.0, 999.0, 999.0)) == 1
+
+
+# -- the stdlib PNG encoder ------------------------------------------------
+
+
+class TestPng:
+    def test_signature_determinism_all_kinds(self):
+        rng = np.random.default_rng(0)
+        grid = rng.integers(0, 50, (32, 32)).astype(np.float64)
+        for kind in KINDS:
+            a = render(kind, grid)
+            b = render(kind, grid)
+            assert a == b
+            assert a[:8] == b"\x89PNG\r\n\x1a\n"
+            assert a.endswith(b"IEND\xaeB`\x82")
+        with pytest.raises(ValueError):
+            render("viridis", grid)
+
+    def test_empty_grid_renders(self):
+        grid = np.zeros((16, 16))
+        for kind in KINDS:
+            assert render(kind, grid)[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_scanlines_decode(self):
+        import struct
+        import zlib
+
+        grid = np.arange(64, dtype=np.float64).reshape(8, 8)
+        png = render("count", grid)
+        # IHDR dims match the grid
+        w, h = struct.unpack(">II", png[16:24])
+        assert (w, h) == (8, 8)
+        # IDAT inflates to h filter-0 scanlines of w bytes
+        i = png.index(b"IDAT")
+        (length,) = struct.unpack(">I", png[i - 4:i])
+        raw = zlib.decompress(png[i + 4:i + 4 + length])
+        assert len(raw) == h * (1 + w)
+        assert all(raw[r * (w + 1)] == 0 for r in range(h))
+
+
+# -- bit-identity: the tentpole contract -----------------------------------
+
+
+class TestPyramidIdentity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_identity_matrix_fuzzed(self, seed):
+        ds, _sft = _store(n=800, seed=seed)
+        p = TilePyramid(ds, SMALL)
+        assert _assert_identical(p) == 800.0
+        ds.close()
+
+    def test_identity_with_points_on_every_tile_edge(self):
+        ds, sft = _store()
+        lat = TileLattice(SMALL.leaf_zoom, SMALL.px)
+        # one point ON every interior leaf-TILE boundary intersection
+        xs = [float(lat.xe[c]) for c in range(0, lat.nx, SMALL.px)][1:]
+        ys = [float(lat.ye[r]) for r in range(0, lat.ny, SMALL.px)][1:-1]
+        px_, py_ = np.meshgrid(np.array(xs), np.array(ys))
+        px_, py_ = px_.ravel(), py_.ravel()
+        ds.write("t", _xy_fc(sft, [f"e{i}" for i in range(len(px_))],
+                             px_, py_))
+        p = TilePyramid(ds, SMALL)
+        # shared-edge points bin exactly once: totals conserve
+        assert _assert_identical(p) == float(len(px_))
+        ds.close()
+
+    def test_identity_under_sustained_flush_and_fold(self):
+        ds, sft = _store(n=400, seed=3)
+        p = TilePyramid(ds, SMALL)
+        _assert_identical(p)  # warm the whole pyramid
+        lam = LambdaStore(
+            ds, "t", config=StreamConfig(chunk_rows=32, fold_rows=8),
+        )
+        rng = np.random.default_rng(9)
+        total = 400
+        for round_ in range(3):
+            rows = [
+                {"name": "h", "dtg": T0 + round_,
+                 "geom": geo.Point(float(rng.uniform(-170, 170)),
+                                   float(rng.uniform(-80, 80)))}
+                for _ in range(40)
+            ]
+            lam.write(rows, ids=[f"h{round_}_{i}" for i in range(40)])
+            lam.flush()
+            total += 40
+            assert _assert_identical(p) == float(total)
+        # a fold that REPLACES existing ids must not change totals
+        moved = _fc(sft, [f"c{i}" for i in range(50)], seed=77)
+        ds.fold_upsert("t", moved)
+        assert _assert_identical(p) == float(total)
+        lam.close()
+
+    def test_fold_slices_straddling_tile_boundaries(self):
+        """The adversarial case: a sliced fold whose every slice
+        straddles a leaf-tile boundary — per-slice scoped bumps must
+        leave every tile bit-identical to the oracle."""
+        ds, sft = _store(n=200, seed=4)
+        p = TilePyramid(ds, SMALL)
+        _assert_identical(p)
+        # points alternating across the lon=0 tile boundary (a boundary
+        # at EVERY zoom), in batch order, so each 8-row slice straddles
+        n = 64
+        x = np.where(np.arange(n) % 2 == 0, -0.25, 0.25)
+        y = np.linspace(-40, 40, n)
+        batch = _xy_fc(sft, [f"s{i}" for i in range(n)], x, y)
+        ds.fold_upsert("t", batch, slice_rows=8)
+        assert _assert_identical(p) == float(200 + n)
+        ds.close()
+
+    def test_uncached_store_still_correct(self):
+        ds, _sft = _store(n=150, seed=5, cache=False)
+        p = TilePyramid(ds, SMALL)
+        assert p.stats()["tile_grid_entries"] == 0
+        assert _assert_identical(p, zooms=(0, 2)) == 150.0
+        assert p.stats()["tile_grid_entries"] == 0  # never caches
+        ds.close()
+
+
+# -- scoped invalidation: both directions ----------------------------------
+
+
+class TestScopedInvalidation:
+    def test_flush_dirties_only_touched_tiles(self):
+        ds, sft = _store(n=300, seed=6)
+        p = TilePyramid(ds, SMALL)
+        _assert_identical(p)  # warm every tile at every zoom
+        compose0 = ds.metrics.counter_value("geomesa.tiles.compose")
+        # one point deep inside a single generation grid cell, far from
+        # any tile boundary: exactly ONE tile per zoom overlaps it
+        ds.write("t", _xy_fc(sft, ["probe"], [8.0], [8.0]))
+        # direction 1: far tiles stay warm (peek still serves them)
+        far = p.peek("t", SMALL.leaf_zoom, 0, 0)  # far west tile
+        assert far is not None
+        # direction 2: the touched tile is stale (peek refuses it)
+        tx = 4  # lon 8 at z=2: col 4 of 8
+        ty = 1  # lat 8 from north: row 1 of 4
+        assert p.peek("t", SMALL.leaf_zoom, tx, ty) is None
+        # a full refetch recomposes EXACTLY one tile per zoom
+        _assert_identical(p)
+        recomposed = (
+            ds.metrics.counter_value("geomesa.tiles.compose") - compose0
+        )
+        assert recomposed == SMALL.leaf_zoom + 1, recomposed
+        ds.close()
+
+    def test_tick_is_the_etag_source(self):
+        ds, sft = _store(n=100, seed=7)
+        p = TilePyramid(ds, SMALL)
+        g1 = p.fetch("t", 0, 0, 0)
+        assert p.fetch("t", 0, 0, 0).tick == g1.tick  # warm: same tick
+        ds.write("t", _xy_fc(sft, ["w"], [-90.0 + 1.0], [45.0]))
+        g2 = p.fetch("t", 0, 0, 0)
+        assert g2.tick > g1.tick  # dirtied tile recomposed at a new tick
+
+    def test_note_delta_accounting(self):
+        ds, sft = _store(n=50, seed=8)
+        p = TilePyramid(ds, SMALL)
+        s0 = p.stats()
+        ds.write("t", _xy_fc(sft, ["a"], [10.0], [10.0]))
+        s1 = p.stats()
+        assert s1["tile_deltas"] == s0["tile_deltas"] + 1
+        assert s1["tile_dirty_leaves"] == s0["tile_dirty_leaves"] + 1
+        assert ds.metrics.counter_value("geomesa.tiles.dirty") >= 1
+
+    def test_schema_drop_and_quarantine_hooks(self):
+        ds, _sft = _store(n=60, seed=9)
+        p = TilePyramid(ds, SMALL)
+        p.fetch("t", 0, 0, 0)  # composes (and caches) its whole subtree
+        assert p.stats()["tile_grid_entries"] > 0
+        ds.cache.on_schema_dropped("t")
+        assert p.stats()["tile_grid_entries"] == 0
+
+
+# -- TTL jitter (geomesa.cache.ttl.jitter) ---------------------------------
+
+
+class TestTtlJitter:
+    def _cache(self, jitter):
+        from geomesa_tpu.cache.generations import GenerationTracker
+        from geomesa_tpu.cache.result import ResultCache, ResultCacheConf
+
+        return ResultCache(
+            ResultCacheConf(
+                max_bytes=1 << 20, ttl_s=100.0, ttl_jitter=jitter
+            ),
+            GenerationTracker(),
+        )
+
+    def _expiry(self, cache, key):
+        import time
+
+        from geomesa_tpu.cache.generations import KeyRange
+
+        t0 = time.monotonic()
+        cache.admit(key, "t", KeyRange.everything(),
+                    np.zeros(4), 1.0, cache.generations.tick())
+        return cache._entries[key].expires_at - t0
+
+    def test_jitter_spreads_expiry_deterministically(self):
+        c = self._cache(0.5)
+        keys = [f"tiles/t/2/{x}/{y}" for x in range(4) for y in range(2)]
+        expiries = {k: self._expiry(c, k) for k in keys}
+        # a burst of same-TTL admissions no longer expires in lockstep:
+        # spread inside [ttl, ttl * 1.5], and meaningfully apart
+        for e in expiries.values():
+            assert 100.0 <= e <= 150.0 + 0.1
+        assert max(expiries.values()) - min(expiries.values()) > 5.0
+        # deterministic: a fresh cache re-derives the SAME schedule
+        c2 = self._cache(0.5)
+        for k, e in expiries.items():
+            assert abs(self._expiry(c2, k) - e) < 0.1
+
+    def test_zero_jitter_is_exact_ttl(self):
+        c = self._cache(0.0)
+        for key in ("k1", "k2"):
+            assert abs(self._expiry(c, key) - 100.0) < 0.1
+
+    def test_knob_plumbs_through_both_cache_tiers(self):
+        from geomesa_tpu import conf
+        from geomesa_tpu.cache import CacheConfig as CC
+
+        conf.CACHE_TTL_JITTER.set(0.25)
+        try:
+            assert CC.from_properties().ttl_jitter == 0.25
+            assert TilesConfig.from_properties().ttl_jitter == 0.25
+            # knob-resolved configs flow into BOTH ResultCache tiers
+            ds = DataStore(cache=CC.from_properties())
+            ds.create_schema(FeatureType.from_spec("t", SPEC))
+            assert ds.cache.result.conf.ttl_jitter == 0.25
+            p = TilePyramid(ds)
+            assert p._result.conf.ttl_jitter == 0.25
+            ds.close()
+        finally:
+            conf.CACHE_TTL_JITTER.clear()
+
+
+# -- fault points under chaos ----------------------------------------------
+
+
+class TestChaos:
+    def test_tiles_fault_points_fire_and_recover(self):
+        ds, _sft = _store(n=80, seed=10)
+        p = TilePyramid(ds, SMALL)
+        with fault.chaos(
+            seed=1, rate=1.0, points="tiles.*", kinds=("io_error",)
+        ) as spec:
+            with pytest.raises(fault.InjectedIOError):
+                p.fetch("t", 0, 0, 0)
+            assert spec.fired >= 1
+        # leaf-scan point specifically: compose passes, the scan trips
+        with fault.chaos(
+            seed=2, rate=1.0, points="tiles.leaf.*", kinds=("io_error",)
+        ) as spec:
+            with pytest.raises(fault.InjectedIOError):
+                p.fetch("t", SMALL.leaf_zoom, 0, 0)
+            assert spec.fired >= 1
+        # disarmed: the pyramid serves correct tiles again
+        assert _assert_identical(p, zooms=(0,)) == 80.0
+        ds.close()
+
+
+# -- the offline CLI twin --------------------------------------------------
+
+
+class TestCli:
+    def test_cmd_tile_writes_the_served_png(self, tmp_path):
+        from geomesa_tpu.cli import main
+        from geomesa_tpu.storage import persist
+
+        root = str(tmp_path / "cat")
+        ds, sft = _store(n=120, seed=11)
+        persist.save(ds, root)
+        out = str(tmp_path / "tile.png")
+        rc = main([
+            "tile", "-c", root, "-f", "t", "1", "1", "0",
+            "--kind", "density", "-o", out,
+        ])
+        assert rc == 0
+        data = open(out, "rb").read()
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+        # the CLI bytes equal the pyramid render of the same tile
+        p = TilePyramid(ds)
+        assert data == render("density", p.fetch("t", 1, 1, 0).grid)
+        # --fresh (the oracle path) produces the same bytes
+        out2 = str(tmp_path / "tile2.png")
+        assert main([
+            "tile", "-c", root, "-f", "t", "1", "1", "0", "-o", out2,
+            "--fresh",
+        ]) == 0
+        assert open(out2, "rb").read() == data
+        # error paths: bad kind, bad zoom, unknown type
+        assert main([
+            "tile", "-c", root, "-f", "t", "1", "1", "0", "--kind", "x",
+        ]) == 1
+        assert main(["tile", "-c", root, "-f", "t", "9", "0", "0"]) == 1
+        assert main(["tile", "-c", root, "-f", "zz", "0", "0", "0"]) == 1
+        ds.close()
+
+
+def test_encode_png_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        encode_png(np.zeros((4, 4, 5), np.uint8))
