@@ -872,6 +872,273 @@ def config_cache(out_path: "str | None" = None):
     return rec
 
 
+# ------------------------------------------------------- drift scenario
+
+
+def config_drift(out_path: "str | None" = None):
+    """Workload-drift self-tuning scenario (docs/tuning.md "The drift
+    gate"): one dashboard workload served by a FROZEN store (an
+    operator-pinned cache-admission threshold), a SELF-TUNED store (the
+    same pin, ``cache_min_cost`` controller armed) and an ORACLE store
+    (the threshold an operator who had seen the drift coming would
+    pick). Phase 1 is a steady hotspot whose scans cost more than the
+    pinned threshold — all three serve repeats warm. Then the hotspot
+    MOVES and the new queries' scans are cheaper than the pin: the
+    frozen store stops admitting and re-scans every repeat, while the
+    armed controller senses the hit collapse and relaxes the floor.
+    Reported: the frozen store's own pre/post-drift QPS ratio, the
+    oracle/tuned post-drift ratio, the recorded decisions, and the
+    disarmed bit-identity flag. Emits BENCH_DRIFT.json (or
+    GEOMESA_BENCH_DRIFT_OUT / ``out_path``); scripts/bench_gate.py's
+    ``config_drift`` bounds are the teeth. Env knobs:
+    GEOMESA_BENCH_DRIFT_N (points), GEOMESA_BENCH_DRIFT_QUERIES,
+    GEOMESA_BENCH_DRIFT_REPS (measured passes per phase)."""
+    from geomesa_tpu import conf as gconf
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.metrics import MetricsRegistry
+    from geomesa_tpu.planning.explain import Explainer
+    from geomesa_tpu.planning.hints import QueryHints
+    from geomesa_tpu.sft import FeatureType
+
+    n = int(os.environ.get("GEOMESA_BENCH_DRIFT_N", 1_000_000))
+    n_q = int(os.environ.get("GEOMESA_BENCH_DRIFT_QUERIES", 12))
+    reps = int(os.environ.get("GEOMESA_BENCH_DRIFT_REPS", 6))
+    rng = np.random.default_rng(SEED + 90)
+    log(f"[drift] building 3x {n:,} point stores ...")
+    x = rng.uniform(-180.0, 180.0, n)
+    y = rng.uniform(-90.0, 90.0, n)
+    ids = np.arange(n)
+
+    def build(min_cost_s, tuned=False):
+        # the pin IS the knob: each store runs with its own
+        # ``geomesa.cache.min.cost`` setting (the cache snapshots it at
+        # build; the armed controller reads it live as the value it is
+        # allowed to move). Stores run strictly sequentially — the
+        # caller clears the knob after each store's run.
+        gconf.CACHE_MIN_COST.set(float(min_cost_s))
+        sft = FeatureType.from_spec("dash", "*geom:Point:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "z2"
+        reg = MetricsRegistry()
+        ds = DataStore(metrics=reg, cache=True)
+        ds.create_schema(sft)
+        ds.write("dash", FeatureCollection.from_columns(
+            sft, ids, {"geom": (x, y)}), check_ids=False)
+        mgr = None
+        if tuned:
+            # controller pulses ride the query path: twice per pass
+            mgr = ds.attach_tuning(enabled=True, interval=max(1, n_q // 2))
+        return ds, reg, mgr
+
+    def star(cx, cy, r_out, r_in, points=60):
+        # a concave 120-vertex star: the PIP refinement over its
+        # candidates is a STRUCTURAL cost floor (vertex count x
+        # candidate count), not a statistical one — scan-noise on a
+        # shared host cannot push it near a plain bbox probe
+        th = np.linspace(0.0, 2.0 * np.pi, 2 * points, endpoint=False)
+        rr = np.where(np.arange(2 * points) % 2 == 0, r_out, r_in)
+        xs, ys = cx + rr * np.cos(th), cy + rr * np.sin(th)
+        coords = ", ".join(f"{a:.4f} {b:.4f}" for a, b in zip(xs, ys))
+        return f"POLYGON(({coords}, {xs[0]:.4f} {ys[0]:.4f}))"
+
+    # hotspot A: concave-polygon region dashboards — expensive scans
+    # (device PIP over tens of k candidates). The drift moves the
+    # dashboard to hotspot B: drill-down bboxes in the east whose
+    # scans bottom out near the probe floor — far below any admission
+    # threshold tuned for A.
+    arng = np.random.default_rng(SEED + 91)
+    qa = [
+        f"INTERSECTS(geom, {star(float(arng.uniform(-130.0, -50.0)), float(arng.uniform(-35.0, 35.0)), 40.0, 18.0)})"
+        for _ in range(n_q)
+    ]
+    brng = np.random.default_rng(SEED + 92)
+    qb = []
+    for _ in range(n_q):
+        x0 = float(brng.uniform(5.0, 173.0))
+        y0 = float(brng.uniform(-85.0, 84.0))
+        qb.append(
+            f"bbox(geom, {x0:.4f}, {y0:.4f}, {x0 + 1.5:.4f}, {y0 + 1.0:.4f})"
+        )
+    bypass = QueryHints(cache="bypass")
+
+    # calibrate the operator's frozen pin between the two hotspots'
+    # measured scan costs (machine-dependent), inside the controller's
+    # [0, 50 ms] range
+    probe_ds, _, _ = build(0.0)
+    gconf.CACHE_MIN_COST.clear()
+    for q in qa + qb:  # compile kernels off the clock
+        probe_ds.query("dash", q, hints=bypass)
+
+    def _cost(ds, queries):
+        out = []
+        for q in queries:
+            s = time.perf_counter()
+            ds.query("dash", q, hints=bypass)
+            out.append(time.perf_counter() - s)
+        return float(np.median(out))
+
+    t_hi = _cost(probe_ds, qa)
+    t_lo = _cost(probe_ds, qb)
+    # split the measured costs: B scans must price BELOW the pin (the
+    # frozen store stops admitting after the drift) and A scans above
+    # it (the pin looked right when it was set). Geometric mean keeps
+    # equal RELATIVE margins on both sides of the wide polygon-vs-bbox
+    # gap; the controller's range caps the pin at 50 ms either way.
+    thr = float(np.sqrt(max(t_lo, 1e-6) * max(t_hi, 1e-6)))
+    if 1.25 * t_lo <= 0.8 * t_hi:
+        thr = max(1.25 * t_lo, min(thr, 0.8 * t_hi))
+    thr = min(thr, 0.05)
+    log(f"[drift] scan cost: hotspot A {t_hi * 1e3:.1f}ms, "
+        f"B {t_lo * 1e3:.1f}ms -> frozen pin {thr * 1e3:.1f}ms")
+    if not (t_lo < thr < t_hi):  # pragma: no cover - host-dependent
+        log("[drift] WARNING: could not place the pin between the "
+            "hotspots' costs; the scenario premise is weak on this host")
+    probe_ds.close()
+    del probe_ds
+    gc.collect()
+
+    def qps(ds, queries, passes):
+        t0 = time.perf_counter()
+        for _ in range(passes):
+            for q in queries:
+                ds.query("dash", q)
+        return (passes * len(queries)) / (time.perf_counter() - t0)
+
+    def run(ds):
+        for q in qa + qb:  # compile both hotspots off the clock
+            ds.query("dash", q, hints=bypass)
+        for _ in range(2):  # phase 1 populate
+            for q in qa:
+                ds.query("dash", q)
+        pre = qps(ds, qa, reps)  # steady hotspot, served warm
+        # the drift: the hotspot moves. Every store gets the same
+        # adaptation window (the tuned one senses the hit collapse in
+        # it; the frozen one just re-scans), then the same measurement.
+        for _ in range(6):
+            for q in qb:
+                ds.query("dash", q)
+        post = qps(ds, qb, reps)
+        return pre, post
+
+    results = {}
+    decisions = []
+    final_min_cost = None
+    for name, min_cost, tuned in (
+        ("frozen", thr, False), ("oracle", 0.0, False),
+        ("tuned", thr, True),
+    ):
+        ds, reg, mgr = build(min_cost, tuned=tuned)
+        try:
+            pre, post = run(ds)
+            results[name] = {
+                "pin_ms": round(min_cost * 1e3, 3),
+                "qps_pre": round(pre, 1),
+                "qps_post": round(post, 1),
+            }
+            log(f"[drift] {name}: pre {pre:.0f} q/s -> post {post:.0f} q/s")
+            if mgr is not None:
+                rep = mgr.report()
+                decisions = [
+                    d for d in rep["decisions"]
+                    if d.get("controller") == "cache_min_cost"
+                ]
+                final_min_cost = ds.cache.result.conf.min_cost_s
+                results[name]["final_pin_ms"] = round(final_min_cost * 1e3, 3)
+                results[name]["pulses"] = rep["pulses"]
+            ds.close()
+        finally:
+            gconf.CACHE_MIN_COST.clear()
+        del ds
+        gc.collect()
+
+    # the off switch: a DISARMED manager must leave a store
+    # bit-identical to one without the tier (plans, explains, results)
+    def small_store():
+        sft = FeatureType.from_spec("dash", "*geom:Point:srid=4326")
+        sft.user_data["geomesa.indices.enabled"] = "z2"
+        ds = DataStore(metrics=MetricsRegistry(), cache=True)
+        ds.create_schema(sft)
+        k = min(n, 50_000)
+        ds.write("dash", FeatureCollection.from_columns(
+            sft, ids[:k], {"geom": (x[:k], y[:k])}), check_ids=False)
+        return ds
+
+    plain, disarmed = small_store(), small_store()
+    disarmed.attach_tuning(enabled=False)
+
+    def _strip(e):  # timing lines differ run to run; everything else may not
+        return [l for l in e.lines if "ms" not in l]
+
+    identical = True
+    for q in (qa + qb)[:8]:
+        e1, e2 = Explainer(), Explainer()
+        r1 = plain.query("dash", q, explain=e1)
+        r2 = disarmed.query("dash", q, explain=e2)
+        if (
+            not np.array_equal(np.asarray(r1.ids), np.asarray(r2.ids))
+            or _strip(e1) != _strip(e2)
+        ):
+            identical = False
+    plain.close()
+    disarmed.close()
+
+    frozen_degradation = (
+        results["frozen"]["qps_pre"]
+        / max(results["frozen"]["qps_post"], 1e-9)
+    )
+    tuned_over_oracle = (
+        results["oracle"]["qps_post"]
+        / max(results["tuned"]["qps_post"], 1e-9)
+    )
+    row = {
+        "scenario": "config_drift",
+        "n_points": n,
+        "n_queries": n_q,
+        "reps": reps,
+        "pin_ms": round(thr * 1e3, 3),
+        "hotspot_scan_ms": {
+            "pre": round(t_hi * 1e3, 3), "post": round(t_lo * 1e3, 3),
+        },
+        "frozen": results["frozen"],
+        "oracle": results["oracle"],
+        "tuned": results["tuned"],
+        "frozen_degradation": round(frozen_degradation, 3),
+        "tuned_over_oracle": round(tuned_over_oracle, 3),
+        "decisions_recorded": len(decisions),
+        "decisions": decisions[:8],
+        "disarmed_identical": identical,
+        "identical": identical,
+    }
+    log(f"[drift] frozen degraded {frozen_degradation:.1f}x; tuned holds "
+        f"{1 / max(tuned_over_oracle, 1e-9):.2f}x of oracle; "
+        f"{len(decisions)} decisions; disarmed identical: {identical}")
+
+    import jax
+
+    payload = {"platform": jax.default_backend(), "rows": [row]}
+    if out_path is None:
+        out_path = os.environ.get("GEOMESA_BENCH_DRIFT_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DRIFT.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec = {
+        "metric": "drift_frozen_degradation",
+        "value": round(frozen_degradation, 3),
+        "unit": "x",
+        "tuned_over_oracle": round(tuned_over_oracle, 3),
+        "decisions_recorded": len(decisions),
+        "disarmed_identical": identical,
+        "n_points": n,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ----------------------------------------------------- serving scenario
 
 
@@ -3959,6 +4226,7 @@ def child_main():
         "obs": config_obs, "standing": config_standing,
         "ops": config_ops, "replica": config_replica,
         "serve_http": config_serve_http, "tiles": config_tiles,
+        "drift": config_drift,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

@@ -174,7 +174,7 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
            "while held — it sits on every batch's match path)"),
     _d("_MatchGate._lock", "geomesa_tpu/streaming/standing.py", 45,
        hot=True,
-       fields=("host_s", "fused_s"),
+       fields=("_host", "_fused"),
        doc="fused/host cost-gate EWMAs: read by every batch's candidate "
            "pick and updated after every matcher path runs — pure "
            "arithmetic under it, no other lock ever held"),
@@ -196,7 +196,7 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
        doc="result-cache LRU + single-flight bookkeeping (probed at "
            "admission by the serving tier)"),
     _d("TileAggregateCache._lock", "geomesa_tpu/cache/tiles.py", 52,
-       fields=("_tiles", "_scan_s", "_compose_s", "_compose_n", "_gated"),
+       fields=("_tiles", "_scan_s", "_compose_s", "_probe"),
        doc="tile LRU + adaptive cost-gate EWMAs"),
     _d("TilePyramid._lock", "geomesa_tpu/tiles/pyramid.py", 54,
        fields=("_deltas", "_dirty_leaves", "_leaf_scan_s"),
@@ -228,6 +228,13 @@ LOCKS: dict[str, LockDecl] = {d.name: d for d in [
        doc="trace retention rings + sampling counter: taken once per "
            "root begin/end, never per child span; nothing blocking "
            "runs under it and it acquires no other lock"),
+    _d("TuningManager._lock", "geomesa_tpu/tuning/manager.py", 77,
+       fields=("_queries", "_pulses", "_pulsing", "_decisions"),
+       doc="tuning pacing counters + the decision ring + the pulse "
+           "claim flag: a LEAF by design — every sense/adjust step "
+           "(metrics reads, accuracy report, SLO burn, conf writes) "
+           "runs OUTSIDE it between claim and release; only arithmetic "
+           "and the deque extend ever hold it"),
     _d("TelemetryRecorder._lock", "geomesa_tpu/obs/ops.py", 79,
        fields=("_rings",),
        doc="telemetry history rings: the 1 Hz sampler appends points "

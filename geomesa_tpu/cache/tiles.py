@@ -37,6 +37,7 @@ from typing import Optional
 import numpy as np
 
 from geomesa_tpu.cache.generations import GenerationTracker, KeyRange
+from geomesa_tpu.tuning.primitives import ProbeGate, ewma_step
 
 
 @dataclass
@@ -76,6 +77,8 @@ class TileCacheConf:
 # for fragmented edge-strip scans. The cache measures BOTH costs per type
 # (EWMAs) and gates composition off when it is losing, re-probing
 # periodically in case the balance shifts (store grew, tiles warmed).
+# The blend/explore/re-probe mechanics live in tuning/primitives.py —
+# this gate, the join gate and standing's match gate share them.
 _EXPLORE_MIN = 6     # composes observed before the gate may trip
 _REPROBE_EVERY = 8   # gated attempts between re-explorations
 _EWMA_ALPHA = 0.25
@@ -113,8 +116,7 @@ class TileAggregateCache:
         # composition cost, plus the gated-attempt counter for re-probes
         self._scan_s: dict[str, float] = {}      # guarded-by: _lock
         self._compose_s: dict[str, float] = {}   # guarded-by: _lock
-        self._compose_n: dict[str, int] = {}     # guarded-by: _lock
-        self._gated: dict[str, int] = {}         # guarded-by: _lock
+        self._probe: "dict[str, ProbeGate]" = {}  # guarded-by: _lock
         self._scanning = threading.local()
         n = 1 << conf.tile_bits
         # exact binary-rational tile edges (i * 360/2^bits sums exactly in
@@ -167,20 +169,24 @@ class TileAggregateCache:
         if getattr(self._scanning, "active", False):
             return
         with self._lock:
-            prev = self._scan_s.get(type_name)
-            self._scan_s[type_name] = (
-                seconds if prev is None
-                else prev + _EWMA_ALPHA * (seconds - prev)
+            self._scan_s[type_name] = ewma_step(
+                self._scan_s.get(type_name), seconds, _EWMA_ALPHA
             )
 
     def _note_compose(self, type_name: str, seconds: float) -> None:
         with self._lock:
-            prev = self._compose_s.get(type_name)
-            self._compose_s[type_name] = (
-                seconds if prev is None
-                else prev + _EWMA_ALPHA * (seconds - prev)
+            self._compose_s[type_name] = ewma_step(
+                self._compose_s.get(type_name), seconds, _EWMA_ALPHA
             )
-            self._compose_n[type_name] = self._compose_n.get(type_name, 0) + 1
+            self._gate_locked(type_name).note_trial()
+
+    def _gate_locked(self, type_name: str) -> ProbeGate:
+        gate = self._probe.get(type_name)
+        if gate is None:
+            gate = self._probe[type_name] = ProbeGate(
+                _EXPLORE_MIN, _REPROBE_EVERY
+            )
+        return gate
 
     def worth_composing(self, type_name: str) -> bool:
         """The gate: True until _EXPLORE_MIN compositions are measured,
@@ -188,17 +194,15 @@ class TileAggregateCache:
         re-exploration every _REPROBE_EVERY gated attempts. Gating is a
         pure perf decision; composed answers stay exact either way."""
         with self._lock:
-            if self._compose_n.get(type_name, 0) < _EXPLORE_MIN:
+            gate = self._gate_locked(type_name)
+            if gate.exploring:
                 return True
             scan = self._scan_s.get(type_name)
             comp = self._compose_s.get(type_name)
             if scan is None or comp is None or comp <= scan:
                 return True
-            g = self._gated.get(type_name, 0) + 1
-            if g >= _REPROBE_EVERY:
-                self._gated[type_name] = 0
+            if gate.block():
                 return True
-            self._gated[type_name] = g
             self.metrics.counter("geomesa.cache.tile.gated")
             return False
 

@@ -210,6 +210,11 @@ class QueryPlanner:
         self._config_memo: "OrderedDict" = OrderedDict()
         self._memo_lock = threading.Lock()
         self._memo_epoch = 0  # bumped by every invalidation (see below)
+        # plan-feedback hook (docs/tuning.md): an armed tuning tier
+        # installs its IndexReweighter here; None (the default, and the
+        # disarmed state) keeps cost() bit-identical to the static
+        # multipliers. Reads are lock-free (immutable table swap).
+        self.reweighter = None
 
     @property
     def mutation_epoch(self) -> int:
@@ -447,8 +452,21 @@ class QueryPlanner:
         StrategyDecider.scala:143-180). The primary estimator is exact —
         the sum of the searchsorted row spans the ranges cover, since the
         sorted keys are host-resident; the sketch estimate (Z3Histogram)
-        and the bare priority constant are fallbacks."""
+        and the bare priority constant are fallbacks. An armed tuning
+        tier inflates the multiplier of an index whose row estimates
+        chronically miss (docs/tuning.md leg a) — bounded, hysteretic,
+        and explain-traced; factor 1.0 (or no reweighter) leaves the
+        cost bit-identical to the static decision."""
         mult = index_priority(index_name)
+        rw = self.reweighter
+        if rw is not None:
+            fac = rw.factor(type_name, index_name)
+            if fac != 1.0:
+                mult *= fac
+                exp(
+                    f"Index {index_name}: estimate-accuracy reweight "
+                    f"x{fac:.2f} (docs/tuning.md)"
+                )
         try:
             table = self.store.table(type_name, index_name)
         except KeyError:

@@ -116,11 +116,10 @@ def derive_link_constants(rtt_ms: float, pull_mb_s: "float | None" = None) -> di
     """Pure derivation (no state change): the fused-chunk slot cap and
     M-bucket floor a measured link profile calls for."""
     from geomesa_tpu.storage.table import FUSED_CHUNK_SLOTS
+    from geomesa_tpu.tuning.primitives import doubling_ladder
 
     want = FUSED_CHUNK_SLOTS * max(float(rtt_ms), 1e-3) / DESIGN_LINK_RTT_MS
-    slots = 256
-    while slots < want and slots < FUSED_CHUNK_SLOTS:
-        slots *= 2
+    slots = doubling_ladder(want, 256, FUSED_CHUNK_SLOTS)
     fast = rtt_ms <= 5.0 or (pull_mb_s is not None and pull_mb_s >= 200.0)
     return {
         "fused_chunk_slots": slots,
@@ -154,7 +153,16 @@ def link_constants() -> dict:
 
 def fused_slot_cap() -> int:
     """The fused-chunk slot cap in force (IndexTable.fused_slots clamps
-    to min(this, the table's own block-count bucket))."""
+    to min(this, the table's own block-count bucket)). Resolution:
+    the ``geomesa.scan.fused.slots`` knob when pinned nonzero (how the
+    tuning tier's fused_chunk_slots controller actuates), else the
+    probed link constants, else the compiled default — so an untuned,
+    unprobed store keeps today's deterministic shapes."""
+    from geomesa_tpu import conf
+
+    pinned = int(conf.SCAN_FUSED_SLOTS.get() or 0)
+    if pinned > 0:
+        return pinned
     cap = _LINK_CONSTANTS["fused_chunk_slots"]
     if cap is not None:
         return int(cap)

@@ -42,39 +42,43 @@ from geomesa_tpu import geometry as geo
 from geomesa_tpu.features import FeatureCollection
 from geomesa_tpu.filter.predicates import PointColumn
 from geomesa_tpu.metrics import resolve as _resolve_metrics
+from geomesa_tpu.tuning.primitives import CostEwma
 
 
 class _AdaptiveGate:
     """Measured-cost strategy picker (the tile cache's adaptive-gate
-    pattern): EWMAs of the exact predicate's per-(point x edge) cost and
-    the raster classification's per-point cost, updated from every
-    partition actually executed. Predictions are per partition:
+    pattern, shared mechanics in tuning/primitives.py): EWMAs of the
+    exact predicate's per-(point x edge) cost and the raster
+    classification's per-point cost, updated from every partition
+    actually executed. Predictions are per partition:
     plain = n * E * pip vs raster = n * cls + boundary_frac * n * E * pip
     with ``boundary_frac`` the partition's sampled selectivity."""
 
     _ALPHA = 0.25
 
     def __init__(self):
-        self.pip_s: float | None = None  # seconds per point*edge
-        self.cls_s: float | None = None  # seconds per classified point
+        self._pip = CostEwma(self._ALPHA)  # seconds per point*edge
+        self._cls = CostEwma(self._ALPHA)  # seconds per classified point
         self._lock = threading.Lock()
 
+    @property
+    def pip_s(self) -> "float | None":
+        return self._pip.value
+
+    @property
+    def cls_s(self) -> "float | None":
+        return self._cls.value
+
     def update(self, kind: str, seconds: float, units: int) -> None:
-        if units <= 0 or seconds <= 0:
-            return
-        per = seconds / units
+        ewma = self._pip if kind == "pip_s" else self._cls
         with self._lock:
-            cur = getattr(self, kind)
-            setattr(
-                self, kind,
-                per if cur is None else (1 - self._ALPHA) * cur + self._ALPHA * per,
-            )
+            ewma.update_cost(seconds, units)
 
     def pick(self, n_cand: int, n_edges: int, boundary_frac: float) -> str:
         # cold-start priors from the measured CPU bench (PERF.md §13);
         # real measurements take over after the first partitions
-        pip = self.pip_s if self.pip_s is not None else 4e-9
-        cls = self.cls_s if self.cls_s is not None else 2e-8
+        pip = self._pip.value_or(4e-9)
+        cls = self._cls.value_or(2e-8)
         plain = n_cand * n_edges * pip
         rast = n_cand * cls + boundary_frac * n_cand * n_edges * pip
         return "raster" if rast < plain else "exact"

@@ -341,30 +341,32 @@ class _MatchGate:
 
     def __init__(self):
         from geomesa_tpu.lockwitness import witness
+        from geomesa_tpu.tuning.primitives import CostEwma
 
-        self.host_s: "float | None" = None   # guarded-by: _lock
-        self.fused_s: "float | None" = None  # guarded-by: _lock
+        self._host = CostEwma(self._ALPHA)   # guarded-by: _lock
+        self._fused = CostEwma(self._ALPHA)  # guarded-by: _lock
         self._lock = witness(threading.Lock(), "_MatchGate._lock")
 
+    @property
+    def host_s(self) -> "float | None":
+        return self._host.value
+
+    @property
+    def fused_s(self) -> "float | None":
+        return self._fused.value
+
     def update(self, kind: str, seconds: float, units: int) -> None:
-        if units <= 0 or seconds <= 0:
-            return
-        per = seconds / units
+        ewma = self._host if kind == "host_s" else self._fused
         with self._lock:
-            cur = getattr(self, kind)
-            setattr(
-                self, kind,
-                per if cur is None
-                else (1 - self._ALPHA) * cur + self._ALPHA * per,
-            )
+            ewma.update_cost(seconds, units)
 
     def pick(self, host_units: np.ndarray,
              fused_units: np.ndarray) -> "np.ndarray | None":
         """Per-candidate fused-wins mask, or None when the fused side is
         still unmeasured (the caller runs the bounded probe)."""
         with self._lock:
-            fused_s = self.fused_s
-            host_s = self.host_s
+            fused_s = self._fused.value
+            host_s = self._host.value
         if fused_s is None:
             return None
         if host_s is None:

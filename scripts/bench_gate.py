@@ -102,6 +102,12 @@ SCENARIO_SPECS = {
         ("zooms_measured", "higher", ()),
     ],
     "tiles_invalidation": [("warmed_tiles", "higher", ())],
+    # self-tuning drift (docs/tuning.md "The drift gate"): absolute QPS
+    # swings on a shared host, so the baseline comparison pins only the
+    # deterministic workload shape (and the identical-flag sweep, which
+    # here is the DISARMED-off-switch bit-identity oracle); the
+    # degradation / oracle-ratio / decision teeth live in FRESH_BOUNDS
+    "config_drift": [("n_points", "higher", ())],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -226,6 +232,21 @@ FRESH_BOUNDS = {
         ("touched_recomposed", 1.0, "min",
          "a tile overlapping the write must recompose with a new ETag"),
     ],
+    # the ISSUE 19 self-tuning acceptance (docs/tuning.md): under the
+    # drifted workload a FROZEN config degrades its own pre-drift rate
+    # by >=30% while the armed controller holds within 1.5x of the
+    # oracle config, records its decisions, and the disarmed store
+    # stays bit-identical to a store without the tier
+    "config_drift": [
+        ("frozen_degradation", 1.30, "min",
+         "the frozen config must degrade >=30% under the drifted workload"),
+        ("tuned_over_oracle", 1.5, "max",
+         "the self-tuned store must hold within 1.5x of the oracle config"),
+        ("decisions_recorded", 1.0, "min",
+         "the controller must RECORD the decisions that recovered the rate"),
+        ("disarmed_identical", 1.0, "min",
+         "geomesa.tuning.enabled=false must be bit-identical to no tier"),
+    ],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -239,6 +260,7 @@ BASELINES = {
     "BENCH_REPLICA": "BENCH_REPLICA.json",
     "BENCH_SERVE_HTTP": "BENCH_SERVE_HTTP.json",
     "BENCH_TILES": "BENCH_TILES.json",
+    "BENCH_DRIFT": "BENCH_DRIFT.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

@@ -354,6 +354,35 @@ def test_fault_point_registry_matches_kinds():
         assert doc, name
 
 
+def test_unregistered_controller_spec_is_caught(fixture_result):
+    """ISSUE 19 must-fail: one ControllerSpec trips every direction the
+    controller-registry rule checks — unregistered name, undeclared
+    knob, inverted bounds, unemitted objective — while the disciplined
+    twin (mirroring the shipped derive controller) stays silent."""
+    bad = _at(fixture_result, "controller_bad.py", "controller-registry")
+    symbols = {f.symbol for f in bad}
+    assert symbols == {
+        "bogus_controller", "knob:bogus_controller",
+        "bounds:bogus_controller", "objective:bogus_controller",
+    }, _render(bad)
+    for f in bad:
+        assert "bogus_controller" in f.message
+
+
+def test_controller_registry_matches_specs():
+    """Registry hygiene: CONTROLLERS names are snake_case with docs,
+    and the shipped spec tuple backs every entry exactly (the unbacked
+    direction of the rule at zero findings on the clean tree)."""
+    from geomesa_tpu.analysis.registries import CONTROLLERS
+    from geomesa_tpu.tuning.controllers import CONTROLLER_SPECS
+
+    assert len(CONTROLLERS) >= 4
+    for name, doc in CONTROLLERS.items():
+        assert name == name.lower() and " " not in name, name
+        assert doc, name
+    assert {s.name for s in CONTROLLER_SPECS} == set(CONTROLLERS)
+
+
 def test_fstring_family_reported_once(fixture_result):
     """An f-string fragment is scanned exactly once: the JoinedStr
     branch owns it, the plain-Constant walk must skip it (the
