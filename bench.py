@@ -4187,6 +4187,204 @@ def config_tiles(out_path: "str | None" = None):
     return rec_line
 
 
+def config_pod(out_path: "str | None" = None):
+    """Multi-host pod scenario (docs/distributed.md): H=4 sim hosts
+    against the H=1 flat mesh on the SAME device budget, emitted as
+    BENCH_POD.json.
+
+    1. **Selective scan** — a closed loop of small-bbox queries against
+       a ``DataStore(mesh=host_group)`` (per-host contiguous shards;
+       non-owning hosts do zero work) vs the identical store on the
+       flat single-process mesh over the same devices. The speedup is
+       REAL wall-clock work reduction — fewer, smaller per-host legs —
+       and the ``identical`` flag is the in-bench differential: every
+       probe (and a fused ``query_many`` batch) answers with exactly
+       the flat store's ids.
+    2. **Host-local ingest** — the collection partitions by owner and
+       each host's pipelined ``BulkLoader`` leg is timed IN ISOLATION;
+       the pod wall-clock is the slowest host's leg (in deployment each
+       host is its own machine, so in-process thread concurrency would
+       only measure this bench host's single-core contention, not pod
+       capacity — the replica read-scaling measurement's reasoning).
+       The ``identical`` flag checks the union of per-host shards
+       answers exactly like the flat store.
+
+    Needs >= hosts devices (CPU runs: XLA_FLAGS=
+    --xla_force_host_platform_device_count=8). Env knobs:
+    GEOMESA_BENCH_POD_HOSTS, GEOMESA_BENCH_POD_N (scan rows),
+    GEOMESA_BENCH_POD_INGEST_N, GEOMESA_BENCH_POD_READ_S,
+    GEOMESA_BENCH_POD_OUT (fresh-side output path)."""
+    import zlib
+
+    import jax
+
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.ingest.pipeline import BulkLoader
+    from geomesa_tpu.pod import make_host_group
+    from geomesa_tpu.sft import FeatureType
+
+    hosts = int(os.environ.get("GEOMESA_BENCH_POD_HOSTS", 4))
+    n_scan = int(os.environ.get("GEOMESA_BENCH_POD_N", 150_000))
+    n_ingest = int(os.environ.get("GEOMESA_BENCH_POD_INGEST_N", 400_000))
+    read_s = float(os.environ.get("GEOMESA_BENCH_POD_READ_S", 3.0))
+    n_dev = len(jax.devices())
+    if n_dev < hosts:
+        raise RuntimeError(
+            f"config_pod needs >= {hosts} devices, found {n_dev}; on CPU "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    group = make_host_group(
+        hosts=hosts, devices_per_host=n_dev // hosts, driver="sim"
+    )
+    t0_ms = 1_704_067_200_000
+    spec = "dtg:Date,*geom:Point:srid=4326"
+
+    def point_fc(sft, n, seed):
+        rng = np.random.default_rng(seed)
+        return FeatureCollection.from_columns(
+            sft, np.arange(n).astype(str),
+            {"dtg": t0_ms + rng.integers(0, 20 * 86_400_000, n),
+             "geom": (rng.uniform(-60, 60, n), rng.uniform(-45, 45, n))},
+        )
+
+    def build(mesh, n, seed):
+        sft = FeatureType.from_spec("pp", spec)
+        ds = DataStore(mesh=mesh)
+        ds.create_schema(sft)
+        ds.write("pp", point_fc(sft, n, seed), check_ids=False)
+        ds.compact("pp")
+        return ds
+
+    # 1. selective scan: pod vs flat, same devices, same rows
+    pod = build(group, n_scan, SEED + 120)
+    flat = build(group.flat_mesh(), n_scan, SEED + 120)
+    rng = np.random.default_rng(SEED + 121)
+    probes = []
+    for _ in range(12):
+        x0, y0 = rng.uniform(-55, 40), rng.uniform(-40, 30)
+        probes.append(
+            f"bbox(geom, {x0:.3f}, {y0:.3f}, {x0 + 4:.3f}, {y0 + 3:.3f})"
+        )
+
+    def ids_of(fc):
+        return sorted(np.asarray(fc.ids, dtype=str).tolist())
+
+    for ds in (pod, flat):
+        for q in probes:
+            ds.query("pp", q)  # warm the per-variant kernels
+    scan_identical = all(
+        ids_of(pod.query("pp", q)) == ids_of(flat.query("pp", q))
+        for q in probes
+    ) and all(
+        ids_of(a) == ids_of(b)
+        for a, b in zip(pod.query_many("pp", probes),
+                        flat.query_many("pp", probes))
+    )
+
+    def measure(ds):
+        k = 0
+        t0 = time.perf_counter()
+        while True:
+            ds.query("pp", probes[k % len(probes)])
+            k += 1
+            dt = time.perf_counter() - t0
+            if dt >= read_s:
+                return k / dt
+
+    pod_qps = measure(pod)
+    flat_qps = measure(flat)
+    scan_speedup = pod_qps / max(flat_qps, 1e-9)
+    log(
+        f"[pod] selective scan H={hosts}: {pod_qps:,.1f} q/s vs flat "
+        f"{flat_qps:,.1f} q/s (x{scan_speedup:.2f}), identical="
+        f"{scan_identical}"
+    )
+
+    # 2. host-local ingest: per-owner partitions, each host's loader
+    # leg timed in isolation; pod wall = the slowest host's leg
+    sft = FeatureType.from_spec("pp", spec)
+    fc = point_fc(sft, n_ingest, SEED + 122)
+    owners = np.array(
+        [zlib.crc32(str(i).encode()) % hosts for i in fc.ids], np.int64
+    )
+
+    def load(mesh, sub):
+        ds = DataStore(mesh=mesh)
+        ds.create_schema(FeatureType.from_spec("pp", spec))
+        t0 = time.perf_counter()
+        loader = BulkLoader(ds, "pp")
+        loader.put(sub)
+        loader.close()
+        return ds, time.perf_counter() - t0
+
+    flat_ing, flat_s = load(group.flat_mesh(), fc)
+    host_stores, host_s = [], []
+    for h in range(hosts):
+        ds, t = load(group.mesh(h), fc.take(np.flatnonzero(owners == h)))
+        host_stores.append(ds)
+        host_s.append(t)
+    pod_model_s = max(host_s)
+    ingest_speedup = flat_s / max(pod_model_s, 1e-9)
+    ing_q = "bbox(geom, -20, -15, 10, 12)"
+    union_ids = sorted(
+        i for ds in host_stores
+        for i in np.asarray(ds.query("pp", ing_q).ids, dtype=str).tolist()
+    )
+    ingest_identical = (
+        union_ids == ids_of(flat_ing.query("pp", ing_q))
+        and sum(ds.count("pp") for ds in host_stores)
+        == flat_ing.count("pp") == n_ingest
+    )
+    log(
+        f"[pod] host-local ingest: flat {flat_s:.2f}s vs slowest host "
+        f"{pod_model_s:.2f}s (x{ingest_speedup:.2f} host-parallel "
+        f"model), identical={ingest_identical}"
+    )
+
+    rows = [
+        {
+            "scenario": "pod_scan",
+            "hosts": hosts, "devices": n_dev, "rows": n_scan,
+            "read_s": read_s,
+            "pod_qps": round(pod_qps, 1),
+            "flat_qps": round(flat_qps, 1),
+            "scan_speedup": round(scan_speedup, 3),
+            "identical": bool(scan_identical),
+        },
+        {
+            "scenario": "pod_ingest",
+            "hosts": hosts, "rows": n_ingest,
+            "flat_s": round(flat_s, 4),
+            "host_s": [round(t, 4) for t in host_s],
+            "pod_model_s": round(pod_model_s, 4),
+            "ingest_speedup": round(ingest_speedup, 3),
+            "identical": bool(ingest_identical),
+        },
+    ]
+
+    payload = {"platform": jax.default_backend(), "rows": rows}
+    if out_path is None:
+        out_path = os.environ.get("GEOMESA_BENCH_POD_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_POD.json"
+        )
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:  # pragma: no cover - read-only checkout
+        log(f"WARNING: could not write {out_path}: {e}")
+
+    rec_line = {
+        "metric": "pod_scan_speedup",
+        "value": rows[0]["scan_speedup"],
+        "unit": "x",
+        "ingest_speedup": rows[1]["ingest_speedup"],
+        "identical": bool(scan_identical and ingest_identical),
+    }
+    print(json.dumps(rec_line), flush=True)
+    return rec_line
+
+
 def child_main():
     """One bench attempt in THIS process (device init + all configs)."""
     import threading
@@ -4226,7 +4424,7 @@ def child_main():
         "obs": config_obs, "standing": config_standing,
         "ops": config_ops, "replica": config_replica,
         "serve_http": config_serve_http, "tiles": config_tiles,
-        "drift": config_drift,
+        "drift": config_drift, "pod": config_pod,
     }
     results: dict[str, dict] = {}
     for c in CONFIGS:

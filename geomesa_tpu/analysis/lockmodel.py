@@ -94,6 +94,19 @@ def _d(name, path, rank, hot=False, fields=(), doc=""):
 #: tiers. Rank numbers are sparse on purpose (new locks slot between
 #: neighbors without renumbering). Outermost (lowest rank) first.
 LOCKS: dict[str, LockDecl] = {d.name: d for d in [
+    _d("HostGroup._probe_lock", "geomesa_tpu/pod/hostgroup.py", 6,
+       fields=("link_rtts_ms", "slot_caps"),
+       doc="per-host link profile (probed RTTs + derived fused slot "
+           "caps): a LEAF acquired before any store/table lock — "
+           "profiles install at group construction, before tables "
+           "build, and shard builds only READ the caps after release"),
+    _d("PodStore._route_lock", "geomesa_tpu/pod/store.py", 8,
+       fields=("_next_id",),
+       doc="pod-level id assignment for ownership routing: ranks BELOW "
+           "every host store's locks (DataStore._write_lock 10 up) "
+           "because a routed write next descends into one host's "
+           "LambdaStore; held only around the id counter, never across "
+           "host calls"),
     _d("DataStore._write_lock", "geomesa_tpu/datastore.py", 10,
        fields=("_publish_seq", "_fold_progress"),
        doc="store mutation lock: writes/compactions/folds serialize; "
@@ -372,7 +385,7 @@ DECLARED_BLOCKING: list[tuple[str, str, str]] = [
 ENFORCED_SCOPES = (
     "geomesa_tpu/streaming/", "geomesa_tpu/serving/", "geomesa_tpu/cache/",
     "geomesa_tpu/ingest/", "geomesa_tpu/metrics.py", "geomesa_tpu/fault.py",
-    "geomesa_tpu/datastore.py", "geomesa_tpu/obs/",
+    "geomesa_tpu/datastore.py", "geomesa_tpu/obs/", "geomesa_tpu/pod/",
 )
 
 #: attribute-name type hints for cross-class call resolution where the

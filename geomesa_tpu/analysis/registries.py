@@ -110,6 +110,11 @@ FAULT_POINTS: dict[str, str] = {
     # map-tile pyramid (tiles/pyramid.py; docs/tiles.md)
     "tiles.compose": "before a pyramid tile composes (leaf scan or child fold)",
     "tiles.leaf.scan": "before a leaf tile's backing row scan",
+    # multi-host pod tier (pod/; docs/distributed.md)
+    "pod.dispatch": "before one host's scan/ingest leg is dispatched",
+    "pod.join": "before per-host results merge at the coordinator",
+    "pod.wal.route": "before a routed slice reaches its owning host's WAL",
+    "pod.wal.replay": "before a killed host's WAL replay on rejoin",
 }
 
 # -- controllers ----------------------------------------------------------

@@ -163,6 +163,33 @@ INGEST_MERGE_MIN_BINS = SystemProperty(
 )
 
 
+# -- multi-host pod tier (geomesa_tpu.pod; docs/distributed.md) -----------
+
+POD_HOSTS = SystemProperty(
+    "geomesa.pod.hosts", 0, int,
+    "host-group size H for the pod tier (0 = one host per jax process "
+    "under the distributed driver, else one simulated host per local "
+    "device slice)",
+)
+POD_DEVICES_PER_HOST = SystemProperty(
+    "geomesa.pod.devices.per.host", 0, int,
+    "devices each host contributes to its shard mesh (0 = divide the "
+    "visible devices evenly over the hosts)",
+)
+POD_DRIVER = SystemProperty(
+    "geomesa.pod.driver", "auto", str,
+    "host-group driver: 'distributed' (real jax.distributed processes), "
+    "'sim' (in-process per-host device slices), or 'auto' (distributed "
+    "when launched under a multi-process jax runtime, else sim)",
+)
+POD_LINK_PROBE = SystemProperty(
+    "geomesa.pod.link.probe", False, _parse_bool,
+    "measure each host's pull link at host-group construction and derive "
+    "PER-HOST fused slot caps from the probes (off = deterministic "
+    "design-point shapes on every host; see docs/distributed.md)",
+)
+
+
 # -- raster-interval polygon approximations + adaptive spatial joins
 # (geomesa_tpu.filter.raster, sql/join.py; docs/joins.md) ------------------
 

@@ -203,11 +203,12 @@ class DistributedIndexTable(IndexTable):
         keys: WriteKeys,
         mesh: Mesh,
         tile: int | None = None,
+        sorted_state: "np.ndarray | None" = None,
     ):
         self.mesh = mesh
         self.n_devices = int(mesh.devices.size)
         self.axis = mesh.axis_names[0]
-        super().__init__(keyspace, keys, tile=tile)
+        super().__init__(keyspace, keys, tile=tile, sorted_state=sorted_state)
 
     # -- layout hooks ----------------------------------------------------
     def _round_blocks(self, n_blocks: int) -> int:
@@ -263,8 +264,12 @@ class DistributedIndexTable(IndexTable):
         """PER-DEVICE slot bucket of the canonical fused shape: the
         single-chip clamp applied to the LOCAL block count (each device
         scans its own round-robin share, so a mesh table's fused dispatch
-        is D lists of this size, not one global list)."""
-        return min(bk.fused_slot_cap(), bk.bucket_of(max(1, self.blocks_local)))
+        is D lists of this size, not one global list). ``_slot_cap`` is a
+        per-shard probed cap (pod host groups set one per host)."""
+        return min(
+            bk.fused_slot_cap(self._slot_cap),
+            bk.bucket_of(max(1, self.blocks_local)),
+        )
 
     @property
     def fused_pack_capacity(self) -> int:
@@ -283,19 +288,12 @@ class DistributedIndexTable(IndexTable):
         device's planes. Members decode lazily per (member, device)
         segment and merge like per-query distributed scans, so fused
         results are bit-identical to `_device_scan_submit` per query."""
-        from geomesa_tpu.planning.errors import check_deadline
-
-        D = self.n_devices
-        slots = self.fused_slots
         if self._fused_route_single(members, finishes, deadline):
             return
-        # member-major per-device split: global block g -> device g % D,
-        # local slot g // D (the round-robin deal, _place_cols)
-        per = [
-            [m[2][m[2] % D == d] // D for m in members] for d in range(D)
-        ]
-        counts = [sum(len(p) for p in row) for row in per]
-        if max(counts) > slots:
+        raw = self._fused_raw_finishes(
+            members, names, has_boxes, has_windows, deadline
+        )
+        if raw is None:
             # candidate skew overflowed one device's static slot bucket
             # (members' blocks clustered on one residue class): split the
             # chunk and recurse — bottoms out at the per-query route
@@ -307,6 +305,39 @@ class DistributedIndexTable(IndexTable):
                 members[half:], names, has_boxes, has_windows, finishes, deadline
             )
             return
+
+        def member_finish(k):
+            j, config, blocks, overlap, contained = members[k]
+            rows, certain = raw[k]()
+            return self._post_decode(rows, certain, config, overlap, contained)
+
+        for k, (j, *_rest) in enumerate(members):
+            finishes[j] = lambda k=k: member_finish(k)
+
+    def _fused_raw_finishes(
+        self, members, names, has_boxes, has_windows, deadline
+    ):
+        """The dispatch half of the fused chunk, decoupled from routing:
+        submit ONE `_dist_scan_multi` over every member's candidate
+        blocks and return one raw finish per member — each yields this
+        table's (rows, certain) in SORTED-ROW coordinates, before
+        `_post_decode`. Returns None (nothing dispatched) when candidate
+        skew overflows the static slot bucket, leaving the split/retry
+        policy to the caller. The pod table drives this seam per host
+        shard — one batched plane pull per host — and applies the global
+        `_post_decode` itself after offsetting shard rows."""
+        from geomesa_tpu.planning.errors import check_deadline
+
+        D = self.n_devices
+        slots = self.fused_slots
+        # member-major per-device split: global block g -> device g % D,
+        # local slot g // D (the round-robin deal, _place_cols)
+        per = [
+            [m[2][m[2] % D == d] // D for m in members] for d in range(D)
+        ]
+        counts = [sum(len(p) for p in row) for row in per]
+        if max(counts) > slots:
+            return None
         check_deadline(deadline, "device scan dispatch")
         boxes, wins = self._fused_param_stacks(members)
         chunk_e, edges, pip = self._chunk_edge_stack(members)
@@ -341,8 +372,7 @@ class DistributedIndexTable(IndexTable):
         wide, inner = out if isinstance(out, tuple) else (out, None)
         group_pull = self._fused_pull(wide, inner)
 
-        def member_finish(k):
-            j, config, blocks, overlap, contained = members[k]
+        def raw_finish(k):
             wide_h, inner_h = group_pull()
             check_deadline(deadline, "bitmask decode")
             parts = []
@@ -356,11 +386,9 @@ class DistributedIndexTable(IndexTable):
                     None if inner_h is None else np.ascontiguousarray(inner_h[d, s:e]),
                     gb, e - s,
                 ))
-            rows, certain = self._merge_device_rows(parts)
-            return self._post_decode(rows, certain, config, overlap, contained)
+            return self._merge_device_rows(parts)
 
-        for k, (j, *_rest) in enumerate(members):
-            finishes[j] = lambda k=k: member_finish(k)
+        return [lambda k=k: raw_finish(k) for k in range(len(members))]
 
     # -- device hooks ----------------------------------------------------
     def _device_scan_submit(self, blocks: np.ndarray, config: ScanConfig):

@@ -66,12 +66,16 @@ def make_multihost_mesh(
     )
 
 
-def _host_major(devs, hosts: int, devices_per_host: int) -> list:
-    """Order devices host-major by ``process_index``: the first
-    ``devices_per_host`` devices of each of the first ``hosts`` processes,
-    concatenated. Single-process runs (tests, the virtual CPU mesh) have
-    one process_index — they slice its devices into synthetic host
-    groups, which preserves the layout semantics without a pod."""
+def host_major_slices(devs, hosts: int, devices_per_host: int) -> list:
+    """Per-host device slices, host-major: ``out[h]`` is host h's
+    ``devices_per_host`` devices. Devices group by ``process_index``
+    (real multi-process pods); single-process runs (tests, the virtual
+    CPU mesh) slice the one process's devices into synthetic host
+    groups, which preserves the layout semantics without a pod. This is
+    the shared layout authority: ``make_multihost_mesh`` concatenates
+    the slices into one flat scan axis, and the pod host-group tier
+    (geomesa_tpu.pod) builds one PER-HOST shard mesh from each slice —
+    both see the same device-to-host assignment."""
     by_host: dict = {}
     for d in devs:
         by_host.setdefault(getattr(d, "process_index", 0), []).append(d)
@@ -83,12 +87,20 @@ def _host_major(devs, hosts: int, devices_per_host: int) -> list:
                 raise ValueError(
                     f"host {h} has {len(hd)} devices, need {devices_per_host}"
                 )
-            out.extend(hd[:devices_per_host])
+            out.append(hd[:devices_per_host])
         return out
     n = hosts * devices_per_host
     if n > len(devs):
         raise ValueError(f"asked for {n} devices, have {len(devs)}")
-    return list(devs[:n])
+    return [
+        list(devs[h * devices_per_host : (h + 1) * devices_per_host])
+        for h in range(hosts)
+    ]
+
+
+def _host_major(devs, hosts: int, devices_per_host: int) -> list:
+    """Flat host-major device order (see ``host_major_slices``)."""
+    return [d for hd in host_major_slices(devs, hosts, devices_per_host) for d in hd]
 
 
 def shard_spec(mesh: Mesh) -> NamedSharding:

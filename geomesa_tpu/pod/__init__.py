@@ -1,0 +1,33 @@
+"""Multi-host pod tier: host-group abstraction over the mesh scan path.
+
+The reference GeoMesa scales by spreading tablets over Accumulo/HBase
+region servers; this package is the TPU-pod analogue. A
+:class:`~geomesa_tpu.pod.hostgroup.HostGroup` names H hosts and their
+per-host device slices behind two interchangeable drivers (a real
+``jax.distributed`` multi-process world, or a deterministic in-process
+simulation over local device slices), a
+:class:`~geomesa_tpu.pod.table.PodIndexTable` deals the sorted table's
+blocks HOST-MAJOR so each host owns one contiguous shard (per-host
+memory ~1/H, selective queries dispatch only to owning hosts), and a
+:class:`~geomesa_tpu.pod.store.PodStore` shards the streaming story —
+per-host WAL + hot tier with host-local acks, host-local pipelined
+ingest, per-host standing-subscription shards. See docs/distributed.md.
+"""
+
+from geomesa_tpu.pod.hostgroup import (
+    HostGroup,
+    PodUnsupported,
+    make_host_group,
+    probe_capability,
+)
+from geomesa_tpu.pod.table import PodIndexTable
+from geomesa_tpu.pod.store import PodStore
+
+__all__ = [
+    "HostGroup",
+    "PodIndexTable",
+    "PodStore",
+    "PodUnsupported",
+    "make_host_group",
+    "probe_capability",
+]

@@ -151,18 +151,23 @@ def link_constants() -> dict:
     return out
 
 
-def fused_slot_cap() -> int:
+def fused_slot_cap(local_cap: "int | None" = None) -> int:
     """The fused-chunk slot cap in force (IndexTable.fused_slots clamps
     to min(this, the table's own block-count bucket)). Resolution:
     the ``geomesa.scan.fused.slots`` knob when pinned nonzero (how the
-    tuning tier's fused_chunk_slots controller actuates), else the
-    probed link constants, else the compiled default — so an untuned,
-    unprobed store keeps today's deterministic shapes."""
+    tuning tier's fused_chunk_slots controller actuates), else
+    ``local_cap`` (a PER-HOST probed cap — pod host groups derive one
+    per shard so a slow host's bigger amortization bucket never inflates
+    its peers' pad-slot work), else the probed link constants, else the
+    compiled default — so an untuned, unprobed store keeps today's
+    deterministic shapes."""
     from geomesa_tpu import conf
 
     pinned = int(conf.SCAN_FUSED_SLOTS.get() or 0)
     if pinned > 0:
         return pinned
+    if local_cap is not None:
+        return int(local_cap)
     cap = _LINK_CONSTANTS["fused_chunk_slots"]
     if cap is not None:
         return int(cap)

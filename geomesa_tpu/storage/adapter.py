@@ -86,7 +86,14 @@ class InProcessAdapter:
             kwargs: dict = {"tile": self.tile} if self.tile else {}
             if self.mesh is not None:
                 from geomesa_tpu.parallel import DistributedIndexTable
+                from geomesa_tpu.pod.hostgroup import HostGroup
 
+                if isinstance(self.mesh, HostGroup):
+                    from geomesa_tpu.pod.table import PodIndexTable
+
+                    # a host group rides the mesh seam: per-host
+                    # contiguous shards instead of one flat deal
+                    return PodIndexTable(keyspace, keys, self.mesh, **kwargs)
                 # mesh tables re-sort (their deal layout derives from the
                 # sort anyway); ignoring sorted_state is correct
                 return DistributedIndexTable(keyspace, keys, self.mesh, **kwargs)

@@ -393,6 +393,11 @@ class IndexTable(SortedKeys):
         multiple of the mesh size)."""
         return n_blocks
 
+    # per-table probed slot cap: pod host groups stamp one per host shard
+    # so a slow host's bigger amortization bucket stays its own (None =
+    # the process-wide link constants)
+    _slot_cap: "int | None" = None
+
     @property
     def fused_slots(self) -> int:
         """Slot count of THIS table's canonical fused-dispatch shape:
@@ -403,8 +408,9 @@ class IndexTable(SortedKeys):
         this is the PER-DEVICE slot bucket. The cap itself is
         link-derived (bk.fused_slot_cap: the hand-tuned 2048 on the 66 ms
         design link, smaller on a measured fast link — bench.py installs
-        the probe-derived constants before warmup)."""
-        return min(bk.fused_slot_cap(), bk.bucket_of(self.n_blocks))
+        the probe-derived constants before warmup, per host via
+        ``_slot_cap`` under a pod host group)."""
+        return min(bk.fused_slot_cap(self._slot_cap), bk.bucket_of(self.n_blocks))
 
     @property
     def fused_pack_capacity(self) -> int:

@@ -108,6 +108,13 @@ SCENARIO_SPECS = {
     # here is the DISARMED-off-switch bit-identity oracle); the
     # degradation / oracle-ratio / decision teeth live in FRESH_BOUNDS
     "config_drift": [("n_points", "higher", ())],
+    # multi-host pods (docs/distributed.md): the baseline-compared
+    # metrics are the WITHIN-RUN speedup ratios (host-speed cancels
+    # out, like replica_scaling); the absolute floors live in
+    # FRESH_BOUNDS and the in-bench differential rides the
+    # identical-flag sweep
+    "pod_scan": [("scan_speedup", "higher", ())],
+    "pod_ingest": [("ingest_speedup", "higher", ())],
 }
 
 # within-run invariants checked on the FRESH file alone (no baseline
@@ -247,6 +254,23 @@ FRESH_BOUNDS = {
         ("disarmed_identical", 1.0, "min",
          "geomesa.tuning.enabled=false must be bit-identical to no tier"),
     ],
+    # the ISSUE 20 pod acceptance (docs/distributed.md): H=4 sim hosts
+    # on the same device budget clear real speedup floors — selective
+    # scan from owning-host-only dispatch, ingest from per-host 1/H
+    # legs (slowest-host wall, the host-parallel model) — with the
+    # in-bench pod-vs-flat differential green
+    "pod_scan": [
+        ("scan_speedup", 2.5, "min",
+         "H=4 selective scan must clear 2.5x the flat mesh on the "
+         "same device budget"),
+        ("hosts", 4.0, "min",
+         "the pod bench must run >= 4 sim hosts"),
+    ],
+    "pod_ingest": [(
+        "ingest_speedup", 2.0, "min",
+        "host-local ingest (slowest-host wall) must clear 2x the "
+        "single flat loader",
+    )],
 }
 
 # fresh-file basename marker -> committed baseline it gates against
@@ -261,6 +285,7 @@ BASELINES = {
     "BENCH_SERVE_HTTP": "BENCH_SERVE_HTTP.json",
     "BENCH_TILES": "BENCH_TILES.json",
     "BENCH_DRIFT": "BENCH_DRIFT.json",
+    "BENCH_POD": "BENCH_POD.json",
 }
 DEFAULT_BASELINE = "BENCH_PIP_JOIN.json"
 

@@ -3,7 +3,15 @@ contributes 4 virtual CPU devices via jax.distributed, the multihost
 mesh spans all 8, and a shard_map psum crosses the process boundary —
 the DCN-analogue path executed for real (single machine, TCP transport).
 
-Usage: python scripts/probe_multiprocess.py  (spawns its two workers)
+Usage: python scripts/probe_multiprocess.py          (spawns its two workers)
+       python scripts/probe_multiprocess.py --json   (machine-readable verdict)
+
+The ``--json`` mode is the pod host-group tier's capability probe
+(geomesa_tpu/pod/hostgroup.py): it always exits 0 and prints ONE json
+line ``{"supported": ..., "verdict": "supported"|"UNSUPPORTED"|"error",
+"reason": ...}`` — the distributed driver and its tests key off the
+verdict (skip-not-fail on CPU backends without multi-process
+collectives) instead of pattern-matching exit codes.
 
 Environment note (late round 5): the TPU tunnel plugin used to hang the
 workers — its sitecustomize.py (on PYTHONPATH) monkeypatches
@@ -78,10 +86,56 @@ def worker(pid: int, port: int):
         print(f"PASS: cross-process psum = {got} (expected {want})", flush=True)
 
 
+def probe() -> dict:
+    """Launch the two workers and distill their exit codes into the
+    machine-readable capability verdict (never raises):
+
+    - ``supported``  — the cross-process psum ran and checked out;
+    - ``UNSUPPORTED`` — a worker hit the backend's missing-collective
+      error (exit 3): the environment can't run multi-process
+      collectives, which is a skip, not a failure;
+    - ``error``      — anything else (crash, timeout, port exhaustion).
+    """
+    try:
+        rc = _launch_workers()
+    except Exception as e:  # launcher infrastructure failure
+        return {"supported": False, "verdict": "error",
+                "reason": f"probe launcher failed: {e}", "worker_rcs": None}
+    if not any(rc):
+        return {"supported": True, "verdict": "supported",
+                "reason": "two-process jax.distributed psum OK",
+                "worker_rcs": rc}
+    if 3 in rc:
+        return {"supported": False, "verdict": "UNSUPPORTED",
+                "reason": "no cross-process collectives on this backend "
+                          "(CPU client without multiprocess computations)",
+                "worker_rcs": rc}
+    return {"supported": False, "verdict": "error",
+            "reason": f"probe workers failed (rcs={rc})", "worker_rcs": rc}
+
+
 def main():
+    if sys.argv[1:2] == ["--json"]:
+        import json
+
+        print(json.dumps(probe()), flush=True)
+        return
     if len(sys.argv) > 2:
         worker(int(sys.argv[1]), int(sys.argv[2]))
         return
+    rc = _launch_workers()
+    if not any(rc):
+        print("two-process distributed probe: OK", flush=True)
+        return
+    if 3 in rc:
+        # a worker reported UNSUPPORTED (see worker()): propagate the
+        # distinct code so the suite can skip, not fail
+        raise SystemExit(3)
+    raise SystemExit(f"worker rcs: {rc}")
+
+
+def _launch_workers() -> list:
+    """Spawn the two isolated workers; return their exit codes."""
     # isolate the CPU-only workers from the TPU tunnel plugin: it
     # injects via a sitecustomize.py on PYTHONPATH that monkeypatches
     # jax.get_backend to initialize EVERY backend — jax.devices() then
@@ -134,18 +188,13 @@ def main():
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
-            if not any(rc):
-                print("two-process distributed probe: OK", flush=True)
-                return
-            if 3 in rc:
-                # a worker reported UNSUPPORTED (see worker()): propagate
-                # the distinct code so the suite can skip, not fail
-                raise SystemExit(3)
+            if not any(rc) or 3 in rc:
+                return rc
             if attempt == 0:
                 print(f"worker rcs: {rc}; retrying on a fresh port", flush=True)
     finally:
         shutil.rmtree(shadow, ignore_errors=True)
-    raise SystemExit(f"worker rcs: {rc}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,408 @@
+#!/usr/bin/env python
+"""The single-provenance pod scale driver (docs/distributed.md).
+
+One script produces every `SCALE_1B.json` row, and every row it writes
+carries an AT-DRIVER-TIME provenance stamp (driver, argv, UTC time,
+platform, device count, git revision) — the fix for the carry-forward
+problem VERDICT flags: a row whose stamp names an old revision is
+visibly stale, never silently re-asserted by a later round.
+
+The run, at every scale, is the same code path:
+
+1. **host-local ingest** — rows generate in chunks (per-chunk seeds, so
+   the brute-force referee can regenerate any chunk without holding the
+   dataset), partition by owner hash, and feed one pipelined
+   ``BulkLoader`` per host; per-host leg seconds accumulate so the
+   host-parallel wall (slowest host) is reported next to the measured
+   single-process wall;
+2. **config-1 queries** — the 12-probe bbox+DURING ladder against the
+   pod store, each answer checked EXACT against chunked brute-force
+   recomputation over the regenerated columns (no second store, so the
+   referee scales to 1e9);
+3. **the fused join leg** — a >8-member same-variant ``query_many``
+   batch that must take the cross-host fused dispatch (instrumented at
+   the shard seam), every member exact vs brute force;
+4. **streamed compaction** — ``geomesa.tpu.compact.span.rows`` bounded
+   so `_stream_cols` genuinely runs many spans per column, peak RSS
+   sampled and reported as a multiple of the store's column set.
+
+``--ci`` runs the identical path at a scaled-down row count and turns
+the report into assertions (exactness, fused dispatch taken, bounded
+RSS) with a nonzero exit on violation — the tier-1-adjacent smoke the
+1B row's code path is pinned by. Without ``--ci`` the defaults target
+the full 1e9-row run (TPU pod or a large-RAM host).
+
+Usage:
+    python scripts/run_pod_scale.py --ci
+    python scripts/run_pod_scale.py --rows 1000000000 --hosts 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import gc
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+DAY = 86_400_000
+T0 = 1_704_067_200_000
+SEED = 20_001
+SPEC = "dtg:Date,*geom:Point:srid=4326"
+DUR_LO = T0 + 3 * DAY
+DUR_HI = T0 + 12 * DAY
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ci", action="store_true",
+                    help="scaled-down assertion mode (the CI smoke)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="total rows (default: 2M with --ci, 1e9 without)")
+    ap.add_argument("--hosts", type=int, default=4)
+    ap.add_argument("--devices-per-host", type=int, default=0,
+                    help="0 = even split of visible devices")
+    ap.add_argument("--driver", default="sim",
+                    choices=("sim", "distributed", "auto"))
+    ap.add_argument("--chunk", type=int, default=500_000,
+                    help="generation/referee chunk rows")
+    ap.add_argument("--span-rows", type=int, default=4_194_304,
+                    help="geomesa.tpu.compact.span.rows for the streamed "
+                         "compaction (CI forces 65536)")
+    ap.add_argument("--out", default=os.path.join(ROOT, "SCALE_1B.json"))
+    return ap.parse_args(argv)
+
+
+def provenance(argv) -> dict:
+    try:
+        rev = subprocess.run(
+            ["git", "-C", ROOT, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        rev = None
+    import jax
+
+    return {
+        "driver": "scripts/run_pod_scale.py",
+        "argv": list(argv),
+        "time_utc": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "python": sys.version.split()[0],
+        "git_rev": rev,
+    }
+
+
+def _chunk_cols(ci: int, k: int):
+    """Chunk ci's columns, regenerable independently of every other
+    chunk (per-chunk seed): the ingest side and the brute-force referee
+    call this with identical arguments and get identical rows."""
+    import numpy as np
+
+    rng = np.random.default_rng(SEED + 7 * ci)
+    return (
+        rng.uniform(-60, 60, k),                      # x
+        rng.uniform(-45, 45, k),                      # y
+        T0 + rng.integers(0, 20 * DAY, k),            # dtg ms
+    )
+
+
+def _probes():
+    import numpy as np
+
+    rng = np.random.default_rng(SEED + 3)
+    out = []
+    for i in range(12):
+        # round to the filter string's 4 decimals so the brute-force
+        # referee tests EXACTLY the box the store parses
+        x0 = round(float(rng.uniform(-55, 40)), 4)
+        y0 = round(float(rng.uniform(-40, 30)), 4)
+        w, h = (4.0, 3.0) if i % 2 else (14.0, 10.0)
+        # config 1 is bbox+DURING: every probe is timed
+        out.append((x0, y0, round(x0 + w, 4), round(y0 + h, 4), True))
+    return out
+
+
+def _filter(box, timed: bool) -> str:
+    f = f"bbox(geom, {box[0]:.4f}, {box[1]:.4f}, {box[2]:.4f}, {box[3]:.4f})"
+    if timed:
+        f += (" AND dtg DURING 2024-01-04T00:00:00Z/2024-01-13T00:00:00Z")
+    return f
+
+
+def _brute_counts(n: int, chunk: int, boxes) -> list:
+    """Chunked brute-force truth for every probe at once: one pass over
+    the regenerated columns, O(chunk) memory at any n."""
+    import numpy as np
+
+    # the DURING window above, in ms (inclusive bounds match the store)
+    lo = int(np.datetime64("2024-01-04T00:00:00", "ms").astype(np.int64))
+    hi = int(np.datetime64("2024-01-13T00:00:00", "ms").astype(np.int64))
+    counts = [0] * len(boxes)
+    ci = 0
+    for s in range(0, n, chunk):
+        k = min(chunk, n - s)
+        x, y, t = _chunk_cols(ci, k)
+        ci += 1
+        for j, (x0, y0, x1, y1, timed) in enumerate(boxes):
+            m = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+            if timed:
+                # DURING: inclusive lo, exclusive hi (validate_1b.py)
+                m &= (t >= lo) & (t < hi)
+            counts[j] += int(m.sum())
+    return counts
+
+
+def run(args, argv) -> dict:
+    import numpy as np
+
+    from bench import _RssSampler, _ingest_column_set_bytes, _malloc_trim, \
+        _rss_bytes
+    from geomesa_tpu import conf
+    from geomesa_tpu.datastore import DataStore
+    from geomesa_tpu.features import FeatureCollection
+    from geomesa_tpu.ingest.pipeline import BulkLoader
+    from geomesa_tpu.parallel.dtable import DistributedIndexTable
+    from geomesa_tpu.pod import make_host_group
+    from geomesa_tpu.sft import FeatureType
+
+    n = args.rows or (2_000_000 if args.ci else 1_000_000_000)
+    span_rows = 65_536 if args.ci else args.span_rows
+    stamp = provenance(argv)
+    print(f"[pod-scale] provenance: {json.dumps(stamp)}", file=sys.stderr)
+
+    gc.collect()
+    _malloc_trim()
+    rss_baseline = _rss_bytes()
+    group = make_host_group(
+        hosts=args.hosts,
+        devices_per_host=args.devices_per_host or None,
+        driver=args.driver,
+    )
+    H = group.hosts
+    sft = FeatureType.from_spec("sc", SPEC)
+    sft.user_data["geomesa.indices.enabled"] = "z3"
+    ds = DataStore(mesh=group)
+    ds.create_schema(sft)
+
+    # 1. pipelined ingest into the pod store, chunked generation (the
+    # pod table deals each build host-major: every host sorts/builds
+    # only its own contiguous shard on its own device slice; the
+    # per-host ingest differential itself is BENCH_POD.json's row)
+    loader = BulkLoader(ds, "sc")
+    t_ingest0 = time.perf_counter()
+    ci = 0
+    for s0 in range(0, n, args.chunk):
+        k = min(args.chunk, n - s0)
+        x, y, t = _chunk_cols(ci, k)
+        ci += 1
+        loader.put(FeatureCollection.from_columns(
+            sft, np.arange(s0, s0 + k).astype(str),
+            {"dtg": t, "geom": (x, y)},
+        ))
+    loader.close()
+    ingest_s = time.perf_counter() - t_ingest0
+    assert ds.count("sc") == n
+    print(
+        f"[pod-scale] ingest {n:,} rows in {ingest_s:.1f}s "
+        f"({n / ingest_s:,.0f} rows/s)", file=sys.stderr,
+    )
+
+    # 2. config-1 queries, exact vs chunked brute force
+    boxes = _probes()
+    truth = _brute_counts(n, args.chunk, boxes)
+    latencies = []
+    got = []
+    for box in boxes:
+        f = _filter(box, box[4])
+        t0 = time.perf_counter()
+        got.append(int(ds.count("sc", f)))
+        latencies.append(round(time.perf_counter() - t0, 4))
+    queries_exact = got == truth
+    print(
+        f"[pod-scale] queries exact={queries_exact} "
+        f"p50={sorted(latencies)[len(latencies) // 2]:.3f}s "
+        f"(hits {min(truth):,}..{max(truth):,})", file=sys.stderr,
+    )
+
+    # 3. the fused join leg: >8 same-variant members so the batch
+    # genuinely packs fused chunks; instrument the shard seam
+    fused_calls = [0]
+    orig = DistributedIndexTable._fused_raw_finishes
+
+    def spy(self, *a, **kw):
+        fused_calls[0] += 1
+        return orig(self, *a, **kw)
+
+    DistributedIndexTable._fused_raw_finishes = spy
+    try:
+        batch = [_filter(b, True) for b in boxes[:10]]
+        outs = ds.query_many("sc", batch)
+    finally:
+        DistributedIndexTable._fused_raw_finishes = orig
+    fused_exact = [len(o) for o in outs] == truth[:10]
+    print(
+        f"[pod-scale] fused join: {len(batch)} members, "
+        f"{fused_calls[0]} shard legs, exact={fused_exact}",
+        file=sys.stderr,
+    )
+
+    # 4. streamed compaction under a bounded span: the pipelined load
+    # already built the base table, so a delta write forces the real
+    # full merge-and-rebuild `_stream_cols` bounds at 1B
+    lo = int(np.datetime64("2024-01-04T00:00:00", "ms").astype(np.int64))
+    hi = int(np.datetime64("2024-01-13T00:00:00", "ms").astype(np.int64))
+    n_delta = max(args.chunk // 5, min(n // 50, 2_000_000))
+    dx, dy, dt = _chunk_cols(10_000_019, n_delta)  # reserved chunk seed
+    ds.write("sc", FeatureCollection.from_columns(
+        sft, np.char.add("d", np.arange(n_delta).astype(str)),
+        {"dtg": dt, "geom": (dx, dy)},
+    ), check_ids=False)
+    b0 = boxes[0]
+    delta0 = int((
+        (dx >= b0[0]) & (dx <= b0[2]) & (dy >= b0[1]) & (dy <= b0[3])
+        & (dt >= lo) & (dt < hi)
+    ).sum())
+    del dx, dy, dt
+    conf.COMPACT_SPAN_ROWS.set(span_rows)
+    try:
+        gc.collect()
+        _malloc_trim()
+        column_set = _ingest_column_set_bytes(ds, "sc")
+        rss_pre = _rss_bytes()
+        t0 = time.perf_counter()
+        with _RssSampler() as rss:
+            ds.compact("sc")
+        compact_s = time.perf_counter() - t0
+    finally:
+        conf.COMPACT_SPAN_ROWS.clear()
+    # the 1B memory claim: compaction's TRANSIENT stays a small
+    # multiple of one column set on top of the resident store —
+    # never a second doubled copy of every column at once
+    transient_over_cs = (rss.peak - rss_pre) / max(column_set, 1)
+    table = next(t for (tn, _), t in ds._tables.items() if tn == "sc")
+    spans_per_column = -(-table.n // max(table.block, span_rows))
+    post = int(ds.count("sc", _filter(b0, b0[4])))
+    compact_exact = post == truth[0] + delta0
+    print(
+        f"[pod-scale] streamed compaction of {n_delta:,}-row delta in "
+        f"{compact_s:.1f}s, {spans_per_column} spans/column, transient "
+        f"{transient_over_cs:.2f}x column set, exact={compact_exact}",
+        file=sys.stderr,
+    )
+
+    row = {
+        "scenario": "pod_scale_ci" if args.ci else "pod_scale",
+        "n_rows": n,
+        "hosts": H,
+        "devices_per_host": group.devices_per_host,
+        "pod_driver": group.driver,
+        "ingest": {
+            "measured_s": round(ingest_s, 1),
+            "rows_per_s": int(n / ingest_s),
+        },
+        "queries": {
+            "n": len(boxes),
+            "exact": bool(queries_exact),
+            "latencies_s": latencies,
+            "p50_s": sorted(latencies)[len(latencies) // 2],
+        },
+        "fused_join": {
+            "members": len(batch),
+            "shard_legs": fused_calls[0],
+            "exact": bool(fused_exact),
+        },
+        "compaction": {
+            "streamed": True,
+            "span_rows": span_rows,
+            "delta_rows": int(n_delta),
+            "spans_per_column": int(spans_per_column),
+            "compact_s": round(compact_s, 1),
+            "column_set_bytes": int(column_set),
+            "rss_baseline_bytes": int(rss_baseline),
+            "rss_pre_compact_bytes": int(rss_pre),
+            "rss_peak_bytes": int(rss.peak),
+            "transient_over_column_set": round(transient_over_cs, 3),
+            "exact": bool(compact_exact),
+        },
+        "provenance": stamp,
+    }
+
+    if args.ci:
+        failures = []
+        if not queries_exact:
+            failures.append(f"query counts {got} != truth {truth}")
+        if not fused_exact:
+            failures.append("fused join member counts diverge from truth")
+        if fused_calls[0] < 1:
+            failures.append("batch never took the fused dispatch")
+        if not compact_exact:
+            failures.append("post-compaction probe diverges")
+        if spans_per_column < 10:
+            failures.append(
+                f"only {spans_per_column} spans/column — the bounded "
+                "path did not really run"
+            )
+        if transient_over_cs >= 2.0:
+            failures.append(
+                f"compaction transient {transient_over_cs:.2f}x column "
+                "set (bound 2.0)"
+            )
+        row["ci_failures"] = failures
+        if failures:
+            for f in failures:
+                print(f"[pod-scale] CI FAIL: {f}", file=sys.stderr)
+    return row
+
+
+def write_row(out_path: str, row: dict) -> None:
+    """Append to SCALE_1B.json's row list; a pre-provenance legacy
+    single-object file becomes rows[0], marked carried-forward."""
+    rows = []
+    if os.path.exists(out_path):
+        with open(out_path) as fh:
+            old = json.load(fh)
+        if isinstance(old, dict) and "rows" in old:
+            rows = old["rows"]
+        elif isinstance(old, dict):
+            old.setdefault("provenance", {
+                "driver": "scripts/validate_1b.py",
+                "note": "pre-provenance row carried forward verbatim; "
+                        "stamped rows begin with scripts/run_pod_scale.py",
+            })
+            rows = [old]
+    rows.append(row)
+    with open(out_path, "w") as fh:
+        json.dump({"rows": rows}, fh, indent=1)
+    print(f"[pod-scale] wrote {out_path} ({len(rows)} rows)",
+          file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = _parse_args(argv)
+    if args.driver == "sim" and "XLA_FLAGS" not in os.environ and (
+        os.environ.get("JAX_PLATFORMS", "cpu") == "cpu"
+    ):
+        # the sim driver needs >= hosts devices; on CPU, fork the
+        # virtual-device world BEFORE jax initializes
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count="
+            f"{max(8, args.hosts)}"
+        )
+    row = run(args, argv)
+    write_row(args.out, row)
+    print(json.dumps(row))
+    return 1 if row.get("ci_failures") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -273,6 +273,25 @@ def _workload(tmp_path, metrics=None):
     finally:
         dsv.close()
         srv.close()
+    # multi-host pod tier (docs/distributed.md), constructed armed: the
+    # link-profile install crosses HostGroup._probe_lock, and id-less
+    # writes cross PodStore._route_lock on the auto-id counter before
+    # the pod.wal.route hop fans the batch out to its owning hosts
+    from geomesa_tpu.pod import PodStore, make_host_group
+
+    pg = make_host_group(hosts=2, devices_per_host=1, driver="sim")
+    pg.set_link_profile([10.0, 40.0])
+    pod = PodStore(FeatureType.from_spec("p", SPEC), pg)
+    try:
+        pod.write([
+            {"name": "p", "dtg": np.datetime64(T0, "ms"),
+             "geom": geo.Point(float(i), float(i))}
+            for i in range(8)
+        ])
+        pod.query()
+        pod.count()
+    finally:
+        pod.close()
     # streaming tier over a durably saved cold store, WAL attached,
     # tiny segments so rotation happens (the fixed seal-fsync path),
     # chaos armed at rate=0 so every stream.* fault point consults the

@@ -1,0 +1,321 @@
+"""PodStore (docs/distributed.md): per-host WAL / hot tier / standing
+shards behind one routed facade — and the chaos matrix.
+
+The pinned contracts (ISSUE 20):
+
+- **equivalence** — routed writes, queries, counts, bulk loads and the
+  UNION of per-host standing alerts all equal a single-process
+  ``LambdaStore`` fed the same batches;
+- **zero acknowledged loss** — with ``sync="always"`` an acked write is
+  durable on its owning host: kill ANY single host (``kill -9``
+  surface: hot tier gone, unsynced WAL buffer dropped) — including MID
+  FLUSH, crashed between its WAL and its cold publish — and
+  ``rejoin``'s per-host WAL replay reproduces the never-crashed pod
+  bit-for-bit while every other host keeps serving untouched;
+- **per-host fault seams** — ``pod.wal.route`` faults surface to the
+  writer without corrupting earlier hosts' acks (retry converges), and
+  a ``pod.wal.replay`` crash leaves the host down and cleanly
+  re-joinable.
+
+Tier-1 runs the single-host smoke of the kill matrix; the full
+host x fault-point soak is @slow.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import fault
+from geomesa_tpu import geometry as geo
+from geomesa_tpu.datastore import DataStore
+from geomesa_tpu.features import FeatureCollection
+from geomesa_tpu.pod import PodStore, make_host_group
+from geomesa_tpu.sft import FeatureType
+from geomesa_tpu.streaming.standing import Subscription
+from geomesa_tpu.streaming.store import LambdaStore
+from geomesa_tpu.streaming.wal import WalConfig
+
+SPEC = "dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64))
+HOSTS = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    fault.injector().reset()
+
+
+@pytest.fixture(scope="module")
+def group():
+    return make_host_group(hosts=HOSTS, devices_per_host=2, driver="sim")
+
+
+def _sft():
+    return FeatureType.from_spec("pd", SPEC)
+
+
+def _rows(n, seed):
+    r = np.random.default_rng(seed)
+    return [
+        {"dtg": int(T0 + r.integers(0, 10 * 86400_000)),
+         "geom": geo.Point(float(r.uniform(-60, 60)), float(r.uniform(-30, 30)))}
+        for _ in range(n)
+    ]
+
+
+def _subs():
+    return [
+        Subscription("fence", "geofence", geom=geo.Polygon(
+            [(-20.0, -20.0), (20.0, -20.0), (20.0, 20.0), (-20.0, 20.0)]
+        )),
+        Subscription("near", "proximity",
+                     points=np.array([[5.0, 5.0], [-40.0, 10.0]]),
+                     distance_m=400_000.0),
+    ]
+
+
+def _pod(group, root=None, sync="always"):
+    return PodStore(
+        _sft(), group,
+        root=None if root is None else str(root),
+        wal_config=WalConfig(sync=sync),
+    )
+
+
+def _referee():
+    cold = DataStore()
+    cold.create_schema(_sft())
+    return LambdaStore(cold, "pd")
+
+
+def _alert_set(alerts):
+    return sorted((a["sub"], a["kind"], a["id"]) for a in alerts)
+
+
+def _ids(fc):
+    return sorted(np.asarray(fc.ids, dtype=str).tolist())
+
+
+class TestEquivalence:
+    def test_write_query_count_alerts_match_single_process(self, group):
+        pod, ref = _pod(group), _referee()
+        try:
+            for s in _subs():
+                pod.subscribe(s)
+                ref.subscribe(Subscription.from_record(s.to_record()))
+            b0, ids0 = _rows(300, 1), [f"f{i}" for i in range(300)]
+            b1, ids1 = _rows(200, 2), [f"g{i}" for i in range(200)]
+            assert pod.write(b0, ids0) == ref.write(b0, ids0) == 300
+            assert pod.write(b1, ids1) == ref.write(b1, ids1) == 200
+            pa = _alert_set(pod.drain_alerts())
+            ra = _alert_set(ref.standing().alerts.drain())
+            assert pa == ra and len(pa) > 0
+            assert {k for _, k, _ in pa} == {"geofence", "proximity"}
+            assert pod.count() == ref.count() == 500
+            assert _ids(pod.query()) == _ids(ref.query())
+            # deletes route to the same owners the upserts did
+            dead = [f"f{i}" for i in range(0, 300, 3)]
+            assert pod.delete(dead) == ref.delete(dead) == 100
+            assert pod.count() == ref.count() == 400
+            assert _ids(pod.query()) == _ids(ref.query())
+            # unsubscribe reaches every shard: no further fence alerts
+            assert pod.unsubscribe("fence") and ref.unsubscribe("fence")
+            b2, ids2 = _rows(100, 3), [f"h{i}" for i in range(100)]
+            pod.write(b2, ids2), ref.write(b2, ids2)
+            pa2 = _alert_set(pod.drain_alerts())
+            assert pa2 == _alert_set(ref.standing().alerts.drain())
+            assert all(s != "fence" for s, _, _ in pa2)
+        finally:
+            pod.close(), ref.close()
+
+    def test_auto_ids_are_pod_unique(self, group):
+        pod = _pod(group)
+        try:
+            assert pod.write(_rows(50, 4)) == 50
+            assert pod.write(_rows(50, 5)) == 50
+            assert pod.count() == 100
+            ids = _ids(pod.query())
+            assert len(set(ids)) == 100
+            assert all(i.startswith("pod-") for i in ids)
+        finally:
+            pod.close()
+
+    def test_ownership_partitions_rows(self, group):
+        pod = _pod(group)
+        try:
+            ids = [f"f{i}" for i in range(200)]
+            pod.write(_rows(200, 6), ids)
+            per_host = [pod.stores[h].count() for h in range(HOSTS)]
+            assert sum(per_host) == 200
+            assert all(c > 0 for c in per_host)  # crc32 spreads the ids
+            for h in range(HOSTS):
+                owned = _ids(pod.stores[h].query())
+                assert all(pod.owner(i) == h for i in owned)
+        finally:
+            pod.close()
+
+    def test_bulk_load_matches_routed_writes(self, group):
+        pod, ref = _pod(group), _referee()
+        try:
+            rng = np.random.default_rng(8)
+            n = 400
+            fc = FeatureCollection.from_columns(
+                _sft(), [f"bl{i}" for i in range(n)],
+                {"dtg": T0 + rng.integers(0, 10 * 86400_000, n),
+                 "geom": (rng.uniform(-60, 60, n), rng.uniform(-30, 30, n))},
+            )
+            results = pod.bulk_load(fc)
+            assert sum(r.written for r in results if r is not None) == n
+            ref.cold.write("pd", fc)
+            assert pod.count() == ref.count() == n
+            assert _ids(pod.query()) == _ids(ref.query())
+        finally:
+            pod.close(), ref.close()
+
+
+def _kill_mid_flush_and_verify(group, tmp_path, victim, point):
+    """The chaos matrix body: referee pod (never crashed) vs a pod whose
+    ``victim`` host crashes at ``point`` mid-flush, is killed, and
+    rejoins via per-host WAL replay. Everything acknowledged must match
+    the referee bit-for-bit afterwards."""
+    pod = _pod(group, root=tmp_path / "crash")
+    ref = _pod(group, root=tmp_path / "ref")
+    try:
+        for s in _subs():
+            pod.subscribe(s)
+            ref.subscribe(Subscription.from_record(s.to_record()))
+        b0, ids0 = _rows(300, 10), [f"f{i}" for i in range(300)]
+        pod.write(b0, ids0), ref.write(b0, ids0)
+        # both pods consume the first batch's alerts (delivered = gone);
+        # the checkpoint then anchors replay after this point
+        assert _alert_set(pod.drain_alerts()) == _alert_set(ref.drain_alerts())
+        pod.flush(), ref.flush()
+        pod.checkpoint(), ref.checkpoint()
+        b1, ids1 = _rows(160, 11), [f"g{i}" for i in range(160)]
+        assert pod.write(b1, ids1) == ref.write(b1, ids1) == 160  # ACKED
+        ref.flush()
+        # the victim crashes INSIDE its own flush — after the WAL ack,
+        # between micro-chunk stages / before the hot->cold publish
+        with fault.inject(point, kind="crash", times=1):
+            with pytest.raises(fault.InjectedCrash):
+                pod.stores[victim].flush()
+        pod.kill(victim)
+        with pytest.raises(RuntimeError, match="down"):
+            pod.count()
+        # the OTHER hosts never noticed: they still serve their shards
+        for h in range(HOSTS):
+            if h != victim:
+                assert _ids(pod.stores[h].query()) == _ids(ref.stores[h].query())
+        pod.rejoin(victim)
+        # bit-for-bit with the never-crashed referee: counts, ids, the
+        # crashed host's own shard, and the replayed standing alerts
+        assert pod.count() == ref.count() == 460 - 0
+        assert _ids(pod.query()) == _ids(ref.query())
+        assert _ids(pod.stores[victim].query()) == _ids(ref.stores[victim].query())
+        pa, ra = pod.drain_alerts(), ref.drain_alerts()
+        # alerts are at-most-once (docs/standing.md): the victim's
+        # undrained in-memory queue died with it — exactly a
+        # single-process crash's semantics — while every OTHER host's
+        # alerts still match the referee's for the ids it owns
+        assert _alert_set([a for a in pa if pod.owner(a["id"]) != victim]) \
+            == _alert_set([a for a in ra if ref.owner(a["id"]) != victim])
+        assert all(pod.owner(a["id"]) != victim for a in pa)
+        # and the recovered host keeps serving: registrations survived
+        b2 = _rows(80, 12)
+        ids2 = [f"k{i}" for i in range(80)]
+        assert pod.write(b2, ids2) == ref.write(b2, ids2) == 80
+        assert _alert_set(pod.drain_alerts()) == _alert_set(ref.drain_alerts())
+        assert _ids(pod.query()) == _ids(ref.query())
+    finally:
+        pod.close(), ref.close()
+
+
+class TestKillMatrix:
+    def test_kill_one_host_mid_flush_smoke(self, group, tmp_path):
+        """Tier-1 smoke of the chaos matrix: one victim, crash at the
+        hot->cold publish."""
+        _kill_mid_flush_and_verify(group, tmp_path, 2, "streaming.persist")
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("victim", range(HOSTS))
+    @pytest.mark.parametrize(
+        "point", ["stream.flush.keys", "streaming.persist", "streaming.evict"]
+    )
+    def test_kill_any_host_any_stage_soak(self, group, tmp_path, victim, point):
+        """The full matrix: ANY single host, crashed at every flush
+        stage, recovers bit-for-bit (slow soak)."""
+        _kill_mid_flush_and_verify(group, tmp_path, victim, point)
+
+    def test_acked_rows_survive_kill_without_any_flush(self, group, tmp_path):
+        """Zero acknowledged loss, pure-WAL edition: nothing was ever
+        flushed, the hot tier dies with the host, and replay alone
+        restores every acked row."""
+        pod = _pod(group, root=tmp_path / "p")
+        try:
+            ids = [f"f{i}" for i in range(240)]
+            assert pod.write(_rows(240, 13), ids) == 240  # acked
+            before = _ids(pod.query())
+            pod.kill(1)
+            pod.rejoin(1)
+            assert _ids(pod.query()) == before
+            assert pod.count() == 240
+        finally:
+            pod.close()
+
+
+class TestPodWalFaultPoints:
+    def test_route_fault_leaves_earlier_acks_intact(self, group, tmp_path):
+        """An IO error on the pod.wal.route hop fails the write AT a
+        host boundary: hosts acked before it keep their slices (per-host
+        ack contract), and retrying the same batch converges (upsert
+        idempotence) — no loss, no duplicates."""
+        pod = _pod(group, root=tmp_path / "p")
+        try:
+            ids = [f"f{i}" for i in range(120)]
+            rows = _rows(120, 14)
+            with fault.inject("pod.wal.route", kind="io_error", after=1,
+                              times=1):
+                with pytest.raises(OSError):
+                    pod.write(rows, ids)
+            partial = pod.count()
+            assert 0 < partial < 120  # first host acked, later ones not
+            assert pod.write(rows, ids) == 120  # retry converges
+            assert pod.count() == 120
+            assert _ids(pod.query()) == sorted(ids)
+        finally:
+            pod.close()
+
+    def test_replay_crash_is_retryable(self, group, tmp_path):
+        """A crash at pod.wal.replay leaves the host DOWN (not half
+        recovered): a second rejoin replays clean."""
+        pod = _pod(group, root=tmp_path / "p")
+        try:
+            ids = [f"f{i}" for i in range(100)]
+            pod.write(_rows(100, 15), ids)
+            before = _ids(pod.query())
+            pod.kill(3)
+            with fault.inject("pod.wal.replay", kind="crash", times=1):
+                with pytest.raises(fault.InjectedCrash):
+                    pod.rejoin(3)
+            with pytest.raises(RuntimeError, match="down"):
+                pod.count()
+            pod.rejoin(3)
+            assert _ids(pod.query()) == before
+        finally:
+            pod.close()
+
+    def test_rejoin_requires_down_host(self, group, tmp_path):
+        pod = _pod(group, root=tmp_path / "p")
+        try:
+            with pytest.raises(RuntimeError, match="not down"):
+                pod.rejoin(0)
+        finally:
+            pod.close()
+
+    def test_checkpoint_requires_root(self, group):
+        pod = _pod(group)
+        try:
+            with pytest.raises(ValueError, match="root"):
+                pod.checkpoint()
+        finally:
+            pod.close()
